@@ -1,0 +1,25 @@
+"""langstream_tpu — a TPU-native, event-driven framework for streaming Gen-AI apps.
+
+Same capabilities as the reference (LangStream: declarative YAML pipelines of
+agents wired through broker topics, planner, per-agent runners with ordered
+at-least-once commit, websocket/HTTP gateway, control plane/operator) but with
+inference served locally on TPUs through a JAX/XLA engine (continuous batching,
+jit prefill/decode, tensor/expert parallelism over an ICI mesh).
+
+Layer map (mirrors SURVEY.md §1):
+  api/            L0 model + SPIs (pure dataclasses/ABCs)
+  core/           L1 parser / placeholder resolver / validator / planner
+  messaging/      L2 broker runtimes (in-memory reference impl; kafka gated)
+  runtime/        L3 agent runner main loop, ordered commit, local runner
+  agents/         L4 built-in agent library
+  ai/             provider SPI (completions/embeddings) + TPU provider
+  models/         JAX model family (decoder LMs + encoder embedders)
+  serving/        continuous-batching TPU serving engine
+  ops/            Pallas kernels + XLA fallbacks (attention, paged attention)
+  parallel/       mesh / sharding / collectives helpers
+  gateway/        L6 websocket/HTTP API gateway
+  control_plane/  L7/L8 REST control plane + operator resource factory
+  cli/            L9 command line client
+"""
+
+__version__ = "0.1.0"

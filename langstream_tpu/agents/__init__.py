@@ -1,0 +1,19 @@
+"""L4 — built-in agent library. Importing this package registers every
+built-in agent type into `core.registry.REGISTRY` (the ServiceLoader/NAR
+equivalent of the reference's META-INF/services discovery, SURVEY §2.5)."""
+
+from langstream_tpu.agents import builtin  # noqa: F401  (registration side effects)
+
+
+def _register_all() -> None:
+    # Each sub-module registers on import; keep imports in dependency order.
+    from langstream_tpu.agents import genai  # noqa: F401
+    from langstream_tpu.agents import text  # noqa: F401
+    from langstream_tpu.agents import flow  # noqa: F401
+    from langstream_tpu.agents import http  # noqa: F401
+    from langstream_tpu.agents import vector  # noqa: F401
+    from langstream_tpu.agents import web  # noqa: F401
+    from langstream_tpu.agents import storage  # noqa: F401
+
+
+_register_all()

@@ -1,0 +1,89 @@
+"""L0 — application model and SPIs. Everything else depends on this layer only.
+
+Parity target: reference `langstream-api/` (see SURVEY.md §2.1). Pure data +
+abstract contracts; no IO, no broker, no JAX imports here.
+"""
+
+from langstream_tpu.api.model import (
+    AgentConfiguration,
+    Application,
+    ComputeCluster,
+    DiskSpec,
+    ErrorsSpec,
+    Gateway,
+    Instance,
+    Module,
+    Pipeline,
+    Resource,
+    ResourcesSpec,
+    Secret,
+    Secrets,
+    StreamingCluster,
+    TopicDefinition,
+    TpuSpec,
+)
+from langstream_tpu.api.record import Header, Record, SimpleRecord
+from langstream_tpu.api.agent import (
+    AgentCode,
+    AgentContext,
+    AgentProcessor,
+    AgentService,
+    AgentSink,
+    AgentSource,
+    ComponentType,
+    ProcessorResult,
+    RecordSink,
+)
+from langstream_tpu.api.topics import (
+    TopicAdmin,
+    TopicConsumer,
+    TopicOffsetPosition,
+    TopicProducer,
+    TopicReader,
+)
+from langstream_tpu.api.planner import (
+    AgentNode,
+    Connection,
+    ExecutionPlan,
+    ExecutionPlanOptimiser,
+)
+
+__all__ = [
+    "AgentCode",
+    "AgentConfiguration",
+    "AgentContext",
+    "AgentNode",
+    "AgentProcessor",
+    "AgentService",
+    "AgentSink",
+    "AgentSource",
+    "Application",
+    "ComponentType",
+    "ComputeCluster",
+    "Connection",
+    "DiskSpec",
+    "ErrorsSpec",
+    "ExecutionPlan",
+    "ExecutionPlanOptimiser",
+    "Gateway",
+    "Header",
+    "Instance",
+    "Module",
+    "Pipeline",
+    "ProcessorResult",
+    "Record",
+    "RecordSink",
+    "Resource",
+    "ResourcesSpec",
+    "Secret",
+    "Secrets",
+    "SimpleRecord",
+    "StreamingCluster",
+    "TopicAdmin",
+    "TopicConsumer",
+    "TopicDefinition",
+    "TopicOffsetPosition",
+    "TopicProducer",
+    "TopicReader",
+    "TpuSpec",
+]

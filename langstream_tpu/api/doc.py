@@ -1,0 +1,39 @@
+"""Self-describing config schema model — drives validation errors and docs.
+
+Parity: reference `api/doc/AgentConfigurationModel.java`, `ConfigProperty.java`
+plus the reflection-driven `ClassConfigValidator` (565 LoC). Here the schema is
+declared as ``ConfigProperty`` descriptors on agent/resource config classes;
+`core.validator` consumes them for unknown-field rejection and type checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class ConfigProperty:
+    name: str
+    description: str = ""
+    type: str = "string"  # string|integer|number|boolean|object|array
+    required: bool = False
+    default: Any = None
+    extended_validation: Optional[str] = None
+
+
+@dataclass
+class ConfigModel:
+    """Schema for one agent/resource/asset type."""
+
+    type: str
+    description: str = ""
+    properties: dict[str, ConfigProperty] = field(default_factory=dict)
+    allow_unknown: bool = False
+
+    def prop(self, name: str) -> Optional[ConfigProperty]:
+        return self.properties.get(name)
+
+
+def props(*items: ConfigProperty) -> dict[str, ConfigProperty]:
+    return {p.name: p for p in items}

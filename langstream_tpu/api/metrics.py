@@ -1,0 +1,92 @@
+"""Metrics SPI (reference api/runner/code/MetricsReporter.java:18).
+
+Hierarchical reporters: ``with_prefix`` returns a child whose counters are
+namespaced; the runtime installs a Prometheus-text implementation, tests use
+the in-memory default. TPU additions: gauges for tokens/sec, TTFT, batch
+occupancy, HBM use (SURVEY §5 observability note).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help_: str = "") -> None:
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def count(self, n: float = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help_: str = "") -> None:
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class MetricsReporter:
+    """In-memory reporter; also the base class for exporters."""
+
+    def __init__(self, prefix: str = "", registry: Optional[dict] = None) -> None:
+        self._prefix = prefix
+        self._registry: dict[str, Counter | Gauge] = registry if registry is not None else {}
+
+    def with_prefix(self, prefix: str) -> "MetricsReporter":
+        joined = f"{self._prefix}_{prefix}" if self._prefix else prefix
+        return MetricsReporter(joined, self._registry)
+
+    def _full(self, name: str) -> str:
+        return f"{self._prefix}_{name}" if self._prefix else name
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        full = self._full(name)
+        c = self._registry.get(full)
+        if not isinstance(c, Counter):
+            c = Counter(full, help_)
+            self._registry[full] = c
+        return c
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        full = self._full(name)
+        g = self._registry.get(full)
+        if not isinstance(g, Gauge):
+            g = Gauge(full, help_)
+            self._registry[full] = g
+        return g
+
+    def prometheus_text(self) -> str:
+        """Render all metrics in Prometheus text exposition format."""
+        lines: list[str] = []
+        for name, m in sorted(self._registry.items()):
+            safe = name.replace("-", "_").replace(".", "_")
+            kind = "counter" if isinstance(m, Counter) else "gauge"
+            if m.help:
+                lines.append(f"# HELP {safe} {m.help}")
+            lines.append(f"# TYPE {safe} {kind}")
+            lines.append(f"{safe} {m.value}")
+        return "\n".join(lines) + "\n"
+
+
+DISABLED = MetricsReporter()

@@ -1,0 +1,17 @@
+"""L1 — YAML parser, placeholder resolver, config validation, planner, deploy.
+
+Parity: reference `langstream-core/` (SURVEY.md §2.2).
+"""
+
+from langstream_tpu.core.parser import ModelBuilder, ModelParseError
+from langstream_tpu.core.resolver import resolve_placeholders
+from langstream_tpu.core.planner import ClusterRuntime
+from langstream_tpu.core.deployer import ApplicationDeployer
+
+__all__ = [
+    "ApplicationDeployer",
+    "ClusterRuntime",
+    "ModelBuilder",
+    "ModelParseError",
+    "resolve_placeholders",
+]

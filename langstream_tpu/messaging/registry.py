@@ -1,0 +1,47 @@
+"""Streaming-cluster runtime registry (reference TopicConnectionsRuntimeRegistry).
+
+Maps `instance.streamingCluster.type` → TopicConnectionsRuntime. The kafka
+runtime registers itself only when a client library is importable (the image
+ships none; the memory broker is the default transport).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from langstream_tpu.api.topics import TopicConnectionsRuntime
+
+
+class TopicConnectionsRuntimeRegistry:
+    _factories: dict[str, Callable[[], TopicConnectionsRuntime]] = {}
+
+    @classmethod
+    def register(cls, type_: str, factory: Callable[[], TopicConnectionsRuntime]) -> None:
+        cls._factories[type_] = factory
+
+    @classmethod
+    def get(cls, type_: str) -> TopicConnectionsRuntime:
+        cls._ensure_builtins()
+        factory = cls._factories.get(type_)
+        if factory is None:
+            known = ", ".join(sorted(cls._factories))
+            raise ValueError(f"unknown streaming cluster type {type_!r}; known: {known}")
+        return factory()
+
+    @classmethod
+    def _ensure_builtins(cls) -> None:
+        if "memory" not in cls._factories:
+            from langstream_tpu.messaging.memory import MemoryTopicConnectionsRuntime
+
+            cls._factories["memory"] = MemoryTopicConnectionsRuntime
+        if "kafka" not in cls._factories:
+            try:
+                from langstream_tpu.messaging.kafka import KafkaTopicConnectionsRuntime
+
+                cls._factories["kafka"] = KafkaTopicConnectionsRuntime
+            except ImportError:
+                pass
+
+
+def get_topic_connections_runtime(type_: str) -> TopicConnectionsRuntime:
+    return TopicConnectionsRuntimeRegistry.get(type_)

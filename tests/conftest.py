@@ -1,0 +1,36 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's mock-K8s tier (SURVEY §4): multi-chip behavior is
+validated on virtual devices; real-TPU paths run via bench.py on hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import pytest  # noqa: E402
+
+from langstream_tpu.messaging.memory import MemoryBroker  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_memory_broker():
+    MemoryBroker.reset()
+    yield
+    MemoryBroker.reset()
+
+
+@pytest.fixture
+def run():
+    """Run a coroutine to completion on a fresh event loop."""
+
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run

@@ -1,0 +1,125 @@
+"""In-memory broker semantics: partitioning, groups, contiguous-prefix commit
+(reference KafkaConsumerWrapper manual offset bookkeeping tests)."""
+
+from langstream_tpu.api.record import SimpleRecord
+from langstream_tpu.api.topics import TopicOffsetPosition
+from langstream_tpu.messaging.memory import MemoryBroker, MemoryTopicConnectionsRuntime
+
+
+def test_publish_and_consume(run):
+    async def main():
+        broker = MemoryBroker.instance()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        consumer = rt.create_consumer("agent-1", "t")
+        await consumer.start()
+        producer = rt.create_producer("agent-1", "t")
+        await producer.start()
+        for i in range(5):
+            await producer.write(SimpleRecord.of(i))
+        records = await consumer.read()
+        assert [r.value for r in records] == [0, 1, 2, 3, 4]
+        await consumer.commit(records)
+        info = consumer.get_info()
+        assert info["committed"]["0"] == 5
+
+    run(main())
+
+
+def test_contiguous_prefix_commit(run):
+    async def main():
+        broker = MemoryBroker.instance()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        consumer = rt.create_consumer("a", "t")
+        await consumer.start()
+        producer = rt.create_producer("a", "t")
+        for i in range(4):
+            await producer.write(SimpleRecord.of(i))
+        records = await consumer.read()
+        # ack out of order: offsets 1,2 first — committed must stay 0
+        await consumer.commit([records[1], records[2]])
+        assert consumer.get_info()["committed"]["0"] == 0
+        # ack offset 0 — committed jumps over the whole prefix to 3
+        await consumer.commit([records[0]])
+        assert consumer.get_info()["committed"]["0"] == 3
+        await consumer.commit([records[3]])
+        assert consumer.get_info()["committed"]["0"] == 4
+
+    run(main())
+
+
+def test_redelivery_after_restart(run):
+    async def main():
+        broker = MemoryBroker.instance()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        consumer = rt.create_consumer("a", "t", {"group": "g"})
+        await consumer.start()
+        producer = rt.create_producer("a", "t")
+        for i in range(3):
+            await producer.write(SimpleRecord.of(i))
+        records = await consumer.read()
+        await consumer.commit([records[0]])  # only offset 0 committed
+        await consumer.close()
+
+        # new consumer in the same group resumes from committed offset 1
+        consumer2 = rt.create_consumer("a", "t", {"group": "g"})
+        await consumer2.start()
+        redelivered = await consumer2.read()
+        assert [r.value for r in redelivered] == [1, 2]
+
+    run(main())
+
+
+def test_keyed_records_same_partition(run):
+    async def main():
+        broker = MemoryBroker.instance()
+        broker.create_topic("t", partitions=4)
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("a", "t")
+        for _ in range(8):
+            await producer.write(SimpleRecord.of("v", key="same-key"))
+        parts = {
+            p
+            for p, part in enumerate(broker.topics["t"].partitions)
+            if part.records
+        }
+        assert len(parts) == 1
+
+    run(main())
+
+
+def test_group_partition_split(run):
+    async def main():
+        broker = MemoryBroker.instance()
+        broker.create_topic("t", partitions=2)
+        rt = MemoryTopicConnectionsRuntime(broker)
+        c1 = rt.create_consumer("a", "t", {"group": "g"})
+        c2 = rt.create_consumer("a", "t", {"group": "g"})
+        await c1.start()
+        await c2.start()
+        assigned = sorted(c1._assigned + c2._assigned)
+        assert assigned == [0, 1]
+        assert len(c1._assigned) == 1 and len(c2._assigned) == 1
+
+    run(main())
+
+
+def test_reader_positions(run):
+    async def main():
+        broker = MemoryBroker.instance()
+        rt = MemoryTopicConnectionsRuntime(broker)
+        producer = rt.create_producer("a", "t")
+        for i in range(3):
+            await producer.write(SimpleRecord.of(i))
+
+        earliest = rt.create_reader("t", TopicOffsetPosition(position="earliest"))
+        await earliest.start()
+        res = await earliest.read()
+        assert [r.value for r in res.records] == [0, 1, 2]
+
+        latest = rt.create_reader("t", TopicOffsetPosition(position="latest"))
+        await latest.start()
+        await producer.write(SimpleRecord.of(99))
+        res = await latest.read()
+        assert [r.value for r in res.records] == [99]
+
+    run(main())

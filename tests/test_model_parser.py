@@ -1,0 +1,195 @@
+"""Tier-1 parser/model tests (reference ModelBuilderTest analogue)."""
+
+import pytest
+
+from langstream_tpu.api.model import ErrorsSpec, ResourcesSpec, TpuSpec
+from langstream_tpu.core.parser import ModelBuilder, ModelParseError
+from langstream_tpu.core.resolver import resolve_placeholders
+
+PIPELINE = """
+module: default
+id: my-pipeline
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+    partitions: 4
+  - name: output-topic
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: skip
+  retries: 3
+pipeline:
+  - name: "step one"
+    id: step1
+    type: identity
+    input: input-topic
+  - name: "step two"
+    id: step2
+    type: identity
+    output: output-topic
+    errors:
+      retries: 7
+    resources:
+      parallelism: 2
+      tpu:
+        type: v5e
+        topology: "8"
+        mesh: {data: 2, model: 4}
+"""
+
+CONFIGURATION = """
+configuration:
+  resources:
+    - id: llm
+      type: tpu-serving
+      configuration:
+        model: "${globals.model-name}"
+        dtype: bfloat16
+"""
+
+GATEWAYS = """
+gateways:
+  - id: chat
+    type: chat
+    chat-options:
+      questions-topic: input-topic
+      answers-topic: output-topic
+      headers:
+        - value-from-parameters: sessionId
+  - id: produce
+    type: produce
+    topic: input-topic
+    parameters: [sessionId]
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+  globals:
+    model-name: gemma-2b
+"""
+
+SECRETS = """
+secrets:
+  - id: llm-creds
+    data:
+      token: "s3cr3t"
+"""
+
+
+def build():
+    return ModelBuilder.build_application_from_files(
+        {
+            "pipeline.yaml": PIPELINE,
+            "configuration.yaml": CONFIGURATION,
+            "gateways.yaml": GATEWAYS,
+        },
+        instance_text=INSTANCE,
+        secrets_text=SECRETS,
+    )
+
+
+def test_parse_pipeline_topics_agents():
+    app = build().application
+    mod = app.modules["default"]
+    assert set(mod.topics) == {"input-topic", "output-topic"}
+    assert mod.topics["input-topic"].partitions == 4
+    pipe = mod.pipelines["my-pipeline"]
+    assert [a.id for a in pipe.agents] == ["step1", "step2"]
+    assert pipe.agents[0].input == "input-topic"
+    assert pipe.agents[1].output == "output-topic"
+
+
+def test_errors_cascade():
+    app = build().application
+    pipe = app.modules["default"].pipelines["my-pipeline"]
+    # step1 inherits pipeline errors
+    assert pipe.agents[0].errors.resolved_on_failure() == "skip"
+    assert pipe.agents[0].errors.resolved_retries() == 3
+    # step2 overrides retries, inherits on-failure
+    assert pipe.agents[1].errors.resolved_retries() == 7
+    assert pipe.agents[1].errors.resolved_on_failure() == "skip"
+
+
+def test_tpu_resources_spec():
+    app = build().application
+    agent = app.modules["default"].pipelines["my-pipeline"].agents[1]
+    tpu = agent.resources.tpu
+    assert tpu == TpuSpec(type="v5e", topology="8", mesh={"data": 2, "model": 4})
+    assert tpu.chips == 8
+    assert agent.resources.resolved_parallelism() == 2
+
+
+def test_gateways_parsed():
+    app = build().application
+    chat = app.gateways[0]
+    assert chat.type == "chat"
+    assert chat.chat_options.questions_topic == "input-topic"
+    produce = app.gateways[1]
+    assert produce.topic == "input-topic"
+    assert produce.parameters == ["sessionId"]
+
+
+def test_instance_and_secrets():
+    app = build().application
+    assert app.instance.streaming_cluster.type == "memory"
+    assert app.instance.globals_["model-name"] == "gemma-2b"
+    assert app.secrets.secrets["llm-creds"].data["token"] == "s3cr3t"
+
+
+def test_placeholder_resolution():
+    app = resolve_placeholders(build().application)
+    assert app.resources["llm"].configuration["model"] == "gemma-2b"
+
+
+def test_placeholder_secrets_and_types():
+    from langstream_tpu.core.resolver import resolve_value
+
+    ctx = {"secrets": {"s": {"port": 8080, "host": "h"}}}
+    # single placeholder keeps native type
+    assert resolve_value("${secrets.s.port}", ctx) == 8080
+    # interpolation stringifies
+    assert resolve_value("http://${secrets.s.host}:${secrets.s.port}", ctx) == "http://h:8080"
+
+
+def test_unknown_toplevel_field_rejected():
+    with pytest.raises(ModelParseError, match="unknown top-level"):
+        ModelBuilder.build_application_from_files(
+            {"pipeline.yaml": "id: p\nbogus: 1\npipeline: []\n"}
+        )
+
+
+def test_duplicate_agent_id_rejected():
+    bad = """
+id: p
+pipeline:
+  - type: identity
+    id: a
+  - type: identity
+    id: a
+"""
+    with pytest.raises(ModelParseError, match="duplicate agent id"):
+        ModelBuilder.build_application_from_files({"pipeline.yaml": bad})
+
+
+def test_invalid_errors_spec():
+    with pytest.raises(ValueError, match="on-failure"):
+        ErrorsSpec.from_dict({"on-failure": "explode"})
+
+
+def test_resources_defaults():
+    spec = ResourcesSpec()
+    assert spec.resolved_parallelism() == 1
+    assert spec.resolved_size() == 1
+    merged = ResourcesSpec(size=3).with_defaults_from(ResourcesSpec(parallelism=5))
+    assert merged.resolved_parallelism() == 5
+    assert merged.resolved_size() == 3
+
+
+def test_digest_stable():
+    a = build()
+    b = build()
+    assert a.digest == b.digest

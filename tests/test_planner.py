@@ -1,0 +1,203 @@
+"""Planner tests: topic detection, implicit topics, fusion (reference
+BasicClusterRuntime + ComposableAgentExecutionPlanOptimiser tests)."""
+
+import pytest
+
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.core.planner import ClusterRuntime, PlanError
+
+APP = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - type: identity
+    id: a
+    input: in-t
+  - type: identity
+    id: b
+  - type: identity
+    id: c
+    output: out-t
+"""
+
+
+def plan_for(yaml_text: str, fusion: bool = True):
+    app = ModelBuilder.build_application_from_files({"pipeline.yaml": yaml_text}).application
+    return ClusterRuntime(enable_fusion=fusion).build_execution_plan("app", app)
+
+
+def test_fusion_merges_adjacent_composable():
+    plan = plan_for(APP)
+    # all three identity agents fuse into one composite node
+    assert len(plan.agents) == 1
+    node = plan.agents["a"]
+    assert node.agent_type == "composite-agent"
+    assert [c.id for c in node.composite] == ["a", "b", "c"]
+    assert node.input.topic == "in-t"
+    assert node.output.topic == "out-t"
+    # no implicit topics created
+    assert set(plan.topics) == {"in-t", "out-t"}
+
+
+def test_no_fusion_creates_implicit_topics():
+    plan = plan_for(APP, fusion=False)
+    assert set(plan.agents) == {"a", "b", "c"}
+    implicit = [t for t in plan.topics.values() if t.implicit]
+    assert {t.name for t in implicit} == {"app-b-input", "app-c-input"}
+    assert plan.agents["a"].output.topic == "app-b-input"
+    assert plan.agents["b"].input.topic == "app-b-input"
+    assert plan.agents["b"].output.topic == "app-c-input"
+    assert all(t.creation_mode == "create-if-not-exists" for t in implicit)
+
+
+def test_different_resources_block_fusion():
+    yaml_text = """
+id: p
+topics:
+  - name: in-t
+pipeline:
+  - type: identity
+    id: a
+    input: in-t
+  - type: identity
+    id: b
+    resources:
+      parallelism: 4
+"""
+    plan = plan_for(yaml_text)
+    assert set(plan.agents) == {"a", "b"}
+    # implicit topic partitions follow the max parallelism of the two sides
+    assert plan.topics["app-b-input"].partitions == 4
+
+
+def test_source_leads_fused_chain():
+    yaml_text = """
+id: p
+topics:
+  - name: out-t
+pipeline:
+  - type: list-source
+    id: src
+    configuration:
+      items: [1, 2]
+  - type: identity
+    id: proc
+    output: out-t
+"""
+    # list-source is not composable → no fusion, implicit topic in between
+    plan = plan_for(yaml_text)
+    assert set(plan.agents) == {"src", "proc"}
+
+
+def test_unknown_topic_rejected():
+    bad = """
+id: p
+pipeline:
+  - type: identity
+    id: a
+    input: nope
+"""
+    with pytest.raises(PlanError, match="undefined topic"):
+        plan_for(bad)
+
+
+def test_unknown_agent_type_rejected():
+    bad = """
+id: p
+pipeline:
+  - type: warp-drive
+    id: a
+"""
+    from langstream_tpu.core.registry import UnknownAgentType
+
+    with pytest.raises(UnknownAgentType):
+        plan_for(bad)
+
+
+def test_tpu_mesh_validation():
+    bad = """
+id: p
+topics:
+  - name: in-t
+pipeline:
+  - type: identity
+    id: a
+    input: in-t
+    resources:
+      tpu:
+        topology: "8"
+        mesh: {data: 2, model: 2}
+"""
+    with pytest.raises(PlanError, match="mesh"):
+        plan_for(bad)
+
+
+def test_half_specified_link_prev_output():
+    # A has explicit output, B has no input → B must consume A's output topic
+    yaml_text = """
+id: p
+topics:
+  - name: in-t
+  - name: mid-t
+pipeline:
+  - type: identity
+    id: a
+    input: in-t
+    output: mid-t
+  - type: identity
+    id: b
+"""
+    plan = plan_for(yaml_text)
+    assert plan.agents["b"].input.topic == "mid-t"
+
+
+def test_half_specified_link_next_input():
+    # A has no output, B has explicit input → A must produce to B's input topic
+    yaml_text = """
+id: p
+topics:
+  - name: in-t
+  - name: mid-t
+pipeline:
+  - type: identity
+    id: a
+    input: in-t
+  - type: identity
+    id: b
+    input: mid-t
+"""
+    plan = plan_for(yaml_text)
+    assert plan.agents["a"].output.topic == "mid-t"
+
+
+def test_different_errors_block_fusion():
+    yaml_text = """
+id: p
+topics:
+  - name: in-t
+pipeline:
+  - type: identity
+    id: a
+    input: in-t
+  - type: identity
+    id: b
+    errors:
+      on-failure: skip
+      retries: 5
+"""
+    plan = plan_for(yaml_text)
+    assert set(plan.agents) == {"a", "b"}
+    assert plan.agents["b"].errors.resolved_on_failure() == "skip"
+
+
+def test_tpu_topology_prefixes():
+    from langstream_tpu.api.model import TpuSpec
+
+    assert TpuSpec(topology="8").chips == 8
+    assert TpuSpec(topology="2x4").chips == 8
+    assert TpuSpec(topology="v5e-8").chips == 8
+    assert TpuSpec(type="v5p", topology="v5p-2x2").chips == 4
