@@ -1,0 +1,27 @@
+"""AI service-provider layer: the slot the TPU serving engine plugs into.
+
+Parity: reference `langstream-ai-agents` provider SPI
+(`services/ServiceProvider.java:24`, `completions/CompletionsService.java:22-33`,
+`embeddings/EmbeddingsService.java:24-36`). SURVEY §2.5: "The TPU serving
+provider implements exactly this SPI surface."
+"""
+
+from langstream_tpu.ai.provider import (
+    ChatChunk,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    ServiceProviderRegistry,
+    StreamingChunksConsumer,
+)
+
+__all__ = [
+    "ChatChunk",
+    "ChatMessage",
+    "CompletionsService",
+    "EmbeddingsService",
+    "ServiceProvider",
+    "ServiceProviderRegistry",
+    "StreamingChunksConsumer",
+]

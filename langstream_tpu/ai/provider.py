@@ -1,0 +1,138 @@
+"""Completions / embeddings provider SPI + registry.
+
+Parity: reference `ai/agents/services/ServiceProvider.java:24`,
+`completions/CompletionsService.java:22-33` (getChatCompletions with a
+StreamingChunksConsumer), `embeddings/EmbeddingsService.java:24-36`, and the
+provider registry resolved from `configuration.resources` entries
+(AIProvidersResourceProvider). The TPU JAX provider registers as resource type
+``tpu-serving`` (replacing `open-ai-configuration` et al. as the default).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from langstream_tpu.api.model import Application, Resource
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChatMessage":
+        return ChatMessage(role=str(d.get("role", "user")), content=str(d.get("content", "")))
+
+
+@dataclass
+class ChatChunk:
+    """One streamed delta (reference Chunk/StreamingChunksConsumer contract)."""
+
+    content: str
+    index: int
+    last: bool
+    answer_id: str = ""
+
+
+# consume_chunk(chunk) — called for every streamed delta, including the last
+StreamingChunksConsumer = Callable[[ChatChunk], None]
+
+
+@dataclass
+class ChatCompletionsResult:
+    content: str
+    role: str = "assistant"
+    finish_reason: str = "stop"
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    ttft_ms: float = 0.0
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+class CompletionsService(abc.ABC):
+    """Reference CompletionsService.java:22-33."""
+
+    @abc.abstractmethod
+    async def get_chat_completions(
+        self,
+        messages: list[ChatMessage],
+        options: dict[str, Any],
+        chunks_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionsResult: ...
+
+    async def get_text_completions(
+        self,
+        prompt: list[str],
+        options: dict[str, Any],
+        chunks_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionsResult:
+        messages = [ChatMessage(role="user", content=p) for p in prompt]
+        return await self.get_chat_completions(messages, options, chunks_consumer)
+
+
+class EmbeddingsService(abc.ABC):
+    """Reference EmbeddingsService.java:24-36."""
+
+    @abc.abstractmethod
+    async def compute_embeddings(self, texts: list[str]) -> list[list[float]]: ...
+
+
+class ServiceProvider(abc.ABC):
+    """Reference ServiceProvider.java:24."""
+
+    @abc.abstractmethod
+    def get_completions_service(self, config: dict[str, Any]) -> CompletionsService: ...
+
+    @abc.abstractmethod
+    def get_embeddings_service(self, config: dict[str, Any]) -> EmbeddingsService: ...
+
+    async def close(self) -> None:  # noqa: B027
+        pass
+
+
+class ServiceProviderRegistry:
+    """Resolves providers from the app's `configuration.resources` entries.
+
+    Agents ask for completions/embeddings either by explicit resource id
+    (configuration ``ai-service``) or by taking the first AI resource declared
+    (the reference behaves the same with its single-provider lookup).
+    """
+
+    def __init__(self, application: Optional[Application] = None) -> None:
+        self._providers: dict[str, ServiceProvider] = {}
+        self._resources: dict[str, Resource] = {}
+        if application is not None:
+            from langstream_tpu.core.registry import REGISTRY
+
+            for rid, resource in application.resources.items():
+                info = REGISTRY.resource(resource.type)
+                if info is not None and info.factory is not None:
+                    provider = info.factory(resource.configuration)
+                    if isinstance(provider, ServiceProvider):
+                        self._providers[rid] = provider
+                        self._resources[rid] = resource
+
+    def register(self, resource_id: str, provider: ServiceProvider) -> None:
+        self._providers[resource_id] = provider
+
+    def get_provider(self, resource_id: Optional[str] = None) -> ServiceProvider:
+        if resource_id is not None:
+            if resource_id not in self._providers:
+                raise ValueError(
+                    f"no AI service provider for resource {resource_id!r}; "
+                    f"known: {sorted(self._providers)}"
+                )
+            return self._providers[resource_id]
+        if not self._providers:
+            raise ValueError(
+                "no AI service provider configured; declare a configuration.resources "
+                "entry (e.g. type tpu-serving)"
+            )
+        return next(iter(self._providers.values()))
+
+    async def close(self) -> None:
+        for p in self._providers.values():
+            await p.close()

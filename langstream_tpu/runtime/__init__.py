@@ -1,0 +1,13 @@
+"""L3 — agent runtime (data plane): the poll→process→write main loop with
+ordered at-least-once commit, error routing, composite agents, and the
+in-process local application runner.
+
+Parity: reference `langstream-runtime/langstream-runtime-impl/` (SURVEY §2.4)
+and `langstream-runtime-tester/LocalApplicationRunner` (§2.10).
+"""
+
+from langstream_tpu.runtime.runner import AgentRunner
+from langstream_tpu.runtime.tracker import SourceRecordTracker
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+__all__ = ["AgentRunner", "LocalApplicationRunner", "SourceRecordTracker"]

@@ -1,0 +1,76 @@
+"""Default source/sink adapters: topic consumer as source, producer as sink.
+
+Parity: reference `TopicConsumerSource.java`, `TopicProducerSink.java` — the
+halves the runner plugs in when an agent node has no explicit source/sink.
+The source also owns the dead-letter producer (`<topic>-deadletter`,
+AgentRunner.java:282-284).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from langstream_tpu.api.agent import AgentSink, AgentSource
+from langstream_tpu.api.record import Record
+from langstream_tpu.api.topics import TopicConsumer, TopicProducer
+
+
+class TopicConsumerSource(AgentSource):
+    def __init__(
+        self, consumer: TopicConsumer, dead_letter_producer: Optional[TopicProducer] = None
+    ) -> None:
+        super().__init__()
+        self.agent_type = "topic-source"
+        self.consumer = consumer
+        self.dead_letter_producer = dead_letter_producer
+
+    async def start(self) -> None:
+        await self.consumer.start()
+        if self.dead_letter_producer is not None:
+            await self.dead_letter_producer.start()
+
+    async def close(self) -> None:
+        await self.consumer.close()
+        if self.dead_letter_producer is not None:
+            await self.dead_letter_producer.close()
+
+    async def read(self) -> list[Record]:
+        records = await self.consumer.read()
+        self.processed(len(records))
+        return records
+
+    async def commit(self, records: list[Record]) -> None:
+        await self.consumer.commit(records)
+
+    async def permanent_failure(self, record: Record, error: BaseException) -> None:
+        if self.dead_letter_producer is not None:
+            from langstream_tpu.api.record import SimpleRecord
+
+            dl = SimpleRecord.copy_from(record).with_headers(
+                [("error-msg", str(error)), ("error-class", type(error).__name__)]
+            )
+            await self.dead_letter_producer.write(dl)
+        else:
+            raise error
+
+    def agent_info(self) -> dict[str, Any]:
+        info = super().agent_info()
+        info["consumer"] = self.consumer.get_info()
+        return info
+
+
+class TopicProducerSink(AgentSink):
+    def __init__(self, producer: TopicProducer) -> None:
+        super().__init__()
+        self.agent_type = "topic-sink"
+        self.producer = producer
+
+    async def start(self) -> None:
+        await self.producer.start()
+
+    async def close(self) -> None:
+        await self.producer.close()
+
+    async def write(self, record: Record) -> None:
+        await self.producer.write(record)
+        self.processed(1)

@@ -1,0 +1,395 @@
+"""Agent runtime tests: main loop, ordered commit, error routing, composite
+chains (reference AgentRunnerTest / AgentRecordTrackerTest / ErrorHandlingTest
+analogues, SURVEY §4 tier-1)."""
+
+import asyncio
+
+import pytest
+
+from langstream_tpu.api.agent import BadRecordError, ProcessorResult, SingleRecordProcessor
+from langstream_tpu.api.doc import ConfigModel
+from langstream_tpu.api.record import Record, SimpleRecord
+from langstream_tpu.api.agent import ComponentType
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.core.registry import REGISTRY, AgentTypeInfo
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+
+def make_app(pipeline_yaml: str, instance_yaml: str = "instance:\n  streamingCluster:\n    type: memory\n"):
+    return ModelBuilder.build_application_from_files(
+        {"pipeline.yaml": pipeline_yaml}, instance_text=instance_yaml
+    ).application
+
+
+class UpperProcessor(SingleRecordProcessor):
+    async def process_record(self, record: Record) -> list[Record]:
+        return [SimpleRecord.copy_from(record, value=str(record.value).upper())]
+
+
+class ExplodeProcessor(SingleRecordProcessor):
+    """Splits comma-separated values into multiple records."""
+
+    async def process_record(self, record: Record) -> list[Record]:
+        return [
+            SimpleRecord.copy_from(record, value=part)
+            for part in str(record.value).split(",")
+        ]
+
+
+class FailNTimesProcessor(SingleRecordProcessor):
+    fails_left = {}
+
+    async def init(self, configuration):
+        self._fail_values = set(configuration.get("fail-values", []))
+        self._times = int(configuration.get("times", 1000))
+
+    async def process_record(self, record: Record) -> list[Record]:
+        if record.value in self._fail_values:
+            left = FailNTimesProcessor.fails_left.setdefault(record.value, self._times)
+            if left > 0:
+                FailNTimesProcessor.fails_left[record.value] = left - 1
+                raise ValueError(f"boom on {record.value}")
+        return [record]
+
+
+class BadRecordProcessor(SingleRecordProcessor):
+    async def init(self, configuration):
+        self._bad = set(configuration.get("bad-values", []))
+
+    async def process_record(self, record: Record) -> list[Record]:
+        if record.value in self._bad:
+            raise BadRecordError(f"bad record {record.value}")
+        return [record]
+
+
+def _register_test_agents():
+    for type_, cls in [
+        ("upper", UpperProcessor),
+        ("explode", ExplodeProcessor),
+        ("fail-n-times", FailNTimesProcessor),
+        ("bad-record", BadRecordProcessor),
+    ]:
+        REGISTRY.register_agent(
+            AgentTypeInfo(
+                type=type_,
+                component_type=ComponentType.PROCESSOR,
+                factory=cls,
+                composable=True,
+                config_model=ConfigModel(type=type_, allow_unknown=True),
+            )
+        )
+
+
+_register_test_agents()
+
+
+async def run_app(pipeline, produce, expect_topic, expect_n, timeout=5.0, pre_stop=None):
+    app = make_app(pipeline)
+    runner = LocalApplicationRunner("test-app", app)
+    await runner.run()
+    for topic, value, key in produce:
+        await runner.produce(topic, value, key=key)
+    try:
+        records = await runner.consume(expect_topic, expect_n, timeout=timeout)
+    finally:
+        if pre_stop:
+            pre_stop(runner)
+        await runner.stop()
+    return records, runner
+
+
+def test_end_to_end_pipeline(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - type: upper
+    id: up
+    input: in-t
+    output: out-t
+"""
+
+    async def main():
+        records, _ = await run_app(
+            pipeline, [("in-t", "hello", None), ("in-t", "world", None)], "out-t", 2
+        )
+        assert sorted(r.value for r in records) == ["HELLO", "WORLD"]
+
+    run(main())
+
+
+def test_fused_chain_end_to_end(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - type: explode
+    id: ex
+    input: in-t
+  - type: upper
+    id: up
+  - type: identity
+    id: idn
+    output: out-t
+"""
+
+    async def main():
+        records, runner = await run_app(
+            pipeline, [("in-t", "a,b,c", None)], "out-t", 3
+        )
+        assert sorted(r.value for r in records) == ["A", "B", "C"]
+        # fused into a single physical agent
+        assert len(runner.runners) == 1
+        info = runner.agents_info()[0]
+        assert info["records-in"] == 1
+        assert info["records-out"] == 3
+
+    async def check_commit():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("t2", app)
+        await runner.run()
+        await runner.produce("in-t", "x,y")
+        await runner.wait_for_records_out("ex", 2)
+        await runner.stop()
+
+    run(main())
+    run(check_commit())
+
+
+def test_source_commit_after_sink_write(run):
+    """Ordered commit: the source offset advances only after all sink writes."""
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - type: explode
+    id: ex
+    input: in-t
+    output: out-t
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("app", app)
+        await runner.run()
+        await runner.produce("in-t", "1,2,3")
+        await runner.consume("out-t", 3)
+        await runner.wait_for_records_out("ex", 3)
+        # after drain, consumer committed offset must be 1
+        agent = runner.runners[0]
+        await agent.wait_for_no_pending_records()
+        info = agent.source.consumer.get_info()
+        assert info["committed"]["0"] == 1
+        await runner.stop()
+
+    run(main())
+
+
+def test_errors_skip(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: skip
+  retries: 0
+pipeline:
+  - type: bad-record
+    id: br
+    input: in-t
+    output: out-t
+    configuration:
+      bad-values: ["poison"]
+"""
+
+    async def main():
+        records, runner = await run_app(
+            pipeline,
+            [("in-t", "ok1", None), ("in-t", "poison", None), ("in-t", "ok2", None)],
+            "out-t",
+            2,
+        )
+        assert sorted(r.value for r in records) == ["ok1", "ok2"]
+        info = runner.agents_info()[0]
+        assert info["failures"] == 1
+
+    run(main())
+
+
+def test_errors_retry_then_success(run):
+    FailNTimesProcessor.fails_left.clear()
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: fail
+  retries: 3
+pipeline:
+  - type: fail-n-times
+    id: f
+    input: in-t
+    output: out-t
+    configuration:
+      fail-values: ["flaky"]
+      times: 2
+"""
+
+    async def main():
+        records, _ = await run_app(pipeline, [("in-t", "flaky", None)], "out-t", 1)
+        assert records[0].value == "flaky"
+
+    run(main())
+
+
+def test_errors_dead_letter(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: dead-letter
+  retries: 0
+pipeline:
+  - type: bad-record
+    id: br
+    input: in-t
+    output: out-t
+    configuration:
+      bad-values: ["poison"]
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("app", app)
+        await runner.run()
+        await runner.produce("in-t", "ok")
+        await runner.produce("in-t", "poison")
+        good = await runner.consume("out-t", 1)
+        assert good[0].value == "ok"
+        dead = await runner.consume("in-t-deadletter", 1)
+        assert dead[0].value == "poison"
+        from langstream_tpu.api.record import header_value
+
+        assert "bad record" in header_value(dead[0], "error-msg")
+        await runner.stop()
+
+    run(main())
+
+
+def test_errors_fail_crashes_application(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+errors:
+  on-failure: fail
+  retries: 0
+pipeline:
+  - type: bad-record
+    id: br
+    input: in-t
+    output: out-t
+    configuration:
+      bad-values: ["poison"]
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("app", app)
+        await runner.run()
+        await runner.produce("in-t", "poison")
+        await asyncio.sleep(0.3)
+        with pytest.raises(RuntimeError, match="application failed"):
+            await runner.stop(drain=False)
+
+    run(main())
+
+
+def test_parallelism_replicas(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+    partitions: 2
+  - name: out-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - type: upper
+    id: up
+    input: in-t
+    output: out-t
+    resources:
+      parallelism: 2
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("app", app)
+        await runner.run()
+        assert len(runner.runners) == 2
+        for i in range(10):
+            await runner.produce("in-t", f"v{i}", key=f"k{i}")
+        records = await runner.consume("out-t", 10)
+        assert len(records) == 10
+        # both replicas got work (keys spread over 2 partitions)
+        per_replica = [r._records_in for r in runner.runners]
+        assert all(n > 0 for n in per_replica), per_replica
+        await runner.stop()
+
+    run(main())
+
+
+def test_source_to_sink_agents(run):
+    pipeline = """
+id: p
+pipeline:
+  - type: list-source
+    id: src
+    configuration:
+      items: ["a", "b"]
+  - type: upper
+    id: up
+  - type: collect-sink
+    id: snk
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("app", app)
+        await runner.run()
+        await runner.wait_for_records_out("src", 2)
+        await runner.stop()
+        # locate the collect sink instance
+        collected = []
+        for r in runner.runners:
+            if r.sink is not None and hasattr(r.sink, "collected"):
+                collected = r.sink.collected
+        assert sorted(x.value for x in collected) == ["A", "B"]
+
+    run(main())
