@@ -1,0 +1,24 @@
+"""JAX model family: decoder-only LMs (Llama/Gemma/Mixtral-style) and encoder
+embedders — the local replacement for the reference's remote AI providers
+(OpenAICompletionService.java et al., SURVEY §2.5).
+
+Pure-functional: params are pytrees, `forward`/`prefill`/`decode` are jittable
+and shardable over a `parallel.mesh` Mesh. bfloat16 by default (MXU-friendly).
+"""
+
+from langstream_tpu.models.configs import MODEL_PRESETS, ModelConfig
+from langstream_tpu.models.transformer import (
+    decode_step,
+    forward,
+    init_params,
+    prefill,
+)
+
+__all__ = [
+    "MODEL_PRESETS",
+    "ModelConfig",
+    "decode_step",
+    "forward",
+    "init_params",
+    "prefill",
+]

@@ -1,0 +1,154 @@
+"""Model architecture configs + presets for the supported families.
+
+Families cover BASELINE.json configs: Gemma-2B (single chip), Llama-3-8B
+(TP over v5e-8), Mixtral-8x7B (MoE, expert-parallel), plus tiny test configs.
+Field semantics follow the HF config.json conventions so `models.loader` can
+map checkpoints mechanically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    head_dim: Optional[int] = None  # defaults to d_model // n_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    max_seq_len: int = 8192
+    activation: str = "silu"  # silu (llama/mixtral) | gelu (gemma)
+    tie_embeddings: bool = False
+    # gemma-style stabilisers
+    embedding_scale: bool = False  # multiply embeddings by sqrt(d_model)
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    # MoE (mixtral-style); n_experts=0 → dense FFN
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    # post-norm variants (gemma2) — not needed for the supported presets yet
+    dtype: str = "bfloat16"
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+
+def _preset(**kw) -> ModelConfig:
+    return ModelConfig(**kw)
+
+
+MODEL_PRESETS: dict[str, ModelConfig] = {
+    # test-size configs (CI / CPU mesh) — dims divisible by 8 for TP tests
+    "tiny-test": _preset(
+        name="tiny-test",
+        vocab_size=512,
+        d_model=64,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=128,
+        max_seq_len=256,
+    ),
+    "tiny-moe-test": _preset(
+        name="tiny-moe-test",
+        vocab_size=512,
+        d_model=64,
+        n_layers=2,
+        n_heads=8,
+        n_kv_heads=4,
+        d_ff=128,
+        max_seq_len=256,
+        n_experts=8,
+        n_experts_per_tok=2,
+    ),
+    "gemma-2b": _preset(
+        name="gemma-2b",
+        vocab_size=256000,
+        d_model=2048,
+        n_layers=18,
+        n_heads=8,
+        n_kv_heads=1,
+        d_ff=16384,
+        head_dim=256,
+        rope_theta=10000.0,
+        activation="gelu",
+        tie_embeddings=True,
+        embedding_scale=True,
+        max_seq_len=8192,
+    ),
+    "llama-3-8b": _preset(
+        name="llama-3-8b",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        max_seq_len=8192,
+    ),
+    "llama-3-8b-shallow": _preset(
+        # 8B widths with 4 layers: single-chip perf probing without 16G of HBM
+        name="llama-3-8b-shallow",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=4,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        max_seq_len=8192,
+    ),
+    "mixtral-8x7b": _preset(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-5,
+        max_seq_len=32768,
+        n_experts=8,
+        n_experts_per_tok=2,
+    ),
+}
+
+
+@dataclass
+class GenerationOptions:
+    """Per-request sampling options (the knobs the reference forwards to the
+    OpenAI API: max-tokens/temperature/top-p, AIChatCompletionsConfiguration)."""
+
+    max_new_tokens: int = 256
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0  # 0 → disabled
+    top_p: float = 1.0
+    stop_tokens: tuple[int, ...] = ()
+    seed: Optional[int] = None
+
+    @staticmethod
+    def from_dict(d: dict) -> "GenerationOptions":
+        return GenerationOptions(
+            max_new_tokens=int(d.get("max-tokens", d.get("max_new_tokens", 256))),
+            temperature=float(d.get("temperature", 0.0)),
+            top_k=int(d.get("top-k", d.get("top_k", 0))),
+            top_p=float(d.get("top-p", d.get("top_p", 1.0))),
+            seed=d.get("seed"),
+        )

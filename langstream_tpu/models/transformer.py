@@ -1,0 +1,384 @@
+"""Decoder-only transformer (Llama / Gemma / Mixtral families) in pure JAX.
+
+TPU-first design notes:
+- layer params are STACKED on a leading axis and the layer loop is a
+  `lax.scan` — one compiled layer body regardless of depth (fast compiles,
+  XLA pipelining across layers);
+- all shapes static; KV cache is a fixed [L, B, Smax, Hkv, D] buffer with
+  per-slot lengths and masked attention (paged attention kernel: ops/);
+- GQA via einsum grouping; bf16 activations/params, fp32 softmax/norms;
+- MoE uses the dispatch/combine einsum pattern (GShard-style) so the expert
+  axis shards cleanly over an ICI mesh ("expert" axis) with `pjit`;
+- sharding is annotated EXTERNALLY via parallel/sharding.py param specs —
+  this file stays mesh-agnostic so the same code runs single-chip and TP/EP.
+
+Replaces (functionally) the reference's remote completion providers
+(`OpenAICompletionService.java`, `VertexAIProvider.java` — SURVEY §2.5);
+there is deliberately no architectural counterpart.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from langstream_tpu.models.configs import ModelConfig
+
+Params = dict
+KVCache = dict
+
+
+def _dtype(config: ModelConfig):
+    return jnp.dtype(config.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype: Optional[Any] = None) -> Params:
+    """Random-init params (shape-identical to checkpoint-loaded ones)."""
+    dtype = dtype or _dtype(config)
+    d, h, hkv = config.d_model, config.n_heads, config.n_kv_heads
+    hd = config.resolved_head_dim
+    f, L, v = config.d_ff, config.n_layers, config.vocab_size
+
+    keys = jax.random.split(key, 12)
+
+    def norm(k, *shape, scale=None):
+        scale = scale if scale is not None else (shape[-2] if len(shape) >= 2 else d)
+        return (jax.random.normal(k, shape, jnp.float32) * (scale**-0.5)).astype(dtype)
+
+    layers: dict[str, jax.Array] = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "wq": norm(keys[0], L, d, h * hd, scale=d),
+        "wk": norm(keys[1], L, d, hkv * hd, scale=d),
+        "wv": norm(keys[2], L, d, hkv * hd, scale=d),
+        "wo": norm(keys[3], L, h * hd, d, scale=h * hd),
+        "ffn_norm": jnp.ones((L, d), dtype),
+    }
+    if config.is_moe:
+        e = config.n_experts
+        layers["router"] = norm(keys[4], L, d, e, scale=d)
+        layers["w_gate"] = norm(keys[5], L, e, d, f, scale=d)
+        layers["w_up"] = norm(keys[6], L, e, d, f, scale=d)
+        layers["w_down"] = norm(keys[7], L, e, f, d, scale=f)
+    else:
+        layers["w_gate"] = norm(keys[5], L, d, f, scale=d)
+        layers["w_up"] = norm(keys[6], L, d, f, scale=d)
+        layers["w_down"] = norm(keys[7], L, f, d, scale=f)
+
+    params: Params = {
+        "embed": norm(keys[8], v, d, scale=d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = norm(keys[9], d, v, scale=d)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    normed = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_freqs(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    # positions: [B, S] → sin/cos [B, S, head_dim/2], fp32
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, S, half]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    # x: [B, S, H, D]; half-rotation convention (HF llama/gemma)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    sin, cos = sin[:, :, None, :], cos[:, :, None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf2 * cos + xf1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
+    if cap is None:
+        return x
+    return jnp.tanh(x / cap) * cap
+
+
+def attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    mask: jax.Array,  # [B, S, T] bool — True = attend
+    config: ModelConfig,
+) -> jax.Array:
+    """GQA attention, fp32 softmax. S=query len, T=key len (cache width)."""
+    h, hkv = config.n_heads, config.n_kv_heads
+    group = h // hkv
+    b, s, _, d = q.shape
+    t = k.shape[1]
+    qg = q.reshape(b, s, hkv, group, d)
+    scores = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    scores = _softcap(scores, config.attn_logit_softcap)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+    return out.reshape(b, s, h * d)
+
+
+def _activation(x: jax.Array, kind: str) -> jax.Array:
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def dense_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
+    gate = _activation(x @ lp["w_gate"], config.activation)
+    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def moe_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
+    """Mixture-of-experts via dispatch/combine einsums (GShard pattern).
+
+    Tokens route to top-k experts with a capacity limit; the [T,E,C] dispatch
+    tensor keeps every shape static so the expert axis ("expert") shards over
+    ICI with no data-dependent control flow. Overflowing tokens fall back to
+    their residual stream (standard token-dropping).
+    """
+    b, s, d = x.shape
+    t = b * s
+    e, k = config.n_experts, config.n_experts_per_tok
+    xf = x.reshape(t, d)
+
+    logits = (xf @ lp["router"]).astype(jnp.float32)  # [T, E]
+    weights, chosen = lax.top_k(logits, k)  # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+
+    # Lossless capacity: each token assigns each expert at most once, so C=T
+    # guarantees no token-dropping — required for serving-path equivalence
+    # (padding tokens must not evict real ones). Training may later trade this
+    # for a capacity factor; the dispatch shapes stay static either way.
+    capacity = t
+    # position of each (token, slot) within its expert's capacity buffer
+    onehot = jax.nn.one_hot(chosen, e, dtype=jnp.int32)  # [T, k, E]
+    flat = onehot.reshape(t * k, e)
+    pos_in_expert = jnp.cumsum(flat, axis=0) - 1  # [T*k, E]
+    pos = (pos_in_expert * flat).sum(-1).reshape(t, k)  # [T, k]
+    keep = pos < capacity
+
+    # dispatch: [T, E, C]
+    dispatch = (
+        jax.nn.one_hot(chosen, e, dtype=xf.dtype)[..., None]
+        * jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity, dtype=xf.dtype)[
+            :, :, None, :
+        ]
+    ).sum(axis=1)
+    # combine weights per (token, expert, cap-slot)
+    combine = (
+        jax.nn.one_hot(chosen, e, dtype=jnp.float32)[..., None]
+        * jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity, dtype=jnp.float32)[
+            :, :, None, :
+        ]
+        * weights[..., None, None]
+    ).sum(axis=1)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)  # [E, C, D]
+    gate = _activation(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]), config.activation)
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_down"])  # [E, C, D]
+    out = jnp.einsum("tec,ecd->td", combine.astype(xf.dtype), expert_out)
+    return out.reshape(b, s, d)
+
+
+# ---------------------------------------------------------------------------
+# Layer + model
+# ---------------------------------------------------------------------------
+
+
+def _layer(
+    x: jax.Array,
+    lp: dict,
+    sin: jax.Array,
+    cos: jax.Array,
+    mask: jax.Array,
+    config: ModelConfig,
+    cache_kv: Optional[tuple[jax.Array, jax.Array]] = None,
+    cache_positions: Optional[jax.Array] = None,
+) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
+    """One transformer block. If cache_kv given, k/v are written at
+    cache_positions and attention runs over the full cache width."""
+    b, s, d = x.shape
+    hd = config.resolved_head_dim
+
+    attn_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
+    q = (attn_in @ lp["wq"]).reshape(b, s, config.n_heads, hd)
+    k = (attn_in @ lp["wk"]).reshape(b, s, config.n_kv_heads, hd)
+    v = (attn_in @ lp["wv"]).reshape(b, s, config.n_kv_heads, hd)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+
+    new_cache = None
+    if cache_kv is not None:
+        ck, cv = cache_kv
+        # scatter this step's k/v into the cache at cache_positions [B, S]
+        bidx = jnp.arange(b)[:, None]
+        ck = ck.at[bidx, cache_positions].set(k)
+        cv = cv.at[bidx, cache_positions].set(v)
+        new_cache = (ck, cv)
+        k_all, v_all = ck, cv
+    else:
+        k_all, v_all = k, v
+
+    attn_out = attention(q, k_all, v_all, mask, config) @ lp["wo"]
+    x = x + attn_out
+
+    ffn_in = rms_norm(x, lp["ffn_norm"], config.rms_norm_eps)
+    if config.is_moe:
+        ffn_out = moe_ffn(ffn_in, lp, config)
+    else:
+        ffn_out = dense_ffn(ffn_in, lp, config)
+    return x + ffn_out, new_cache
+
+
+def _embed(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    x = params["embed"][tokens]
+    if config.embedding_scale:
+        x = x * jnp.sqrt(jnp.float32(config.d_model)).astype(x.dtype)
+    return x
+
+
+def _unembed(params: Params, x: jax.Array, config: ModelConfig) -> jax.Array:
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return _softcap(logits, config.final_logit_softcap)
+
+
+def _scan_layers(params, x, sin, cos, mask, config, cache=None, cache_positions=None):
+    """lax.scan over stacked layer params; carries (x, cache)."""
+    layers = params["layers"]
+
+    if cache is None:
+
+        def body(carry, lp):
+            y, _ = _layer(carry, lp, sin, cos, mask, config)
+            return y, None
+
+        x, _ = lax.scan(body, x, layers)
+        return x, None
+
+    def body_cached(carry, inputs):
+        lp, (ck, cv) = inputs
+        y, new_kv = _layer(
+            carry, lp, sin, cos, mask, config, cache_kv=(ck, cv), cache_positions=cache_positions
+        )
+        return y, new_kv
+
+    x, new_kv = lax.scan(body_cached, x, (layers, (cache["k"], cache["v"])))
+    return x, {"k": new_kv[0], "v": new_kv[1]}
+
+
+# ---------------------------------------------------------------------------
+# Public entry points (all jittable; config is static)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def forward(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Full-sequence causal forward → logits [B, S, V] (training / scoring)."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))[None, :, :]
+    mask = jnp.broadcast_to(mask, (b, s, s))
+    x = _embed(params, tokens, config)
+    x, _ = _scan_layers(params, x, sin, cos, mask, config)
+    return _unembed(params, x, config)
+
+
+def make_kv_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    dtype = dtype or _dtype(config)
+    shape = (config.n_layers, batch, max_len, config.n_kv_heads, config.resolved_head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(
+    params: Params,
+    tokens: jax.Array,  # [B, S] padded prompts
+    lengths: jax.Array,  # [B] true prompt lengths
+    cache: KVCache,
+    config: ModelConfig,
+) -> tuple[jax.Array, KVCache]:
+    """Process prompts, fill cache slots 0..len, return logits at the last
+    real token of each prompt ([B, V])."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
+    t = cache["k"].shape[2]
+    # causal over the prompt, nothing beyond; cache cols ≥ S are masked out
+    q_pos = positions  # [B, S]
+    kv_pos = jnp.arange(t)[None, None, :]  # [1, 1, T]
+    mask = kv_pos <= q_pos[:, :, None]
+    mask = mask & (kv_pos < s)
+    x = _embed(params, tokens, config)
+    x, cache = _scan_layers(
+        params, x, sin, cos, mask, config, cache=cache, cache_positions=positions
+    )
+    last = jnp.clip(lengths - 1, 0, s - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    logits = _unembed(params, x_last[:, None, :], config)[:, 0]
+    return logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step(
+    params: Params,
+    tokens: jax.Array,  # [B] current token per slot
+    positions: jax.Array,  # [B] position of that token
+    cache: KVCache,
+    config: ModelConfig,
+) -> tuple[jax.Array, KVCache]:
+    """One decode step for every active slot → logits [B, V], updated cache."""
+    b = tokens.shape[0]
+    t = cache["k"].shape[2]
+    pos2 = positions[:, None]  # [B, 1]
+    sin, cos = _rope_freqs(pos2, config.resolved_head_dim, config.rope_theta)
+    kv_pos = jnp.arange(t)[None, None, :]
+    mask = kv_pos <= pos2[:, :, None]  # attend to everything written ≤ position
+    x = _embed(params, tokens[:, None], config)
+    x, cache = _scan_layers(
+        params, x, sin, cos, mask, config, cache=cache, cache_positions=pos2
+    )
+    return _unembed(params, x, config)[:, 0], cache
+
+
+# ---------------------------------------------------------------------------
+# Loss (fine-tuning; used by __graft_entry__ dryrun + training module)
+# ---------------------------------------------------------------------------
+
+
+def causal_lm_loss(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array:
+    """Next-token cross-entropy over a [B, S] batch (pad id 0 masked out)."""
+    logits = forward(params, tokens, config)  # [B, S, V]
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (targets != 0).astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,24 @@
+"""Mesh / sharding / collectives — the intra-agent device-communication plane.
+
+The reference has NO counterpart (SURVEY §2.11: TP/PP/SP/EP absent; inference
+was remote HTTP). Here one agent replica = one JAX process group over an ICI
+mesh; the broker stays the inter-agent transport, preserving the reference's
+L2/L4 split.
+"""
+
+from langstream_tpu.parallel.mesh import build_mesh, mesh_from_tpu_spec
+from langstream_tpu.parallel.sharding import (
+    data_spec,
+    kv_cache_specs,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "build_mesh",
+    "data_spec",
+    "kv_cache_specs",
+    "mesh_from_tpu_spec",
+    "param_specs",
+    "shard_params",
+]

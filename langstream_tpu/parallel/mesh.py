@@ -1,0 +1,51 @@
+"""Device mesh construction from TpuSpec / axis dicts.
+
+Axes (any may be size 1): "data" (DP/replica), "model" (TP over ICI),
+"expert" (EP for MoE), "seq" (SP/context parallelism for long sequences).
+The planner validated that the axis product matches the topology chip count
+(core/planner._validate_tpu_meshes).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from langstream_tpu.api.model import TpuSpec
+
+AXIS_ORDER = ("data", "expert", "seq", "model")
+
+
+def build_mesh(
+    axes: dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh with canonical axis order; missing axes get size 1.
+
+    "model" is innermost so tensor-parallel collectives ride the fastest ICI
+    links (scaling-book recipe: contract the heaviest-traffic axis last).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = [int(axes.get(a, 1)) for a in AXIS_ORDER]
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(f"mesh {axes} needs {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(sizes)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def mesh_from_tpu_spec(
+    spec: Optional[TpuSpec], devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    if spec is None or not spec.mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        return build_mesh({"model": 1}, devices[:1])
+    return build_mesh(spec.mesh, devices)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    device = device or jax.devices()[0]
+    return build_mesh({}, [device])

@@ -1,0 +1,83 @@
+"""Parameter / activation sharding rules (the "annotate and let XLA insert
+collectives" recipe).
+
+Tensor parallel ("model" axis): attention heads and FFN hidden dim are
+column-sharded on the up-projection and row-sharded on the down-projection,
+so each layer needs exactly one psum (inserted by XLA) after wo and w_down —
+the Megatron schedule, expressed declaratively. Experts shard on "expert";
+batch/cache slots on "data"; vocab on "model" for the (un)embedding.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from langstream_tpu.models.configs import ModelConfig
+
+Params = dict
+
+
+def param_specs(config: ModelConfig) -> Params:
+    """PartitionSpec tree matching models.transformer.init_params layout."""
+    layers: dict[str, P] = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "model"),
+        "wk": P(None, None, "model"),
+        "wv": P(None, None, "model"),
+        "wo": P(None, "model", None),
+        "ffn_norm": P(None, None),
+    }
+    if config.is_moe:
+        layers["router"] = P(None, None, None)
+        layers["w_gate"] = P(None, "expert", None, "model")
+        layers["w_up"] = P(None, "expert", None, "model")
+        layers["w_down"] = P(None, "expert", "model", None)
+    else:
+        layers["w_gate"] = P(None, None, "model")
+        layers["w_up"] = P(None, None, "model")
+        layers["w_down"] = P(None, "model", None)
+
+    specs: Params = {
+        "embed": P("model", None),  # vocab-sharded; gather rides ICI
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = P(None, "model")
+    return specs
+
+
+def kv_cache_specs() -> dict[str, P]:
+    # [L, B, T, Hkv, D] — slots on data, kv heads on model
+    spec = P(None, "data", None, "model", None)
+    return {"k": spec, "v": spec}
+
+
+def data_spec() -> P:
+    return P("data", None)
+
+
+def _named(mesh: Mesh, tree: Any) -> Any:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Params, mesh: Mesh, config: ModelConfig) -> Params:
+    """Place a param tree onto the mesh with TP/EP shardings."""
+    return jax.device_put(params, _named(mesh, param_specs(config)))
+
+
+def shard_kv_cache(cache: dict, mesh: Mesh) -> dict:
+    return jax.device_put(cache, _named(mesh, kv_cache_specs()))
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.device_put(
+        tree, NamedSharding(mesh, P())
+    )

@@ -1,0 +1,13 @@
+"""TPU serving engine: continuous batching over jit prefill/decode.
+
+The genuinely new core of the rebuild (SURVEY §7 step 5). The engine owns the
+device; broker-fed requests enter a queue, the scheduler packs them into cache
+slots, tokens stream back through callbacks that re-enter the agent at the
+RecordSink.emit point — preserving the reference's StreamingChunksConsumer
+contract (ChatCompletionsStep.java:137) and its ordered-commit semantics.
+"""
+
+from langstream_tpu.serving.sampling import sample
+from langstream_tpu.serving.engine import GenerationRequest, GenerationResult, ServingEngine
+
+__all__ = ["GenerationRequest", "GenerationResult", "ServingEngine", "sample"]

@@ -1,0 +1,362 @@
+"""Continuous-batching serving engine.
+
+The device loop owns the TPU (SURVEY §3.2 note: "the continuous batcher owns
+the device; the poll loop feeds it"): requests enter a thread-safe queue, the
+engine thread admits them into free KV-cache slots (prefill, bucketed padding),
+then every iteration runs ONE fused decode+sample step for ALL active slots.
+Tokens stream back per-slot through callbacks; finished slots free immediately
+and new requests take their place — no generation waits for the longest one.
+
+Replaces the reference's OrderedAsyncBatchExecutor slot (SURVEY §2.1) as the
+batching scheduler, and the remote-API call in ChatCompletionsStep (§3.3) as
+the compute. Streaming callbacks preserve the StreamingChunksConsumer timing:
+first token → first chunk, before the source record commits.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.configs import GenerationOptions, ModelConfig
+from langstream_tpu.models.transformer import decode_step, make_kv_cache, prefill
+from langstream_tpu.serving.sampling import sample
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class GenerationRequest:
+    prompt_tokens: list[int]
+    options: GenerationOptions
+    # called from the engine thread with each new token id (stream path)
+    on_token: Optional[Callable[[int], None]] = None
+    submitted_at: float = field(default_factory=time.monotonic)
+    _done: threading.Event = field(default_factory=threading.Event)
+    _result: Optional["GenerationResult"] = None
+
+    def result(self, timeout: Optional[float] = None) -> "GenerationResult":
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation did not complete in time")
+        assert self._result is not None
+        if self._result.error is not None:
+            raise self._result.error
+        return self._result
+
+
+@dataclass
+class GenerationResult:
+    tokens: list[int]
+    finish_reason: str  # stop | length
+    prompt_tokens: int
+    ttft_s: float
+    total_s: float
+    error: Optional[BaseException] = None
+
+
+@dataclass
+class _Slot:
+    request: Optional[GenerationRequest] = None
+    position: int = 0  # next write position (= prompt len + generated so far)
+    generated: list[int] = field(default_factory=list)
+    started_at: float = 0.0
+    first_token_at: float = 0.0
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def _decode_and_sample(params, tokens, positions, cache, key, temp, top_k, top_p, config):
+    logits, cache = decode_step(params, tokens, positions, cache, config)
+    key, sub = jax.random.split(key)
+    next_tokens = sample(logits, sub, temp, top_k, top_p)
+    return next_tokens, cache, key
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config",), donate_argnames=("local_cache",)
+)
+def _prefill_and_sample(params, tokens, length, local_cache, key, temp, top_k, top_p, config):
+    logits, local_cache = prefill(params, tokens, length, local_cache, config)
+    key, sub = jax.random.split(key)
+    first = sample(logits, sub, temp, top_k, top_p)
+    return first, local_cache, key
+
+
+def _make_insert(config: ModelConfig):
+    @functools.partial(jax.jit, donate_argnames=("cache",))
+    def insert(cache, local_cache, slot):
+        # local_cache leaves: [L, 1, W, Hkv, D] → write into cache[:, slot, :W]
+
+        def put(big, small):
+            return jax.lax.dynamic_update_slice(
+                big, small.astype(big.dtype), (0, slot, 0, 0, 0)
+            )
+
+        return {
+            "k": put(cache["k"], local_cache["k"]),
+            "v": put(cache["v"], local_cache["v"]),
+        }
+
+    return insert
+
+
+class ServingEngine:
+    """One engine per model per agent replica; owns the device loop."""
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        params: Any,
+        max_batch: int = 8,
+        max_seq_len: Optional[int] = None,
+        eos_token_id: Optional[int] = None,
+        prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048),
+        rng_seed: int = 0,
+    ) -> None:
+        self.config = config
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len or config.max_seq_len
+        self.eos_token_id = eos_token_id
+        self.prefill_buckets = tuple(
+            b for b in prefill_buckets if b <= self.max_seq_len
+        ) or (self.max_seq_len,)
+        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(maxsize=max_batch * 4)
+        self._slots = [_Slot() for _ in range(max_batch)]
+        self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
+        self._insert = _make_insert(config)
+        self._key = jax.random.PRNGKey(rng_seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # device-side per-slot sampling params, rebuilt on admit
+        self._temp = np.zeros(max_batch, np.float32)
+        self._top_k = np.zeros(max_batch, np.int32)
+        self._top_p = np.ones(max_batch, np.float32)
+        # stats
+        self.total_generated = 0
+        self.total_requests = 0
+        self._busy_steps = 0
+
+    # -- public API ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, name="serving-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def submit(self, request: GenerationRequest) -> GenerationRequest:
+        """Thread-safe enqueue; blocks when the queue is full (backpressure
+        toward the broker poll loop — SURVEY §7 hard parts)."""
+        if len(request.prompt_tokens) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt of {len(request.prompt_tokens)} tokens exceeds max_seq_len "
+                f"{self.max_seq_len}"
+            )
+        self._queue.put(request)
+        return request
+
+    def generate(
+        self,
+        prompt_tokens: list[int],
+        options: Optional[GenerationOptions] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        timeout: float = 300.0,
+    ) -> GenerationResult:
+        """Blocking convenience wrapper (submit + wait)."""
+        req = GenerationRequest(
+            prompt_tokens=list(prompt_tokens),
+            options=options or GenerationOptions(),
+            on_token=on_token,
+        )
+        self.submit(req)
+        return req.result(timeout)
+
+    def stats(self) -> dict[str, Any]:
+        active = sum(1 for s in self._slots if s.active)
+        return {
+            "active-slots": active,
+            "max-batch": self.max_batch,
+            "queued": self._queue.qsize(),
+            "total-requests": self.total_requests,
+            "total-generated-tokens": self.total_generated,
+            "busy-steps": self._busy_steps,
+        }
+
+    # -- engine thread ------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                admitted = self._admit()
+                if not any(s.active for s in self._slots):
+                    if not admitted:
+                        time.sleep(0.001)
+                    continue
+                self._decode_iteration()
+        except BaseException as e:  # noqa: BLE001 — fail every pending request
+            log.exception("serving engine loop crashed")
+            self._fail_all(e)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _admit(self) -> bool:
+        """Move queued requests into free slots (prefill path)."""
+        admitted = False
+        for idx, slot in enumerate(self._slots):
+            if slot.active:
+                continue
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._prefill_into_slot(idx, request)
+            admitted = True
+        return admitted
+
+    def _prefill_into_slot(self, idx: int, request: GenerationRequest) -> None:
+        slot = self._slots[idx]
+        prompt = request.prompt_tokens
+        n = len(prompt)
+        width = self._bucket(n)
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, :n] = prompt
+        local_cache = make_kv_cache(self.config, 1, width)
+        opts = request.options
+        started = time.monotonic()
+        first, local_cache, self._key = _prefill_and_sample(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray([n], jnp.int32),
+            local_cache,
+            self._key,
+            jnp.asarray([opts.temperature], jnp.float32),
+            jnp.asarray([opts.top_k], jnp.int32),
+            jnp.asarray([opts.top_p], jnp.float32),
+            self.config,
+        )
+        self._cache = self._insert(self._cache, local_cache, idx)
+        first_token = int(jax.device_get(first)[0])
+
+        slot.request = request
+        slot.position = n  # first generated token goes to position n
+        slot.generated = []
+        slot.started_at = started
+        slot.first_token_at = time.monotonic()
+        self._temp[idx] = opts.temperature
+        self._top_k[idx] = opts.top_k
+        self._top_p[idx] = opts.top_p
+        self.total_requests += 1
+        self._deliver_token(idx, first_token)
+
+    def _decode_iteration(self) -> None:
+        """One decode step for every slot (inactive slots run masked junk —
+        static shapes keep XLA happy; their outputs are ignored)."""
+        tokens = np.zeros(self.max_batch, np.int32)
+        positions = np.zeros(self.max_batch, np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot.active:
+                # current token = last delivered; it sits at position-1... the
+                # NEXT token is produced by feeding the last token at `position`
+                tokens[i] = slot.generated[-1]
+                positions[i] = slot.position
+        next_tokens, self._cache, self._key = _decode_and_sample(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            self._cache,
+            self._key,
+            jnp.asarray(self._temp),
+            jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+            self.config,
+        )
+        host_tokens = np.asarray(jax.device_get(next_tokens))
+        self._busy_steps += 1
+        for i, slot in enumerate(self._slots):
+            if slot.active:
+                slot.position += 1
+                self._deliver_token(i, int(host_tokens[i]))
+
+    def _deliver_token(self, idx: int, token: int) -> None:
+        slot = self._slots[idx]
+        request = slot.request
+        assert request is not None
+        opts = request.options
+        finished_reason = None
+
+        is_stop = (self.eos_token_id is not None and token == self.eos_token_id) or (
+            token in opts.stop_tokens
+        )
+        if is_stop:
+            finished_reason = "stop"
+        else:
+            slot.generated.append(token)
+            self.total_generated += 1
+            if request.on_token is not None:
+                try:
+                    request.on_token(token)
+                except Exception:  # noqa: BLE001 — stream consumer must not kill the loop
+                    log.exception("on_token callback failed")
+            if len(slot.generated) >= opts.max_new_tokens:
+                finished_reason = "length"
+            elif slot.position >= self.max_seq_len - 1:
+                # cache full — scattering past the buffer would silently drop
+                finished_reason = "length"
+
+        if finished_reason is not None:
+            now = time.monotonic()
+            request._result = GenerationResult(
+                tokens=list(slot.generated),
+                finish_reason=finished_reason,
+                prompt_tokens=len(request.prompt_tokens),
+                ttft_s=slot.first_token_at - request.submitted_at,
+                total_s=now - request.submitted_at,
+            )
+            request._done.set()
+            slot.request = None
+            slot.generated = []
+            slot.position = 0
+
+    def _fail_all(self, error: BaseException) -> None:
+        for slot in self._slots:
+            if slot.request is not None:
+                slot.request._result = GenerationResult(
+                    tokens=[], finish_reason="error", prompt_tokens=0,
+                    ttft_s=0, total_s=0, error=error,
+                )
+                slot.request._done.set()
+                slot.request = None
+        while True:
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            request._result = GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=error,
+            )
+            request._done.set()

@@ -1,0 +1,48 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jittable and batched.
+
+Per-slot sampling params are carried as arrays so one compiled sampler serves
+a heterogeneous continuous batch (different temperatures per request).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sample(
+    logits: jax.Array,  # [B, V] fp32
+    key: jax.Array,
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32, 0 = disabled
+    top_p: jax.Array,  # [B] fp32, 1.0 = disabled
+) -> jax.Array:
+    """Returns sampled token ids [B]. temperature 0 → greedy for that slot."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest (k=0 → keep all)
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus): smallest prefix of sorted probs with cumsum ≥ p
+    sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens whose cumulative prob (exclusive) < p
+    keep_sorted = (cum - probs_sorted) < top_p[:, None]
+    cutoff = jnp.where(
+        keep_sorted, sorted2, jnp.inf
+    ).min(axis=-1, keepdims=True)  # smallest kept logit
+    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -14,7 +14,12 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import asyncio  # noqa: E402
+import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# CPU XLA's default matmul precision is bf16-level; correctness tests compare
+# fp32 paths, so force true fp32 matmuls (TPU perf paths use bf16 on purpose).
+jax.config.update("jax_default_matmul_precision", "highest")
 
 from langstream_tpu.messaging.memory import MemoryBroker  # noqa: E402
 
