@@ -1,0 +1,83 @@
+"""North-star bench: chat-completions decode throughput on the local chip.
+
+Runs the continuous-batching ServingEngine (the component that replaces the
+reference's remote OpenAI call in ChatCompletionsStep — see SURVEY §3.3) on
+randomly-initialised Gemma-2B weights and measures aggregate generated
+tokens/sec across a full batch of concurrent requests.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is against BASELINE.json's 2000 tok/s aggregate target.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if not on_tpu:
+        # CPU fallback (CI smoke): tiny config, same code path.
+        preset, max_batch, new_tokens, n_requests = "tiny-test", 4, 32, 8
+    else:
+        preset, max_batch, new_tokens, n_requests = "gemma-2b", 8, 128, 16
+
+    import numpy as np
+
+    from langstream_tpu.models.configs import (
+        MODEL_PRESETS,
+        GenerationOptions,
+    )
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+
+    config = MODEL_PRESETS[preset]
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = ServingEngine(
+        config,
+        params,
+        max_batch=max_batch,
+        max_seq_len=min(1024, config.max_seq_len),
+        prefill_buckets=(64,),
+    )
+    engine.start()
+
+    rng = np.random.default_rng(0)
+
+    def make_request() -> GenerationRequest:
+        prompt = rng.integers(1, config.vocab_size, size=32).tolist()
+        return GenerationRequest(
+            prompt_tokens=prompt,
+            options=GenerationOptions(max_new_tokens=new_tokens, temperature=0.0),
+        )
+
+    # warmup: trigger prefill + decode compiles
+    engine.submit(make_request()).result(timeout=600)
+
+    start = time.monotonic()
+    requests = [engine.submit(make_request()) for _ in range(n_requests)]
+    results = [r.result(timeout=1200) for r in requests]
+    elapsed = time.monotonic() - start
+    engine.stop()
+
+    total_tokens = sum(len(r.tokens) for r in results)
+    tok_s = total_tokens / elapsed
+    baseline = 2000.0  # BASELINE.json aggregate target
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_tokens_per_sec_per_chip[{preset}]",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,8 @@ class ModelConfig:
     # MoE (mixtral-style); n_experts=0 → dense FFN
     n_experts: int = 0
     n_experts_per_tok: int = 2
+    # expert capacity = ceil(T*k*factor/E) (≤0 → lossless C=T, quadratic in T)
+    moe_capacity_factor: float = 2.0
     # post-norm variants (gemma2) — not needed for the supported presets yet
     dtype: str = "bfloat16"
 
@@ -145,10 +147,12 @@ class GenerationOptions:
 
     @staticmethod
     def from_dict(d: dict) -> "GenerationOptions":
+        stops = d.get("stop-tokens", d.get("stop_tokens", ()))
         return GenerationOptions(
             max_new_tokens=int(d.get("max-tokens", d.get("max_new_tokens", 256))),
             temperature=float(d.get("temperature", 0.0)),
             top_k=int(d.get("top-k", d.get("top_k", 0))),
             top_p=float(d.get("top-p", d.get("top_p", 1.0))),
+            stop_tokens=tuple(int(t) for t in stops),
             seed=d.get("seed"),
         )

@@ -20,6 +20,7 @@ there is deliberately no architectural counterpart.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Optional
 
 import jax
@@ -169,11 +170,14 @@ def moe_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
     weights, chosen = lax.top_k(logits, k)  # [T, k]
     weights = jax.nn.softmax(weights, axis=-1)
 
-    # Lossless capacity: each token assigns each expert at most once, so C=T
-    # guarantees no token-dropping — required for serving-path equivalence
-    # (padding tokens must not evict real ones). Training may later trade this
-    # for a capacity factor; the dispatch shapes stay static either way.
-    capacity = t
+    # Capacity bounds the [T,E,C] dispatch tensor to linear in T. factor<=0
+    # restores lossless C=T (exactness tests); the floor keeps tiny decode
+    # batches from dropping tokens when T is comparable to E.
+    factor = config.moe_capacity_factor
+    if factor and factor > 0:
+        capacity = min(t, max(math.ceil(t * k * factor / e), min(t, 64)))
+    else:
+        capacity = t
     # position of each (token, slot) within its expert's capacity buffer
     onehot = jax.nn.one_hot(chosen, e, dtype=jnp.int32)  # [T, k, E]
     flat = onehot.reshape(t * k, e)

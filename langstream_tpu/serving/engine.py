@@ -94,7 +94,7 @@ def _prefill_and_sample(params, tokens, length, local_cache, key, temp, top_k, t
     return first, local_cache, key
 
 
-def _make_insert(config: ModelConfig):
+def _make_insert():
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def insert(cache, local_cache, slot):
         # local_cache leaves: [L, 1, W, Hkv, D] → write into cache[:, slot, :W]
@@ -136,11 +136,11 @@ class ServingEngine:
         self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(maxsize=max_batch * 4)
         self._slots = [_Slot() for _ in range(max_batch)]
         self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
-        self._insert = _make_insert(config)
+        self._insert = _make_insert()
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._dead: Optional[BaseException] = None
         # device-side per-slot sampling params, rebuilt on admit
         self._temp = np.zeros(max_batch, np.float32)
         self._top_k = np.zeros(max_batch, np.int32)
@@ -155,6 +155,8 @@ class ServingEngine:
     def start(self) -> None:
         if self._thread is not None:
             return
+        self._dead = None
+        self._stop.clear()
         self._thread = threading.Thread(target=self._run, name="serving-engine", daemon=True)
         self._thread.start()
 
@@ -163,14 +165,19 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        # resolve everything still in flight so blocked callers return now
+        self._fail_all(RuntimeError("serving engine stopped"))
 
     def submit(self, request: GenerationRequest) -> GenerationRequest:
         """Thread-safe enqueue; blocks when the queue is full (backpressure
         toward the broker poll loop — SURVEY §7 hard parts)."""
-        if len(request.prompt_tokens) >= self.max_seq_len:
+        if self._dead is not None:
+            raise RuntimeError("serving engine is stopped") from self._dead
+        limit = min(self.max_seq_len - 1, self.prefill_buckets[-1])
+        if len(request.prompt_tokens) > limit:
             raise ValueError(
-                f"prompt of {len(request.prompt_tokens)} tokens exceeds max_seq_len "
-                f"{self.max_seq_len}"
+                f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
+                f"engine limit of {limit} (largest prefill bucket / max_seq_len)"
             )
         self._queue.put(request)
         return request
@@ -233,7 +240,16 @@ class ServingEngine:
                 request = self._queue.get_nowait()
             except queue.Empty:
                 break
-            self._prefill_into_slot(idx, request)
+            try:
+                self._prefill_into_slot(idx, request)
+            except Exception as e:  # noqa: BLE001 — fail THIS request, not the engine
+                log.exception("prefill failed for one request")
+                request._result = GenerationResult(
+                    tokens=[], finish_reason="error", prompt_tokens=0,
+                    ttft_s=0, total_s=0, error=e,
+                )
+                request._done.set()
+                continue
             admitted = True
         return admitted
 
@@ -342,6 +358,7 @@ class ServingEngine:
             slot.position = 0
 
     def _fail_all(self, error: BaseException) -> None:
+        self._dead = error
         for slot in self._slots:
             if slot.request is not None:
                 slot.request._result = GenerationResult(

@@ -6,7 +6,10 @@ validated on virtual devices; real-TPU paths run via bench.py on hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the shell exports JAX_PLATFORMS (e.g. the axon TPU
+# tunnel sets JAX_PLATFORMS=axon and registers its backend from
+# sitecustomize before this file runs, so setdefault is not enough).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +19,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import asyncio  # noqa: E402
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# sitecustomize may have imported jax already; the env var alone is then
+# ignored, but the config flag still switches platforms pre-initialisation.
+jax.config.update("jax_platforms", "cpu")
 
 # CPU XLA's default matmul precision is bf16-level; correctness tests compare
 # fp32 paths, so force true fp32 matmuls (TPU perf paths use bf16 on purpose).
