@@ -7,6 +7,7 @@ from langstream_tpu.agents import builtin  # noqa: F401  (registration side effe
 
 def _register_all() -> None:
     # Each sub-module registers on import; keep imports in dependency order.
+    from langstream_tpu import ai  # noqa: F401  (AI resource types)
     from langstream_tpu.agents import genai  # noqa: F401
     from langstream_tpu.agents import text  # noqa: F401
     from langstream_tpu.agents import flow  # noqa: F401

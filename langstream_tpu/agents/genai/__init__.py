@@ -1,1 +1,8 @@
-"""Registered on import; see sibling modules."""
+"""GenAI toolkit agents (reference `langstream-ai-agents`, SURVEY §2.5)."""
+
+from langstream_tpu.agents.genai.agent import (  # noqa: F401
+    GenAIToolKitAgent,
+    register_genai_agents,
+)
+
+register_genai_agents()

@@ -1,0 +1,166 @@
+"""`ai-chat-completions` / `ai-text-completions` steps.
+
+Parity: reference `ChatCompletionsStep.java:42,115,137` and
+`TextCompletionsStep.java` — prompt templates rendered per record, completion
+via the resolved CompletionsService, streamed chunks written to
+`stream-to-topic` with `stream-id`/`stream-index`/`stream-last-message`
+properties BEFORE the final record commits (this is what gives the gateway
+its TTFT), final answer into `completion-field`, request metadata into
+`log-field`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from langstream_tpu.agents.genai import el
+from langstream_tpu.agents.genai.mutable import MutableRecord
+from langstream_tpu.agents.genai.steps import Step
+from langstream_tpu.ai.provider import ChatChunk, ChatMessage
+
+
+def _set_result_field(record: MutableRecord, field: Optional[str], content: str) -> None:
+    if field:
+        record.set_field(field, content)
+    else:
+        record.value = content
+        record._value_was_json = False
+
+
+class _BaseCompletionsStep(Step):
+    streaming_field_key = "stream-response-completion-field"
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        super().__init__(config)
+        self.model = config.get("model", "")
+        self.completion_field = config.get("completion-field")
+        self.log_field = config.get("log-field")
+        self.stream_to_topic = config.get("stream-to-topic")
+        self.stream_response_field = config.get(self.streaming_field_key)
+        self.min_chunks = int(config.get("min-chunks-per-message", 20))
+        self.ai_service = config.get("ai-service")
+        self._producer = None
+        self._service = None
+
+    async def start(self, context: Any) -> None:
+        registry = context.get_service_provider_registry()
+        provider = registry.get_provider(self.ai_service)
+        self._service = provider.get_completions_service(dict(self.config))
+        if self.stream_to_topic:
+            self._producer = context.get_topic_producer(self.stream_to_topic)
+            await self._producer.start()
+
+    async def close(self) -> None:
+        if self._producer is not None:
+            await self._producer.close()
+            self._producer = None
+
+    def _options(self) -> dict[str, Any]:
+        opts = {
+            k: self.config[k]
+            for k in (
+                "max-tokens", "temperature", "top-p", "top-k", "stop",
+                "logit-bias", "user", "presence-penalty", "frequency-penalty",
+                "options",
+            )
+            if self.config.get(k) is not None
+        }
+        opts["model"] = self.model
+        opts["min-chunks-per-message"] = self.min_chunks
+        return opts
+
+    def _chunk_writer(self, record: MutableRecord, loop, futures: list) -> Any:
+        """Returns a chunks_consumer that writes each chunk as its own record
+        to the stream topic. May be invoked from the engine thread → schedule
+        onto the agent event loop; the write futures are collected so
+        process() can await them (chunks must not be silently lost)."""
+        import asyncio
+
+        step = self
+
+        def consume(chunk: ChatChunk) -> None:
+            copy = MutableRecord(
+                key=record.key,
+                value=record.value,
+                properties=dict(record.properties),
+                origin=record.origin,
+                timestamp=record.timestamp,
+                _key_was_json=record._key_was_json,
+                _value_was_json=record._value_was_json,
+            )
+            copy.properties["stream-id"] = chunk.answer_id
+            copy.properties["stream-index"] = str(chunk.index)
+            copy.properties["stream-last-message"] = str(chunk.last).lower()
+            _set_result_field(copy, step.stream_response_field, chunk.content)
+            out = copy.to_record()
+            if step._producer is not None:
+                futures.append(
+                    asyncio.run_coroutine_threadsafe(step._producer.write(out), loop)
+                )
+
+        return consume
+
+    async def process(self, record: MutableRecord, context: Any) -> None:
+        import asyncio
+
+        assert self._service is not None, "step not started"
+        options = self._options()
+        chunks_consumer = None
+        chunk_futures: list = []
+        if self.stream_to_topic:
+            chunks_consumer = self._chunk_writer(
+                record, asyncio.get_running_loop(), chunk_futures
+            )
+        result = await self._complete(record, options, chunks_consumer)
+        if chunk_futures:
+            # all chunks reach the stream topic before the final record commits
+            await asyncio.gather(*(asyncio.wrap_future(f) for f in chunk_futures))
+        _set_result_field(record, self.completion_field, result.content)
+        if self.log_field:
+            record.set_field(
+                self.log_field,
+                json.dumps({"model": self.model, "options": {k: v for k, v in options.items() if k != "options"}, "messages": self._log_messages(record)}),
+            )
+
+    # subclass hooks -------------------------------------------------------
+
+    async def _complete(self, record, options, chunks_consumer):
+        raise NotImplementedError
+
+    def _log_messages(self, record: MutableRecord) -> Any:
+        raise NotImplementedError
+
+
+class ChatCompletionsStep(_BaseCompletionsStep):
+    def _messages(self, record: MutableRecord) -> list[ChatMessage]:
+        return [
+            ChatMessage(
+                role=m.get("role", "user"),
+                content=el.render_template(m.get("content", ""), record),
+            )
+            for m in self.config.get("messages", [])
+        ]
+
+    async def _complete(self, record, options, chunks_consumer):
+        return await self._service.get_chat_completions(
+            self._messages(record), options, chunks_consumer
+        )
+
+    def _log_messages(self, record: MutableRecord) -> Any:
+        return [{"role": m.role, "content": m.content} for m in self._messages(record)]
+
+
+class TextCompletionsStep(_BaseCompletionsStep):
+    streaming_field_key = "stream-response-completion-field"
+
+    def _prompts(self, record: MutableRecord) -> list[str]:
+        return [el.render_template(p, record) for p in self.config.get("prompt", [])]
+
+    async def _complete(self, record, options, chunks_consumer):
+        return await self._service.get_text_completions(
+            self._prompts(record), options, chunks_consumer
+        )
+
+    def _log_messages(self, record: MutableRecord) -> Any:
+        return self._prompts(record)

@@ -25,3 +25,14 @@ __all__ = [
     "ServiceProviderRegistry",
     "StreamingChunksConsumer",
 ]
+
+
+def register_providers() -> None:
+    """Register built-in AI resource types (called from agents bootstrap)."""
+    from langstream_tpu.ai import mock_provider, tpu_serving
+
+    mock_provider.register()
+    tpu_serving.register()
+
+
+register_providers()

@@ -103,8 +103,10 @@ class ServiceProviderRegistry:
 
     def __init__(self, application: Optional[Application] = None) -> None:
         self._providers: dict[str, ServiceProvider] = {}
+        self._datasources: dict[str, Any] = {}
         self._resources: dict[str, Resource] = {}
         if application is not None:
+            from langstream_tpu.api.storage import DataSource
             from langstream_tpu.core.registry import REGISTRY
 
             for rid, resource in application.resources.items():
@@ -114,9 +116,30 @@ class ServiceProviderRegistry:
                     if isinstance(provider, ServiceProvider):
                         self._providers[rid] = provider
                         self._resources[rid] = resource
+                    elif isinstance(provider, DataSource):
+                        self._datasources[rid] = provider
+                        self._resources[rid] = resource
 
     def register(self, resource_id: str, provider: ServiceProvider) -> None:
         self._providers[resource_id] = provider
+
+    def register_datasource(self, resource_id: str, datasource: Any) -> None:
+        self._datasources[resource_id] = datasource
+
+    def get_datasource(self, resource_id: Optional[str] = None) -> Any:
+        if resource_id is not None:
+            if resource_id not in self._datasources:
+                raise ValueError(
+                    f"no datasource for resource {resource_id!r}; "
+                    f"known: {sorted(self._datasources)}"
+                )
+            return self._datasources[resource_id]
+        if not self._datasources:
+            raise ValueError(
+                "no datasource configured; declare a configuration.resources "
+                "entry of type datasource/vector-database"
+            )
+        return next(iter(self._datasources.values()))
 
     def get_provider(self, resource_id: Optional[str] = None) -> ServiceProvider:
         if resource_id is not None:
@@ -134,5 +157,12 @@ class ServiceProviderRegistry:
         return next(iter(self._providers.values()))
 
     async def close(self) -> None:
-        for p in self._providers.values():
-            await p.close()
+        import logging
+
+        for target in (*self._providers.values(), *self._datasources.values()):
+            try:
+                await target.close()
+            except Exception:  # noqa: BLE001 — close the rest regardless
+                logging.getLogger(__name__).exception(
+                    "error closing AI provider/datasource"
+                )

@@ -1,0 +1,323 @@
+"""TPU-native AI service provider: local JAX serving instead of remote APIs.
+
+This is the component the whole rebuild exists for (BASELINE.md north star):
+it implements the reference's ServiceProvider/CompletionsService/
+EmbeddingsService SPI surface (`services/ServiceProvider.java:24`,
+`completions/CompletionsService.java:22-33`, `embeddings/EmbeddingsService.java:24-36`)
+with a local continuous-batching engine on the chip, replacing
+`OpenAICompletionService.java` et al. Registered as resource type
+``tpu-serving`` in `configuration.resources`.
+
+Resource configuration:
+  model: preset name (models.configs.MODEL_PRESETS) — gemma-2b, llama-3-8b, …
+  tokenizer: "byte" (default) | "hf:<local path>"
+  weights: "random" (default) | path to HF safetensors dir (models.loader)
+  max-batch / max-seq-len / prefill-buckets: engine knobs
+  mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
+
+Streaming follows the reference's growth batching (OpenAICompletionService:
+"start from 1 chunk, then double the size until min-chunks-per-message"), so
+the first token becomes the first chunk — TTFT is one decode step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import uuid
+from typing import Any, Optional
+
+import numpy as np
+
+from langstream_tpu.ai.provider import (
+    ChatChunk,
+    ChatCompletionsResult,
+    ChatMessage,
+    CompletionsService,
+    EmbeddingsService,
+    ServiceProvider,
+    StreamingChunksConsumer,
+)
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions, ModelConfig
+
+
+class _EngineHolder:
+    """Lazy, thread-safe singleton build of tokenizer/params/engine —
+    engine construction compiles XLA programs, so it must happen once."""
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        self.config = config
+        self._lock = threading.Lock()
+        self._engine = None
+        self._tokenizer = None
+        self._model_config: Optional[ModelConfig] = None
+        self._params = None
+        self._embed_fn = None
+
+    def model_config(self) -> ModelConfig:
+        if self._model_config is None:
+            name = self.config.get("model", "tiny-test")
+            if name not in MODEL_PRESETS:
+                raise ValueError(
+                    f"unknown model preset {name!r}; known: {sorted(MODEL_PRESETS)}"
+                )
+            self._model_config = MODEL_PRESETS[name]
+        return self._model_config
+
+    def tokenizer(self):
+        if self._tokenizer is None:
+            from langstream_tpu.serving.tokenizer import get_tokenizer
+
+            self._tokenizer = get_tokenizer(self.config.get("tokenizer", "byte"))
+        return self._tokenizer
+
+    def params(self):
+        import jax
+
+        if self._params is None:
+            from langstream_tpu.models.transformer import init_params
+
+            weights = self.config.get("weights", "random")
+            mc = self.model_config()
+            if weights in (None, "random"):
+                params = init_params(mc, jax.random.PRNGKey(0))
+            else:
+                from langstream_tpu.models.loader import load_params
+
+                params = load_params(weights, mc)
+            mesh_axes = self.config.get("mesh")
+            if mesh_axes:
+                from langstream_tpu.parallel.mesh import build_mesh
+                from langstream_tpu.parallel.sharding import shard_params
+
+                mesh = build_mesh(dict(mesh_axes))
+                params = shard_params(params, mesh, mc)
+            self._params = params
+        return self._params
+
+    def engine(self):
+        with self._lock:
+            if self._engine is None:
+                from langstream_tpu.serving.engine import ServingEngine
+
+                mc = self.model_config()
+                buckets = tuple(
+                    self.config.get("prefill-buckets", (32, 64, 128, 256, 512, 1024, 2048))
+                )
+                self._engine = ServingEngine(
+                    mc,
+                    self.params(),
+                    max_batch=int(self.config.get("max-batch", 8)),
+                    max_seq_len=int(self.config.get("max-seq-len", min(2048, mc.max_seq_len))),
+                    eos_token_id=self.tokenizer().eos_token_id,
+                    prefill_buckets=buckets,
+                )
+                self._engine.start()
+            return self._engine
+
+    def embed_fn(self):
+        with self._lock:
+            if self._embed_fn is None:
+                import functools
+
+                import jax
+
+                from langstream_tpu.models.transformer import encode
+
+                self._embed_fn = functools.partial(
+                    jax.jit(encode, static_argnames=("config",)),
+                    config=self.model_config(),
+                )
+            return self._embed_fn
+
+    def close(self) -> None:
+        with self._lock:
+            if self._engine is not None:
+                self._engine.stop()
+                self._engine = None
+
+
+class _StreamState:
+    """Growth batching: flush after 1 raw token, then 2, 4, … capped at
+    min_chunks — the reference provider's schedule."""
+
+    def __init__(self, tokenizer, consumer: StreamingChunksConsumer, min_chunks: int):
+        self.tokenizer = tokenizer
+        self.consumer = consumer
+        self.min_chunks = max(1, min_chunks)
+        self.threshold = 1
+        self.pending = 0
+        self.tokens: list[int] = []
+        self.emitted_text = ""
+        self.index = 0
+        self.answer_id = str(uuid.uuid4())
+
+    def on_token(self, token: int) -> None:
+        self.tokens.append(token)
+        self.pending += 1
+        if self.pending >= self.threshold:
+            self._flush(last=False)
+            self.threshold = min(self.threshold * 2, self.min_chunks)
+
+    def _flush(self, last: bool) -> None:
+        text = self.tokenizer.decode(self.tokens)
+        if not last:
+            # a token boundary may split a multibyte char: hold back the
+            # undecodable tail so the next flush re-emits it whole
+            text = text.rstrip("�")
+            if not text.startswith(self.emitted_text):
+                # decode prefix not stable yet (mid-grapheme) — wait
+                self.pending = 0
+                return
+        delta = text[len(self.emitted_text) :]
+        if delta or last:
+            self.consumer(
+                ChatChunk(content=delta, index=self.index, last=last, answer_id=self.answer_id)
+            )
+            self.index += 1
+            self.emitted_text = text
+        self.pending = 0
+
+    def finish(self) -> None:
+        self._flush(last=True)
+
+
+class TpuCompletionsService(CompletionsService):
+    def __init__(self, holder: _EngineHolder, step_config: dict[str, Any]) -> None:
+        self.holder = holder
+        self.step_config = step_config
+
+    def _render_prompt(self, messages: list[ChatMessage]) -> str:
+        tok = self.holder.tokenizer()
+        hf = getattr(tok, "_tok", None)
+        if hf is not None and getattr(hf, "chat_template", None):
+            return hf.apply_chat_template(
+                [{"role": m.role, "content": m.content} for m in messages],
+                tokenize=False,
+                add_generation_prompt=True,
+            )
+        lines = [f"{m.role}: {m.content}" for m in messages]
+        lines.append("assistant:")
+        return "\n".join(lines)
+
+    async def get_chat_completions(
+        self,
+        messages: list[ChatMessage],
+        options: dict[str, Any],
+        chunks_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionsResult:
+        return await self._generate(self._render_prompt(messages), options, chunks_consumer)
+
+    async def get_text_completions(
+        self,
+        prompt: list[str],
+        options: dict[str, Any],
+        chunks_consumer: Optional[StreamingChunksConsumer] = None,
+    ) -> ChatCompletionsResult:
+        return await self._generate("\n".join(prompt), options, chunks_consumer)
+
+    async def _generate(
+        self,
+        prompt: str,
+        options: dict[str, Any],
+        chunks_consumer: Optional[StreamingChunksConsumer],
+    ) -> ChatCompletionsResult:
+        from langstream_tpu.serving.engine import GenerationRequest
+
+        engine = self.holder.engine()
+        tokenizer = self.holder.tokenizer()
+        gen_options = GenerationOptions.from_dict(options)
+        stream_state = None
+        on_token = None
+        if chunks_consumer is not None:
+            stream_state = _StreamState(
+                tokenizer,
+                chunks_consumer,
+                int(options.get("min-chunks-per-message", 20)),
+            )
+            on_token = stream_state.on_token
+
+        request = GenerationRequest(
+            prompt_tokens=tokenizer.encode(prompt), options=gen_options, on_token=on_token
+        )
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, engine.submit, request)  # may block: backpressure
+        result = await loop.run_in_executor(None, request.result, 600.0)
+        if stream_state is not None:
+            stream_state.finish()
+
+        content = tokenizer.decode(result.tokens)
+        # string-level stop sequences (token-level stops handled in-engine)
+        for stop in options.get("stop") or []:
+            cut = content.find(stop)
+            if cut >= 0:
+                content = content[:cut]
+        return ChatCompletionsResult(
+            content=content,
+            finish_reason=result.finish_reason,
+            prompt_tokens=result.prompt_tokens,
+            completion_tokens=len(result.tokens),
+            ttft_ms=result.ttft_s * 1000.0,
+        )
+
+
+class TpuEmbeddingsService(EmbeddingsService):
+    def __init__(self, holder: _EngineHolder, step_config: dict[str, Any]) -> None:
+        self.holder = holder
+        self.max_len = int(step_config.get("max-text-tokens", 512))
+
+    async def compute_embeddings(self, texts: list[str]) -> list[list[float]]:
+        import jax.numpy as jnp
+
+        tokenizer = self.holder.tokenizer()
+        params = self.holder.params()
+        embed = self.holder.embed_fn()
+
+        token_lists = [tokenizer.encode(t)[: self.max_len] for t in texts]
+        # bucket the width to limit recompiles
+        width = 16
+        longest = max((len(t) for t in token_lists), default=1)
+        while width < longest:
+            width *= 2
+        batch = np.zeros((len(texts), width), np.int32)
+        lengths = np.zeros(len(texts), np.int32)
+        for i, toks in enumerate(token_lists):
+            batch[i, : len(toks)] = toks
+            lengths[i] = max(1, len(toks))
+
+        loop = asyncio.get_running_loop()
+
+        def run():
+            out = embed(params, jnp.asarray(batch), jnp.asarray(lengths))
+            return np.asarray(out)
+
+        vectors = await loop.run_in_executor(None, run)
+        return [v.tolist() for v in vectors]
+
+
+class TpuServingProvider(ServiceProvider):
+    def __init__(self, resource_config: dict[str, Any]) -> None:
+        self.holder = _EngineHolder(resource_config)
+
+    def get_completions_service(self, config: dict[str, Any]) -> CompletionsService:
+        return TpuCompletionsService(self.holder, config)
+
+    def get_embeddings_service(self, config: dict[str, Any]) -> EmbeddingsService:
+        return TpuEmbeddingsService(self.holder, config)
+
+    async def close(self) -> None:
+        self.holder.close()
+
+
+def register() -> None:
+    from langstream_tpu.api.doc import ConfigModel
+    from langstream_tpu.core.registry import REGISTRY, ResourceTypeInfo
+
+    REGISTRY.register_resource(
+        ResourceTypeInfo(
+            type="tpu-serving",
+            description="Local JAX/TPU completions+embeddings serving engine.",
+            config_model=ConfigModel(type="tpu-serving", allow_unknown=True),
+            factory=TpuServingProvider,
+        )
+    )

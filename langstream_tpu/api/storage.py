@@ -110,3 +110,26 @@ class VectorDatabaseWriter(abc.ABC):
 
     async def close(self) -> None:  # noqa: B027
         pass
+
+
+class DataSource(abc.ABC):
+    """Queryable datasource (vector or SQL) resolved from a
+    `configuration.resources` datasource entry.
+
+    Reference: `ai/agents/datasource/DataSourceProvider` and the per-DB
+    QueryStepDataSource implementations used by the `query` /
+    `query-vector-db` agents.
+    """
+
+    async def init(self, config: dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+    @abc.abstractmethod
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]: ...
+
+    async def execute_statement(self, query: str, params: list[Any]) -> dict[str, Any]:
+        """DML path (`mode: execute`); returns e.g. generated keys."""
+        raise NotImplementedError("this datasource is read-only")
+
+    async def close(self) -> None:  # noqa: B027
+        pass

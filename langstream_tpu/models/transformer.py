@@ -315,6 +315,31 @@ def forward(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array
     return _unembed(params, x, config)
 
 
+def encode(
+    params: Params,
+    tokens: jax.Array,  # [B, S] padded
+    lengths: jax.Array,  # [B] true lengths
+    config: ModelConfig,
+) -> jax.Array:
+    """Mean-pooled, L2-normalised final hidden states → [B, D] embeddings.
+
+    Backs the TPU EmbeddingsService (replacing the reference's remote
+    embedding providers — EmbeddingsService.java:24-36). Bidirectional
+    attention within each prompt (encoder-style pooling, not causal LM).
+    """
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
+    valid = positions < lengths[:, None]  # [B, S]
+    mask = valid[:, None, :] & valid[:, :, None]  # full attention over real tokens
+    x = _embed(params, tokens, config)
+    x, _ = _scan_layers(params, x, sin, cos, mask, config)
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    w = valid[:, :, None].astype(jnp.float32)
+    pooled = (x.astype(jnp.float32) * w).sum(1) / jnp.maximum(w.sum(1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
 def make_kv_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> KVCache:
     dtype = dtype or _dtype(config)
     shape = (config.n_layers, batch, max_len, config.n_kv_heads, config.resolved_head_dim)

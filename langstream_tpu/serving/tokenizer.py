@@ -1,0 +1,76 @@
+"""Tokenizers for the serving stack.
+
+`hf:<path-or-name>` loads a Hugging Face tokenizer (transformers is baked
+into the image; zero-egress means the path must be local). `byte` is a
+dependency-free byte-level tokenizer used by tests and random-weight
+benches. The serving provider picks via its `tokenizer` config key.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+
+class Tokenizer(abc.ABC):
+    eos_token_id: Optional[int] = None
+    bos_token_id: Optional[int] = None
+
+    @abc.abstractmethod
+    def encode(self, text: str) -> list[int]: ...
+
+    @abc.abstractmethod
+    def decode(self, tokens: list[int]) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def vocab_size(self) -> int: ...
+
+
+class ByteTokenizer(Tokenizer):
+    """UTF-8 bytes + 2 specials: 256=BOS, 257=EOS."""
+
+    bos_token_id = 256
+    eos_token_id = 257
+
+    def __init__(self, add_bos: bool = True) -> None:
+        self.add_bos = add_bos
+
+    @property
+    def vocab_size(self) -> int:
+        return 258
+
+    def encode(self, text: str) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_token_id] + ids if self.add_bos else ids
+
+    def decode(self, tokens: list[int]) -> str:
+        data = bytes(t for t in tokens if 0 <= t < 256)
+        return data.decode("utf-8", "replace")
+
+
+class HFTokenizer(Tokenizer):
+    def __init__(self, name_or_path: str) -> None:
+        from transformers import AutoTokenizer  # lazy; heavy import
+
+        self._tok = AutoTokenizer.from_pretrained(name_or_path)
+        self.eos_token_id = self._tok.eos_token_id
+        self.bos_token_id = self._tok.bos_token_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, tokens: list[int]) -> str:
+        return self._tok.decode(tokens, skip_special_tokens=True)
+
+
+def get_tokenizer(spec: str) -> Tokenizer:
+    if spec in ("byte", "bytes"):
+        return ByteTokenizer()
+    if spec.startswith("hf:"):
+        return HFTokenizer(spec[3:])
+    raise ValueError(f"unknown tokenizer spec {spec!r} (use 'byte' or 'hf:<path>')")

@@ -1,0 +1,351 @@
+"""GenAI toolkit tests: EL, transform steps, completions/embeddings agents,
+streaming chunk contract, and the TPU provider on the tiny model.
+
+Mirrors the reference's ChatCompletionsIT / ComputeEmbeddingsIT /
+GenAITest (WireMock-stubbed providers → here the mock-ai provider;
+SURVEY §4 tier-2)."""
+
+import json
+
+import pytest
+
+from langstream_tpu.agents.genai import el
+from langstream_tpu.agents.genai.mutable import MutableRecord
+from langstream_tpu.api.record import Header, SimpleRecord
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+"""
+
+
+def build_app(pipeline, configuration=None):
+    files = {"pipeline.yaml": pipeline}
+    if configuration:
+        files["configuration.yaml"] = configuration
+    return ModelBuilder.build_application_from_files(
+        files, instance_text=INSTANCE
+    ).application
+
+
+# ---------------------------------------------------------------------------
+# expression language
+# ---------------------------------------------------------------------------
+
+
+def rec(value=None, key=None, props=None):
+    return MutableRecord.from_record(
+        SimpleRecord(
+            key=key,
+            value=value,
+            headers=tuple(Header(k, v) for k, v in (props or {}).items()),
+        )
+    )
+
+
+def test_el_basics():
+    r = rec(value=json.dumps({"a": {"b": 3}, "name": "World"}))
+    assert el.evaluate("value.a.b + 1", r) == 4
+    assert el.evaluate("fn:concat('Hello ', value.name)", r) == "Hello World"
+    assert el.evaluate_bool("value.a.b == 3 && value.name == 'World'", r)
+    assert el.evaluate_bool("value.a.b > 5 || fn:contains(value.name, 'orl')", r)
+    assert el.evaluate("fn:uppercase(value.name)", r) == "WORLD"
+    assert el.evaluate("value.missing", r) is None
+    assert el.evaluate("fn:coalesce(value.missing, 'dflt')", r) == "dflt"
+
+
+def test_el_rejects_dangerous():
+    r = rec(value="x")
+    with pytest.raises(el.ExpressionError):
+        el.evaluate("__import__('os')", r)
+    with pytest.raises(el.ExpressionError):
+        el.evaluate("value.__class__", r)
+
+
+def test_template_render():
+    r = rec(value=json.dumps({"question": "why?"}), props={"session": "s1"})
+    out = el.render_template(
+        "Q: {{ value.question }} (session {{ properties.session }})", r
+    )
+    assert out == "Q: why? (session s1)"
+
+
+def test_mutable_record_field_paths():
+    r = rec(value=json.dumps({"a": 1}), key=json.dumps({"id": 7}))
+    r.set_field("value.b.c", 2)
+    assert r.get_field("value.b.c") == 2
+    r.drop_field("value.a")
+    assert r.get_field("value.a") is None
+    r.set_field("properties.p", "v")
+    out = r.to_record()
+    assert json.loads(out.value) == {"b": {"c": 2}}
+    assert dict((h.key, h.value) for h in out.headers)["p"] == "v"
+
+
+# ---------------------------------------------------------------------------
+# transform steps (driven through the registered agents + local runner)
+# ---------------------------------------------------------------------------
+
+
+async def run_pipeline_once(app, value, input_topic="input-topic", output_topic="output-topic"):
+    runner = LocalApplicationRunner("genai-test", app)
+    await runner.deploy()
+    await runner.start()
+    try:
+        await runner.produce(input_topic, value)
+        out = await runner.consume(output_topic, n=1, timeout=10)
+        return out[0], runner
+    finally:
+        await runner.stop()
+
+
+TRANSFORM_PIPELINE = """
+module: default
+id: p
+name: transforms
+topics:
+  - name: input-topic
+  - name: output-topic
+pipeline:
+  - name: to-json
+    type: document-to-json
+    input: input-topic
+    configuration:
+      text-field: text
+  - name: compute
+    type: compute
+    configuration:
+      fields:
+        - name: "value.upper"
+          expression: "fn:uppercase(value.text)"
+          type: STRING
+        - name: "value.n"
+          expression: "5 * 3"
+          type: INT32
+  - name: drop-junk
+    type: drop-fields
+    output: output-topic
+    configuration:
+      fields: ["text"]
+"""
+
+
+def test_transform_chain(run):
+    app = build_app(TRANSFORM_PIPELINE)
+    record, _ = run(run_pipeline_once(app, "hello"))
+    value = json.loads(record.value)
+    assert value["upper"] == "HELLO"
+    assert value["n"] == 15
+    assert "text" not in value
+
+
+DROP_WHEN_PIPELINE = """
+module: default
+id: p
+name: drop
+topics:
+  - name: input-topic
+  - name: output-topic
+pipeline:
+  - name: to-json
+    type: document-to-json
+    input: input-topic
+  - name: drop-bad
+    type: drop
+    output: output-topic
+    configuration:
+      when: "fn:contains(value.text, 'bad')"
+"""
+
+
+def test_drop_when(run):
+    async def scenario():
+        app = build_app(DROP_WHEN_PIPELINE)
+        runner = LocalApplicationRunner("drop-test", app)
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce("input-topic", "bad record")
+            await runner.produce("input-topic", "good record")
+            out = await runner.consume("output-topic", n=1, timeout=10)
+            assert json.loads(out[0].value)["text"] == "good record"
+        finally:
+            await runner.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# chat completions with mock provider (streaming chunk contract)
+# ---------------------------------------------------------------------------
+
+MOCK_CONFIG = """
+configuration:
+  resources:
+    - id: mock
+      type: mock-ai-configuration
+      configuration:
+        response: "The answer is 42"
+        chunk-size: 6
+"""
+
+CHAT_PIPELINE = """
+module: default
+id: p
+name: chat
+topics:
+  - name: input-topic
+  - name: output-topic
+  - name: stream-topic
+pipeline:
+  - name: to-json
+    type: document-to-json
+    input: input-topic
+    configuration:
+      text-field: question
+  - name: chat
+    type: ai-chat-completions
+    output: output-topic
+    configuration:
+      model: test-model
+      completion-field: "value.answer"
+      log-field: "value.log"
+      stream-to-topic: stream-topic
+      stream-response-completion-field: "value.chunk"
+      min-chunks-per-message: 2
+      messages:
+        - role: user
+          content: "Answer: {{ value.question }}"
+"""
+
+
+def test_chat_completions_with_streaming(run):
+    async def scenario():
+        app = build_app(CHAT_PIPELINE, MOCK_CONFIG)
+        runner = LocalApplicationRunner("chat-test", app)
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce("input-topic", "what is the answer?")
+            out = await runner.consume("output-topic", n=1, timeout=10)
+            value = json.loads(out[0].value)
+            assert value["answer"] == "The answer is 42"
+            log = json.loads(value["log"])
+            assert log["messages"][0]["content"] == "Answer: what is the answer?"
+
+            # chunks landed on stream-topic BEFORE/independently of the final record
+            chunks = await runner.consume("stream-topic", n=3, timeout=10)
+            headers = [dict((h.key, h.value) for h in c.headers) for c in chunks]
+            assert headers[0]["stream-index"] == "0"
+            assert all(h["stream-id"] == headers[0]["stream-id"] for h in headers)
+            text = "".join(json.loads(c.value)["chunk"] for c in chunks)
+            assert text == "The answer is 42"
+            assert headers[-1]["stream-last-message"] == "true"
+        finally:
+            await runner.stop()
+
+    run(scenario())
+
+
+EMBED_PIPELINE = """
+module: default
+id: p
+name: embed
+topics:
+  - name: input-topic
+  - name: output-topic
+pipeline:
+  - name: to-json
+    type: document-to-json
+    input: input-topic
+  - name: embed
+    type: compute-ai-embeddings
+    output: output-topic
+    configuration:
+      model: test-embed
+      text: "{{ value.text }}"
+      embeddings-field: "value.embeddings"
+"""
+
+
+def test_compute_embeddings_mock(run):
+    app = build_app(EMBED_PIPELINE, MOCK_CONFIG)
+    record, _ = run(run_pipeline_once(app, "embed me"))
+    value = json.loads(record.value)
+    assert len(value["embeddings"]) == 8
+    assert abs(sum(x * x for x in value["embeddings"]) - 1.0) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# the real TPU provider on the tiny model (CPU in CI, same code on chip)
+# ---------------------------------------------------------------------------
+
+TPU_CONFIG = """
+configuration:
+  resources:
+    - id: tpu
+      type: tpu-serving
+      configuration:
+        model: tiny-test
+        tokenizer: byte
+        max-batch: 2
+        max-seq-len: 128
+        prefill-buckets: [32]
+"""
+
+TPU_CHAT_PIPELINE = """
+module: default
+id: p
+name: tpu-chat
+topics:
+  - name: input-topic
+  - name: output-topic
+pipeline:
+  - name: to-json
+    type: document-to-json
+    input: input-topic
+    configuration:
+      text-field: question
+  - name: chat
+    type: ai-chat-completions
+    output: output-topic
+    configuration:
+      model: tiny-test
+      completion-field: "value.answer"
+      max-tokens: 8
+      messages:
+        - role: user
+          content: "{{ value.question }}"
+"""
+
+
+def test_tpu_provider_end_to_end(run):
+    app = build_app(TPU_CHAT_PIPELINE, TPU_CONFIG)
+    record, _ = run(run_pipeline_once(app, "hi"))
+    value = json.loads(record.value)
+    assert "answer" in value
+    assert isinstance(value["answer"], str)
+
+
+def test_tpu_embeddings(run):
+    async def scenario():
+        from langstream_tpu.ai.tpu_serving import TpuServingProvider
+
+        provider = TpuServingProvider(
+            {"model": "tiny-test", "tokenizer": "byte", "max-seq-len": 64}
+        )
+        service = provider.get_embeddings_service({})
+        vectors = await service.compute_embeddings(["hello world", "hello world", "different"])
+        assert len(vectors) == 3
+        assert vectors[0] == vectors[1]
+        assert vectors[0] != vectors[2]
+        # L2-normalised
+        assert abs(sum(x * x for x in vectors[0]) - 1.0) < 1e-4
+        await provider.close()
+
+    run(scenario())
