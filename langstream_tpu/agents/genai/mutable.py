@@ -47,14 +47,19 @@ class MutableRecord:
 
     @staticmethod
     def from_record(record: Record) -> "MutableRecord":
+        from langstream_tpu.runtime.topic_adapters import DESTINATION_HEADER
+
         key, key_json = _parse_side(record.key)
         value, value_json = _parse_side(record.value)
+        properties = {h.key: h.value for h in record.headers}
+        destination = properties.pop(DESTINATION_HEADER, None)
         return MutableRecord(
             key=key,
             value=value,
-            properties={h.key: h.value for h in record.headers},
+            properties=properties,
             origin=record.origin,
             timestamp=record.timestamp,
+            destination_topic=destination,
             _key_was_json=key_json,
             _value_was_json=value_json,
         )
@@ -156,10 +161,15 @@ class MutableRecord:
         return side
 
     def to_record(self) -> SimpleRecord:
+        headers = [Header(k, v) for k, v in self.properties.items()]
+        if self.destination_topic:
+            from langstream_tpu.runtime.topic_adapters import DESTINATION_HEADER
+
+            headers.append(Header(DESTINATION_HEADER, self.destination_topic))
         return SimpleRecord(
             key=self._serialise(self.key, self._key_was_json),
             value=self._serialise(self.value, self._value_was_json),
-            headers=tuple(Header(k, v) for k, v in self.properties.items()),
+            headers=tuple(headers),
             origin=self.origin,
             timestamp=self.timestamp,
         )

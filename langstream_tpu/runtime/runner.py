@@ -223,7 +223,7 @@ class AgentRunner:
             self.sink = sinks[0]
         elif self.node.output is not None and self.node.output.kind == Connection.TOPIC:
             producer = self.topic_runtime.create_producer(self.node.id, self.node.output.topic)
-            self.sink = TopicProducerSink(producer)
+            self.sink = TopicProducerSink(producer, self.context.get_topic_producer)
 
         self.tracker = SourceRecordTracker(self.source)
 

@@ -8,11 +8,16 @@ AgentRunner.java:282-284).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from langstream_tpu.api.agent import AgentSink, AgentSource
-from langstream_tpu.api.record import Record
+from langstream_tpu.api.record import Record, header_value
 from langstream_tpu.api.topics import TopicConsumer, TopicProducer
+
+# Well-known header carrying a per-record destination override (the rebuild's
+# equivalent of the reference MutableRecord.destinationTopic / dispatch agent
+# routing, flow/DispatchAgent.java). The default sink honors it.
+DESTINATION_HEADER = "langstream-destination-topic"
 
 
 class TopicConsumerSource(AgentSource):
@@ -60,10 +65,19 @@ class TopicConsumerSource(AgentSource):
 
 
 class TopicProducerSink(AgentSink):
-    def __init__(self, producer: TopicProducer) -> None:
+    """Default sink; honors per-record DESTINATION_HEADER routing overrides
+    via ``producer_factory`` (usually AgentContext.get_topic_producer, so
+    side-channel producers are cached and closed with the context)."""
+
+    def __init__(
+        self,
+        producer: TopicProducer,
+        producer_factory: Optional[Callable[[str], TopicProducer]] = None,
+    ) -> None:
         super().__init__()
         self.agent_type = "topic-sink"
         self.producer = producer
+        self.producer_factory = producer_factory
 
     async def start(self) -> None:
         await self.producer.start()
@@ -72,5 +86,18 @@ class TopicProducerSink(AgentSink):
         await self.producer.close()
 
     async def write(self, record: Record) -> None:
-        await self.producer.write(record)
+        destination = header_value(record, DESTINATION_HEADER)
+        if destination is not None:
+            # The override is per-hop: strip it so downstream stages route
+            # to their own outputs (reference resets destinationTopic per step).
+            from langstream_tpu.api.record import SimpleRecord
+
+            record = SimpleRecord.copy_from(
+                record,
+                headers=tuple(h for h in record.headers if h.key != DESTINATION_HEADER),
+            )
+        if destination and self.producer_factory is not None:
+            await self.producer_factory(str(destination)).write(record)
+        else:
+            await self.producer.write(record)
         self.processed(1)

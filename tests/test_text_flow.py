@@ -1,0 +1,275 @@
+"""Text-processing + flow-control agent tests.
+
+Mirrors the reference's text-processing unit tests and FlowControlRunnerIT
+(SURVEY §4 tier-1/2)."""
+
+import asyncio
+import json
+
+from langstream_tpu.agents.text import (
+    DocumentToJsonAgent,
+    LanguageDetectorAgent,
+    TextExtractorAgent,
+    TextNormaliserAgent,
+    TextSplitterAgent,
+    detect_language,
+    recursive_split,
+)
+from langstream_tpu.api.record import SimpleRecord, header_value
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+from langstream_tpu.runtime.topic_adapters import DESTINATION_HEADER
+
+
+def make_app(pipeline_yaml):
+    return ModelBuilder.build_application_from_files(
+        {"pipeline.yaml": pipeline_yaml},
+        instance_text="instance:\n  streamingCluster:\n    type: memory\n",
+    ).application
+
+
+async def one(agent, value, config=None, **record_kw):
+    await agent.init(config or {})
+    return await agent.process_record(SimpleRecord.of(value, **record_kw))
+
+
+# ---------------------------------------------------------------------------
+# text-splitter
+# ---------------------------------------------------------------------------
+
+
+def test_recursive_split_respects_chunk_size():
+    text = "para one is short.\n\npara two is a bit longer than one.\n\n" + "word " * 100
+    chunks = recursive_split(text, 80, 20, ["\n\n", "\n", " ", ""], len)
+    assert len(chunks) > 2
+    assert all(len(c) <= 80 for c in chunks)
+    # no content lost (modulo separators)
+    joined = " ".join(chunks)
+    assert "para one is short." in joined
+    assert "para two is a bit longer than one." in joined
+
+
+def test_recursive_split_overlap():
+    text = " ".join(f"w{i}" for i in range(50))
+    chunks = recursive_split(text, 40, 15, ["\n\n", "\n", " ", ""], len)
+    assert len(chunks) >= 2
+    # consecutive chunks share some suffix/prefix words (overlap)
+    first_words = chunks[0].split()
+    second_words = chunks[1].split()
+    assert set(first_words) & set(second_words)
+
+
+def test_splitter_agent_headers(run):
+    async def main():
+        agent = TextSplitterAgent()
+        out = await one(
+            agent,
+            "a b c d e f g h i j k l m n o p",
+            {"chunk_size": 10, "chunk_overlap": 0},
+        )
+        assert len(out) > 1
+        assert header_value(out[0], "chunk_id") == "0"
+        assert header_value(out[0], "chunk_num_chunks") == str(len(out))
+
+    run(main())
+
+
+def test_recursive_split_never_exceeds_chunk_size():
+    # regression: overlap carry must also leave room for the incoming split
+    text = "\n\n".join(["a" * 80, "b" * 80, "c " * 75])
+    chunks = recursive_split(text, 200, 100, ["\n\n", "\n", " ", ""], len)
+    assert all(len(c) <= 200 for c in chunks), [len(c) for c in chunks]
+
+
+def test_splitter_single_chunk(run):
+    async def main():
+        out = await one(TextSplitterAgent(), "tiny", {"chunk_size": 100})
+        assert [r.value for r in out] == ["tiny"]
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# text-extractor / normaliser / document-to-json / language-detector
+# ---------------------------------------------------------------------------
+
+
+def test_extract_html(run):
+    async def main():
+        html = "<html><head><style>x{}</style></head><body><h1>Title</h1><p>Hello <b>world</b></p></body></html>"
+        out = await one(TextExtractorAgent(), html)
+        assert "Title" in out[0].value and "Hello" in out[0].value
+        assert "style" not in out[0].value
+
+    run(main())
+
+
+def test_extract_plain_bytes(run):
+    async def main():
+        out = await one(TextExtractorAgent(), "plain text".encode())
+        assert out[0].value == "plain text"
+
+    run(main())
+
+
+def test_normaliser(run):
+    async def main():
+        out = await one(TextNormaliserAgent(), "  Hello   WORLD  \n  second Line ")
+        assert out[0].value == "hello world\nsecond line"
+
+    run(main())
+
+
+def test_document_to_json(run):
+    async def main():
+        out = await one(
+            DocumentToJsonAgent(), "some text", {"text-field": "content"},
+            headers=[("name", "doc1")],
+        )
+        doc = json.loads(out[0].value)
+        assert doc == {"name": "doc1", "content": "some text"}
+
+    run(main())
+
+
+def test_language_detection():
+    assert detect_language("the quick brown fox jumps over the lazy dog and runs") == "en"
+    assert detect_language("el perro corre por la calle y no se detiene porque quiere") == "es"
+    assert detect_language("le chien court dans la rue et il ne veut pas s'arrêter") == "fr"
+    assert detect_language("der Hund läuft durch die Straße und will nicht anhalten") == "de"
+
+
+def test_language_filter(run):
+    async def main():
+        agent = LanguageDetectorAgent()
+        keep = await one(
+            agent, "the cat sat on the mat and it was happy there",
+            {"allowedLanguages": ["en"]},
+        )
+        assert len(keep) == 1
+        assert header_value(keep[0], "language") == "en"
+        drop = await agent.process_record(
+            SimpleRecord.of("el gato está en la casa y no quiere salir de ella")
+        )
+        assert drop == []
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# flow control
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_routes_to_topics(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+  - name: out-t
+  - name: spanish-t
+pipeline:
+  - type: dispatch
+    id: d
+    input: in-t
+    output: out-t
+    configuration:
+      routes:
+        - when: properties.language == 'es'
+          destination: spanish-t
+        - when: properties.language == 'xx'
+          action: drop
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("t", app)
+        await runner.run()
+        await runner.produce("in-t", "hola", headers=[("language", "es")])
+        await runner.produce("in-t", "dropped", headers=[("language", "xx")])
+        await runner.produce("in-t", "hello", headers=[("language", "en")])
+        spanish = await runner.consume("spanish-t", 1, timeout=5)
+        default = await runner.consume("out-t", 1, timeout=5)
+        await runner.stop()
+        assert spanish[0].value == "hola"
+        assert [r.value for r in default] == ["hello"]
+        # the routing override is per-hop: it must not leak into the topic
+        assert header_value(spanish[0], DESTINATION_HEADER) is None
+
+    run(main())
+
+
+def test_timer_source(run):
+    pipeline = """
+id: p
+topics:
+  - name: out-t
+pipeline:
+  - type: timer-source
+    id: t
+    output: out-t
+    configuration:
+      period-seconds: 0.05
+      fields:
+        - name: value.kind
+          expression: "'tick'"
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("t", app)
+        await runner.run()
+        records = await runner.consume("out-t", 2, timeout=5)
+        await runner.stop()
+        assert all(r.value["kind"] == "tick" for r in records)
+
+    run(main())
+
+
+def test_trigger_event(run):
+    pipeline = """
+id: p
+topics:
+  - name: in-t
+  - name: out-t
+  - name: events-t
+pipeline:
+  - type: trigger-event
+    id: t
+    input: in-t
+    output: out-t
+    configuration:
+      when: value == 'boom'
+      destination: events-t
+      continue-processing: true
+      fields:
+        - name: value.original
+          expression: value
+"""
+
+    async def main():
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("t", app)
+        await runner.run()
+        await runner.produce("in-t", "quiet")
+        await runner.produce("in-t", "boom")
+        out = await runner.consume("out-t", 2, timeout=5)
+        events = await runner.consume("events-t", 1, timeout=5)
+        await runner.stop()
+        assert sorted(r.value for r in out) == ["boom", "quiet"]
+        assert events[0].value == {"original": "boom"}
+
+    run(main())
+
+
+def test_log_event_passthrough(run):
+    from langstream_tpu.agents.flow import LogEventProcessor
+
+    async def main():
+        out = await one(
+            LogEventProcessor(), "x",
+            {"when": "value == 'x'", "message": "seen"},
+        )
+        assert [r.value for r in out] == ["x"]
+
+    run(main())
