@@ -21,7 +21,7 @@ class QueryStep(Step):
         super().__init__(config)
         self.query = config.get("query", "")
         self.fields = config.get("fields", [])
-        self.output_field = config.get("output-field", "query-result")
+        self.output_field = config.get("output-field", "value.query-result")
         self.only_first = bool(config.get("only-first", False))
         self.loop_over = config.get("loop-over")
         self.mode = config.get("mode", "query")

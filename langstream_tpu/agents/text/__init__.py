@@ -107,10 +107,19 @@ def recursive_split(
     return _split(text, separators)
 
 
+_TOKEN_ENCODINGS = {"cl100k_base", "p50k_base", "r50k_base", "o200k_base"}
+
+
 def _token_length_function(encoding: str) -> Callable[[str], int]:
     """Token-count length function (the reference counts cl100k_base tokens via
     jtokkit; no tokenizer vocab ships in this image, so estimate ~4 chars/token
-    — same scale, monotonic in text length)."""
+    — same scale, monotonic in text length). Unknown names are rejected so a
+    typo doesn't silently change chunk sizes 4x."""
+    if encoding not in _TOKEN_ENCODINGS:
+        raise ValueError(
+            f"unknown length_function {encoding!r}; use 'length' or one of "
+            f"{sorted(_TOKEN_ENCODINGS)}"
+        )
     return lambda s: max(1, len(s) // 4)
 
 
@@ -266,7 +275,10 @@ class LanguageDetectorAgent(SingleRecordProcessor):
         self.processed(1)
         if self.allowed and lang not in self.allowed:
             return []
-        out = SimpleRecord.copy_from(record).with_headers([(self.property, lang)])
+        headers = tuple(h for h in record.headers if h.key != self.property)
+        out = SimpleRecord.copy_from(record, headers=headers).with_headers(
+            [(self.property, lang)]
+        )
         return [out]
 
 

@@ -1,1 +1,690 @@
-"""Registered on import; see sibling modules."""
+"""Vector / SQL datasource agents.
+
+Parity: reference `langstream-vector-agents` (SURVEY §2.5): `vector-db-sink`
+and `query-vector-db` over per-DB datasources, plus asset managers for
+declarative table/index creation. The reference ships clients for
+Cassandra/Astra/Pinecone/Milvus/OpenSearch/Solr/JDBC; none of those client
+libraries is bundled here, so the in-tree backends are:
+
+- ``service: jdbc`` → SQLite (stdlib) — the relational path,
+- ``service: local-vector`` → a TPU-first brute-force vector store whose
+  top-k similarity search is one jitted matmul over a padded [capacity, dim]
+  matrix (MXU-shaped; on CPU the identical code path runs under XLA:CPU).
+
+Other services register their config models for validation but raise a
+clear "client not bundled" error when instantiated.
+
+Also here: `re-rank` (MMR — reference rerank/ReRankAgent.java) and
+`flare-controller` (reference flare/FlareControllerAgent.java).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import re
+import sqlite3
+from typing import Any, Optional
+
+import numpy as np
+
+from langstream_tpu.agents.genai import el
+from langstream_tpu.agents.genai.mutable import MutableRecord
+from langstream_tpu.api.agent import (
+    AgentSink,
+    ComponentType,
+    SingleRecordProcessor,
+)
+from langstream_tpu.api.doc import ConfigModel, ConfigProperty, props
+from langstream_tpu.api.record import Record
+from langstream_tpu.api.storage import AssetManager, DataSource, VectorDatabaseWriter
+from langstream_tpu.core.registry import (
+    REGISTRY,
+    AgentTypeInfo,
+    AssetTypeInfo,
+    ResourceTypeInfo,
+)
+
+# ---------------------------------------------------------------------------
+# SQLite datasource (the bundled "jdbc" driver)
+# ---------------------------------------------------------------------------
+
+
+class SqliteDataSource(DataSource):
+    """`service: jdbc` datasource backed by stdlib sqlite3 (reference
+    jdbc/JdbcDataSource). Queries use `?` positional params; sqlite calls run
+    in a worker thread to keep the event loop free."""
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        url = config.get("url", ":memory:")
+        if url.startswith("jdbc:sqlite:"):
+            url = url[len("jdbc:sqlite:") :]
+        # URI-style urls (file:...?cache=shared — the only way to share an
+        # in-memory DB between connections) need uri=True or sqlite treats
+        # them as literal filenames.
+        uri = url.startswith("file:")
+        if url.startswith(":memory:"):
+            url = ":memory:"
+        self._conn = sqlite3.connect(url, uri=uri, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = asyncio.Lock()
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        async with self._lock:
+            rows = await asyncio.to_thread(self._fetch, query, params)
+        return rows
+
+    def _fetch(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        cur = self._conn.execute(query, [_to_sql_param(p) for p in params])
+        return [dict(r) for r in cur.fetchall()]
+
+    async def execute_statement(self, query: str, params: list[Any]) -> dict[str, Any]:
+        async with self._lock:
+            return await asyncio.to_thread(self._execute, query, params)
+
+    def _execute(self, query: str, params: list[Any]) -> dict[str, Any]:
+        cur = self._conn.execute(query, [_to_sql_param(p) for p in params])
+        self._conn.commit()
+        return {"generated-keys": [cur.lastrowid], "count": cur.rowcount}
+
+    async def close(self) -> None:
+        self._conn.close()
+
+
+def _to_sql_param(p: Any) -> Any:
+    if isinstance(p, (list, dict)):
+        return json.dumps(p)
+    return p
+
+
+class JdbcTableWriter(VectorDatabaseWriter):
+    """vector-db-sink writer for SQL tables: upsert by configured fields
+    (reference jdbc/JdbcWriter)."""
+
+    def __init__(self, datasource: SqliteDataSource, config: dict[str, Any]) -> None:
+        self.datasource = datasource
+        self.table = config.get("table-name", "documents")
+        self.fields = list(config.get("fields", []))
+
+    async def upsert(self, record: Any, context: dict[str, Any]) -> None:
+        ctx = MutableRecord.from_record(record)
+        names, values, keys = [], [], []
+        for f in self.fields:
+            names.append(f["name"])
+            values.append(_to_sql_param(el.evaluate(f.get("expression", "value"), ctx)))
+            if f.get("primary-key"):
+                keys.append(f["name"])
+        cols = ", ".join(names)
+        placeholders = ", ".join("?" for _ in names)
+        sql = f"INSERT INTO {self.table} ({cols}) VALUES ({placeholders})"
+        if keys:
+            updates = ", ".join(f"{n}=excluded.{n}" for n in names if n not in keys)
+            conflict = f" ON CONFLICT ({', '.join(keys)})"
+            sql += f"{conflict} DO UPDATE SET {updates}" if updates else f"{conflict} DO NOTHING"
+        await self.datasource.execute_statement(sql, values)
+
+
+# ---------------------------------------------------------------------------
+# Local TPU-first vector store
+# ---------------------------------------------------------------------------
+
+
+class _JitSimilarity:
+    """Jitted cosine top-k over a padded [capacity, dim] matrix. Capacity
+    doubles on growth, so XLA recompiles O(log n) times; each search is a
+    single [1, dim] x [dim, capacity] matmul + top_k — the MXU-friendly
+    brute-force layout (no index structure to maintain)."""
+
+    def __init__(self) -> None:
+        self._fn = None
+
+    def __call__(self, query: np.ndarray, matrix: np.ndarray, valid: np.ndarray, k: int):
+        import jax
+        import jax.numpy as jnp
+
+        if self._fn is None:
+
+            @jax.jit
+            def topk(q, m, mask, k=k):
+                qn = q / (jnp.linalg.norm(q) + 1e-9)
+                mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True) + 1e-9)
+                scores = mn @ qn  # [capacity]
+                scores = jnp.where(mask, scores, -jnp.inf)
+                return jax.lax.top_k(scores, k)
+
+            self._fn = topk
+        return self._fn(query, matrix, valid)
+
+
+class LocalVectorDataSource(DataSource):
+    """`service: local-vector` — an embedded vector database.
+
+    Indexes hold (id, vector, metadata). Query dialect is JSON (the reference
+    uses per-DB JSON dialects for Pinecone/Astra too):
+        {"index": "docs", "vector": [...], "topK": 5, "include-metadata": true}
+    Results: [{"id", "similarity", ...metadata}]. Writes go through the
+    vector-db-sink writer. Persistence: optional `path` (one .npz + .json
+    per index, saved on close/flush); default in-memory.
+    """
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        self._indexes: dict[str, dict[str, Any]] = {}
+        self._path = config.get("path")
+        self._searchers: dict[tuple[str, int, int], _JitSimilarity] = {}
+        if self._path:
+            self._load()
+
+    def _index(self, name: str, dim: Optional[int] = None) -> dict[str, Any]:
+        if name not in self._indexes:
+            if dim is None:
+                raise ValueError(f"vector index {name!r} does not exist")
+            self._indexes[name] = {
+                "dim": dim,
+                "ids": [],
+                "pos": {},
+                "matrix": np.zeros((16, dim), dtype=np.float32),
+                "meta": [],
+            }
+        return self._indexes[name]
+
+    def create_index(self, name: str, dim: int) -> None:
+        self._index(name, dim)
+
+    def has_index(self, name: str) -> bool:
+        return name in self._indexes
+
+    def drop_index(self, name: str) -> None:
+        self._indexes.pop(name, None)
+
+    def upsert(self, index: str, id_: str, vector: list[float], meta: dict[str, Any]) -> None:
+        idx = self._index(index, dim=len(vector))
+        vec = np.asarray(vector, dtype=np.float32)
+        if vec.shape != (idx["dim"],):
+            raise ValueError(f"vector dim {vec.shape} != index dim {idx['dim']}")
+        if id_ in idx["pos"]:
+            row = idx["pos"][id_]
+            idx["matrix"][row] = vec
+            idx["meta"][row] = meta
+            return
+        row = len(idx["ids"])
+        if row >= idx["matrix"].shape[0]:
+            grown = np.zeros((idx["matrix"].shape[0] * 2, idx["dim"]), dtype=np.float32)
+            grown[:row] = idx["matrix"][:row]
+            idx["matrix"] = grown
+        idx["matrix"][row] = vec
+        idx["ids"].append(id_)
+        idx["pos"][id_] = row
+        idx["meta"].append(meta)
+
+    def search(
+        self, index: str, vector: list[float], top_k: int = 5
+    ) -> list[dict[str, Any]]:
+        idx = self._index(index)
+        n = len(idx["ids"])
+        if n == 0:
+            return []
+        capacity = idx["matrix"].shape[0]
+        k = min(top_k, capacity)
+        searcher = self._searchers.setdefault((index, capacity, k), _JitSimilarity())
+        valid = np.zeros(capacity, dtype=bool)
+        valid[:n] = True
+        scores, rows = searcher(
+            np.asarray(vector, dtype=np.float32), idx["matrix"], valid, k
+        )
+        out = []
+        for s, r in zip(np.asarray(scores), np.asarray(rows)):
+            if not math.isfinite(float(s)):
+                continue
+            r = int(r)
+            out.append({"id": idx["ids"][r], "similarity": float(s), **idx["meta"][r]})
+        return out[:top_k]
+
+    # -- DataSource contract (JSON dialect) ---------------------------------
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        q = json.loads(query) if isinstance(query, str) else dict(query)
+        # positional params substitute "?" placeholders anywhere in the doc
+        q = _substitute_params(q, list(params))
+        index = q.get("index", "default")
+        vector = q.get("vector")
+        if vector is None:
+            raise ValueError("local-vector query requires a 'vector' field")
+        return self.search(index, vector, int(q.get("topK", q.get("top-k", 5))))
+
+    async def close(self) -> None:
+        if self._path:
+            self._save()
+
+    # -- persistence --------------------------------------------------------
+
+    def _save(self) -> None:
+        from pathlib import Path
+
+        root = Path(self._path)
+        root.mkdir(parents=True, exist_ok=True)
+        for name, idx in self._indexes.items():
+            n = len(idx["ids"])
+            np.savez(root / f"{name}.npz", matrix=idx["matrix"][:n])
+            (root / f"{name}.json").write_text(
+                json.dumps({"dim": idx["dim"], "ids": idx["ids"], "meta": idx["meta"]})
+            )
+
+    def _load(self) -> None:
+        from pathlib import Path
+
+        root = Path(self._path)
+        if not root.exists():
+            return
+        for meta_file in root.glob("*.json"):
+            name = meta_file.stem
+            info = json.loads(meta_file.read_text())
+            data = np.load(root / f"{name}.npz")["matrix"]
+            self.create_index(name, info["dim"])
+            for i, id_ in enumerate(info["ids"]):
+                self.upsert(name, id_, data[i].tolist(), info["meta"][i])
+
+
+def _substitute_params(obj: Any, params: list[Any]) -> Any:
+    if isinstance(obj, dict):
+        return {k: _substitute_params(v, params) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_substitute_params(v, params) for v in obj]
+    if obj == "?" and params:
+        return params.pop(0)
+    return obj
+
+
+class LocalVectorWriter(VectorDatabaseWriter):
+    """vector-db-sink writer for the local vector store."""
+
+    def __init__(self, datasource: LocalVectorDataSource, config: dict[str, Any]) -> None:
+        self.datasource = datasource
+        self.index = config.get("index-name", config.get("table-name", "default"))
+        self.id_expr = config.get("id", "fn:uuid()")
+        self.vector_expr = config.get("vector", "value.embeddings")
+        self.metadata_fields = list(config.get("fields", []))
+
+    async def upsert(self, record: Any, context: dict[str, Any]) -> None:
+        ctx = MutableRecord.from_record(record)
+        id_ = str(el.evaluate(self.id_expr, ctx))
+        vector = el.evaluate(self.vector_expr, ctx)
+        if vector is None:
+            raise ValueError(f"vector expression {self.vector_expr!r} produced None")
+        meta = {
+            f["name"]: el.evaluate(f.get("expression", "value"), ctx)
+            for f in self.metadata_fields
+        }
+        self.datasource.upsert(self.index, id_, list(map(float, vector)), meta)
+
+
+# ---------------------------------------------------------------------------
+# datasource resource resolution
+# ---------------------------------------------------------------------------
+
+_UNBUNDLED = {
+    "cassandra", "astra", "astra-vector-db", "pinecone", "milvus",
+    "opensearch", "solr",
+}
+
+
+def build_datasource(config: dict[str, Any]) -> DataSource:
+    service = config.get("service", "jdbc")
+    if service in ("jdbc", "sqlite"):
+        return SqliteDataSource(config)
+    if service in ("local-vector", "in-memory", "tpu-vector"):
+        return LocalVectorDataSource(config)
+    if service in _UNBUNDLED:
+        raise ValueError(
+            f"datasource service {service!r} requires an external client that is "
+            f"not bundled; use 'jdbc' (sqlite) or 'local-vector'"
+        )
+    raise ValueError(f"unknown datasource service {service!r}")
+
+
+def build_writer(datasource: DataSource, config: dict[str, Any]) -> VectorDatabaseWriter:
+    if isinstance(datasource, LocalVectorDataSource):
+        return LocalVectorWriter(datasource, config)
+    if isinstance(datasource, SqliteDataSource):
+        return JdbcTableWriter(datasource, config)
+    raise ValueError(f"no vector writer for datasource {type(datasource).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# agents
+# ---------------------------------------------------------------------------
+
+
+class VectorDBSinkAgent(AgentSink):
+    """`vector-db-sink`: upsert each record into the configured datasource
+    (reference VectorDBSinkAgent; per-DB writers)."""
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self._config = dict(configuration)
+        self._writer: Optional[VectorDatabaseWriter] = None
+
+    async def start(self) -> None:
+        assert self.context is not None
+        registry = self.context.get_service_provider_registry()
+        datasource = registry.get_datasource(self._config.get("datasource"))
+        self._writer = build_writer(datasource, self._config)
+        await self._writer.init(self._config)
+
+    async def write(self, record: Record) -> None:
+        assert self._writer is not None
+        await self._writer.upsert(record, {})
+        self.processed(1)
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            await self._writer.close()
+
+
+class QueryVectorDBAgent(SingleRecordProcessor):
+    """`query-vector-db`: standalone query agent (reference
+    QueryVectorDBAgentProvider) — same semantics as the GenAI `query` step."""
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.query = configuration.get("query", "")
+        self.fields = list(configuration.get("fields", []))
+        self.output_field = configuration.get("output-field", "value.query-result")
+        self.only_first = bool(configuration.get("only-first", False))
+        self.mode = configuration.get("mode", "query")
+        self.datasource_name = configuration.get("datasource")
+        self._datasource: Optional[DataSource] = None
+
+    async def start(self) -> None:
+        assert self.context is not None
+        registry = self.context.get_service_provider_registry()
+        self._datasource = registry.get_datasource(self.datasource_name)
+
+    async def process_record(self, record: Record) -> list[Record]:
+        assert self._datasource is not None
+        ctx = MutableRecord.from_record(record)
+        params = [el.evaluate(f, ctx) for f in self.fields]
+        if self.mode == "execute":
+            result: Any = await self._datasource.execute_statement(self.query, params)
+        else:
+            rows = await self._datasource.fetch_data(self.query, params)
+            result = (rows[0] if rows else None) if self.only_first else rows
+        ctx.set_field(self.output_field, result)
+        self.processed(1)
+        return [ctx.to_record()]
+
+
+def _cosine(a: np.ndarray, b: np.ndarray) -> float:
+    denom = float(np.linalg.norm(a) * np.linalg.norm(b)) + 1e-9
+    return float(np.dot(a, b)) / denom
+
+
+class ReRankAgent(SingleRecordProcessor):
+    """`re-rank`: re-order candidate documents against the query with MMR
+    (Maximal Marginal Relevance) — reference rerank/ReRankAgent.java.
+
+    Reads candidates from `field` (list of docs), the query embedding from
+    `query-embeddings`, per-doc embeddings from `embeddings-field` (an EL
+    evaluated with `record` bound to the doc), writes the top `max` docs to
+    `output-field`.
+    """
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.field = configuration.get("field", "value.query-result")
+        self.output_field = configuration.get("output-field", self.field)
+        self.query_embeddings = configuration.get("query-embeddings", "value.embeddings")
+        self.embeddings_field = configuration.get("embeddings-field", "record.embeddings")
+        self.text_field = configuration.get("text-field", "record.text")
+        self.algorithm = configuration.get("algorithm", "MMR")
+        self.lambda_ = float(configuration.get("lambda", 0.5))
+        self.max = int(configuration.get("max", 5))
+
+    async def process_record(self, record: Record) -> list[Record]:
+        ctx = MutableRecord.from_record(record)
+        docs = el.evaluate(self.field, ctx) or []
+        query_vec = el.evaluate(self.query_embeddings, ctx)
+        self.processed(1)
+        if not docs or query_vec is None:
+            ctx.set_field(self.output_field, docs)
+            return [ctx.to_record()]
+        q = np.asarray(query_vec, dtype=np.float32)
+        vecs = []
+        for d in docs:
+            v = el.evaluate(self.embeddings_field, ctx, extra={"record": d})
+            vecs.append(np.asarray(v, dtype=np.float32) if v is not None else None)
+
+        if self.algorithm.upper() == "MMR":
+            ranked = self._mmr(docs, vecs, q)
+        else:  # plain cosine relevance
+            scored = sorted(
+                range(len(docs)),
+                key=lambda i: -(_cosine(vecs[i], q) if vecs[i] is not None else -1.0),
+            )
+            ranked = [docs[i] for i in scored[: self.max]]
+        ctx.set_field(self.output_field, ranked)
+        return [ctx.to_record()]
+
+    def _mmr(self, docs: list, vecs: list, q: np.ndarray) -> list:
+        selected: list[int] = []
+        candidates = [i for i in range(len(docs)) if vecs[i] is not None]
+        while candidates and len(selected) < self.max:
+            best, best_score = None, -np.inf
+            for i in candidates:
+                relevance = _cosine(vecs[i], q)
+                redundancy = max(
+                    (_cosine(vecs[i], vecs[j]) for j in selected), default=0.0
+                )
+                score = self.lambda_ * relevance - (1 - self.lambda_) * redundancy
+                if score > best_score:
+                    best, best_score = i, score
+            assert best is not None
+            selected.append(best)
+            candidates.remove(best)
+        return [docs[i] for i in selected]
+
+
+class FlareControllerAgent(SingleRecordProcessor):
+    """`flare-controller` (reference flare/FlareControllerAgent.java): FLARE
+    active-RAG loop control. Inspects the tokens/logprobs of a generated
+    answer; if any token's probability falls below `min-prob`, extracts the
+    low-confidence span as a retrieval query, stores it in
+    `retrieve-query-field` and routes the record to `loop-topic` for another
+    retrieve→generate round; confident answers pass through."""
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.tokens_field = configuration.get("tokens-field", "value.tokens")
+        self.logprobs_field = configuration.get("logprobs-field", "value.logprobs")
+        self.min_prob = float(configuration.get("min-prob", 0.2))
+        self.query_field = configuration.get("retrieve-query-field", "value.flare-query")
+        self.loop_topic = configuration.get("loop-topic", "")
+
+    async def process_record(self, record: Record) -> list[Record]:
+        ctx = MutableRecord.from_record(record)
+        tokens = el.evaluate(self.tokens_field, ctx) or []
+        logprobs = el.evaluate(self.logprobs_field, ctx) or []
+        self.processed(1)
+        uncertain = [
+            str(tok)
+            for tok, lp in zip(tokens, logprobs)
+            if math.exp(float(lp)) < self.min_prob
+        ]
+        if not uncertain:
+            return [record]
+        # the retrieval query is the low-confidence span, whitespace-joined
+        query = re.sub(r"\s+", " ", " ".join(uncertain)).strip()
+        ctx.set_field(self.query_field, query)
+        if self.loop_topic:
+            ctx.destination_topic = self.loop_topic
+        return [ctx.to_record()]
+
+
+# ---------------------------------------------------------------------------
+# assets
+# ---------------------------------------------------------------------------
+
+
+class JdbcTableAssetManager(AssetManager):
+    """`jdbc-table` asset: create/drop a table via DDL statements in the
+    asset config (reference JdbcAssetsManagerProvider)."""
+
+    def __init__(self) -> None:
+        self._asset = None
+        self._datasource: Optional[SqliteDataSource] = None
+
+    async def initialize(self, asset) -> None:
+        self._asset = asset
+        ds_config = asset.config.get("datasource", {})
+        if isinstance(ds_config, dict):
+            ds_config = ds_config.get("configuration", ds_config)
+        # NOTE: this opens its own connection. For in-memory sqlite to be
+        # visible to pipeline agents, use a shared-cache URI in BOTH the
+        # asset and the datasource resource: url "file:name?mode=memory&cache=shared"
+        self._datasource = SqliteDataSource(ds_config)
+
+    async def close(self) -> None:
+        if self._datasource is not None:
+            await self._datasource.close()
+
+    async def asset_exists(self) -> bool:
+        assert self._asset and self._datasource
+        table = self._asset.config.get("table-name", "")
+        rows = await self._datasource.fetch_data(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?", [table]
+        )
+        return bool(rows)
+
+    async def deploy_asset(self) -> None:
+        assert self._asset and self._datasource
+        for stmt in self._asset.config.get("create-statements", []):
+            await self._datasource.execute_statement(stmt, [])
+
+    async def delete_asset(self) -> None:
+        assert self._asset and self._datasource
+        stmts = self._asset.config.get("delete-statements") or [
+            f"DROP TABLE IF EXISTS {self._asset.config.get('table-name', '')}"
+        ]
+        for stmt in stmts:
+            await self._datasource.execute_statement(stmt, [])
+
+
+# ---------------------------------------------------------------------------
+# registration
+# ---------------------------------------------------------------------------
+
+
+def _register() -> None:
+    for rtype in ("datasource", "vector-database"):
+        REGISTRY.register_resource(
+            ResourceTypeInfo(
+                type=rtype,
+                factory=build_datasource,
+                description="SQL or vector datasource (jdbc/sqlite or local-vector).",
+                config_model=ConfigModel(
+                    type=rtype,
+                    properties=props(
+                        ConfigProperty("service", "backend driver", required=True),
+                        ConfigProperty("url", "connection url"),
+                        ConfigProperty("path", "persistence dir (local-vector)"),
+                    ),
+                    allow_unknown=True,
+                ),
+            )
+        )
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="vector-db-sink",
+            component_type=ComponentType.SINK,
+            factory=VectorDBSinkAgent,
+            description="Upsert records into a vector/SQL datasource.",
+            config_model=ConfigModel(
+                type="vector-db-sink",
+                properties=props(
+                    ConfigProperty("datasource", "resource id", required=True),
+                    ConfigProperty("table-name", "SQL table (jdbc)"),
+                    ConfigProperty("index-name", "vector index (local-vector)"),
+                    ConfigProperty("id", "EL for the row/vector id"),
+                    ConfigProperty("vector", "EL for the embedding vector"),
+                    ConfigProperty("fields", "list of {name, expression, primary-key}", type="array"),
+                ),
+            ),
+        )
+    )
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="query-vector-db",
+            component_type=ComponentType.PROCESSOR,
+            factory=QueryVectorDBAgent,
+            composable=True,
+            description="Query a vector/SQL datasource per record.",
+            config_model=ConfigModel(
+                type="query-vector-db",
+                properties=props(
+                    ConfigProperty("datasource", "resource id"),
+                    ConfigProperty("query", "query text / JSON dialect", required=True),
+                    ConfigProperty("fields", "EL expressions for params", type="array"),
+                    ConfigProperty("output-field", "where results land", default="query-result"),
+                    ConfigProperty("only-first", "store only the first row", type="boolean"),
+                    ConfigProperty("mode", "query|execute", default="query"),
+                ),
+            ),
+        )
+    )
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="re-rank",
+            component_type=ComponentType.PROCESSOR,
+            factory=ReRankAgent,
+            composable=True,
+            description="Re-rank retrieved documents (MMR).",
+            config_model=ConfigModel(
+                type="re-rank",
+                properties=props(
+                    ConfigProperty("field", "EL for the candidate docs list"),
+                    ConfigProperty("output-field", "where ranked docs land"),
+                    ConfigProperty("query-embeddings", "EL for the query vector"),
+                    ConfigProperty("embeddings-field", "EL for a doc's vector (record bound)"),
+                    ConfigProperty("text-field", "EL for a doc's text (record bound)"),
+                    ConfigProperty("algorithm", "MMR|cosine", default="MMR"),
+                    ConfigProperty("lambda", "MMR relevance/diversity trade-off", type="number", default=0.5),
+                    ConfigProperty("max", "documents to keep", type="integer", default=5),
+                ),
+            ),
+        )
+    )
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="flare-controller",
+            component_type=ComponentType.PROCESSOR,
+            factory=FlareControllerAgent,
+            composable=False,  # routes to the loop topic
+            description="FLARE active-RAG loop controller.",
+            config_model=ConfigModel(
+                type="flare-controller",
+                properties=props(
+                    ConfigProperty("tokens-field", "EL for generated tokens"),
+                    ConfigProperty("logprobs-field", "EL for per-token logprobs"),
+                    ConfigProperty("min-prob", "confidence threshold", type="number", default=0.2),
+                    ConfigProperty("retrieve-query-field", "where the retrieval query lands"),
+                    ConfigProperty("loop-topic", "topic for another RAG round"),
+                ),
+            ),
+        )
+    )
+    REGISTRY.register_asset(
+        AssetTypeInfo(
+            type="jdbc-table",
+            factory=JdbcTableAssetManager,
+            description="Create/drop a SQL table from DDL statements.",
+            config_model=ConfigModel(
+                type="jdbc-table",
+                properties=props(
+                    ConfigProperty("table-name", "table to manage", required=True),
+                    ConfigProperty("create-statements", "DDL to create", type="array"),
+                    ConfigProperty("delete-statements", "DDL to drop", type="array"),
+                    ConfigProperty("datasource", "datasource config", type="object"),
+                ),
+                allow_unknown=True,
+            ),
+        )
+    )
+
+
+_register()

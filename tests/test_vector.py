@@ -1,0 +1,325 @@
+"""Vector / datasource agent tests.
+
+Mirrors the reference's JdbcDatabaseIT / QueryVectorDBAgent tests /
+ReRankAgent tests (SURVEY §4 tier-2) on the bundled sqlite and local-vector
+backends."""
+
+import json
+import math
+
+import numpy as np
+
+from langstream_tpu.agents.vector import (
+    FlareControllerAgent,
+    LocalVectorDataSource,
+    ReRankAgent,
+    SqliteDataSource,
+)
+from langstream_tpu.api.record import SimpleRecord, header_value
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+from langstream_tpu.runtime.topic_adapters import DESTINATION_HEADER
+
+
+def make_app(pipeline_yaml, configuration_yaml=None):
+    files = {"pipeline.yaml": pipeline_yaml}
+    if configuration_yaml:
+        files["configuration.yaml"] = configuration_yaml
+    return ModelBuilder.build_application_from_files(
+        files, instance_text="instance:\n  streamingCluster:\n    type: memory\n"
+    ).application
+
+
+# ---------------------------------------------------------------------------
+# datasources
+# ---------------------------------------------------------------------------
+
+
+def test_sqlite_datasource(run):
+    async def main():
+        ds = SqliteDataSource({"url": ":memory:"})
+        await ds.execute_statement("CREATE TABLE t (id INTEGER PRIMARY KEY, name TEXT)", [])
+        await ds.execute_statement("INSERT INTO t (name) VALUES (?)", ["alice"])
+        rows = await ds.fetch_data("SELECT * FROM t WHERE name = ?", ["alice"])
+        assert rows == [{"id": 1, "name": "alice"}]
+        await ds.close()
+
+    run(main())
+
+
+def test_local_vector_search(run):
+    async def main():
+        ds = LocalVectorDataSource({})
+        ds.create_index("docs", 4)
+        ds.upsert("docs", "a", [1, 0, 0, 0], {"text": "doc a"})
+        ds.upsert("docs", "b", [0, 1, 0, 0], {"text": "doc b"})
+        ds.upsert("docs", "c", [0.9, 0.1, 0, 0], {"text": "doc c"})
+        rows = await ds.fetch_data(
+            json.dumps({"index": "docs", "vector": [1, 0, 0, 0], "topK": 2}), []
+        )
+        assert [r["id"] for r in rows] == ["a", "c"]
+        assert rows[0]["similarity"] > 0.99
+        assert rows[0]["text"] == "doc a"
+
+    run(main())
+
+
+def test_local_vector_growth_and_upsert(run):
+    async def main():
+        ds = LocalVectorDataSource({})
+        ds.create_index("d", 8)
+        rng = np.random.default_rng(0)
+        for i in range(50):  # force capacity doubling past 16
+            ds.upsert("d", f"v{i}", rng.normal(size=8).tolist(), {"i": i})
+        ds.upsert("d", "v7", [1.0] * 8, {"i": "updated"})  # overwrite
+        rows = ds.search("d", [1.0] * 8, top_k=1)
+        assert rows[0]["id"] == "v7" and rows[0]["i"] == "updated"
+        assert len(ds.search("d", [1.0] * 8, top_k=100)) == 50
+
+    run(main())
+
+
+def test_local_vector_persistence(run, tmp_path):
+    async def main():
+        ds = LocalVectorDataSource({"path": str(tmp_path / "vx")})
+        ds.create_index("docs", 3)
+        ds.upsert("docs", "a", [1, 2, 3], {"text": "hello"})
+        await ds.close()
+        ds2 = LocalVectorDataSource({"path": str(tmp_path / "vx")})
+        rows = ds2.search("docs", [1, 2, 3], top_k=1)
+        assert rows[0]["id"] == "a" and rows[0]["text"] == "hello"
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# vector-db-sink + query-vector-db end-to-end
+# ---------------------------------------------------------------------------
+
+RAG_CONFIG = """
+configuration:
+  resources:
+    - type: datasource
+      name: vdb
+      id: vdb
+      configuration:
+        service: jdbc
+        url: "file:ragtest?mode=memory&cache=shared"
+"""
+
+
+def test_jdbc_sink_and_query_pipeline(run):
+    pipeline = """
+id: p
+assets:
+  - name: docs-table
+    id: docs-table
+    asset-type: jdbc-table
+    creation-mode: create-if-not-exists
+    config:
+      table-name: docs
+      create-statements:
+        - "CREATE TABLE docs (id TEXT PRIMARY KEY, text TEXT)"
+      datasource:
+        url: "file:ragtest?mode=memory&cache=shared"
+topics:
+  - name: in-t
+  - name: q-in
+  - name: q-out
+pipeline:
+  - type: vector-db-sink
+    id: sink
+    input: in-t
+    configuration:
+      datasource: vdb
+      table-name: docs
+      fields:
+        - name: id
+          expression: value.id
+          primary-key: true
+        - name: text
+          expression: value.text
+  - type: query-vector-db
+    id: q
+    input: q-in
+    output: q-out
+    configuration:
+      datasource: vdb
+      query: "SELECT text FROM docs WHERE id = ?"
+      fields:
+        - value.lookup
+      output-field: value.result
+      only-first: true
+"""
+
+    async def main():
+        app = make_app(pipeline, RAG_CONFIG)
+        runner = LocalApplicationRunner("t", app)
+        # the jdbc-table asset creates the table via the shared-cache URI;
+        # keep one anchor connection open so the shared in-memory DB survives
+        # the asset manager's close
+        anchor = SqliteDataSource({"url": "file:ragtest?mode=memory&cache=shared"})
+        await runner.run()
+        ds = runner._service_registry.get_datasource("vdb")
+        rows = await ds.fetch_data(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name='docs'", []
+        )
+        assert rows, "jdbc-table asset did not create the table"
+        await runner.produce("in-t", json.dumps({"id": "d1", "text": "hello world"}))
+        # wait for the sink to land the row
+        import asyncio
+
+        for _ in range(100):
+            rows = await ds.fetch_data("SELECT * FROM docs", [])
+            if rows:
+                break
+            await asyncio.sleep(0.05)
+        assert rows == [{"id": "d1", "text": "hello world"}]
+        # upsert: same pk, new text
+        await runner.produce("in-t", json.dumps({"id": "d1", "text": "updated"}))
+        for _ in range(100):
+            rows = await ds.fetch_data("SELECT * FROM docs", [])
+            if rows and rows[0]["text"] == "updated":
+                break
+            await asyncio.sleep(0.05)
+        assert rows == [{"id": "d1", "text": "updated"}]
+
+        await runner.produce("q-in", json.dumps({"lookup": "d1"}))
+        out = await runner.consume("q-out", 1, timeout=5)
+        await runner.stop()
+        await anchor.close()
+        doc = json.loads(out[0].value)
+        assert doc["result"] == {"text": "updated"}
+
+    run(main())
+
+
+LOCAL_VECTOR_CONFIG = """
+configuration:
+  resources:
+    - type: vector-database
+      name: vdb
+      id: vdb
+      configuration:
+        service: local-vector
+"""
+
+
+def test_local_vector_pipeline(run):
+    pipeline = """
+id: p
+topics:
+  - name: docs-in
+  - name: q-in
+  - name: q-out
+pipeline:
+  - type: vector-db-sink
+    id: sink
+    input: docs-in
+    configuration:
+      datasource: vdb
+      index-name: docs
+      id: value.id
+      vector: value.embeddings
+      fields:
+        - name: text
+          expression: value.text
+  - type: query-vector-db
+    id: q
+    input: q-in
+    output: q-out
+    configuration:
+      datasource: vdb
+      query: '{"index": "docs", "vector": "?", "topK": 2}'
+      fields:
+        - value.embeddings
+      output-field: value.matches
+"""
+
+    async def main():
+        import asyncio
+
+        app = make_app(pipeline, LOCAL_VECTOR_CONFIG)
+        runner = LocalApplicationRunner("t", app)
+        await runner.run()
+        ds = runner._service_registry.get_datasource("vdb")
+        for i, vec in enumerate([[1, 0, 0], [0, 1, 0], [0.8, 0.2, 0]]):
+            await runner.produce(
+                "docs-in", json.dumps({"id": f"d{i}", "embeddings": vec, "text": f"doc {i}"})
+            )
+        for _ in range(100):
+            if ds.has_index("docs") and len(ds.search("docs", [1, 0, 0], 10)) == 3:
+                break
+            await asyncio.sleep(0.05)
+        await runner.produce("q-in", json.dumps({"embeddings": [1, 0, 0]}))
+        out = await runner.consume("q-out", 1, timeout=5)
+        await runner.stop()
+        doc = json.loads(out[0].value)
+        assert [m["id"] for m in doc["matches"]] == ["d0", "d2"]
+        assert doc["matches"][0]["text"] == "doc 0"
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# re-rank + flare
+# ---------------------------------------------------------------------------
+
+
+def test_rerank_mmr(run):
+    async def main():
+        agent = ReRankAgent()
+        await agent.init(
+            {
+                "field": "value.docs",
+                "output-field": "value.ranked",
+                "query-embeddings": "value.query_vec",
+                "embeddings-field": "record.vec",
+                "algorithm": "MMR",
+                "lambda": 0.3,
+                "max": 2,
+            }
+        )
+        docs = [
+            {"id": "close-dup-1", "vec": [1, 0]},
+            {"id": "close-dup-2", "vec": [0.999, 0.001]},
+            {"id": "diverse", "vec": [0.6, 0.8]},
+        ]
+        rec = SimpleRecord.of(json.dumps({"docs": docs, "query_vec": [1, 0]}))
+        out = await agent.process_record(rec)
+        ranked = json.loads(out[0].value)["ranked"]
+        # MMR picks the most relevant first, then the diverse one over the dup
+        assert ranked[0]["id"] == "close-dup-1"
+        assert ranked[1]["id"] == "diverse"
+
+    run(main())
+
+
+def test_flare_controller(run):
+    async def main():
+        agent = FlareControllerAgent()
+        await agent.init(
+            {
+                "tokens-field": "value.tokens",
+                "logprobs-field": "value.logprobs",
+                "min-prob": 0.5,
+                "retrieve-query-field": "value.flare-query",
+                "loop-topic": "retry-t",
+            }
+        )
+        confident = SimpleRecord.of(
+            json.dumps({"tokens": ["a", "b"], "logprobs": [-0.01, -0.02]})
+        )
+        out = await agent.process_record(confident)
+        assert out[0].value == confident.value  # untouched passthrough
+
+        lp_low = math.log(0.1)
+        uncertain = SimpleRecord.of(
+            json.dumps({"tokens": ["Paris", "is", "wrong"], "logprobs": [-0.01, lp_low, lp_low]})
+        )
+        out = await agent.process_record(uncertain)
+        doc = json.loads(out[0].value)
+        assert doc["flare-query"] == "is wrong"
+        assert header_value(out[0], DESTINATION_HEADER) == "retry-t"
+
+    run(main())
