@@ -620,7 +620,7 @@ def _register() -> None:
                     ConfigProperty("datasource", "resource id"),
                     ConfigProperty("query", "query text / JSON dialect", required=True),
                     ConfigProperty("fields", "EL expressions for params", type="array"),
-                    ConfigProperty("output-field", "where results land", default="query-result"),
+                    ConfigProperty("output-field", "where results land", default="value.query-result"),
                     ConfigProperty("only-first", "store only the first row", type="boolean"),
                     ConfigProperty("mode", "query|execute", default="query"),
                 ),
