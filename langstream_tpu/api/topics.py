@@ -95,6 +95,10 @@ class TopicReader(abc.ABC):
 class TopicReadResult:
     records: list[Record]
     offset: dict[int, int]
+    # per-record resume positions: record_offsets[i] is the offset map to
+    # restart AFTER records[i]; resuming from the batch-level ``offset`` for a
+    # mid-batch record would skip the rest of the batch
+    record_offsets: Optional[list[dict[int, int]]] = None
 
 
 class TopicAdmin(abc.ABC):

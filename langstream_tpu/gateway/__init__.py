@@ -1,0 +1,38 @@
+"""API gateway (L6): websocket + HTTP surface onto application topics.
+
+Parity: reference ``langstream-api-gateway/`` — websocket
+``/v1/{consume,produce,chat}/{tenant}/{application}/{gateway}``
+(WebSocketConfig.java:47-49), HTTP ``/api/gateways/...`` including the
+``service`` request-reply / agent-proxy endpoint (GatewayResource.java:72-360),
+pluggable authentication (langstream-api-gateway-auth).
+"""
+
+from langstream_tpu.gateway.auth import (
+    GatewayAuthenticationProvider,
+    GatewayAuthenticationRegistry,
+    GatewayAuthenticationResult,
+)
+from langstream_tpu.gateway.core import (
+    AuthFailedException,
+    ConsumeGateway,
+    GatewayRequestContext,
+    ProduceException,
+    ProduceGateway,
+    build_message_filters,
+    resolve_common_headers,
+)
+from langstream_tpu.gateway.server import GatewayServer
+
+__all__ = [
+    "AuthFailedException",
+    "ConsumeGateway",
+    "GatewayAuthenticationProvider",
+    "GatewayAuthenticationRegistry",
+    "GatewayAuthenticationResult",
+    "GatewayRequestContext",
+    "GatewayServer",
+    "ProduceException",
+    "ProduceGateway",
+    "build_message_filters",
+    "resolve_common_headers",
+]

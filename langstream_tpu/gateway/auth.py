@@ -1,0 +1,164 @@
+"""Gateway authentication SPI + built-in providers.
+
+Parity: reference ``api/gateway/GatewayAuthenticationProvider.java`` and the
+``langstream-api-gateway-auth`` plugin modules (jwt / http webhook / test
+credentials via ``GatewayRequestHandler``).
+
+A gateway declares ``authentication: {provider, configuration,
+allow-test-mode}``; clients pass ``credentials`` (or ``test-credentials``)
+as a query parameter.  The provider validates the credential and returns
+*principal values* that header mappings and consume filters can reference
+via ``value-from-authentication``.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class GatewayAuthenticationResult:
+    authenticated: bool
+    reason: Optional[str] = None
+    principal_values: dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def success(principal_values: Optional[dict[str, str]] = None) -> "GatewayAuthenticationResult":
+        return GatewayAuthenticationResult(True, None, dict(principal_values or {}))
+
+    @staticmethod
+    def failure(reason: str) -> "GatewayAuthenticationResult":
+        return GatewayAuthenticationResult(False, reason, {})
+
+
+class GatewayAuthenticationProvider(abc.ABC):
+    """One auth scheme (reference GatewayAuthenticationProvider)."""
+
+    @abc.abstractmethod
+    def initialize(self, configuration: dict[str, Any]) -> None: ...
+
+    @abc.abstractmethod
+    async def authenticate(self, credentials: str) -> GatewayAuthenticationResult: ...
+
+
+class NoAuthProvider(GatewayAuthenticationProvider):
+    def initialize(self, configuration: dict[str, Any]) -> None:
+        pass
+
+    async def authenticate(self, credentials: str) -> GatewayAuthenticationResult:
+        return GatewayAuthenticationResult.success()
+
+
+class HmacJwtAuthProvider(GatewayAuthenticationProvider):
+    """HS256 JWT validation (reference auth-jwt AuthenticationProviderToken,
+    dependency-free: RS256/JWKS needs a crypto lib the image doesn't ship).
+
+    configuration: ``secret-key`` (required), ``audience`` / ``issuer``
+    (optional checks).  Principal values = all string claims.
+    """
+
+    def initialize(self, configuration: dict[str, Any]) -> None:
+        self._secret = str(configuration.get("secret-key", ""))
+        self._audience = configuration.get("audience")
+        self._issuer = configuration.get("issuer")
+        if not self._secret:
+            raise ValueError("jwt auth requires configuration.secret-key")
+
+    async def authenticate(self, credentials: str) -> GatewayAuthenticationResult:
+        try:
+            header_b64, payload_b64, sig_b64 = credentials.split(".")
+        except ValueError:
+            return GatewayAuthenticationResult.failure("malformed JWT")
+
+        def b64d(s: str) -> bytes:
+            return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+        try:
+            header = json.loads(b64d(header_b64))
+            payload = json.loads(b64d(payload_b64))
+            signature = b64d(sig_b64)
+        except Exception:
+            return GatewayAuthenticationResult.failure("undecodable JWT")
+        if header.get("alg") != "HS256":
+            return GatewayAuthenticationResult.failure("only HS256 supported")
+        expected = hmac.new(
+            self._secret.encode(), f"{header_b64}.{payload_b64}".encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(signature, expected):
+            return GatewayAuthenticationResult.failure("bad signature")
+        if "exp" in payload and time.time() > float(payload["exp"]):
+            return GatewayAuthenticationResult.failure("token expired")
+        if self._audience is not None and payload.get("aud") != self._audience:
+            return GatewayAuthenticationResult.failure("bad audience")
+        if self._issuer is not None and payload.get("iss") != self._issuer:
+            return GatewayAuthenticationResult.failure("bad issuer")
+        values = {k: str(v) for k, v in payload.items() if isinstance(v, (str, int, float))}
+        if "sub" in payload:
+            values.setdefault("subject", str(payload["sub"]))
+        return GatewayAuthenticationResult.success(values)
+
+
+class HttpWebhookAuthProvider(GatewayAuthenticationProvider):
+    """POSTs the credential to an external endpoint; 2xx = authenticated
+    (reference langstream-api-gateway-auth ``http`` provider)."""
+
+    def initialize(self, configuration: dict[str, Any]) -> None:
+        self._base_url = str(configuration.get("base-url", ""))
+        self._path = str(configuration.get("path-template", "/auth"))
+        self._headers = dict(configuration.get("headers", {}))
+        if not self._base_url:
+            raise ValueError("http auth requires configuration.base-url")
+
+    async def authenticate(self, credentials: str) -> GatewayAuthenticationResult:
+        import aiohttp
+
+        url = self._base_url.rstrip("/") + self._path
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                url,
+                headers={"Authorization": f"Bearer {credentials}", **self._headers},
+            ) as resp:
+                if 200 <= resp.status < 300:
+                    try:
+                        body = await resp.json(content_type=None)
+                    except Exception:
+                        body = {}
+                    values = (
+                        {k: str(v) for k, v in body.items()} if isinstance(body, dict) else {}
+                    )
+                    return GatewayAuthenticationResult.success(values)
+                return GatewayAuthenticationResult.failure(f"webhook returned {resp.status}")
+
+
+class GatewayAuthenticationRegistry:
+    """provider name → factory (reference GatewayAuthenticationProviderRegistry)."""
+
+    _factories: dict[str, Callable[[], GatewayAuthenticationProvider]] = {}
+
+    @classmethod
+    def register(cls, name: str, factory: Callable[[], GatewayAuthenticationProvider]) -> None:
+        cls._factories[name] = factory
+
+    @classmethod
+    def load(cls, name: str, configuration: dict[str, Any]) -> GatewayAuthenticationProvider:
+        cls._ensure_builtins()
+        factory = cls._factories.get(name)
+        if factory is None:
+            known = ", ".join(sorted(cls._factories))
+            raise ValueError(f"unknown auth provider {name!r}; known: {known}")
+        provider = factory()
+        provider.initialize(configuration)
+        return provider
+
+    @classmethod
+    def _ensure_builtins(cls) -> None:
+        cls._factories.setdefault("none", NoAuthProvider)
+        cls._factories.setdefault("jwt", HmacJwtAuthProvider)
+        cls._factories.setdefault("http", HttpWebhookAuthProvider)

@@ -1,0 +1,460 @@
+"""aiohttp gateway server: websocket + HTTP endpoints.
+
+Parity: reference ``langstream-api-gateway`` —
+  WS  /v1/produce/{tenant}/{application}/{gateway}   (ProduceHandler)
+  WS  /v1/consume/{tenant}/{application}/{gateway}   (ConsumeHandler)
+  WS  /v1/chat/{tenant}/{application}/{gateway}      (ChatHandler.java:63)
+  POST /api/gateways/produce/{tenant}/{application}/{gateway}  (GatewayResource.java:95)
+  POST /api/gateways/service/{tenant}/{application}/{gateway}  (GatewayResource.java:72,335:
+       topic request-reply via the langstream-service-request-id header, or
+       HTTP proxy to the agent's service pod when service-options.agent-id set)
+
+The server is storage-agnostic: an ``ApplicationProvider`` resolves
+``(tenant, application)`` → parsed Application + its topic-connections
+runtime (the control plane and the local runner both implement it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional, Protocol
+
+from aiohttp import WSMsgType, web
+
+from langstream_tpu.api.model import Application, Gateway
+from langstream_tpu.api.record import Header, Record
+from langstream_tpu.api.topics import TopicConnectionsRuntime
+from langstream_tpu.gateway.core import (
+    AuthFailedException,
+    ConsumeGateway,
+    GatewayRequestContext,
+    ProduceException,
+    ProduceGateway,
+    authenticate_and_validate,
+    build_message_filters,
+    resolve_common_headers,
+)
+
+log = logging.getLogger(__name__)
+
+SERVICE_REQUEST_ID_HEADER = "langstream-service-request-id"
+
+
+@dataclass
+class GatewayApplication:
+    application: Application
+    topic_runtime: TopicConnectionsRuntime
+
+
+class ApplicationProvider(Protocol):
+    async def get_application(self, tenant: str, application_id: str) -> GatewayApplication: ...
+
+    def agent_service_uri(self, tenant: str, application_id: str, agent_id: str) -> Optional[str]:
+        """Base URI of a deployed service agent (for service-gateway proxying);
+        None when unknown (local mode without pods)."""
+        return None
+
+
+class DictApplicationProvider:
+    """In-memory provider for tests and the local runner."""
+
+    def __init__(self) -> None:
+        self._apps: dict[tuple[str, str], GatewayApplication] = {}
+        self._service_uris: dict[tuple[str, str, str], str] = {}
+
+    def put(
+        self,
+        tenant: str,
+        application_id: str,
+        application: Application,
+        topic_runtime: TopicConnectionsRuntime,
+    ) -> None:
+        self._apps[(tenant, application_id)] = GatewayApplication(application, topic_runtime)
+
+    def put_service_uri(self, tenant: str, application_id: str, agent_id: str, uri: str) -> None:
+        self._service_uris[(tenant, application_id, agent_id)] = uri
+
+    async def get_application(self, tenant: str, application_id: str) -> GatewayApplication:
+        key = (tenant, application_id)
+        if key not in self._apps:
+            raise KeyError(f"application {tenant}/{application_id} not found")
+        return self._apps[key]
+
+    def agent_service_uri(self, tenant: str, application_id: str, agent_id: str) -> Optional[str]:
+        return self._service_uris.get((tenant, application_id, agent_id))
+
+
+class GatewayServer:
+    def __init__(
+        self,
+        provider: ApplicationProvider,
+        host: str = "127.0.0.1",
+        port: int = 8091,
+        test_auth_provider: Optional[Any] = None,
+    ) -> None:
+        """``test_auth_provider``: server-level provider validating
+        ``test-credentials``; when None (production default) test mode is
+        rejected (reference GatewayRequestHandler.authenticate:229-240)."""
+        self.provider = provider
+        self.host = host
+        self.port = port
+        self.test_auth_provider = test_auth_provider
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/v1/produce/{tenant}/{application}/{gateway}", self._ws_produce),
+                web.get("/v1/consume/{tenant}/{application}/{gateway}", self._ws_consume),
+                web.get("/v1/chat/{tenant}/{application}/{gateway}", self._ws_chat),
+                web.post("/api/gateways/produce/{tenant}/{application}/{gateway}", self._http_produce),
+                web.route(
+                    "*",
+                    "/api/gateways/service/{tenant}/{application}/{gateway}{tail:.*}",
+                    self._http_service,
+                ),
+                web.get("/healthz", self._healthz),
+            ]
+        )
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "OK"})
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        log.info("gateway listening on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def ws_url(self) -> str:
+        return f"ws://{self.host}:{self.port}"
+
+    # -- shared request setup ------------------------------------------------
+
+    async def _context(
+        self, request: web.Request, expected_type: str
+    ) -> tuple[GatewayRequestContext, GatewayApplication]:
+        tenant = request.match_info["tenant"]
+        application_id = request.match_info["application"]
+        gateway_id = request.match_info["gateway"]
+        try:
+            gw_app = await self.provider.get_application(tenant, application_id)
+        except KeyError as e:
+            raise web.HTTPNotFound(reason=str(e)) from e
+        gateway = self._find_gateway(gw_app.application, gateway_id, expected_type)
+        raw_params = {k: v for k, v in request.query.items()}
+        try:
+            context = await authenticate_and_validate(
+                tenant,
+                application_id,
+                gw_app.application,
+                gateway,
+                raw_params,
+                test_auth_provider=self.test_auth_provider,
+            )
+        except AuthFailedException as e:
+            raise web.HTTPUnauthorized(reason=str(e)) from e
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from e
+        return context, gw_app
+
+    @staticmethod
+    def _find_gateway(application: Application, gateway_id: str, expected_type: str) -> Gateway:
+        for g in application.gateways:
+            if g.id == gateway_id:
+                if g.type != expected_type:
+                    raise web.HTTPBadRequest(
+                        reason=f"gateway {gateway_id!r} is of type {g.type}, not {expected_type}"
+                    )
+                return g
+        raise web.HTTPNotFound(reason=f"gateway {gateway_id!r} not found")
+
+    # -- websocket handlers --------------------------------------------------
+
+    async def _ws_produce(self, request: web.Request) -> web.WebSocketResponse:
+        context, gw_app = await self._context(request, "produce")
+        topic = context.gateway.topic
+        if not topic:
+            raise web.HTTPBadRequest(reason="produce gateway has no topic")
+        mappings = (
+            context.gateway.produce_options.headers if context.gateway.produce_options else []
+        )
+        headers = resolve_common_headers(
+            mappings, context.user_parameters, context.principal_values
+        )
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        produce = ProduceGateway(gw_app.topic_runtime)
+        try:
+            await produce.start(topic, headers)
+            await self._publish_event("ClientConnected", context, gw_app)
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                await ws.send_json(await self._safe_produce(produce, msg.data))
+        finally:
+            await produce.close()
+            await self._publish_event("ClientDisconnected", context, gw_app)
+        return ws
+
+    @staticmethod
+    async def _safe_produce(produce: ProduceGateway, payload: str) -> dict[str, Any]:
+        try:
+            await produce.produce_payload(payload)
+            return {"status": "OK", "reason": None}
+        except ProduceException as e:
+            return {"status": e.status, "reason": str(e)}
+
+    async def _ws_consume(self, request: web.Request) -> web.WebSocketResponse:
+        context, gw_app = await self._context(request, "consume")
+        topic = context.gateway.topic
+        if not topic:
+            raise web.HTTPBadRequest(reason="consume gateway has no topic")
+        mappings = (
+            (context.gateway.consume_options.filters or {}).get("headers", [])
+            if context.gateway.consume_options
+            else []
+        )
+        filters = build_message_filters(
+            mappings, context.user_parameters, context.principal_values
+        )
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        consume = ConsumeGateway(gw_app.topic_runtime)
+        try:
+            await consume.setup(topic, filters, context.options.get("position"))
+            consume.start_reading(ws.send_str, on_error=lambda e: ws.close())
+            await self._publish_event("ClientConnected", context, gw_app)
+            async for _ in ws:  # client messages are ignored; close ends the loop
+                pass
+        finally:
+            await consume.close()
+            await self._publish_event("ClientDisconnected", context, gw_app)
+        return ws
+
+    async def _ws_chat(self, request: web.Request) -> web.WebSocketResponse:
+        """One socket: produce to questions-topic, filtered consume from
+        answers-topic (reference ChatHandler.java:63-140)."""
+        context, gw_app = await self._context(request, "chat")
+        chat = context.gateway.chat_options
+        if chat is None or not chat.questions_topic or not chat.answers_topic:
+            raise web.HTTPBadRequest(
+                reason="chat gateway requires chat-options.questions-topic and answers-topic"
+            )
+        headers = resolve_common_headers(
+            chat.headers, context.user_parameters, context.principal_values
+        )
+        filters = build_message_filters(
+            chat.headers, context.user_parameters, context.principal_values
+        )
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        produce = ProduceGateway(gw_app.topic_runtime)
+        consume = ConsumeGateway(gw_app.topic_runtime)
+        try:
+            await produce.start(chat.questions_topic, headers)
+            await consume.setup(chat.answers_topic, filters, context.options.get("position"))
+            consume.start_reading(ws.send_str, on_error=lambda e: ws.close())
+            await self._publish_event("ClientConnected", context, gw_app)
+            async for msg in ws:
+                if msg.type != WSMsgType.TEXT:
+                    continue
+                response = await self._safe_produce(produce, msg.data)
+                if response["status"] != "OK":
+                    await ws.send_json(response)
+        finally:
+            await consume.close()
+            await produce.close()
+            await self._publish_event("ClientDisconnected", context, gw_app)
+        return ws
+
+    async def _publish_event(
+        self, event: str, context: GatewayRequestContext, gw_app: GatewayApplication
+    ) -> None:
+        """Emit a gateway lifecycle event when the gateway declares an
+        events-topic (reference api/events GatewayEventData)."""
+        if not context.gateway.events_topic:
+            return
+        try:
+            await publish_gateway_event(
+                gw_app.topic_runtime, context.gateway.events_topic, event, context
+            )
+        except Exception:  # noqa: BLE001 — events are best-effort
+            log.exception("failed to publish gateway event")
+
+    # -- HTTP handlers -------------------------------------------------------
+
+    async def _http_produce(self, request: web.Request) -> web.Response:
+        context, gw_app = await self._context(request, "produce")
+        topic = context.gateway.topic
+        if not topic:
+            raise web.HTTPBadRequest(reason="produce gateway has no topic")
+        mappings = (
+            context.gateway.produce_options.headers if context.gateway.produce_options else []
+        )
+        headers = resolve_common_headers(
+            mappings, context.user_parameters, context.principal_values
+        )
+        produce = ProduceGateway(gw_app.topic_runtime)
+        await produce.start(topic, headers)
+        try:
+            body = await request.text()
+            response = await self._safe_produce(produce, body)
+        finally:
+            await produce.close()
+        status = 200 if response["status"] == "OK" else 400
+        return web.json_response(response, status=status)
+
+    async def _http_service(self, request: web.Request) -> web.Response:
+        context, gw_app = await self._context(request, "service")
+        service = context.gateway.service_options
+        if service is None:
+            raise web.HTTPBadRequest(reason="service gateway requires service-options")
+
+        if service.agent_id:
+            return await self._proxy_to_agent(request, context, service.agent_id)
+
+        if request.method.upper() != "POST":
+            raise web.HTTPBadRequest(reason="Only POST method is supported")
+        if not service.input_topic or not service.output_topic:
+            raise web.HTTPBadRequest(
+                reason="service gateway requires input-topic and output-topic"
+            )
+
+        request_id = str(uuid.uuid4())
+        payload = await request.text()
+        try:
+            produce_request = ProduceGateway.parse_produce_request(payload)
+        except ProduceException as e:
+            return web.json_response({"status": e.status, "reason": str(e)}, status=400)
+        passed_headers = dict(produce_request.get("headers") or {})
+        passed_headers[SERVICE_REQUEST_ID_HEADER] = request_id
+        produce_request["headers"] = passed_headers
+        try:
+            timeout = float(context.options.get("timeout", "30"))
+        except ValueError:
+            raise web.HTTPBadRequest(reason="option:timeout must be a number") from None
+
+        filters = build_message_filters(
+            service.headers, context.user_parameters, context.principal_values
+        )
+
+        def request_id_filter(record: Record) -> bool:
+            for h in record.headers:
+                if h.key == SERVICE_REQUEST_ID_HEADER:
+                    return h.value_as_string() == request_id
+            return False
+
+        filters.append(request_id_filter)
+
+        reply: asyncio.Future[str] = asyncio.get_event_loop().create_future()
+
+        def on_message(message: str) -> None:
+            if not reply.done():
+                reply.set_result(message)
+
+        consume = ConsumeGateway(gw_app.topic_runtime)
+        produce = ProduceGateway(gw_app.topic_runtime)
+        try:
+            await consume.setup(service.output_topic, filters, "latest")
+            consume.start_reading(on_message)
+            headers = resolve_common_headers(
+                service.headers, context.user_parameters, context.principal_values
+            )
+            await produce.start(service.input_topic, headers)
+            await produce.produce(produce_request)
+            try:
+                message = await asyncio.wait_for(reply, timeout)
+            except asyncio.TimeoutError:
+                raise web.HTTPGatewayTimeout(reason="no reply from pipeline") from None
+            return web.json_response(json.loads(message))
+        except ProduceException as e:
+            return web.json_response({"status": e.status, "reason": str(e)}, status=400)
+        finally:
+            await consume.close()
+            await produce.close()
+
+    async def _proxy_to_agent(
+        self, request: web.Request, context: GatewayRequestContext, agent_id: str
+    ) -> web.Response:
+        """Forward the HTTP request to a service agent (GatewayResource:335-360)."""
+        import aiohttp
+
+        uri = self.provider.agent_service_uri(context.tenant, context.application_id, agent_id)
+        if uri is None:
+            raise web.HTTPBadGateway(reason=f"no service URI known for agent {agent_id!r}")
+        tail = request.match_info.get("tail", "")
+        target = uri.rstrip("/") + (tail or "/")
+        if request.query_string:
+            target += "?" + request.query_string
+        body = await request.read()
+        async with aiohttp.ClientSession() as session:
+            async with session.request(
+                request.method,
+                target,
+                data=body if body else None,
+                headers={
+                    k: v
+                    for k, v in request.headers.items()
+                    if k.lower() not in ("host", "connection", "content-length")
+                },
+            ) as resp:
+                data = await resp.read()
+                return web.Response(
+                    body=data,
+                    status=resp.status,
+                    content_type=resp.content_type,
+                )
+
+
+def gateway_events_record(event: str, context: GatewayRequestContext) -> dict[str, Any]:
+    """Lifecycle event payload (reference api/events EventRecord/GatewayEventData)."""
+    return {
+        "category": "Gateway",
+        "type": event,
+        "source": f"{context.tenant}/{context.application_id}/{context.gateway.id}",
+        "data": {
+            "gateway-id": context.gateway.id,
+            "gateway-type": context.gateway.type,
+            "user-parameters": context.user_parameters,
+            "options": context.options,
+        },
+    }
+
+
+async def publish_gateway_event(
+    topic_runtime: TopicConnectionsRuntime,
+    events_topic: str,
+    event: str,
+    context: GatewayRequestContext,
+) -> None:
+    producer = topic_runtime.create_producer("gateway-events", events_topic)
+    await producer.start()
+    try:
+        from langstream_tpu.api.record import SimpleRecord
+
+        payload = gateway_events_record(event, context)
+        await producer.write(
+            SimpleRecord.of(json.dumps(payload), headers=[Header("ls-event-type", event)])
+        )
+    finally:
+        await producer.close()

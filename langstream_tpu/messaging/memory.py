@@ -308,23 +308,27 @@ class MemoryTopicReader(TopicReader):
             else:  # latest
                 self._pos[p] = len(part.records)
 
-    def _poll(self) -> list[Record]:
+    def _poll(self) -> tuple[list[Record], list[dict[int, int]]]:
         topic = self.broker._get_or_create(self.topic_name)
         out: list[Record] = []
+        offsets: list[dict[int, int]] = []
         for p, part in enumerate(topic.partitions):
             pos = self._pos.get(p, 0)
             while pos < len(part.records):
                 out.append(part.records[pos])
                 pos += 1
+                resume = dict(self._pos)
+                resume[p] = pos
+                offsets.append(resume)
             self._pos[p] = pos
-        return out
+        return out, offsets
 
     async def read(self) -> TopicReadResult:
-        out = self._poll()
+        out, offsets = self._poll()
         if not out:
             await self.broker.wait_for_data(self.poll_timeout)
-            out = self._poll()
-        return TopicReadResult(out, dict(self._pos))
+            out, offsets = self._poll()
+        return TopicReadResult(out, dict(self._pos), record_offsets=offsets)
 
 
 class MemoryTopicAdmin(TopicAdmin):

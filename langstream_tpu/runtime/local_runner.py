@@ -88,6 +88,23 @@ class LocalApplicationRunner:
                 self.runners.append(runner)
         return self.plan
 
+    @property
+    def topic_runtime(self):
+        """The app's topic-connections runtime (available after deploy())."""
+        return self._topic_runtime
+
+    async def serve_gateway(self, host: str = "127.0.0.1", port: int = 0):
+        """Start an API gateway bound to this application (the embedded
+        gateway of reference LocalApplicationRunner / `langstream docker run`)."""
+        from langstream_tpu.gateway.server import DictApplicationProvider, GatewayServer
+
+        assert self._topic_runtime is not None, "deploy() first"
+        provider = DictApplicationProvider()
+        provider.put(self.tenant, self.application_id, self.application, self._topic_runtime)
+        server = GatewayServer(provider, host=host, port=port)
+        await server.start()
+        return server
+
     def _on_critical_failure(self, error: BaseException) -> None:
         self._failed = error
         for r in self.runners:

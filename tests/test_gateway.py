@@ -1,0 +1,321 @@
+"""Gateway tests: WS produce/consume/chat, HTTP produce/service, auth.
+
+Mirrors reference ProduceConsumeHandlerTest / GatewayResourceTest scenarios
+on the in-memory broker.
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+
+import aiohttp
+import pytest
+
+from langstream_tpu.core.parser import ModelBuilder
+
+GATEWAYS = """
+gateways:
+  - id: produce-in
+    type: produce
+    topic: input-topic
+    parameters: [sessionId]
+    produce-options:
+      headers:
+        - key: session-id
+          value-from-parameters: sessionId
+  - id: consume-out
+    type: consume
+    topic: output-topic
+    parameters: [sessionId]
+    consume-options:
+      filters:
+        headers:
+          - key: session-id
+            value-from-parameters: sessionId
+  - id: chat
+    type: chat
+    chat-options:
+      questions-topic: input-topic
+      answers-topic: output-topic
+      headers:
+        - key: session-id
+          value-from-parameters: sessionId
+    parameters: [sessionId]
+  - id: svc
+    type: service
+    service-options:
+      input-topic: input-topic
+      output-topic: output-topic
+  - id: secured
+    type: produce
+    topic: input-topic
+    authentication:
+      provider: jwt
+      configuration:
+        secret-key: s3cret
+    produce-options:
+      headers:
+        - key: user
+          value-from-authentication: subject
+"""
+
+PIPELINE = """
+module: default
+id: p
+name: echo
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: echo
+    type: identity
+    input: input-topic
+    output: output-topic
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+"""
+
+
+def build_app():
+    return ModelBuilder.build_application_from_files(
+        {"pipeline.yaml": PIPELINE, "gateways.yaml": GATEWAYS}, INSTANCE, None
+    ).application
+
+
+async def start_platform():
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    runner = LocalApplicationRunner("gw-test", build_app())
+    await runner.deploy()
+    await runner.start()
+    server = await runner.serve_gateway()
+    return runner, server
+
+
+def make_jwt(payload: dict, secret: str = "s3cret") -> str:
+    def b64(b: bytes) -> str:
+        return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+    header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = b64(json.dumps(payload).encode())
+    sig = b64(hmac.new(secret.encode(), f"{header}.{body}".encode(), hashlib.sha256).digest())
+    return f"{header}.{body}.{sig}"
+
+
+def test_ws_produce_consume_roundtrip(run):
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                consume_url = (
+                    f"{server.ws_url}/v1/consume/default/gw-test/consume-out"
+                    "?param:sessionId=s1&option:position=earliest"
+                )
+                produce_url = f"{server.ws_url}/v1/produce/default/gw-test/produce-in?param:sessionId=s1"
+                async with session.ws_connect(consume_url) as consume_ws:
+                    async with session.ws_connect(produce_url) as produce_ws:
+                        await produce_ws.send_str(json.dumps({"value": "hello"}))
+                        ack = json.loads((await produce_ws.receive()).data)
+                        assert ack["status"] == "OK"
+                    msg = await asyncio.wait_for(consume_ws.receive(), 10)
+                    push = json.loads(msg.data)
+                    assert push["record"]["value"] == "hello"
+                    assert push["record"]["headers"]["session-id"] == "s1"
+                    assert push["offset"]
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_consume_filters_by_session(run):
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                consume_url = (
+                    f"{server.ws_url}/v1/consume/default/gw-test/consume-out"
+                    "?param:sessionId=s2&option:position=earliest"
+                )
+                async with session.ws_connect(consume_url) as consume_ws:
+                    for sid, val in [("s1", "other"), ("s2", "mine")]:
+                        url = f"{server.ws_url}/v1/produce/default/gw-test/produce-in?param:sessionId={sid}"
+                        async with session.ws_connect(url) as produce_ws:
+                            await produce_ws.send_str(json.dumps({"value": val}))
+                            await produce_ws.receive()
+                    msg = await asyncio.wait_for(consume_ws.receive(), 10)
+                    push = json.loads(msg.data)
+                    assert push["record"]["value"] == "mine"
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_ws_chat(run):
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                url = f"{server.ws_url}/v1/chat/default/gw-test/chat?param:sessionId=abc"
+                async with session.ws_connect(url) as ws:
+                    await ws.send_str(json.dumps({"value": "question"}))
+                    msg = await asyncio.wait_for(ws.receive(), 10)
+                    push = json.loads(msg.data)
+                    assert push["record"]["value"] == "question"
+                    assert push["record"]["headers"]["session-id"] == "abc"
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_http_produce_and_param_validation(run):
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                # missing required param
+                url = f"{server.url}/api/gateways/produce/default/gw-test/produce-in"
+                async with session.post(url, data=json.dumps({"value": "x"})) as resp:
+                    assert resp.status == 400
+                # bad param name
+                async with session.post(url + "?bogus=1", data="{}") as resp:
+                    assert resp.status == 400
+                # ok
+                async with session.post(
+                    url + "?param:sessionId=s9", data=json.dumps({"value": "x"})
+                ) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["status"] == "OK"
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_http_service_request_reply(run):
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                url = f"{server.url}/api/gateways/service/default/gw-test/svc"
+                async with session.post(url, data=json.dumps({"value": "ping"})) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                    assert body["record"]["value"] == "ping"
+                    assert "langstream-service-request-id" in body["record"]["headers"]
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_jwt_auth(run):
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                base = f"{server.ws_url}/v1/produce/default/gw-test/secured"
+                # no credentials
+                with pytest.raises(aiohttp.WSServerHandshakeError):
+                    await session.ws_connect(base)
+                # bad token
+                with pytest.raises(aiohttp.WSServerHandshakeError):
+                    await session.ws_connect(
+                        base + "?credentials=" + make_jwt({"sub": "alice"}, secret="wrong")
+                    )
+                # good token: header from authentication principal
+                token = make_jwt({"sub": "alice"})
+                async with session.ws_connect(base + f"?credentials={token}") as ws:
+                    await ws.send_str(json.dumps({"value": "hi"}))
+                    ack = json.loads((await ws.receive()).data)
+                    assert ack["status"] == "OK"
+                # test-credentials REJECTED: no server-level test auth provider
+                with pytest.raises(aiohttp.WSServerHandshakeError):
+                    await session.ws_connect(base + "?test-credentials=anything")
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_test_credentials_with_server_provider(run):
+    async def scenario():
+        from langstream_tpu.gateway.auth import NoAuthProvider
+        from langstream_tpu.gateway.server import DictApplicationProvider, GatewayServer
+        from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+        runner = LocalApplicationRunner("gw-test", build_app())
+        await runner.deploy()
+        await runner.start()
+        provider = DictApplicationProvider()
+        provider.put("default", "gw-test", runner.application, runner.topic_runtime)
+        server = GatewayServer(provider, port=0, test_auth_provider=NoAuthProvider())
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                base = f"{server.ws_url}/v1/produce/default/gw-test/secured"
+                async with session.ws_connect(base + "?test-credentials=anything") as ws:
+                    await ws.send_str(json.dumps({"value": "hi"}))
+                    ack = json.loads((await ws.receive()).data)
+                    assert ack["status"] == "OK"
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_consume_offset_resume(run):
+    """Per-record offsets: resuming from a mid-batch record's token must not
+    skip the rest of the batch."""
+
+    async def scenario():
+        runner, server = await start_platform()
+        try:
+            async with aiohttp.ClientSession() as session:
+                # produce three records in one quick burst
+                url = f"{server.ws_url}/v1/produce/default/gw-test/produce-in?param:sessionId=s1"
+                async with session.ws_connect(url) as produce_ws:
+                    for i in range(3):
+                        await produce_ws.send_str(json.dumps({"value": f"m{i}"}))
+                        await produce_ws.receive()
+                consume_url = (
+                    f"{server.ws_url}/v1/consume/default/gw-test/consume-out"
+                    "?param:sessionId=s1&option:position=earliest"
+                )
+                async with session.ws_connect(consume_url) as ws:
+                    first = json.loads((await asyncio.wait_for(ws.receive(), 10)).data)
+                    assert first["record"]["value"] == "m0"
+                    resume_token = first["offset"]
+                # reconnect from after m0 — must see m1 then m2
+                resume_url = (
+                    f"{server.ws_url}/v1/consume/default/gw-test/consume-out"
+                    f"?param:sessionId=s1&option:position={resume_token}"
+                )
+                async with session.ws_connect(resume_url) as ws:
+                    second = json.loads((await asyncio.wait_for(ws.receive(), 10)).data)
+                    assert second["record"]["value"] == "m1"
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())

@@ -98,7 +98,9 @@ async def run_pipeline_once(app, value, input_topic="input-topic", output_topic=
     await runner.start()
     try:
         await runner.produce(input_topic, value)
-        out = await runner.consume(output_topic, n=1, timeout=10)
+        # generous timeout: first JAX compile on a cold persistent cache can
+        # take tens of seconds on the shared CI machine
+        out = await runner.consume(output_topic, n=1, timeout=60)
         return out[0], runner
     finally:
         await runner.stop()
