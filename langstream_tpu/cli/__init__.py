@@ -1,0 +1,7 @@
+"""CLI (L9): the `langstream-tpu` command.
+
+Parity: reference ``langstream-cli`` (picocli ``RootCmd.java:27-37``) —
+subcommands apps / tenants / gateway / archetypes / run-local (the
+``docker run`` analogue: whole platform in-process) / profiles /
+configure, plus the admin HTTP client (``langstream-admin-client``).
+"""

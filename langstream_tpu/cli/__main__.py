@@ -1,0 +1,3 @@
+from langstream_tpu.cli.main import main
+
+main()

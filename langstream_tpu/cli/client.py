@@ -1,0 +1,159 @@
+"""Admin HTTP client with retries (reference ``langstream-admin-client``
+AdminClient / HttpClientFacade / ExponentialRetryPolicy)."""
+
+from __future__ import annotations
+
+import io
+import time
+import zipfile
+from pathlib import Path
+from typing import Any, Optional
+
+import requests
+
+
+class AdminClientError(Exception):
+    def __init__(self, message: str, status: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class AdminClient:
+    def __init__(
+        self,
+        base_url: str,
+        tenant: str = "default",
+        token: Optional[str] = None,
+        retries: int = 3,
+        backoff_s: float = 0.5,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.tenant = tenant
+        self.token = token
+        self.retries = retries
+        self.backoff_s = backoff_s
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _headers(self) -> dict[str, str]:
+        return {"Authorization": f"Bearer {self.token}"} if self.token else {}
+
+    def _request(self, method: str, path: str, **kwargs: Any) -> requests.Response:
+        url = self.base_url + path
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                resp = requests.request(
+                    method, url, headers=self._headers(), timeout=60, **kwargs
+                )
+            except requests.ConnectionError as e:
+                last = e
+                if attempt + 1 < self.retries:
+                    time.sleep(self.backoff_s * (2**attempt))
+                continue
+            if resp.status_code >= 500 and attempt + 1 < self.retries:
+                time.sleep(self.backoff_s * (2**attempt))
+                continue
+            if resp.status_code >= 400:
+                try:
+                    reason = resp.json().get("error", resp.text)
+                except Exception:  # noqa: BLE001
+                    reason = resp.text
+                raise AdminClientError(
+                    f"{method} {path} → {resp.status_code}: {reason}", resp.status_code
+                )
+            return resp
+        raise AdminClientError(f"{method} {path} failed: {last}")
+
+    # -- applications --------------------------------------------------------
+
+    @staticmethod
+    def zip_app_dir(app_dir: str | Path) -> bytes:
+        """Zip an application directory, honouring .gitignore-style exclusion
+        of hidden files (reference AbstractDeployApplicationCmd zipping)."""
+        app_dir = Path(app_dir)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for p in sorted(app_dir.rglob("*")):
+                rel = p.relative_to(app_dir)
+                if p.is_file() and not any(part.startswith(".") for part in rel.parts):
+                    zf.write(p, str(rel))
+        return buf.getvalue()
+
+    def deploy(
+        self,
+        name: str,
+        app_dir: str | Path,
+        instance_path: Optional[str | Path] = None,
+        secrets_path: Optional[str | Path] = None,
+        update: bool = False,
+        dry_run: bool = False,
+    ) -> dict[str, Any]:
+        files: dict[str, Any] = {
+            "app": ("app.zip", self.zip_app_dir(app_dir), "application/zip")
+        }
+        if instance_path:
+            files["instance"] = ("instance.yaml", Path(instance_path).read_text())
+        if secrets_path:
+            files["secrets"] = ("secrets.yaml", Path(secrets_path).read_text())
+        method = "PATCH" if update else "POST"
+        params = {"dry-run": "true"} if dry_run else {}
+        resp = self._request(
+            method,
+            f"/api/applications/{self.tenant}/{name}",
+            files=files,
+            params=params,
+        )
+        return resp.json()
+
+    def get(self, name: str) -> dict[str, Any]:
+        return self._request("GET", f"/api/applications/{self.tenant}/{name}").json()
+
+    def list(self) -> list[dict[str, Any]]:
+        return self._request("GET", f"/api/applications/{self.tenant}").json()
+
+    def delete(self, name: str) -> dict[str, Any]:
+        return self._request("DELETE", f"/api/applications/{self.tenant}/{name}").json()
+
+    def logs(self, name: str) -> str:
+        return self._request("GET", f"/api/applications/{self.tenant}/{name}/logs").text
+
+    def download(self, name: str) -> bytes:
+        return self._request(
+            "GET", f"/api/applications/{self.tenant}/{name}/code"
+        ).content
+
+    # -- tenants -------------------------------------------------------------
+
+    def tenant_put(self, name: str) -> dict[str, Any]:
+        return self._request("PUT", f"/api/tenants/{name}").json()
+
+    def tenant_get(self, name: str) -> dict[str, Any]:
+        return self._request("GET", f"/api/tenants/{name}").json()
+
+    def tenant_delete(self, name: str) -> dict[str, Any]:
+        return self._request("DELETE", f"/api/tenants/{name}").json()
+
+    def tenant_list(self) -> dict[str, Any]:
+        return self._request("GET", "/api/tenants").json()
+
+    # -- archetypes ----------------------------------------------------------
+
+    def archetype_list(self) -> list[dict[str, Any]]:
+        return self._request("GET", f"/api/archetypes/{self.tenant}").json()
+
+    def archetype_get(self, archetype_id: str) -> dict[str, Any]:
+        return self._request(
+            "GET", f"/api/archetypes/{self.tenant}/{archetype_id}"
+        ).json()
+
+    def archetype_deploy(
+        self, archetype_id: str, name: str, parameters: dict[str, Any]
+    ) -> dict[str, Any]:
+        import json as _json
+
+        return self._request(
+            "POST",
+            f"/api/archetypes/{self.tenant}/{archetype_id}/applications/{name}",
+            data=_json.dumps(parameters),
+        ).json()

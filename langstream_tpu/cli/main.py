@@ -1,0 +1,489 @@
+"""`langstream-tpu` CLI entry point (click).
+
+Parity: reference ``langstream-cli`` commands (RootCmd.java:27-37):
+apps / tenants / gateway (incl. the interactive ``chat`` REPL,
+ChatGatewayCmd) / archetypes / configure / profiles, and ``docker run`` →
+``run local`` (whole platform in one process, runtime-tester
+LocalRunApplicationCmd.java:55).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+from typing import Optional
+
+import click
+
+from langstream_tpu.cli.client import AdminClient, AdminClientError
+from langstream_tpu.cli.config import Profile, load_config, save_config
+
+
+def _client(ctx: click.Context) -> AdminClient:
+    profile = load_config().profile
+    tenant = ctx.obj.get("tenant") or profile.tenant
+    return AdminClient(profile.webServiceUrl, tenant=tenant, token=profile.token)
+
+
+def _echo_json(data) -> None:
+    click.echo(json.dumps(data, indent=2, default=str))
+
+
+@click.group()
+@click.option("--tenant", default=None, help="override the profile tenant")
+@click.pass_context
+def cli(ctx: click.Context, tenant: Optional[str]) -> None:
+    """TPU-native streaming Gen-AI platform CLI."""
+    ctx.ensure_object(dict)
+    ctx.obj["tenant"] = tenant
+
+
+# -- configure / profiles ----------------------------------------------------
+
+
+@cli.command()
+@click.argument("key", type=click.Choice(["webServiceUrl", "apiGatewayUrl", "tenant", "token"]))
+@click.argument("value")
+def configure(key: str, value: str) -> None:
+    """Set a value on the current profile."""
+    config = load_config()
+    profile = config.profiles.setdefault(config.current_profile, Profile())
+    setattr(profile, key, value)
+    save_config(config)
+    click.echo(f"profile {config.current_profile}: {key} = {value}")
+
+
+@cli.group()
+def profiles() -> None:
+    """Manage named connection profiles."""
+
+
+@profiles.command("list")
+def profiles_list() -> None:
+    config = load_config()
+    for name, profile in config.profiles.items():
+        marker = "*" if name == config.current_profile else " "
+        click.echo(f"{marker} {name}: {profile.webServiceUrl} (tenant={profile.tenant})")
+
+
+@profiles.command("create")
+@click.argument("name")
+@click.option("--web-service-url", default="http://localhost:8090")
+@click.option("--api-gateway-url", default="http://localhost:8091")
+@click.option("--tenant", default="default")
+@click.option("--token", default=None)
+def profiles_create(name, web_service_url, api_gateway_url, tenant, token) -> None:
+    config = load_config()
+    config.profiles[name] = Profile(web_service_url, api_gateway_url, tenant, token)
+    save_config(config)
+    click.echo(f"created profile {name}")
+
+
+@profiles.command("use")
+@click.argument("name")
+def profiles_use(name: str) -> None:
+    config = load_config()
+    if name not in config.profiles:
+        raise click.ClickException(f"no profile named {name!r}")
+    config.current_profile = name
+    save_config(config)
+    click.echo(f"using profile {name}")
+
+
+@profiles.command("delete")
+@click.argument("name")
+def profiles_delete(name: str) -> None:
+    config = load_config()
+    config.profiles.pop(name, None)
+    if config.current_profile == name:
+        config.current_profile = "default"
+    save_config(config)
+    click.echo(f"deleted profile {name}")
+
+
+# -- apps --------------------------------------------------------------------
+
+
+@cli.group()
+def apps() -> None:
+    """Deploy and manage applications."""
+
+
+@apps.command("deploy")
+@click.argument("name")
+@click.option("--app", "app_dir", required=True, type=click.Path(exists=True, file_okay=False))
+@click.option("--instance", "-i", type=click.Path(exists=True, dir_okay=False))
+@click.option("--secrets", "-s", type=click.Path(exists=True, dir_okay=False))
+@click.option("--dry-run", is_flag=True)
+@click.pass_context
+def apps_deploy(ctx, name, app_dir, instance, secrets, dry_run) -> None:
+    try:
+        result = _client(ctx).deploy(name, app_dir, instance, secrets, dry_run=dry_run)
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+    _echo_json(result)
+
+
+@apps.command("update")
+@click.argument("name")
+@click.option("--app", "app_dir", required=True, type=click.Path(exists=True, file_okay=False))
+@click.option("--instance", "-i", type=click.Path(exists=True, dir_okay=False))
+@click.option("--secrets", "-s", type=click.Path(exists=True, dir_okay=False))
+@click.pass_context
+def apps_update(ctx, name, app_dir, instance, secrets) -> None:
+    try:
+        result = _client(ctx).deploy(name, app_dir, instance, secrets, update=True)
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+    _echo_json(result)
+
+
+@apps.command("get")
+@click.argument("name")
+@click.option("-o", "output", type=click.Choice(["json", "mermaid"]), default="json")
+@click.pass_context
+def apps_get(ctx, name, output) -> None:
+    try:
+        if output == "mermaid":
+            data = _client(ctx).download(name)
+            import io
+            import zipfile
+
+            from langstream_tpu.cli.mermaid import generate_mermaid
+            from langstream_tpu.core.parser import ModelBuilder
+
+            zf = zipfile.ZipFile(io.BytesIO(data))
+            files = {
+                n: zf.read(n).decode()
+                for n in zf.namelist()
+                if n.endswith((".yaml", ".yml"))
+            }
+            pkg = ModelBuilder.build_application_from_files(files, None, None)
+            click.echo(generate_mermaid(pkg.application))
+        else:
+            _echo_json(_client(ctx).get(name))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@apps.command("list")
+@click.pass_context
+def apps_list(ctx) -> None:
+    try:
+        _echo_json(_client(ctx).list())
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@apps.command("delete")
+@click.argument("name")
+@click.pass_context
+def apps_delete(ctx, name) -> None:
+    try:
+        _echo_json(_client(ctx).delete(name))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@apps.command("logs")
+@click.argument("name")
+@click.pass_context
+def apps_logs(ctx, name) -> None:
+    try:
+        click.echo(_client(ctx).logs(name))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@apps.command("download")
+@click.argument("name")
+@click.option("-o", "output", type=click.Path(), default=None)
+@click.pass_context
+def apps_download(ctx, name, output) -> None:
+    try:
+        data = _client(ctx).download(name)
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+    target = Path(output or f"{name}.zip")
+    target.write_bytes(data)
+    click.echo(f"wrote {target} ({len(data)} bytes)")
+
+
+# -- tenants -----------------------------------------------------------------
+
+
+@cli.group()
+def tenants() -> None:
+    """Manage tenants."""
+
+
+@tenants.command("put")
+@click.argument("name")
+@click.pass_context
+def tenants_put(ctx, name) -> None:
+    try:
+        _echo_json(_client(ctx).tenant_put(name))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@tenants.command("get")
+@click.argument("name")
+@click.pass_context
+def tenants_get(ctx, name) -> None:
+    try:
+        _echo_json(_client(ctx).tenant_get(name))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@tenants.command("delete")
+@click.argument("name")
+@click.pass_context
+def tenants_delete(ctx, name) -> None:
+    try:
+        _echo_json(_client(ctx).tenant_delete(name))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@tenants.command("list")
+@click.pass_context
+def tenants_list(ctx) -> None:
+    try:
+        _echo_json(_client(ctx).tenant_list())
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+# -- archetypes --------------------------------------------------------------
+
+
+@cli.group()
+def archetypes() -> None:
+    """Browse and instantiate application archetypes."""
+
+
+@archetypes.command("list")
+@click.pass_context
+def archetypes_list(ctx) -> None:
+    try:
+        _echo_json(_client(ctx).archetype_list())
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@archetypes.command("get")
+@click.argument("archetype_id")
+@click.pass_context
+def archetypes_get(ctx, archetype_id) -> None:
+    try:
+        _echo_json(_client(ctx).archetype_get(archetype_id))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@archetypes.command("deploy")
+@click.argument("archetype_id")
+@click.argument("name")
+@click.option("--param", "-p", "params", multiple=True, help="key=value")
+@click.pass_context
+def archetypes_deploy(ctx, archetype_id, name, params) -> None:
+    parameters = {}
+    for p in params:
+        key, _, value = p.partition("=")
+        parameters[key] = value
+    try:
+        _echo_json(_client(ctx).archetype_deploy(archetype_id, name, parameters))
+    except AdminClientError as e:
+        raise click.ClickException(str(e)) from e
+
+
+# -- gateway -----------------------------------------------------------------
+
+
+def _gateway_ws_url(ctx: click.Context, kind: str, application: str, gateway: str, params: dict[str, str], credentials: Optional[str]) -> str:
+    from urllib.parse import quote
+
+    profile = load_config().profile
+    tenant = ctx.obj.get("tenant") or profile.tenant
+    base = profile.apiGatewayUrl.replace("http://", "ws://").replace("https://", "wss://")
+    url = f"{base}/v1/{kind}/{tenant}/{application}/{gateway}"
+    query = [f"param:{quote(k)}={quote(v, safe='')}" for k, v in params.items()]
+    if credentials:
+        query.append(f"credentials={quote(credentials, safe='')}")
+    if query:
+        url += "?" + "&".join(query)
+    return url
+
+
+def _parse_params(params: tuple[str, ...]) -> dict[str, str]:
+    out = {}
+    for p in params:
+        key, _, value = p.partition("=")
+        out[key] = value
+    return out
+
+
+@cli.group()
+def gateway() -> None:
+    """Interact with application gateways."""
+
+
+@gateway.command("chat")
+@click.argument("application")
+@click.option("--gateway", "-g", "gateway_id", required=True)
+@click.option("--param", "-p", "params", multiple=True, help="key=value")
+@click.option("--credentials", default=None)
+@click.pass_context
+def gateway_chat(ctx, application, gateway_id, params, credentials) -> None:
+    """Interactive chat REPL over the chat gateway (ChatGatewayCmd)."""
+    url = _gateway_ws_url(ctx, "chat", application, gateway_id, _parse_params(params), credentials)
+
+    async def repl() -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(url) as ws:
+                click.echo("connected — type a message, Ctrl-D to exit")
+                loop = asyncio.get_event_loop()
+                while True:
+                    try:
+                        line = await loop.run_in_executor(None, sys.stdin.readline)
+                    except (EOFError, KeyboardInterrupt):
+                        break
+                    if not line:
+                        break
+                    await ws.send_str(json.dumps({"value": line.strip()}))
+                    msg = await ws.receive()
+                    if msg.type != 1:  # TEXT
+                        break
+                    push = json.loads(msg.data)
+                    record = push.get("record", {})
+                    click.echo(f"< {record.get('value')}")
+
+    asyncio.run(repl())
+
+
+@gateway.command("produce")
+@click.argument("application")
+@click.option("--gateway", "-g", "gateway_id", required=True)
+@click.option("--param", "-p", "params", multiple=True)
+@click.option("--value", "-v", required=True)
+@click.option("--key", "-k", default=None)
+@click.option("--credentials", default=None)
+@click.pass_context
+def gateway_produce(ctx, application, gateway_id, params, value, key, credentials) -> None:
+    url = _gateway_ws_url(ctx, "produce", application, gateway_id, _parse_params(params), credentials)
+
+    async def produce() -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(url) as ws:
+                await ws.send_str(json.dumps({"value": value, "key": key}))
+                msg = await ws.receive()
+                click.echo(msg.data)
+
+    asyncio.run(produce())
+
+
+@gateway.command("consume")
+@click.argument("application")
+@click.option("--gateway", "-g", "gateway_id", required=True)
+@click.option("--param", "-p", "params", multiple=True)
+@click.option("--position", default="latest")
+@click.option("-n", "count", default=0, help="stop after N messages (0 = forever)")
+@click.option("--credentials", default=None)
+@click.pass_context
+def gateway_consume(ctx, application, gateway_id, params, position, count, credentials) -> None:
+    url = _gateway_ws_url(ctx, "consume", application, gateway_id, _parse_params(params), credentials)
+    url += ("&" if "?" in url else "?") + f"option:position={position}"
+
+    async def consume() -> None:
+        import aiohttp
+
+        seen = 0
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(url) as ws:
+                async for msg in ws:
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        break
+                    click.echo(msg.data)
+                    seen += 1
+                    if count and seen >= count:
+                        break
+
+    asyncio.run(consume())
+
+
+# -- run local ---------------------------------------------------------------
+
+
+@cli.group()
+def run() -> None:
+    """Run applications locally."""
+
+
+@run.command("local")
+@click.argument("app_dir", type=click.Path(exists=True, file_okay=False))
+@click.option("--instance", "-i", type=click.Path(exists=True, dir_okay=False))
+@click.option("--secrets", "-s", type=click.Path(exists=True, dir_okay=False))
+@click.option("--name", default="local-app")
+@click.option("--gateway-port", default=8091)
+@click.option("--control-plane-port", default=8090)
+@click.option("--once", is_flag=True, hidden=True, help="start and exit (tests)")
+def run_local(app_dir, instance, secrets, name, gateway_port, control_plane_port, once) -> None:
+    """Whole platform in one process: control plane + runtime + gateway
+    (reference `langstream docker run` / runtime-tester)."""
+
+    async def main() -> None:
+        from langstream_tpu.gateway.server import DictApplicationProvider, GatewayServer
+        from langstream_tpu.webservice.server import ControlPlaneServer
+        from langstream_tpu.webservice.service import make_local_service
+
+        applications, tenant_service, runtime = make_local_service(None)
+        control_plane = ControlPlaneServer(
+            applications, tenant_service, port=control_plane_port
+        )
+        await control_plane.start()
+        client_zip = AdminClient.zip_app_dir(app_dir)
+        instance_text = Path(instance).read_text() if instance else None
+        secrets_text = Path(secrets).read_text() if secrets else None
+        await applications.deploy(
+            "default", name, client_zip, instance_text, secrets_text
+        )
+        runner = runtime.get_runner("default", name)
+        provider = DictApplicationProvider()
+        provider.put("default", name, runner.application, runner.topic_runtime)
+        gateway_server = GatewayServer(provider, port=gateway_port)
+        await gateway_server.start()
+        click.echo(f"control plane: {control_plane.url}")
+        click.echo(f"gateway:       {gateway_server.url}")
+        click.echo(f"application:   {name} (tenant default)")
+        if once:
+            await gateway_server.stop()
+            await runtime.close()
+            await control_plane.stop()
+            return
+        try:
+            while True:  # serve until interrupted
+                await asyncio.sleep(3600)
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            pass
+        finally:
+            await gateway_server.stop()
+            await runtime.close()
+            await control_plane.stop()
+
+    asyncio.run(main())
+
+
+def main() -> None:
+    cli(obj={})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Control plane (L7): REST API over application store + code storage.
+
+Parity: reference ``langstream-webservice/`` — ``/api/applications/{tenant}``
+CRUD (ApplicationResource.java:79-493), ``/api/tenants`` (TenantResource),
+``/api/archetypes`` (ArchetypeResource), code zips into a CodeStorage
+(CodeStorageService), apps persisted through an ApplicationStore
+(reference KubernetesApplicationStore / langstream-k8s-storage).
+"""
+
+from langstream_tpu.webservice.stores import (
+    InMemoryApplicationStore,
+    LocalDiskApplicationStore,
+    LocalDiskCodeStorage,
+    LocalDiskGlobalMetadataStore,
+)
+from langstream_tpu.webservice.service import ApplicationService, TenantService
+from langstream_tpu.webservice.server import ControlPlaneServer
+
+__all__ = [
+    "ApplicationService",
+    "ControlPlaneServer",
+    "InMemoryApplicationStore",
+    "LocalDiskApplicationStore",
+    "LocalDiskCodeStorage",
+    "LocalDiskGlobalMetadataStore",
+    "TenantService",
+]

@@ -1,0 +1,293 @@
+"""Control-plane REST server.
+
+Parity: reference ``ApplicationResource.java:79-493`` route by route:
+
+  POST   /api/applications/{tenant}/{name}           multipart deploy (app zip + instance + secrets)
+  PATCH  /api/applications/{tenant}/{name}           update (same form)
+  GET    /api/applications/{tenant}                  list
+  GET    /api/applications/{tenant}/{name}           describe (+status)
+  DELETE /api/applications/{tenant}/{name}           delete
+  GET    /api/applications/{tenant}/{name}/logs      runtime logs
+  GET    /api/applications/{tenant}/{name}/code      download code archive
+  PUT/GET/DELETE /api/tenants[/{name}]               tenant CRUD (TenantResource)
+  GET    /api/archetypes/{tenant}[/{id}]             archetype catalog (ArchetypeResource)
+  POST   /api/archetypes/{tenant}/{id}/applications/{name}   create app from archetype
+
+Bearer-token auth (reference TokenAuthFilter) via a static admin token in
+local mode; the gateway embeds alongside when serving everything in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import yaml
+from aiohttp import web
+
+from langstream_tpu.webservice.service import (
+    ApplicationService,
+    ApplicationServiceError,
+    TenantService,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ControlPlaneServer:
+    def __init__(
+        self,
+        applications: ApplicationService,
+        tenants: TenantService,
+        host: str = "127.0.0.1",
+        port: int = 8090,
+        auth_token: Optional[str] = None,
+        archetypes_path: Optional[str] = None,
+    ) -> None:
+        self.applications = applications
+        self.tenants = tenants
+        self.host = host
+        self.port = port
+        self.auth_token = auth_token
+        self.archetypes_path = Path(archetypes_path) if archetypes_path else None
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application(middlewares=[self._auth_middleware, self._error_middleware])
+        self.app.add_routes(
+            [
+                web.post("/api/applications/{tenant}/{name}", self._deploy),
+                web.patch("/api/applications/{tenant}/{name}", self._update),
+                web.get("/api/applications/{tenant}", self._list),
+                web.get("/api/applications/{tenant}/{name}", self._get),
+                web.delete("/api/applications/{tenant}/{name}", self._delete),
+                web.get("/api/applications/{tenant}/{name}/logs", self._logs),
+                web.get("/api/applications/{tenant}/{name}/code", self._code),
+                web.put("/api/tenants/{name}", self._tenant_put),
+                web.get("/api/tenants/{name}", self._tenant_get),
+                web.delete("/api/tenants/{name}", self._tenant_delete),
+                web.get("/api/tenants", self._tenant_list),
+                web.get("/api/archetypes/{tenant}", self._archetype_list),
+                web.get("/api/archetypes/{tenant}/{id}", self._archetype_get),
+                web.post(
+                    "/api/archetypes/{tenant}/{id}/applications/{name}",
+                    self._archetype_deploy,
+                ),
+                web.get("/healthz", self._healthz),
+            ]
+        )
+
+    # -- middlewares ---------------------------------------------------------
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        if self.auth_token is not None and request.path != "/healthz":
+            header = request.headers.get("Authorization", "")
+            if header != f"Bearer {self.auth_token}":
+                return web.json_response({"error": "unauthorized"}, status=401)
+        return await handler(request)
+
+    @web.middleware
+    async def _error_middleware(self, request: web.Request, handler):
+        try:
+            return await handler(request)
+        except ApplicationServiceError as e:
+            return web.json_response({"error": str(e)}, status=e.status)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001
+            log.exception("internal error on %s %s", request.method, request.path)
+            return web.json_response({"error": str(e)}, status=500)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        log.info("control plane listening on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "OK"})
+
+    # -- applications --------------------------------------------------------
+
+    def _check_tenant(self, tenant: str) -> None:
+        if not self.tenants.exists(tenant):
+            raise ApplicationServiceError(f"tenant {tenant!r} not found", status=404)
+
+    @staticmethod
+    async def _read_deploy_form(request: web.Request) -> tuple[Optional[bytes], Optional[str], Optional[str], bool]:
+        archive: Optional[bytes] = None
+        instance: Optional[str] = None
+        secrets: Optional[str] = None
+        dry_run = request.query.get("dry-run", "false").lower() == "true"
+        if request.content_type.startswith("multipart/"):
+            reader = await request.multipart()
+            async for part in reader:
+                if part.name == "app":
+                    archive = await part.read(decode=False)
+                elif part.name == "instance":
+                    instance = (await part.read(decode=False)).decode()
+                elif part.name == "secrets":
+                    secrets = (await part.read(decode=False)).decode()
+        else:
+            archive = await request.read() or None
+        return archive, instance, secrets, dry_run
+
+    async def _deploy(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        self._check_tenant(tenant)
+        archive, instance, secrets, dry_run = await self._read_deploy_form(request)
+        result = await self.applications.deploy(
+            tenant, name, archive, instance, secrets, allow_update=False, dry_run=dry_run
+        )
+        return web.json_response(result)
+
+    async def _update(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        name = request.match_info["name"]
+        self._check_tenant(tenant)
+        archive, instance, secrets, dry_run = await self._read_deploy_form(request)
+        result = await self.applications.deploy(
+            tenant, name, archive, instance, secrets, allow_update=True, dry_run=dry_run
+        )
+        return web.json_response(result)
+
+    async def _list(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        self._check_tenant(tenant)
+        return web.json_response(self.applications.list(tenant))
+
+    async def _get(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        self._check_tenant(tenant)
+        return web.json_response(
+            self.applications.describe(tenant, request.match_info["name"])
+        )
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        self._check_tenant(tenant)
+        await self.applications.delete(tenant, request.match_info["name"])
+        return web.json_response({"deleted": request.match_info["name"]})
+
+    async def _logs(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        self._check_tenant(tenant)
+        lines = self.applications.logs(tenant, request.match_info["name"])
+        return web.Response(text="\n".join(lines), content_type="text/plain")
+
+    async def _code(self, request: web.Request) -> web.Response:
+        tenant = request.match_info["tenant"]
+        self._check_tenant(tenant)
+        data = self.applications.download_code(tenant, request.match_info["name"])
+        return web.Response(body=data, content_type="application/zip")
+
+    # -- tenants -------------------------------------------------------------
+
+    async def _tenant_put(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        body: dict[str, Any] = {}
+        if request.can_read_body:
+            try:
+                body = json.loads(await request.text() or "{}")
+            except json.JSONDecodeError:
+                raise ApplicationServiceError("tenant body must be JSON") from None
+            if not isinstance(body, dict):
+                raise ApplicationServiceError("tenant body must be a JSON object")
+        self.tenants.put(name, {"name": name, **body})
+        return web.json_response({"name": name})
+
+    async def _tenant_get(self, request: web.Request) -> web.Response:
+        config = self.tenants.get(request.match_info["name"])
+        if config is None:
+            raise ApplicationServiceError("tenant not found", status=404)
+        return web.json_response(config)
+
+    async def _tenant_delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        for app_id in list(self.applications.store.list(name)):
+            await self.applications.delete(name, app_id)
+        self.tenants.delete(name)
+        return web.json_response({"deleted": name})
+
+    async def _tenant_list(self, request: web.Request) -> web.Response:
+        return web.json_response(self.tenants.list())
+
+    # -- archetypes ----------------------------------------------------------
+
+    def _archetype_dir(self, archetype_id: str) -> Path:
+        if self.archetypes_path is None:
+            raise ApplicationServiceError("no archetypes configured", status=404)
+        path = (self.archetypes_path / archetype_id).resolve()
+        if not path.is_relative_to(self.archetypes_path.resolve()) or not path.is_dir():
+            raise ApplicationServiceError(f"archetype {archetype_id!r} not found", status=404)
+        return path
+
+    async def _archetype_list(self, request: web.Request) -> web.Response:
+        if self.archetypes_path is None or not self.archetypes_path.is_dir():
+            return web.json_response([])
+        out = []
+        for child in sorted(self.archetypes_path.iterdir()):
+            if (child / "archetype.yaml").exists():
+                meta = yaml.safe_load((child / "archetype.yaml").read_text()) or {}
+                out.append({"id": child.name, **meta.get("archetype", {})})
+        return web.json_response(out)
+
+    async def _archetype_get(self, request: web.Request) -> web.Response:
+        path = self._archetype_dir(request.match_info["id"])
+        meta = yaml.safe_load((path / "archetype.yaml").read_text()) or {}
+        return web.json_response({"id": request.match_info["id"], **meta})
+
+    async def _archetype_deploy(self, request: web.Request) -> web.Response:
+        """Materialize an archetype into an application: the posted JSON
+        parameters become instance globals (ArchetypeResource deploy path)."""
+        tenant = request.match_info["tenant"]
+        self._check_tenant(tenant)
+        path = self._archetype_dir(request.match_info["id"])
+        name = request.match_info["name"]
+        try:
+            parameters = json.loads(await request.text() or "{}")
+        except json.JSONDecodeError:
+            raise ApplicationServiceError("parameters must be JSON") from None
+
+        app_dir = path / "application"
+        if not app_dir.is_dir():
+            raise ApplicationServiceError("archetype has no application/ dir", status=500)
+        import io
+        import zipfile
+
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            for p in sorted(app_dir.rglob("*")):
+                if p.is_file():
+                    zf.write(p, str(p.relative_to(app_dir)))
+        instance_file = path / "instance.yaml"
+        instance_data = (
+            yaml.safe_load(instance_file.read_text()) if instance_file.exists() else {"instance": {}}
+        )
+        instance_data.setdefault("instance", {}).setdefault("globals", {}).update(parameters)
+        result = await self.applications.deploy(
+            tenant,
+            name,
+            buf.getvalue(),
+            yaml.safe_dump(instance_data),
+            None,
+            allow_update=False,
+        )
+        return web.json_response(result)

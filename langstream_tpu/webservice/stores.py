@@ -1,0 +1,332 @@
+"""Application / metadata / code stores for the control plane.
+
+Parity: reference ``langstream-k8s-storage`` (apps as CRD+Secret →
+KubernetesApplicationStore.java:138-195) and ``langstream-core``
+``LocalDiskCodeStorage`` / ``LocalStore``.  The TPU rebuild's local mode
+persists the *source package* (the YAML files) plus the instance/secrets
+documents, and re-parses on load — the package is the source of truth the
+same way the CRD-serialized app is in the reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+from pathlib import Path
+from typing import Any, Optional
+
+from langstream_tpu.api.model import Application, Secrets
+from langstream_tpu.api.storage import (
+    ApplicationStore,
+    CodeArchiveMetadata,
+    CodeStorage,
+    GlobalMetadataStore,
+    StoredApplication,
+)
+from langstream_tpu.core.parser import ModelBuilder
+
+
+class InMemoryApplicationStore(ApplicationStore):
+    """Test/local store (reference runtime-tester InMemoryApplicationStore)."""
+
+    def __init__(self) -> None:
+        self._apps: dict[tuple[str, str], StoredApplication] = {}
+        self._secrets: dict[tuple[str, str], Secrets] = {}
+        self._raw: dict[tuple[str, str], tuple[Optional[str], Optional[str]]] = {}
+
+    def put_package(
+        self,
+        tenant: str,
+        application_id: str,
+        package_files: dict[str, str],
+        instance_text: Optional[str],
+        secrets_text: Optional[str],
+        code_archive_id: Optional[str],
+    ) -> StoredApplication:
+        pkg = ModelBuilder.build_application_from_files(
+            package_files, instance_text, secrets_text
+        )
+        self.put(tenant, application_id, pkg.application, code_archive_id)
+        self._raw[(tenant, application_id)] = (instance_text, secrets_text)
+        stored = self.get(tenant, application_id)
+        assert stored is not None
+        return stored
+
+    def get_raw_documents(
+        self, tenant: str, application_id: str
+    ) -> tuple[Optional[str], Optional[str]]:
+        """(instance_text, secrets_text) as last deployed — updates that omit
+        them must fall back to these rather than dropping the environment."""
+        return self._raw.get((tenant, application_id), (None, None))
+
+    def put(
+        self,
+        tenant: str,
+        application_id: str,
+        application: Application,
+        code_archive_id: Optional[str],
+    ) -> None:
+        self._apps[(tenant, application_id)] = StoredApplication(
+            application_id=application_id,
+            application=application,
+            code_archive_id=code_archive_id,
+        )
+        self._secrets[(tenant, application_id)] = application.secrets
+
+    def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]:
+        return self._apps.get((tenant, application_id))
+
+    def delete(self, tenant: str, application_id: str) -> None:
+        self._apps.pop((tenant, application_id), None)
+        self._secrets.pop((tenant, application_id), None)
+        self._raw.pop((tenant, application_id), None)
+
+    def list(self, tenant: str) -> dict[str, StoredApplication]:
+        return {
+            app_id: stored
+            for (t, app_id), stored in self._apps.items()
+            if t == tenant
+        }
+
+    def get_secrets(self, tenant: str, application_id: str) -> Optional[Secrets]:
+        return self._secrets.get((tenant, application_id))
+
+
+class LocalDiskApplicationStore(ApplicationStore):
+    """Persists app packages under ``root/{tenant}/{app}/``:
+
+        package/…yaml   the application files as deployed
+        instance.yaml   environment document
+        secrets.yaml    secrets document (plain on disk — local mode only;
+                        the reference stores these in a K8s Secret)
+        meta.json       code_archive_id + status
+    """
+
+    def __init__(self, root: str | Path) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _dir(self, tenant: str, application_id: str) -> Path:
+        return self.root / tenant / application_id
+
+    def put_package(
+        self,
+        tenant: str,
+        application_id: str,
+        package_files: dict[str, str],
+        instance_text: Optional[str],
+        secrets_text: Optional[str],
+        code_archive_id: Optional[str],
+    ) -> StoredApplication:
+        """Store the raw documents and return the parsed application."""
+        app_dir = self._dir(tenant, application_id)
+        pkg_dir = app_dir / "package"
+        if pkg_dir.exists():
+            shutil.rmtree(pkg_dir)
+        pkg_dir.mkdir(parents=True)
+        for rel, text in package_files.items():
+            target = pkg_dir / rel
+            if not target.resolve().is_relative_to(pkg_dir.resolve()):
+                raise ValueError(f"package path escapes the package dir: {rel}")
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.write_text(text)
+        if instance_text is not None:
+            (app_dir / "instance.yaml").write_text(instance_text)
+        if secrets_text is not None:
+            (app_dir / "secrets.yaml").write_text(secrets_text)
+        meta = {"code_archive_id": code_archive_id, "status": {}}
+        (app_dir / "meta.json").write_text(json.dumps(meta))
+        stored = self.get(tenant, application_id)
+        assert stored is not None
+        return stored
+
+    def put(
+        self,
+        tenant: str,
+        application_id: str,
+        application: Application,
+        code_archive_id: Optional[str],
+    ) -> None:
+        raise NotImplementedError(
+            "LocalDiskApplicationStore persists source packages; use put_package()"
+        )
+
+    def get_raw_documents(
+        self, tenant: str, application_id: str
+    ) -> tuple[Optional[str], Optional[str]]:
+        app_dir = self._dir(tenant, application_id)
+        instance_file = app_dir / "instance.yaml"
+        secrets_file = app_dir / "secrets.yaml"
+        return (
+            instance_file.read_text() if instance_file.exists() else None,
+            secrets_file.read_text() if secrets_file.exists() else None,
+        )
+
+    def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]:
+        app_dir = self._dir(tenant, application_id)
+        pkg_dir = app_dir / "package"
+        if not pkg_dir.is_dir():
+            return None
+        files: dict[str, str] = {}
+        for p in sorted(pkg_dir.rglob("*")):
+            if p.is_file():
+                files[str(p.relative_to(pkg_dir))] = p.read_text()
+        instance_file = app_dir / "instance.yaml"
+        secrets_file = app_dir / "secrets.yaml"
+        pkg = ModelBuilder.build_application_from_files(
+            files,
+            instance_file.read_text() if instance_file.exists() else None,
+            secrets_file.read_text() if secrets_file.exists() else None,
+        )
+        meta_file = app_dir / "meta.json"
+        meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
+        return StoredApplication(
+            application_id=application_id,
+            application=pkg.application,
+            code_archive_id=meta.get("code_archive_id"),
+            status=meta.get("status", {}),
+        )
+
+    def update_status(self, tenant: str, application_id: str, status: dict[str, Any]) -> None:
+        app_dir = self._dir(tenant, application_id)
+        meta_file = app_dir / "meta.json"
+        meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
+        meta["status"] = status
+        meta_file.write_text(json.dumps(meta))
+
+    def delete(self, tenant: str, application_id: str) -> None:
+        app_dir = self._dir(tenant, application_id)
+        if app_dir.exists():
+            shutil.rmtree(app_dir)
+
+    def list(self, tenant: str) -> dict[str, StoredApplication]:
+        tenant_dir = self.root / tenant
+        if not tenant_dir.is_dir():
+            return {}
+        out: dict[str, StoredApplication] = {}
+        for child in sorted(tenant_dir.iterdir()):
+            if child.is_dir():
+                stored = self.get(tenant, child.name)
+                if stored is not None:
+                    out[child.name] = stored
+        return out
+
+    def get_secrets(self, tenant: str, application_id: str) -> Optional[Secrets]:
+        stored = self.get(tenant, application_id)
+        return stored.application.secrets if stored else None
+
+
+class LocalDiskGlobalMetadataStore(GlobalMetadataStore):
+    """Key/value store backed by one JSON file (reference LocalStore /
+    KubernetesGlobalMetadataStore-on-ConfigMaps)."""
+
+    def __init__(self, root: str | Path) -> None:
+        self.path = Path(root) / "global-metadata.json"
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if not self.path.exists():
+            self.path.write_text("{}")
+
+    def _load(self) -> dict[str, str]:
+        return json.loads(self.path.read_text())
+
+    def _save(self, data: dict[str, str]) -> None:
+        self.path.write_text(json.dumps(data, indent=2))
+
+    def put(self, key: str, value: str) -> None:
+        data = self._load()
+        data[key] = value
+        self._save(data)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._load().get(key)
+
+    def delete(self, key: str) -> None:
+        data = self._load()
+        data.pop(key, None)
+        self._save(data)
+
+    def list(self) -> dict[str, str]:
+        return self._load()
+
+
+class InMemoryGlobalMetadataStore(GlobalMetadataStore):
+    def __init__(self) -> None:
+        self._data: dict[str, str] = {}
+
+    def put(self, key: str, value: str) -> None:
+        self._data[key] = value
+
+    def get(self, key: str) -> Optional[str]:
+        return self._data.get(key)
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def list(self) -> dict[str, str]:
+        return dict(self._data)
+
+
+class InMemoryCodeStorage(CodeStorage):
+    """Archive store for the all-in-one local mode (keeps `apps download`
+    and diagram generation working without a disk root)."""
+
+    def __init__(self) -> None:
+        self._archives: dict[tuple[str, str], bytes] = {}
+
+    def store(
+        self, tenant: str, application_id: str, archive_bytes: bytes
+    ) -> CodeArchiveMetadata:
+        digest = hashlib.sha256(archive_bytes).hexdigest()
+        code_store_id = f"{application_id}-{digest[:16]}"
+        self._archives[(tenant, code_store_id)] = archive_bytes
+        return CodeArchiveMetadata(
+            tenant=tenant,
+            code_store_id=code_store_id,
+            application_id=application_id,
+            digests={"archive": digest},
+        )
+
+    def download(self, tenant: str, code_store_id: str) -> bytes:
+        data = self._archives.get((tenant, code_store_id))
+        if data is None:
+            raise FileNotFoundError(f"code archive {tenant}/{code_store_id} not found")
+        return data
+
+    def delete(self, tenant: str, code_store_id: str) -> None:
+        self._archives.pop((tenant, code_store_id), None)
+
+
+class LocalDiskCodeStorage(CodeStorage):
+    """Archive store under ``root/{tenant}/{id}.zip`` (reference
+    LocalDiskCodeStorage.java / S3CodeStorage)."""
+
+    def __init__(self, root: str | Path) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def store(
+        self, tenant: str, application_id: str, archive_bytes: bytes
+    ) -> CodeArchiveMetadata:
+        digest = hashlib.sha256(archive_bytes).hexdigest()
+        code_store_id = f"{application_id}-{digest[:16]}"
+        tenant_dir = self.root / tenant
+        tenant_dir.mkdir(parents=True, exist_ok=True)
+        (tenant_dir / f"{code_store_id}.zip").write_bytes(archive_bytes)
+        return CodeArchiveMetadata(
+            tenant=tenant,
+            code_store_id=code_store_id,
+            application_id=application_id,
+            digests={"archive": digest},
+        )
+
+    def download(self, tenant: str, code_store_id: str) -> bytes:
+        path = self.root / tenant / f"{code_store_id}.zip"
+        if not path.exists():
+            raise FileNotFoundError(f"code archive {tenant}/{code_store_id} not found")
+        return path.read_bytes()
+
+    def delete(self, tenant: str, code_store_id: str) -> None:
+        path = self.root / tenant / f"{code_store_id}.zip"
+        if path.exists():
+            path.unlink()

@@ -1,0 +1,205 @@
+"""CLI tests: click runner against an in-process control plane + gateway."""
+
+import json
+import threading
+
+import pytest
+from click.testing import CliRunner
+
+from langstream_tpu.cli.main import cli
+from langstream_tpu.cli.config import CliConfig, Profile, save_config
+
+PIPELINE = """
+module: default
+id: p
+name: echo
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: echo
+    type: identity
+    input: input-topic
+    output: output-topic
+"""
+
+GATEWAYS = """
+gateways:
+  - id: chat
+    type: chat
+    chat-options:
+      questions-topic: input-topic
+      answers-topic: output-topic
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+"""
+
+
+@pytest.fixture
+def app_dir(tmp_path):
+    d = tmp_path / "app"
+    d.mkdir()
+    (d / "pipeline.yaml").write_text(PIPELINE)
+    (d / "gateways.yaml").write_text(GATEWAYS)
+    (tmp_path / "instance.yaml").write_text(INSTANCE)
+    return d
+
+
+@pytest.fixture
+def platform(run, monkeypatch, tmp_path):
+    """Control plane running on a background event loop + CLI profile
+    pointing at it."""
+    import asyncio
+
+    from langstream_tpu.webservice.server import ControlPlaneServer
+    from langstream_tpu.webservice.service import make_local_service
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    holder = {}
+
+    def runner():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            applications, tenants, runtime = make_local_service(None)
+            server = ControlPlaneServer(applications, tenants, port=0)
+            await server.start()
+            holder["server"] = server
+            holder["runtime"] = runtime
+            started.set()
+
+        loop.run_until_complete(boot())
+        loop.run_forever()
+
+    thread = threading.Thread(target=runner, daemon=True)
+    thread.start()
+    started.wait(10)
+
+    config_path = tmp_path / "cli-config.json"
+    monkeypatch.setenv("LANGSTREAM_TPU_CONFIG", str(config_path))
+    save_config(
+        CliConfig(
+            profiles={"default": Profile(webServiceUrl=holder["server"].url)}
+        )
+    )
+    yield holder
+
+    async def shutdown():
+        await holder["runtime"].close()
+        await holder["server"].stop()
+
+    asyncio.run_coroutine_threadsafe(shutdown(), loop).result(10)
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(10)
+
+
+def test_apps_lifecycle(platform, app_dir, tmp_path):
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        [
+            "apps", "deploy", "myapp",
+            "--app", str(app_dir),
+            "-i", str(tmp_path / "instance.yaml"),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    assert "myapp" in result.output
+
+    result = runner.invoke(cli, ["apps", "list"])
+    assert result.exit_code == 0
+    assert "myapp" in result.output
+
+    result = runner.invoke(cli, ["apps", "get", "myapp"])
+    assert result.exit_code == 0
+    desc = json.loads(result.output)
+    assert desc["status"]["status"] == "DEPLOYED"
+
+    result = runner.invoke(cli, ["apps", "logs", "myapp"])
+    assert result.exit_code == 0
+    assert "identity" in result.output
+
+    result = runner.invoke(cli, ["apps", "delete", "myapp"])
+    assert result.exit_code == 0
+
+    result = runner.invoke(cli, ["apps", "get", "myapp"])
+    assert result.exit_code != 0
+
+
+def test_apps_dry_run(platform, app_dir, tmp_path):
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        [
+            "apps", "deploy", "dry",
+            "--app", str(app_dir),
+            "-i", str(tmp_path / "instance.yaml"),
+            "--dry-run",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    body = json.loads(result.output)
+    assert body["dry-run"] is True
+    # not actually deployed
+    result = runner.invoke(cli, ["apps", "list"])
+    assert "dry" not in result.output
+
+
+def test_tenants_and_profiles(platform, tmp_path):
+    runner = CliRunner()
+    result = runner.invoke(cli, ["tenants", "put", "acme"])
+    assert result.exit_code == 0, result.output
+    result = runner.invoke(cli, ["tenants", "list"])
+    assert "acme" in result.output
+
+    result = runner.invoke(cli, ["profiles", "create", "prod", "--tenant", "acme"])
+    assert result.exit_code == 0
+    result = runner.invoke(cli, ["profiles", "list"])
+    assert "prod" in result.output
+    result = runner.invoke(cli, ["profiles", "use", "prod"])
+    assert result.exit_code == 0
+
+
+def test_mermaid_diagram(platform, app_dir, tmp_path):
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        [
+            "apps", "deploy", "mmd",
+            "--app", str(app_dir),
+            "-i", str(tmp_path / "instance.yaml"),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    result = runner.invoke(cli, ["apps", "get", "mmd", "-o", "mermaid"])
+    assert result.exit_code == 0, result.output
+    assert result.output.startswith("flowchart LR")
+    assert "topic_input_topic" in result.output
+    assert "agent_echo" in result.output
+    assert "gateway_chat" in result.output
+
+
+def test_run_local_once(app_dir, tmp_path, monkeypatch):
+    monkeypatch.setenv("LANGSTREAM_TPU_CONFIG", str(tmp_path / "cfg.json"))
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        [
+            "run", "local", str(app_dir),
+            "-i", str(tmp_path / "instance.yaml"),
+            "--gateway-port", "0",
+            "--control-plane-port", "0",
+            "--once",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    assert "gateway:" in result.output

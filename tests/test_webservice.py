@@ -1,0 +1,310 @@
+"""Control-plane REST tests (reference ApplicationResourceTest scenarios)."""
+
+import io
+import json
+import zipfile
+
+import aiohttp
+
+PIPELINE = """
+module: default
+id: p
+name: echo
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: echo
+    type: identity
+    input: input-topic
+    output: output-topic
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+"""
+
+
+def make_zip(files: dict[str, str]) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for name, text in files.items():
+            zf.writestr(name, text)
+    return buf.getvalue()
+
+
+async def start_control_plane(root=None, auth_token=None):
+    from langstream_tpu.webservice.server import ControlPlaneServer
+    from langstream_tpu.webservice.service import make_local_service
+
+    applications, tenants, runtime = make_local_service(root)
+    server = ControlPlaneServer(
+        applications, tenants, port=0, auth_token=auth_token
+    )
+    await server.start()
+    return server, runtime
+
+
+async def deploy_app(session, server, name="app1", tenant="default"):
+    form = aiohttp.FormData()
+    form.add_field("app", make_zip({"pipeline.yaml": PIPELINE}), filename="app.zip")
+    form.add_field("instance", INSTANCE)
+    async with session.post(
+        f"{server.url}/api/applications/{tenant}/{name}", data=form
+    ) as resp:
+        return resp.status, await resp.json()
+
+
+def test_deploy_describe_delete(run):
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, body = await deploy_app(session, server)
+                assert status == 200, body
+                # duplicate deploy → 409
+                status, _ = await deploy_app(session, server)
+                assert status == 409
+                # describe shows agents + DEPLOYED status
+                async with session.get(
+                    f"{server.url}/api/applications/default/app1"
+                ) as resp:
+                    desc = await resp.json()
+                    assert desc["status"]["status"] == "DEPLOYED"
+                    assert desc["agents"][0]["type"] == "identity"
+                    assert "input-topic" in desc["topics"]
+                # list
+                async with session.get(f"{server.url}/api/applications/default") as resp:
+                    apps = await resp.json()
+                    assert [a["application-id"] for a in apps] == ["app1"]
+                # the app actually runs: produce/consume through the runtime
+                runner = runtime.get_runner("default", "app1")
+                await runner.produce("input-topic", "ping")
+                out = await runner.consume("output-topic", n=1, timeout=10)
+                assert out[0].value == "ping"
+                # logs
+                async with session.get(
+                    f"{server.url}/api/applications/default/app1/logs"
+                ) as resp:
+                    assert "identity" in await resp.text()
+                # delete
+                async with session.delete(
+                    f"{server.url}/api/applications/default/app1"
+                ) as resp:
+                    assert resp.status == 200
+                async with session.get(
+                    f"{server.url}/api/applications/default/app1"
+                ) as resp:
+                    assert resp.status == 404
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
+
+
+def test_update_redeploys(run):
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, _ = await deploy_app(session, server)
+                assert status == 200
+                # update with a changed pipeline
+                form = aiohttp.FormData()
+                changed = PIPELINE.replace("- name: echo", "- name: echo2", 1)
+                form.add_field("app", make_zip({"pipeline.yaml": changed}))
+                form.add_field("instance", INSTANCE)
+                async with session.patch(
+                    f"{server.url}/api/applications/default/app1", data=form
+                ) as resp:
+                    assert resp.status == 200
+                async with session.get(
+                    f"{server.url}/api/applications/default/app1"
+                ) as resp:
+                    desc = await resp.json()
+                    assert desc["agents"][0]["id"] == "echo2"
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
+
+
+def test_update_without_instance_keeps_stored_one(run):
+    """PATCH that omits instance/secrets must reuse the stored documents."""
+
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, _ = await deploy_app(session, server)
+                assert status == 200
+                form = aiohttp.FormData()
+                form.add_field("app", make_zip({"pipeline.yaml": PIPELINE}))
+                # no instance field on the update
+                async with session.patch(
+                    f"{server.url}/api/applications/default/app1", data=form
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                # the app still runs on the stored memory streaming cluster
+                runner = runtime.get_runner("default", "app1")
+                await runner.produce("input-topic", "still-works")
+                out = await runner.consume("output-topic", n=1, timeout=10)
+                assert out[0].value == "still-works"
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
+
+
+def test_invalid_app_rejected(run):
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                form = aiohttp.FormData()
+                form.add_field("app", make_zip({"pipeline.yaml": "pipeline: [{type: nope}]"}))
+                form.add_field("instance", INSTANCE)
+                async with session.post(
+                    f"{server.url}/api/applications/default/bad", data=form
+                ) as resp:
+                    assert resp.status == 400
+                # unknown tenant → 404
+                status, _ = await deploy_app(session, server, tenant="ghost")
+                assert status == 404
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
+
+
+def test_tenants_crud(run):
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.put(f"{server.url}/api/tenants/acme") as resp:
+                    assert resp.status == 200
+                async with session.get(f"{server.url}/api/tenants") as resp:
+                    tenants = await resp.json()
+                    assert "acme" in tenants and "default" in tenants
+                status, _ = await deploy_app(session, server, tenant="acme")
+                assert status == 200
+                async with session.delete(f"{server.url}/api/tenants/acme") as resp:
+                    assert resp.status == 200
+                async with session.get(f"{server.url}/api/tenants/acme") as resp:
+                    assert resp.status == 404
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
+
+
+def test_auth_token(run):
+    async def scenario():
+        server, runtime = await start_control_plane(auth_token="sekrit")
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{server.url}/api/tenants") as resp:
+                    assert resp.status == 401
+                async with session.get(
+                    f"{server.url}/api/tenants",
+                    headers={"Authorization": "Bearer sekrit"},
+                ) as resp:
+                    assert resp.status == 200
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
+
+
+def test_disk_store_persistence(run, tmp_path):
+    async def scenario():
+        root = str(tmp_path / "cp")
+        server, runtime = await start_control_plane(root=root)
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, body = await deploy_app(session, server)
+                assert status == 200
+                assert body["code-archive-id"]
+                # code archive download round-trips
+                async with session.get(
+                    f"{server.url}/api/applications/default/app1/code"
+                ) as resp:
+                    assert resp.status == 200
+                    data = await resp.read()
+                    zf = zipfile.ZipFile(io.BytesIO(data))
+                    assert "pipeline.yaml" in zf.namelist()
+        finally:
+            await runtime.close()
+            await server.stop()
+
+        # a NEW control plane over the same root sees the app (persistence)
+        server2, runtime2 = await start_control_plane(root=root)
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"{server2.url}/api/applications/default/app1"
+                ) as resp:
+                    assert resp.status == 200
+                    desc = await resp.json()
+                    assert desc["agents"][0]["type"] == "identity"
+        finally:
+            await runtime2.close()
+            await server2.stop()
+
+    run(scenario())
+
+
+def test_archetypes(run, tmp_path):
+    async def scenario():
+        arch_root = tmp_path / "archetypes" / "echo-arch"
+        (arch_root / "application").mkdir(parents=True)
+        (arch_root / "archetype.yaml").write_text(
+            "archetype:\n  title: Echo\n  description: echo pipeline\n"
+        )
+        (arch_root / "application" / "pipeline.yaml").write_text(PIPELINE)
+        (arch_root / "instance.yaml").write_text(INSTANCE)
+
+        from langstream_tpu.webservice.server import ControlPlaneServer
+        from langstream_tpu.webservice.service import make_local_service
+
+        applications, tenants, runtime = make_local_service(None)
+        server = ControlPlaneServer(
+            applications,
+            tenants,
+            port=0,
+            archetypes_path=str(tmp_path / "archetypes"),
+        )
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{server.url}/api/archetypes/default") as resp:
+                    archetypes = await resp.json()
+                    assert archetypes[0]["id"] == "echo-arch"
+                    assert archetypes[0]["title"] == "Echo"
+                async with session.post(
+                    f"{server.url}/api/archetypes/default/echo-arch/applications/from-arch",
+                    data=json.dumps({"some-param": "x"}),
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                async with session.get(
+                    f"{server.url}/api/applications/default/from-arch"
+                ) as resp:
+                    assert resp.status == 200
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
