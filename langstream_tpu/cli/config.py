@@ -40,9 +40,15 @@ def load_config() -> CliConfig:
     path = config_path()
     if not path.exists():
         return CliConfig()
-    data = json.loads(path.read_text())
+    try:
+        data = json.loads(path.read_text())
+    except (json.JSONDecodeError, OSError):
+        return CliConfig()
+    known = {f for f in Profile.__dataclass_fields__}
     profiles = {
-        name: Profile(**p) for name, p in data.get("profiles", {}).items()
+        name: Profile(**{k: v for k, v in p.items() if k in known})
+        for name, p in data.get("profiles", {}).items()
+        if isinstance(p, dict)
     }
     if not profiles:
         profiles = {"default": Profile()}

@@ -1,0 +1,29 @@
+"""Kubernetes deployer/operator (L8).
+
+Parity: reference ``langstream-k8s-deployer`` — CRDs
+(``applications.langstream.ai`` / ``agents.langstream.ai``,
+deployer-api/AgentSpec.java:33), JOSDK reconcilers (AppController.java:54,
+AgentController.java:58), resource factories (AgentResourcesFactory.java:91-591,
+AppResourcesFactory.java) — plus the TPU-native extension: agent pods request
+``google.com/tpu`` chips and GKE TPU node-pool selectors derived from the
+agent's ``resources.tpu`` spec (the slot called out in SURVEY §2.11).
+
+No real cluster is required: controllers run against any object implementing
+the small ``KubeApi`` protocol; ``FakeKubeServer`` (the KubeTestServer
+analogue) backs tests and local mode.
+"""
+
+from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+from langstream_tpu.k8s.fake import FakeKubeServer
+from langstream_tpu.k8s.resources import AgentResourcesFactory, AppResourcesFactory
+from langstream_tpu.k8s.controllers import AgentController, AppController
+
+__all__ = [
+    "AgentController",
+    "AgentCustomResource",
+    "AgentResourcesFactory",
+    "AppController",
+    "AppResourcesFactory",
+    "ApplicationCustomResource",
+    "FakeKubeServer",
+]

@@ -1,0 +1,269 @@
+"""Reconcilers (reference JOSDK controllers).
+
+``AppController`` (AppController.java:54,92-245): two-phase reconcile —
+**setup job** (assets) then **deployer job** (planner writes Agent CRs);
+inverse order on delete.  Job *execution* is pluggable: on a real cluster
+the Jobs run in pods; in local/fake mode ``InProcessJobExecutor`` performs
+the same work inline (the runtime-tester topology).
+
+``AgentController`` (AgentController.java:58,116-213): per-Agent dependents —
+config Secret + headless Service + StatefulSet — applied only when the
+generated spec differs (SpecDiffer), with pod→agent status aggregation.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Protocol
+
+from langstream_tpu.k8s.crds import (
+    AgentCustomResource,
+    ApplicationCustomResource,
+    config_checksum,
+)
+from langstream_tpu.k8s.differ import specs_equal
+from langstream_tpu.k8s.fake import FakeKubeServer
+from langstream_tpu.k8s.resources import AgentResourcesFactory, AppResourcesFactory
+
+log = logging.getLogger(__name__)
+
+
+class JobExecutor(Protocol):
+    """Runs the work a reconciler Job would run in-cluster."""
+
+    def run_setup(self, app: ApplicationCustomResource) -> None: ...
+
+    def run_deployer(self, app: ApplicationCustomResource) -> None: ...
+
+    def run_cleanup(self, app: ApplicationCustomResource) -> None: ...
+
+
+class InProcessJobExecutor:
+    """Executes setup/deployer inline against the kube store: parses the app
+    from the CR's package files, builds the execution plan, and writes one
+    Agent CR per physical agent (the deployer job's work,
+    KubernetesClusterRuntime.deploy:93)."""
+
+    def __init__(self, kube: FakeKubeServer) -> None:
+        self.kube = kube
+
+    def _build_plan(self, app: ApplicationCustomResource):
+        from langstream_tpu.core.parser import ModelBuilder
+        from langstream_tpu.core.planner import ClusterRuntime
+        from langstream_tpu.core.resolver import resolve_placeholders
+
+        pkg = ModelBuilder.build_application_from_files(
+            {k: v for k, v in app.package_files.items() if k.endswith((".yaml", ".yml"))},
+            app.instance_text,
+            self._secrets_text(app),
+        )
+        resolved = resolve_placeholders(pkg.application)
+        return ClusterRuntime().build_execution_plan(app.name, resolved)
+
+    def _secrets_text(self, app: ApplicationCustomResource) -> Optional[str]:
+        if not app.secrets_ref:
+            return None
+        secret = self.kube.get("Secret", app.namespace, app.secrets_ref)
+        if secret is None:
+            return None
+        return secret.get("stringData", {}).get("secrets")
+
+    def run_setup(self, app: ApplicationCustomResource) -> None:
+        # assets are provisioned by the agent runtime's asset managers in
+        # local mode; the in-process setup validates they are declarable
+        self._build_plan(app)
+
+    def run_deployer(self, app: ApplicationCustomResource) -> None:
+        plan = self._build_plan(app)
+        desired: set[str] = set()
+        for node in plan.agent_sequence():
+            name = f"{app.name}-{node.id}".lower().replace("_", "-")
+            desired.add(name)
+            tpu = None
+            if node.resources.tpu is not None:
+                spec = node.resources.tpu
+                tpu = {
+                    "type": spec.type,
+                    "topology": spec.topology,
+                    "chips": spec.chips,
+                    "mesh": dict(spec.mesh),
+                }
+            agent = AgentCustomResource(
+                name=name,
+                namespace=app.namespace,
+                tenant=app.tenant,
+                agent_id=node.id,
+                application_id=app.name,
+                agent_type=node.agent_type,
+                component_type=node.component_type,
+                config_secret_ref=f"{name}-config",
+                config_checksum=config_checksum(node.configuration),
+                code_archive_id=app.code_archive_id,
+                parallelism=node.resources.resolved_parallelism(),
+                size=node.resources.resolved_size(),
+                disk={"enabled": True, **({} if node.disk is True else {})}
+                if node.disk
+                else None,
+                tpu=tpu,
+            )
+            self.kube.apply(agent.to_manifest())
+        # prune agents removed by an update (reference deployer delete path)
+        for manifest in self.kube.list(AgentCustomResource.KIND, app.namespace):
+            if (
+                manifest["spec"].get("applicationId") == app.name
+                and manifest["metadata"]["name"] not in desired
+            ):
+                self.kube.delete(
+                    AgentCustomResource.KIND,
+                    app.namespace,
+                    manifest["metadata"]["name"],
+                )
+
+    def run_cleanup(self, app: ApplicationCustomResource) -> None:
+        for manifest in self.kube.list(AgentCustomResource.KIND, app.namespace):
+            if manifest["spec"].get("applicationId") == app.name:
+                self.kube.delete(
+                    AgentCustomResource.KIND,
+                    app.namespace,
+                    manifest["metadata"]["name"],
+                )
+
+
+class AppController:
+    """Two-phase application reconciler."""
+
+    def __init__(
+        self,
+        kube: FakeKubeServer,
+        executor: JobExecutor,
+        factory: Optional[AppResourcesFactory] = None,
+    ) -> None:
+        self.kube = kube
+        self.executor = executor
+        self.factory = factory or AppResourcesFactory()
+
+    def reconcile(self, app_manifest: dict[str, Any]) -> dict[str, Any]:
+        app = ApplicationCustomResource.from_manifest(app_manifest)
+        status = dict(app.status)
+        generation = str(app.generation)
+
+        # phase 1: setup job (assets) — rerun when the generation moved
+        if status.get("setupFor") != generation:
+            job = self.factory.generate_setup_job(app)
+            self.kube.apply(job)
+            try:
+                self.executor.run_setup(app)
+            except Exception as e:  # noqa: BLE001
+                status.update({"phase": "ERROR_SETUP", "reason": str(e)})
+                self.kube.patch_status(app.KIND, app.namespace, app.name, status)
+                return status
+            status["setupFor"] = generation
+
+        # phase 2: deployer job (planner → Agent CRs)
+        if status.get("deployedFor") != generation:
+            job = self.factory.generate_deployer_job(app)
+            self.kube.apply(job)
+            try:
+                self.executor.run_deployer(app)
+            except Exception as e:  # noqa: BLE001
+                status.update({"phase": "ERROR_DEPLOY", "reason": str(e)})
+                self.kube.patch_status(app.KIND, app.namespace, app.name, status)
+                return status
+            status["deployedFor"] = generation
+
+        status["phase"] = "DEPLOYED"
+        status.pop("reason", None)
+        self.kube.patch_status(app.KIND, app.namespace, app.name, status)
+        return status
+
+    def cleanup(self, app_manifest: dict[str, Any]) -> None:
+        """Inverse-order delete (reference AppController delete flow)."""
+        app = ApplicationCustomResource.from_manifest(app_manifest)
+        self.executor.run_cleanup(app)
+        for phase in ("deployer", "setup"):
+            self.kube.delete("Job", app.namespace, self.factory.job_name(app, phase))
+        self.kube.delete(app.KIND, app.namespace, app.name)
+
+
+class AgentController:
+    """Agent CR → Secret + headless Service + StatefulSet dependents."""
+
+    def __init__(
+        self,
+        kube: FakeKubeServer,
+        factory: Optional[AgentResourcesFactory] = None,
+    ) -> None:
+        self.kube = kube
+        self.factory = factory or AgentResourcesFactory()
+
+    def reconcile(self, agent_manifest: dict[str, Any]) -> dict[str, Any]:
+        agent = AgentCustomResource.from_manifest(agent_manifest)
+
+        secret = self.factory.generate_config_secret(
+            agent,
+            runtime_pod_configuration={
+                "agentId": agent.agent_id,
+                "applicationId": agent.application_id,
+                "agentType": agent.agent_type,
+                "configChecksum": agent.config_checksum,
+            },
+        )
+        self._apply_if_changed(secret)
+        self._apply_if_changed(self.factory.generate_headless_service(agent))
+        statefulset = self.factory.generate_stateful_set(agent)
+        self._apply_if_changed(statefulset)
+
+        status = self._aggregate_status(agent)
+        self.kube.patch_status(agent.KIND, agent.namespace, agent.name, status)
+        return status
+
+    def _apply_if_changed(self, manifest: dict[str, Any]) -> bool:
+        existing = self.kube.get(
+            manifest["kind"],
+            manifest["metadata"].get("namespace", "default"),
+            manifest["metadata"]["name"],
+        )
+        if existing is not None and specs_equal(existing, manifest):
+            return False
+        self.kube.apply(manifest)
+        return True
+
+    def _aggregate_status(self, agent: AgentCustomResource) -> dict[str, Any]:
+        sts = self.kube.get("StatefulSet", agent.namespace, agent.name)
+        if sts is None:
+            return {"phase": "DEPLOYING", "replicas": 0, "readyReplicas": 0}
+        sts_status = sts.get("status", {})
+        ready = int(sts_status.get("readyReplicas", 0))
+        want = int(sts["spec"].get("replicas", 1))
+        phase = "DEPLOYED" if ready >= want else "DEPLOYING"
+        return {"phase": phase, "replicas": want, "readyReplicas": ready}
+
+    def cleanup(self, agent_manifest: dict[str, Any]) -> None:
+        agent = AgentCustomResource.from_manifest(agent_manifest)
+        self.kube.delete("StatefulSet", agent.namespace, agent.name)
+        self.kube.delete("Service", agent.namespace, agent.name)
+        self.kube.delete("Secret", agent.namespace, agent.config_secret_ref)
+
+
+class Operator:
+    """Watch-loop glue: hooks the fake API server's apply events to the
+    controllers, so writing an Application CR reconciles everything the way
+    the JOSDK operator does on a real cluster."""
+
+    def __init__(self, kube: FakeKubeServer, executor: Optional[JobExecutor] = None) -> None:
+        self.kube = kube
+        self.app_controller = AppController(kube, executor or InProcessJobExecutor(kube))
+        self.agent_controller = AgentController(kube)
+        kube.on_apply(self._on_apply)
+
+    def _on_apply(self, manifest: dict[str, Any]) -> None:
+        kind = manifest.get("kind")
+        try:
+            if kind == ApplicationCustomResource.KIND:
+                self.app_controller.reconcile(manifest)
+            elif kind == AgentCustomResource.KIND:
+                self.agent_controller.reconcile(manifest)
+        except RecursionError:
+            raise
+        except Exception:  # noqa: BLE001 — operator keeps reconciling others
+            log.exception("reconcile failed for %s", kind)

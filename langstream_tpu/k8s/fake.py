@@ -1,0 +1,80 @@
+"""In-memory Kubernetes API store (reference KubeTestServer — the fabric8
+mock server reused by operator and deployer tests, SURVEY §4 tier 3).
+
+Objects are plain manifest dicts keyed by (kind, namespace, name).  The
+store implements the minimal verbs the controllers need (get / list /
+apply / delete) plus a watch-less "resourceVersion" bump so SpecDiffer
+tests can detect writes.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Callable, Optional
+
+
+class FakeKubeServer:
+    def __init__(self) -> None:
+        self._objects: dict[tuple[str, str, str], dict[str, Any]] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+        # hooks: kind → callback(manifest) invoked after every apply
+        self._on_apply: list[Callable[[dict[str, Any]], None]] = []
+
+    # -- verbs ---------------------------------------------------------------
+
+    def apply(self, manifest: dict[str, Any]) -> dict[str, Any]:
+        kind = manifest.get("kind", "")
+        meta = manifest.setdefault("metadata", {})
+        namespace = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        if not kind or not name:
+            raise ValueError("manifest requires kind and metadata.name")
+        with self._lock:
+            self._version += 1
+            key = (kind, namespace, name)
+            existing = self._objects.get(key)
+            stored = copy.deepcopy(manifest)
+            stored["metadata"]["resourceVersion"] = str(self._version)
+            if existing is not None and existing.get("spec") != manifest.get("spec"):
+                stored["metadata"]["generation"] = (
+                    int(existing.get("metadata", {}).get("generation", 1)) + 1
+                )
+            self._objects[key] = stored
+            out = copy.deepcopy(stored)
+        for hook in self._on_apply:
+            hook(out)
+        return out
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> list[dict[str, Any]]:
+        with self._lock:
+            return [
+                copy.deepcopy(obj)
+                for (k, ns, _), obj in sorted(self._objects.items())
+                if k == kind and (namespace is None or ns == namespace)
+            ]
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        with self._lock:
+            return self._objects.pop((kind, namespace, name), None) is not None
+
+    def patch_status(
+        self, kind: str, namespace: str, name: str, status: dict[str, Any]
+    ) -> Optional[dict[str, Any]]:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                return None
+            self._version += 1
+            obj["status"] = copy.deepcopy(status)
+            obj["metadata"]["resourceVersion"] = str(self._version)
+            return copy.deepcopy(obj)
+
+    def on_apply(self, hook: Callable[[dict[str, Any]], None]) -> None:
+        self._on_apply.append(hook)

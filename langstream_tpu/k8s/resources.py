@@ -1,0 +1,380 @@
+"""Manifest factories (reference AgentResourcesFactory.java:91-591,
+AppResourcesFactory.java).
+
+The TPU-native extension: an agent whose ``resources.tpu`` is set gets
+``google.com/tpu`` container resources and GKE TPU node-pool selectors
+(``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``) so the
+scheduler lands each replica on its own TPU slice — the planner slot called
+out in SURVEY §2.11 ("AgentResourcesFactory is where GKE TPU node pools get
+injected", §3.1).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+
+# GKE accelerator names per TPU generation (public GKE node-pool labels)
+TPU_ACCELERATORS = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+# single-host chip-count → physical topology for v5e/v6e-style 2D slices
+_DEFAULT_TOPOLOGY = {1: "1x1", 4: "2x2", 8: "2x4"}
+
+
+@dataclass
+class AgentResourceUnitConfiguration:
+    """Per-unit sizing defaults (reference AgentResourceUnitConfiguration:
+    cpuPerUnit=0.5, memPerUnit=512MB; max units per reference limits)."""
+
+    cpu_per_unit: float = 0.5
+    mem_per_unit_mb: int = 512
+    max_units: int = 8
+    storage_class: str = "default"
+    runtime_image: str = "langstream-tpu/runtime:latest"
+    image_pull_policy: str = "IfNotPresent"
+
+
+class AgentResourcesFactory:
+    """AgentCustomResource → StatefulSet + headless Service + config Secret."""
+
+    def __init__(
+        self, config: Optional[AgentResourceUnitConfiguration] = None
+    ) -> None:
+        self.config = config or AgentResourceUnitConfiguration()
+
+    # -- naming --------------------------------------------------------------
+
+    @staticmethod
+    def statefulset_name(agent: AgentCustomResource) -> str:
+        return agent.name
+
+    @staticmethod
+    def labels(agent: AgentCustomResource) -> dict[str, str]:
+        return {
+            "app": "langstream-tpu-runtime",
+            "langstream.tpu/tenant": agent.tenant,
+            "langstream.tpu/application": agent.application_id,
+            "langstream.tpu/agent": agent.agent_id,
+        }
+
+    # -- tpu scheduling ------------------------------------------------------
+
+    @staticmethod
+    def tpu_scheduling(tpu: dict[str, Any]) -> tuple[dict[str, str], dict[str, str]]:
+        """(node_selector, container_resources) for one TPU slice per replica."""
+        gen = str(tpu.get("type", "v5e")).lower()
+        accelerator = TPU_ACCELERATORS.get(gen, TPU_ACCELERATORS["v5e"])
+        chips = int(tpu.get("chips", 1))
+        topology = str(tpu.get("topology", "")).strip()
+        if "x" not in topology:
+            topology = _DEFAULT_TOPOLOGY.get(chips, f"{chips}x1")
+        node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": accelerator,
+            "cloud.google.com/gke-tpu-topology": topology,
+        }
+        resources = {"google.com/tpu": str(chips)}
+        return node_selector, resources
+
+    # -- manifests -----------------------------------------------------------
+
+    def generate_config_secret(
+        self, agent: AgentCustomResource, runtime_pod_configuration: dict[str, Any]
+    ) -> dict[str, Any]:
+        """The agent Secret carrying RuntimePodConfiguration
+        (reference AgentResourcesFactory.generateAgentSecret:501-521)."""
+        return {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {
+                "name": agent.config_secret_ref,
+                "namespace": agent.namespace,
+                "labels": self.labels(agent),
+            },
+            "stringData": {
+                "pod-configuration": json.dumps(runtime_pod_configuration),
+            },
+        }
+
+    def generate_headless_service(self, agent: AgentCustomResource) -> dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": agent.name,
+                "namespace": agent.namespace,
+                "labels": self.labels(agent),
+            },
+            "spec": {
+                "clusterIP": "None",
+                "selector": self.labels(agent),
+                "ports": [
+                    {"name": "http", "port": 8080},  # /metrics + /info
+                    {"name": "service", "port": 8000},  # service agents
+                ],
+            },
+        }
+
+    def generate_stateful_set(self, agent: AgentCustomResource) -> dict[str, Any]:
+        size = min(agent.size, self.config.max_units)
+        cpu = self.config.cpu_per_unit * size
+        mem_mb = self.config.mem_per_unit_mb * size
+        resources: dict[str, Any] = {
+            "requests": {"cpu": str(cpu), "memory": f"{mem_mb}M"},
+            "limits": {"memory": f"{mem_mb}M"},
+        }
+        node_selector: dict[str, str] = {}
+        if agent.tpu:
+            node_selector, tpu_resources = self.tpu_scheduling(agent.tpu)
+            resources["limits"] = {**resources["limits"], **tpu_resources}
+            resources["requests"] = {**resources["requests"], **tpu_resources}
+
+        volumes = [
+            {"name": "app-code", "emptyDir": {}},
+            {
+                "name": "pod-config",
+                "secret": {"secretName": agent.config_secret_ref},
+            },
+        ]
+        volume_mounts = [
+            {"name": "app-code", "mountPath": "/app-code-download"},
+            {"name": "pod-config", "mountPath": "/app-config", "readOnly": True},
+        ]
+        init_containers = [
+            {
+                # reference init container pair: code-download-init writes the
+                # downloader config, code-download pulls the archive
+                "name": "code-download",
+                "image": self.config.runtime_image,
+                "imagePullPolicy": self.config.image_pull_policy,
+                "command": ["langstream-tpu-runtime", "agent-code-download"],
+                "env": [
+                    {"name": "CODE_ARCHIVE_ID", "value": agent.code_archive_id or ""},
+                    {"name": "TENANT", "value": agent.tenant},
+                    {"name": "APPLICATION_ID", "value": agent.application_id},
+                ],
+                "volumeMounts": list(volume_mounts),
+            }
+        ]
+        container = {
+            "name": "runtime",
+            "image": self.config.runtime_image,
+            "imagePullPolicy": self.config.image_pull_policy,
+            "command": ["langstream-tpu-runtime", "agent-runtime"],
+            "env": [
+                {"name": "POD_CONFIGURATION", "value": "/app-config/pod-configuration"},
+                {"name": "AGENT_ID", "value": agent.agent_id},
+            ],
+            "ports": [{"containerPort": 8080, "name": "http"}],
+            "resources": resources,
+            "volumeMounts": list(volume_mounts),
+            "livenessProbe": {
+                "httpGet": {"path": "/info", "port": 8080},
+                "initialDelaySeconds": 10,
+                "periodSeconds": 30,
+            },
+        }
+        pod_spec: dict[str, Any] = {
+            "serviceAccountName": f"langstream-agent-{agent.tenant}",
+            "terminationGracePeriodSeconds": 60,
+            "initContainers": init_containers,
+            "containers": [container],
+            "volumes": volumes,
+            # spread replicas across nodes (reference :591 anti-affinity)
+            "affinity": {
+                "podAntiAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": 100,
+                            "podAffinityTerm": {
+                                "labelSelector": {"matchLabels": self.labels(agent)},
+                                "topologyKey": "kubernetes.io/hostname",
+                            },
+                        }
+                    ]
+                }
+            },
+        }
+        if node_selector:
+            pod_spec["nodeSelector"] = node_selector
+
+        manifest: dict[str, Any] = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": self.statefulset_name(agent),
+                "namespace": agent.namespace,
+                "labels": self.labels(agent),
+                "annotations": {
+                    # rollout trigger: a changed config checksum restarts pods
+                    # (reference configSecretRefChecksum semantics)
+                    "langstream.tpu/config-checksum": agent.config_checksum,
+                },
+            },
+            "spec": {
+                # replicas = parallelism (reference :295,:526-556): broker
+                # consumer-group data parallelism; each replica still owns a
+                # full TPU slice (shard parallelism lives INSIDE a replica)
+                "replicas": agent.parallelism,
+                "podManagementPolicy": "Parallel",
+                "serviceName": agent.name,
+                "selector": {"matchLabels": self.labels(agent)},
+                "template": {
+                    "metadata": {
+                        "labels": self.labels(agent),
+                        "annotations": {
+                            "langstream.tpu/config-checksum": agent.config_checksum,
+                        },
+                    },
+                    "spec": pod_spec,
+                },
+            },
+        }
+        if agent.disk and agent.disk.get("enabled"):
+            manifest["spec"]["volumeClaimTemplates"] = [
+                {
+                    "metadata": {"name": "state"},
+                    "spec": {
+                        "accessModes": ["ReadWriteOnce"],
+                        "storageClassName": (
+                            None
+                            if agent.disk.get("type", "default") == "default"
+                            else agent.disk.get("type")
+                        ),
+                        "resources": {
+                            "requests": {"storage": agent.disk.get("size", "256M")}
+                        },
+                    },
+                }
+            ]
+            container["volumeMounts"] = container["volumeMounts"] + [
+                {"name": "state", "mountPath": "/persistent-state"}
+            ]
+        return manifest
+
+    @staticmethod
+    def aggregate_agents_status(
+        agent_manifests: list[dict[str, Any]]
+    ) -> dict[str, Any]:
+        """Roll per-agent statuses up to the application
+        (reference aggregateAgentsStatus:628)."""
+        agents = {}
+        worst = "DEPLOYED"
+        for m in agent_manifests:
+            status = m.get("status", {})
+            phase = status.get("phase", "UNKNOWN")
+            agents[m.get("spec", {}).get("agentId", m["metadata"]["name"])] = status
+            if phase in ("ERROR",):
+                worst = "ERROR"
+            elif phase in ("DEPLOYING", "UNKNOWN") and worst != "ERROR":
+                worst = "DEPLOYING"
+        return {"phase": worst, "agents": agents}
+
+
+class AppResourcesFactory:
+    """Application CR → setup Job + deployer Job + RBAC
+    (reference AppResourcesFactory.java:590)."""
+
+    def __init__(
+        self, config: Optional[AgentResourceUnitConfiguration] = None
+    ) -> None:
+        self.config = config or AgentResourceUnitConfiguration()
+
+    @staticmethod
+    def job_name(app: ApplicationCustomResource, phase: str) -> str:
+        return f"langstream-runtime-{phase}-{app.name}"
+
+    def _job(
+        self, app: ApplicationCustomResource, phase: str, command: str
+    ) -> dict[str, Any]:
+        return {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {
+                "name": self.job_name(app, phase),
+                "namespace": app.namespace,
+                "labels": {
+                    "app": "langstream-tpu",
+                    "langstream.tpu/application": app.name,
+                    "langstream.tpu/phase": phase,
+                },
+                "annotations": {
+                    "langstream.tpu/application-generation": str(app.generation),
+                },
+            },
+            "spec": {
+                "backoffLimit": 6,
+                "template": {
+                    "metadata": {"labels": {"langstream.tpu/application": app.name}},
+                    "spec": {
+                        "serviceAccountName": f"langstream-deployer-{app.tenant}",
+                        "restartPolicy": "OnFailure",
+                        "containers": [
+                            {
+                                "name": phase,
+                                "image": self.config.runtime_image,
+                                "imagePullPolicy": self.config.image_pull_policy,
+                                "command": ["langstream-tpu-runtime", command],
+                                "env": [
+                                    {"name": "APPLICATION_ID", "value": app.name},
+                                    {"name": "TENANT", "value": app.tenant},
+                                ],
+                            }
+                        ],
+                    },
+                },
+            },
+        }
+
+    def generate_setup_job(self, app: ApplicationCustomResource) -> dict[str, Any]:
+        """Asset-provisioning job (reference Main application-setup)."""
+        return self._job(app, "setup", "application-setup")
+
+    def generate_deployer_job(self, app: ApplicationCustomResource) -> dict[str, Any]:
+        """Planner job writing Agent CRs (reference Main deployer-runtime)."""
+        return self._job(app, "deployer", "deployer-runtime")
+
+    def generate_rbac(self, tenant: str, namespace: str) -> list[dict[str, Any]]:
+        service_account = {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": {"name": f"langstream-deployer-{tenant}", "namespace": namespace},
+        }
+        role = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "Role",
+            "metadata": {"name": f"langstream-deployer-{tenant}", "namespace": namespace},
+            "rules": [
+                {
+                    "apiGroups": ["langstream.tpu"],
+                    "resources": ["agents", "applications"],
+                    "verbs": ["*"],
+                },
+                {"apiGroups": [""], "resources": ["secrets"], "verbs": ["*"]},
+            ],
+        }
+        binding = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {"name": f"langstream-deployer-{tenant}", "namespace": namespace},
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": f"langstream-deployer-{tenant}",
+                    "namespace": namespace,
+                }
+            ],
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "Role",
+                "name": f"langstream-deployer-{tenant}",
+            },
+        }
+        return [service_account, role, binding]

@@ -279,9 +279,15 @@ class ControlPlaneServer:
                     zf.write(p, str(p.relative_to(app_dir)))
         instance_file = path / "instance.yaml"
         instance_data = (
-            yaml.safe_load(instance_file.read_text()) if instance_file.exists() else {"instance": {}}
+            yaml.safe_load(instance_file.read_text()) if instance_file.exists() else None
         )
-        instance_data.setdefault("instance", {}).setdefault("globals", {}).update(parameters)
+        if not isinstance(instance_data, dict):
+            instance_data = {}
+        if not isinstance(instance_data.get("instance"), dict):
+            instance_data["instance"] = {}
+        if not isinstance(instance_data["instance"].get("globals"), dict):
+            instance_data["instance"]["globals"] = {}
+        instance_data["instance"]["globals"].update(parameters)
         result = await self.applications.deploy(
             tenant,
             name,

@@ -155,7 +155,7 @@ class ApplicationService:
                 raise ApplicationServiceError(
                     f"application {application_id} already exists", status=409
                 )
-            if existing is None and allow_update and archive_bytes is None:
+            if existing is None and allow_update:
                 raise ApplicationServiceError(
                     f"application {application_id} not found", status=404
                 )

@@ -34,6 +34,7 @@ class InMemoryApplicationStore(ApplicationStore):
         self._apps: dict[tuple[str, str], StoredApplication] = {}
         self._secrets: dict[tuple[str, str], Secrets] = {}
         self._raw: dict[tuple[str, str], tuple[Optional[str], Optional[str]]] = {}
+        self._files: dict[tuple[str, str], dict[str, str]] = {}
 
     def put_package(
         self,
@@ -49,6 +50,7 @@ class InMemoryApplicationStore(ApplicationStore):
         )
         self.put(tenant, application_id, pkg.application, code_archive_id)
         self._raw[(tenant, application_id)] = (instance_text, secrets_text)
+        self._files[(tenant, application_id)] = dict(package_files)
         stored = self.get(tenant, application_id)
         assert stored is not None
         return stored
@@ -59,6 +61,9 @@ class InMemoryApplicationStore(ApplicationStore):
         """(instance_text, secrets_text) as last deployed — updates that omit
         them must fall back to these rather than dropping the environment."""
         return self._raw.get((tenant, application_id), (None, None))
+
+    def get_package_files(self, tenant: str, application_id: str) -> dict[str, str]:
+        return dict(self._files.get((tenant, application_id), {}))
 
     def put(
         self,
@@ -81,6 +86,7 @@ class InMemoryApplicationStore(ApplicationStore):
         self._apps.pop((tenant, application_id), None)
         self._secrets.pop((tenant, application_id), None)
         self._raw.pop((tenant, application_id), None)
+        self._files.pop((tenant, application_id), None)
 
     def list(self, tenant: str) -> dict[str, StoredApplication]:
         return {
@@ -163,6 +169,16 @@ class LocalDiskApplicationStore(ApplicationStore):
             secrets_file.read_text() if secrets_file.exists() else None,
         )
 
+    def get_package_files(self, tenant: str, application_id: str) -> dict[str, str]:
+        pkg_dir = self._dir(tenant, application_id) / "package"
+        if not pkg_dir.is_dir():
+            return {}
+        return {
+            str(p.relative_to(pkg_dir)): p.read_text()
+            for p in sorted(pkg_dir.rglob("*"))
+            if p.is_file()
+        }
+
     def get(self, tenant: str, application_id: str) -> Optional[StoredApplication]:
         app_dir = self._dir(tenant, application_id)
         pkg_dir = app_dir / "package"
@@ -201,15 +217,23 @@ class LocalDiskApplicationStore(ApplicationStore):
             shutil.rmtree(app_dir)
 
     def list(self, tenant: str) -> dict[str, StoredApplication]:
+        """Lightweight listing: ids + meta only — no package re-parse (that
+        would be one full ModelBuilder run per app per list call)."""
         tenant_dir = self.root / tenant
         if not tenant_dir.is_dir():
             return {}
         out: dict[str, StoredApplication] = {}
         for child in sorted(tenant_dir.iterdir()):
-            if child.is_dir():
-                stored = self.get(tenant, child.name)
-                if stored is not None:
-                    out[child.name] = stored
+            if not child.is_dir() or not (child / "package").is_dir():
+                continue
+            meta_file = child / "meta.json"
+            meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
+            out[child.name] = StoredApplication(
+                application_id=child.name,
+                application=Application(),
+                code_archive_id=meta.get("code_archive_id"),
+                status=meta.get("status", {}),
+            )
         return out
 
     def get_secrets(self, tenant: str, application_id: str) -> Optional[Secrets]:
