@@ -1,0 +1,14 @@
+"""Out-of-process (polyglot) agent runtime over gRPC (L5).
+
+Parity: reference ``langstream-agent-grpc`` (Java bridge:
+AbstractGrpcAgent.java:54, GrpcAgentProcessor.java:31, PythonGrpcServer.java:
+40-90) + ``langstream-runtime-impl/src/main/python`` (grpc_service.py:75-415).
+Here the host runtime is Python, so in-process agents are the default; this
+module keeps the proto-level isolation contract so user code can run in a
+separate process (crash isolation, own deps) or another language entirely.
+
+Layout: ``proto/agent.proto`` (IDL), ``agent_pb2`` (protoc-generated
+messages; service glue is hand-written in ``service.py`` because the image
+ships no grpc protoc plugin), ``service.py`` (the subprocess server),
+``bridge.py`` (runtime-side agents + process supervisor).
+"""

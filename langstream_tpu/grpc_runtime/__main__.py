@@ -1,0 +1,3 @@
+from langstream_tpu.grpc_runtime.service import main
+
+main()

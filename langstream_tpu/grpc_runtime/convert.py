@@ -1,0 +1,87 @@
+"""Record ↔ proto conversion (reference grpc_service.py to_grpc_record /
+from_grpc_record; structured values travel as JSON instead of Avro)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+from langstream_tpu.api.record import Header, Record, SimpleRecord
+from langstream_tpu.grpc_runtime import agent_pb2 as pb
+
+
+def to_value(obj: Any) -> pb.Value:
+    value = pb.Value()
+    if obj is None:
+        return value  # oneof unset = null
+    if isinstance(obj, bool):  # before int — bool is an int subclass
+        value.bool_value = obj
+    elif isinstance(obj, str):
+        value.string_value = obj
+    elif isinstance(obj, bytes):
+        value.bytes_value = obj
+    elif isinstance(obj, int):
+        value.long_value = obj
+    elif isinstance(obj, float):
+        value.double_value = obj
+    else:
+        value.json_value = json.dumps(obj, default=str)
+    return value
+
+
+def from_value(value: pb.Value) -> Any:
+    kind = value.WhichOneof("kind")
+    if kind is None:
+        return None
+    if kind == "json_value":
+        return json.loads(value.json_value)
+    return getattr(value, kind)
+
+
+def to_grpc_record(record: Record, record_id: int) -> pb.GrpcRecord:
+    return pb.GrpcRecord(
+        record_id=record_id,
+        key=to_value(record.key),
+        value=to_value(record.value),
+        headers=[pb.Header(key=h.key, value=to_value(h.value)) for h in record.headers],
+        origin=record.origin or "",
+        timestamp=record.timestamp or 0.0,
+    )
+
+
+def from_grpc_record(message: pb.GrpcRecord) -> SimpleRecord:
+    return SimpleRecord(
+        value=from_value(message.value),
+        key=from_value(message.key),
+        headers=tuple(Header(h.key, from_value(h.value)) for h in message.headers),
+        origin=message.origin or None,
+        timestamp=message.timestamp or time.time(),
+    )
+
+
+# hand-written method descriptors (no grpc protoc plugin in the image)
+SERVICE_NAME = "langstream_tpu.AgentService"
+
+
+def method(name: str) -> str:
+    return f"/{SERVICE_NAME}/{name}"
+
+
+RPCS: dict[str, tuple[Any, Any, bool, bool]] = {
+    # name → (request type, response type, request streaming, response streaming)
+    "agent_info": (pb.InfoRequest, pb.InfoResponse, False, False),
+    "read": (pb.SourceRequest, pb.SourceResponse, True, True),
+    "process": (pb.ProcessorRequest, pb.ProcessorResponse, True, True),
+    "write": (pb.SinkRequest, pb.SinkResponse, True, True),
+    "get_topic_producer_records": (
+        pb.TopicProducerWriteResult,
+        pb.TopicProducerRecord,
+        True,
+        True,
+    ),
+}
+
+
+def error_text(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}"

@@ -28,6 +28,35 @@ from langstream_tpu.k8s.resources import AgentResourcesFactory, AppResourcesFact
 log = logging.getLogger(__name__)
 
 
+def delete_agent_and_dependents(
+    kube: FakeKubeServer, namespace: str, manifest: dict[str, Any]
+) -> None:
+    """Remove an Agent CR and everything the AgentController materialized for
+    it (StatefulSet, Service, config Secret) — pruning the CR alone would
+    leave the workload running and holding its TPU slice."""
+    name = manifest["metadata"]["name"]
+    secret_ref = manifest.get("spec", {}).get("configSecretRef", f"{name}-config")
+    kube.delete(AgentCustomResource.KIND, namespace, name)
+    kube.delete("StatefulSet", namespace, name)
+    kube.delete("Service", namespace, name)
+    kube.delete("Secret", namespace, secret_ref)
+
+
+def delete_application_resources(
+    kube: FakeKubeServer, namespace: str, application_id: str
+) -> None:
+    """Full teardown of one application: agents + dependents, the setup and
+    deployer Jobs, the app CR, and its secrets Secret. Single implementation
+    shared by the operator cleanup and the control plane's delete path."""
+    for manifest in kube.list(AgentCustomResource.KIND, namespace):
+        if manifest["spec"].get("applicationId") == application_id:
+            delete_agent_and_dependents(kube, namespace, manifest)
+    for phase in ("deployer", "setup"):
+        kube.delete("Job", namespace, f"langstream-runtime-{phase}-{application_id}")
+    kube.delete(ApplicationCustomResource.KIND, namespace, application_id)
+    kube.delete("Secret", namespace, f"{application_id}-secrets")
+
+
 class JobExecutor(Protocol):
     """Runs the work a reconciler Job would run in-cluster."""
 
@@ -101,32 +130,31 @@ class InProcessJobExecutor:
                 code_archive_id=app.code_archive_id,
                 parallelism=node.resources.resolved_parallelism(),
                 size=node.resources.resolved_size(),
-                disk={"enabled": True, **({} if node.disk is True else {})}
+                disk=(
+                {
+                    "enabled": True,
+                    "type": node.resources.disk.type if node.resources.disk else "default",
+                    "size": node.resources.disk.size if node.resources.disk else "256M",
+                }
                 if node.disk
-                else None,
+                else None
+            ),
                 tpu=tpu,
             )
             self.kube.apply(agent.to_manifest())
-        # prune agents removed by an update (reference deployer delete path)
+        # prune agents removed by an update (reference deployer delete path),
+        # including their materialized dependents
         for manifest in self.kube.list(AgentCustomResource.KIND, app.namespace):
             if (
                 manifest["spec"].get("applicationId") == app.name
                 and manifest["metadata"]["name"] not in desired
             ):
-                self.kube.delete(
-                    AgentCustomResource.KIND,
-                    app.namespace,
-                    manifest["metadata"]["name"],
-                )
+                delete_agent_and_dependents(self.kube, app.namespace, manifest)
 
     def run_cleanup(self, app: ApplicationCustomResource) -> None:
         for manifest in self.kube.list(AgentCustomResource.KIND, app.namespace):
             if manifest["spec"].get("applicationId") == app.name:
-                self.kube.delete(
-                    AgentCustomResource.KIND,
-                    app.namespace,
-                    manifest["metadata"]["name"],
-                )
+                delete_agent_and_dependents(self.kube, app.namespace, manifest)
 
 
 class AppController:
@@ -180,9 +208,7 @@ class AppController:
         """Inverse-order delete (reference AppController delete flow)."""
         app = ApplicationCustomResource.from_manifest(app_manifest)
         self.executor.run_cleanup(app)
-        for phase in ("deployer", "setup"):
-            self.kube.delete("Job", app.namespace, self.factory.job_name(app, phase))
-        self.kube.delete(app.KIND, app.namespace, app.name)
+        delete_application_resources(self.kube, app.namespace, app.name)
 
 
 class AgentController:

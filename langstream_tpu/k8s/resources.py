@@ -25,8 +25,18 @@ TPU_ACCELERATORS = {
     "v6e": "tpu-v6e-slice",
 }
 
-# single-host chip-count → physical topology for v5e/v6e-style 2D slices
-_DEFAULT_TOPOLOGY = {1: "1x1", 4: "2x2", 8: "2x4"}
+# chip-count → physical topology for v5e/v6e-style 2D slices (GKE label values)
+_DEFAULT_TOPOLOGY = {
+    1: "1x1",
+    2: "1x2",
+    4: "2x2",
+    8: "2x4",
+    16: "4x4",
+    32: "4x8",
+    64: "8x8",
+    128: "8x16",
+    256: "16x16",
+}
 
 
 @dataclass
@@ -70,10 +80,16 @@ class AgentResourcesFactory:
     @staticmethod
     def tpu_scheduling(tpu: dict[str, Any]) -> tuple[dict[str, str], dict[str, str]]:
         """(node_selector, container_resources) for one TPU slice per replica."""
+        import re
+
         gen = str(tpu.get("type", "v5e")).lower()
         accelerator = TPU_ACCELERATORS.get(gen, TPU_ACCELERATORS["v5e"])
         chips = int(tpu.get("chips", 1))
-        topology = str(tpu.get("topology", "")).strip()
+        # TpuSpec accepts "8", "2x4", or generation-prefixed "v5e-2x4" — the
+        # GKE label value must be the bare NxM form
+        topology = re.sub(
+            r"^[a-z0-9]*?-", "", str(tpu.get("topology", "")).strip().lower()
+        )
         if "x" not in topology:
             topology = _DEFAULT_TOPOLOGY.get(chips, f"{chips}x1")
         node_selector = {

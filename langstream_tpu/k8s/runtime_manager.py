@@ -63,16 +63,11 @@ class KubernetesRuntimeManager:
         self.kube.apply(app_cr.to_manifest())
 
     async def delete_application(self, tenant: str, application_id: str) -> None:
-        namespace = tenant_namespace(tenant)
-        for manifest in self.kube.list(AgentCustomResource.KIND, namespace):
-            if manifest["spec"].get("applicationId") == application_id:
-                name = manifest["metadata"]["name"]
-                self.kube.delete(AgentCustomResource.KIND, namespace, name)
-                self.kube.delete("StatefulSet", namespace, name)
-                self.kube.delete("Service", namespace, name)
-                self.kube.delete("Secret", namespace, f"{name}-config")
-        self.kube.delete(ApplicationCustomResource.KIND, namespace, application_id)
-        self.kube.delete("Secret", namespace, f"{application_id}-secrets")
+        from langstream_tpu.k8s.controllers import delete_application_resources
+
+        delete_application_resources(
+            self.kube, tenant_namespace(tenant), application_id
+        )
 
     def application_status(self, tenant: str, application_id: str) -> dict[str, Any]:
         namespace = tenant_namespace(tenant)

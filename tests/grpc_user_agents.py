@@ -1,0 +1,61 @@
+"""User agent fixtures loaded BY THE SUBPROCESS in grpc tests (via
+pythonPath) — the analogue of the reference's python example agents."""
+
+import os
+from typing import Any
+
+from langstream_tpu.api.agent import AgentSink, AgentSource, SingleRecordProcessor
+from langstream_tpu.api.record import Record, SimpleRecord
+
+
+class Exclaim(SingleRecordProcessor):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.suffix = configuration.get("suffix", "!")
+
+    async def process_record(self, record: Record) -> list[Record]:
+        if record.value == "explode":
+            raise ValueError("asked to explode")
+        return [SimpleRecord.of(f"{record.value}{self.suffix}", key=record.key,
+                                headers=record.headers)]
+
+
+class CrashOnce(SingleRecordProcessor):
+    """Hard-crashes the whole subprocess the first time it sees 'die'
+    (restart-path fixture); marker file makes the crash happen only once."""
+
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.marker = configuration["marker-file"]
+
+    async def process_record(self, record: Record) -> list[Record]:
+        if record.value == "die" and not os.path.exists(self.marker):
+            open(self.marker, "w").close()
+            os._exit(13)
+        return [SimpleRecord.of(f"survived:{record.value}")]
+
+
+class CountSource(AgentSource):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.limit = int(configuration.get("limit", 3))
+        self.sent = 0
+        self.committed: list[Any] = []
+
+    async def read(self) -> list[Record]:
+        if self.sent >= self.limit:
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return []
+        self.sent += 1
+        return [SimpleRecord.of(f"item-{self.sent}")]
+
+    async def commit(self, records: list[Record]) -> None:
+        self.committed.extend(r.value for r in records)
+
+
+class FileSink(AgentSink):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.path = configuration["path"]
+
+    async def write(self, record: Record) -> None:
+        with open(self.path, "a") as f:
+            f.write(f"{record.value}\n")

@@ -1,0 +1,188 @@
+"""gRPC out-of-process agent tests (reference test_grpc_processor/
+test_grpc_source/test_grpc_sink against an in-process server + the
+subprocess bridge path with crash/restart)."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import grpc
+import pytest
+
+from langstream_tpu.api.record import SimpleRecord
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.grpc_runtime import agent_pb2 as pb
+from langstream_tpu.grpc_runtime.convert import from_grpc_record, method, to_grpc_record
+from langstream_tpu.grpc_runtime.service import AgentServiceServer, load_agent_class
+
+TESTS_DIR = str(Path(__file__).parent)
+
+
+# ---------------------------------------------------------------------------
+# In-process server ↔ raw channel (proto contract tests)
+# ---------------------------------------------------------------------------
+
+
+def test_process_rpc_roundtrip(run):
+    async def scenario():
+        agent = load_agent_class("grpc_user_agents.Exclaim", TESTS_DIR)
+        server = AgentServiceServer(agent, {"suffix": "?!"})
+        port = await server.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        stub = channel.stream_stream(
+            method("process"),
+            request_serializer=pb.ProcessorRequest.SerializeToString,
+            response_deserializer=pb.ProcessorResponse.FromString,
+        )
+        call = stub()
+        records = [
+            to_grpc_record(SimpleRecord.of("hello", key="k1"), 1),
+            to_grpc_record(SimpleRecord.of("explode"), 2),
+            to_grpc_record(SimpleRecord.of({"structured": True}), 3),
+        ]
+        await call.write(pb.ProcessorRequest(records=records))
+        response = await call.read()
+        results = {r.record_id: r for r in response.results}
+        assert from_grpc_record(results[1].records[0]).value == "hello?!"
+        assert from_grpc_record(results[1].records[0]).key == "k1"
+        assert results[2].HasField("error")
+        assert "explode" in results[2].error
+        # structured value → json round trip, then stringified by Exclaim
+        assert "structured" in from_grpc_record(results[3].records[0]).value
+        await call.done_writing()
+        await channel.close()
+        await server.stop()
+
+    run(scenario())
+
+
+def test_source_rpc_commit_flow(run):
+    async def scenario():
+        agent = load_agent_class("grpc_user_agents.CountSource", TESTS_DIR)
+        server = AgentServiceServer(agent, {"limit": 2})
+        port = await server.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        stub = channel.stream_stream(
+            method("read"),
+            request_serializer=pb.SourceRequest.SerializeToString,
+            response_deserializer=pb.SourceResponse.FromString,
+        )
+        call = stub()
+        got = []
+        while len(got) < 2:
+            response = await call.read()
+            got.extend(response.records)
+        assert [from_grpc_record(m).value for m in got] == ["item-1", "item-2"]
+        await call.write(
+            pb.SourceRequest(committed_records=[got[0].record_id])
+        )
+        for _ in range(100):
+            if agent.committed:
+                break
+            await asyncio.sleep(0.02)
+        assert agent.committed == ["item-1"]
+        await call.done_writing()
+        await channel.close()
+        await server.stop()
+
+    run(scenario())
+
+
+def test_agent_info_rpc(run):
+    async def scenario():
+        agent = load_agent_class("grpc_user_agents.Exclaim", TESTS_DIR)
+        agent.agent_id = "my-agent"
+        server = AgentServiceServer(agent, {})
+        port = await server.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        stub = channel.unary_unary(
+            method("agent_info"),
+            request_serializer=pb.InfoRequest.SerializeToString,
+            response_deserializer=pb.InfoResponse.FromString,
+        )
+        response = await stub(pb.InfoRequest())
+        info = json.loads(response.json_info)
+        assert info["agent-id"] == "my-agent"
+        assert info["component-type"] == "processor"
+        await channel.close()
+        await server.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# Subprocess bridge in a full pipeline
+# ---------------------------------------------------------------------------
+
+PIPELINE_TEMPLATE = """
+module: default
+id: p
+name: python
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+errors:
+  retries: 5
+  on-failure: fail
+pipeline:
+  - name: user-code
+    type: python-processor
+    input: input-topic
+    output: output-topic
+    configuration:
+      className: {class_name}
+      pythonPath: {python_path}
+      {extra}
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: local
+"""
+
+
+async def run_python_pipeline(class_name, values, extra="", n_out=None, timeout=30):
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pipeline = PIPELINE_TEMPLATE.format(
+        class_name=class_name, python_path=TESTS_DIR, extra=extra
+    )
+    pkg = ModelBuilder.build_application_from_files(
+        {"pipeline.yaml": pipeline}, INSTANCE, None
+    )
+    runner = LocalApplicationRunner("py-test", pkg.application)
+    await runner.deploy()
+    await runner.start()
+    try:
+        for v in values:
+            await runner.produce("input-topic", v)
+        out = await runner.consume(
+            "output-topic", n=n_out or len(values), timeout=timeout
+        )
+        return [r.value for r in out]
+    finally:
+        await runner.stop()
+
+
+def test_python_processor_subprocess(run):
+    values = run(run_python_pipeline("grpc_user_agents.Exclaim", ["a", "b", "c"]))
+    assert values == ["a!", "b!", "c!"]
+
+
+def test_python_processor_subprocess_crash_restart(run, tmp_path):
+    marker = tmp_path / "crashed"
+    extra = f"marker-file: {marker}"
+    # 'die' crashes the subprocess once (rc=13); the bridge restarts it and
+    # at-least-once redelivery retries the record, which then succeeds
+    values = run(
+        run_python_pipeline(
+            "grpc_user_agents.CrashOnce", ["die"], extra=extra, n_out=1, timeout=60
+        )
+    )
+    assert values == ["survived:die"]
+    assert marker.exists()

@@ -94,6 +94,17 @@ def test_statefulset_tpu_scheduling():
     assert "podAntiAffinity" in pod["affinity"]
 
 
+def test_tpu_topology_normalization():
+    # generation-prefixed and bare forms must normalize to GKE label values
+    sel, res = AgentResourcesFactory.tpu_scheduling(
+        {"type": "v5p", "topology": "v5p-2x2", "chips": 4}
+    )
+    assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+    sel, _ = AgentResourcesFactory.tpu_scheduling({"type": "v5e", "topology": "16", "chips": 16})
+    assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+
+
 def test_statefulset_disk_pvc():
     factory = AgentResourcesFactory()
     sts = factory.generate_stateful_set(
@@ -309,3 +320,9 @@ def test_update_prunes_removed_agents():
     agents = kube.list(AgentCustomResource.KIND, app.namespace)
     assert len(agents) == 1
     assert agents[0]["spec"]["agentType"] == "identity"
+    # the pruned agent's dependents must be gone too (no orphaned pods
+    # holding TPU slices)
+    remaining_sts = kube.list("StatefulSet", app.namespace)
+    assert [s["metadata"]["name"] for s in remaining_sts] == [
+        agents[0]["metadata"]["name"]
+    ] or remaining_sts == []
