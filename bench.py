@@ -24,7 +24,9 @@ def main() -> None:
         # CPU fallback (CI smoke): tiny config, same code path.
         preset, max_batch, new_tokens, n_requests = "tiny-test", 4, 32, 8
     else:
-        preset, max_batch, new_tokens, n_requests = "gemma-2b", 8, 128, 16
+        # decode is HBM-bandwidth-bound: weight reads amortize across slots,
+        # so a big batch is the main throughput lever
+        preset, max_batch, new_tokens, n_requests = "gemma-2b", 32, 256, 64
 
     import numpy as np
 
@@ -43,6 +45,7 @@ def main() -> None:
         max_batch=max_batch,
         max_seq_len=min(1024, config.max_seq_len),
         prefill_buckets=(64,),
+        decode_chunk=16,
     )
     engine.start()
 

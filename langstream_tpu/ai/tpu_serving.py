@@ -53,6 +53,15 @@ class _EngineHolder:
         self._model_config: Optional[ModelConfig] = None
         self._params = None
         self._embed_fn = None
+        self._mesh = None
+
+    def mesh(self):
+        """Device mesh for TP/EP sharding when `mesh` is configured."""
+        if self._mesh is None and self.config.get("mesh"):
+            from langstream_tpu.parallel.mesh import build_mesh
+
+            self._mesh = build_mesh(dict(self.config["mesh"]))
+        return self._mesh
 
     def model_config(self) -> ModelConfig:
         if self._model_config is None:
@@ -85,12 +94,10 @@ class _EngineHolder:
                 from langstream_tpu.models.loader import load_params
 
                 params = load_params(weights, mc)
-            mesh_axes = self.config.get("mesh")
-            if mesh_axes:
-                from langstream_tpu.parallel.mesh import build_mesh
+            mesh = self.mesh()
+            if mesh is not None:
                 from langstream_tpu.parallel.sharding import shard_params
 
-                mesh = build_mesh(dict(mesh_axes))
                 params = shard_params(params, mesh, mc)
             self._params = params
         return self._params
@@ -111,6 +118,8 @@ class _EngineHolder:
                     max_seq_len=int(self.config.get("max-seq-len", min(2048, mc.max_seq_len))),
                     eos_token_id=self.tokenizer().eos_token_id,
                     prefill_buckets=buckets,
+                    mesh=self.mesh(),
+                    decode_chunk=int(self.config.get("decode-chunk", 8)),
                 )
                 self._engine.start()
             return self._engine

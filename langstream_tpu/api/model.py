@@ -53,12 +53,18 @@ class TpuSpec:
     topology: str = "1"  # chips per replica, e.g. "8" or "2x4"
     mesh: dict[str, int] = field(default_factory=dict)
 
-    @property
-    def chips(self) -> int:
+    @staticmethod
+    def normalized_topology(topology: str) -> str:
+        """Strip a generation prefix: "8", "2x4", "v5e-8", "v5p-2x2" → bare
+        "8" / "2x4" form (the single accept-forms contract — GKE label values
+        and chip counting both derive from this)."""
         import re
 
-        # accept "8", "2x4", or generation-prefixed forms like "v5e-8"/"v5p-2x2"
-        topo = re.sub(r"^[a-z0-9]*?-", "", str(self.topology).lower().strip())
+        return re.sub(r"^[a-z0-9]*?-", "", str(topology).lower().strip())
+
+    @property
+    def chips(self) -> int:
+        topo = self.normalized_topology(self.topology)
         n = 1
         for part in topo.split("x"):
             if part.strip().isdigit():

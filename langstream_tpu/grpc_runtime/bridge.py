@@ -98,7 +98,12 @@ class PythonGrpcServer:
                 )
 
         threading.Thread(target=read_banner, daemon=True).start()
-        self.port = await asyncio.wait_for(banner, self.startup_timeout_s)
+        try:
+            self.port = await asyncio.wait_for(banner, self.startup_timeout_s)
+        except (asyncio.TimeoutError, RuntimeError):
+            # never leak a half-started subprocess (hung import etc.)
+            await self.close()
+            raise
         self.channel = grpc.aio.insecure_channel(f"127.0.0.1:{self.port}")
 
     def alive(self) -> bool:
@@ -320,10 +325,18 @@ class GrpcAgentSink(_GrpcAgentBase, AgentSink):
             await self._ensure_stream()
             assert self._call is not None
             self._next_id += 1
-            await self._call.write(
-                pb.SinkRequest(record=to_grpc_record(record, self._next_id))
-            )
-            response = await self._call.read()
+            try:
+                await self._call.write(
+                    pb.SinkRequest(record=to_grpc_record(record, self._next_id))
+                )
+                response = await self._call.read()
+            except grpc.aio.AioRpcError as e:
+                # subprocess crash: drop the dead stream, restart, and let
+                # the errors policy retry the record
+                self._call = None
+                assert self.server is not None
+                await self.server.ensure_running()
+                raise RuntimeError(f"sink subprocess failed: {e.code()}") from e
             if response is grpc.aio.EOF:
                 self._call = None
                 raise RuntimeError("sink stream closed by agent")

@@ -52,7 +52,9 @@ def delete_application_resources(
         if manifest["spec"].get("applicationId") == application_id:
             delete_agent_and_dependents(kube, namespace, manifest)
     for phase in ("deployer", "setup"):
-        kube.delete("Job", namespace, f"langstream-runtime-{phase}-{application_id}")
+        kube.delete(
+            "Job", namespace, AppResourcesFactory.job_name_for(application_id, phase)
+        )
     kube.delete(ApplicationCustomResource.KIND, namespace, application_id)
     kube.delete("Secret", namespace, f"{application_id}-secrets")
 
@@ -266,9 +268,7 @@ class AgentController:
 
     def cleanup(self, agent_manifest: dict[str, Any]) -> None:
         agent = AgentCustomResource.from_manifest(agent_manifest)
-        self.kube.delete("StatefulSet", agent.namespace, agent.name)
-        self.kube.delete("Service", agent.namespace, agent.name)
-        self.kube.delete("Secret", agent.namespace, agent.config_secret_ref)
+        delete_agent_and_dependents(self.kube, agent.namespace, agent_manifest)
 
 
 class Operator:

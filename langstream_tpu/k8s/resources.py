@@ -80,16 +80,13 @@ class AgentResourcesFactory:
     @staticmethod
     def tpu_scheduling(tpu: dict[str, Any]) -> tuple[dict[str, str], dict[str, str]]:
         """(node_selector, container_resources) for one TPU slice per replica."""
-        import re
+        from langstream_tpu.api.model import TpuSpec
 
         gen = str(tpu.get("type", "v5e")).lower()
         accelerator = TPU_ACCELERATORS.get(gen, TPU_ACCELERATORS["v5e"])
         chips = int(tpu.get("chips", 1))
-        # TpuSpec accepts "8", "2x4", or generation-prefixed "v5e-2x4" — the
-        # GKE label value must be the bare NxM form
-        topology = re.sub(
-            r"^[a-z0-9]*?-", "", str(tpu.get("topology", "")).strip().lower()
-        )
+        # the GKE label value must be the bare NxM form
+        topology = TpuSpec.normalized_topology(str(tpu.get("topology", "")))
         if "x" not in topology:
             topology = _DEFAULT_TOPOLOGY.get(chips, f"{chips}x1")
         node_selector = {
@@ -304,8 +301,12 @@ class AppResourcesFactory:
         self.config = config or AgentResourceUnitConfiguration()
 
     @staticmethod
-    def job_name(app: ApplicationCustomResource, phase: str) -> str:
-        return f"langstream-runtime-{phase}-{app.name}"
+    def job_name_for(application_id: str, phase: str) -> str:
+        return f"langstream-runtime-{phase}-{application_id}"
+
+    @classmethod
+    def job_name(cls, app: ApplicationCustomResource, phase: str) -> str:
+        return cls.job_name_for(app.name, phase)
 
     def _job(
         self, app: ApplicationCustomResource, phase: str, command: str

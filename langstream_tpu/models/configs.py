@@ -38,6 +38,10 @@ class ModelConfig:
     moe_capacity_factor: float = 2.0
     # post-norm variants (gemma2) — not needed for the supported presets yet
     dtype: str = "bfloat16"
+    # when set, full-sequence attention runs as RING attention over this
+    # shard_map axis (sequence/context parallelism for long inputs); set via
+    # parallel.sp.sequence_parallel_forward, never directly in presets
+    ring_axis: Optional[str] = None
 
     @property
     def resolved_head_dim(self) -> int:

@@ -248,7 +248,14 @@ def _layer(
     else:
         k_all, v_all = k, v
 
-    attn_out = attention(q, k_all, v_all, mask, config) @ lp["wo"]
+    if config.ring_axis is not None and cache_kv is None:
+        # sequence-parallel path: K/V blocks rotate around the ring; the
+        # causal mask is derived from global block positions inside
+        from langstream_tpu.parallel.ring_attention import ring_attention
+
+        attn_out = ring_attention(q, k_all, v_all, config) @ lp["wo"]
+    else:
+        attn_out = attention(q, k_all, v_all, mask, config) @ lp["wo"]
     x = x + attn_out
 
     ffn_in = rms_norm(x, lp["ffn_norm"], config.rms_norm_eps)
@@ -304,9 +311,16 @@ def _scan_layers(params, x, sin, cos, mask, config, cache=None, cache_positions=
 
 @functools.partial(jax.jit, static_argnames=("config",))
 def forward(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array:
-    """Full-sequence causal forward → logits [B, S, V] (training / scoring)."""
+    """Full-sequence causal forward → logits [B, S, V] (training / scoring).
+
+    With ``config.ring_axis`` set (under shard_map, parallel.sp), ``tokens``
+    is the LOCAL sequence block; RoPE positions are globalised from the ring
+    index and the causal mask is handled inside ring attention.
+    """
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    if config.ring_axis is not None:
+        positions = positions + lax.axis_index(config.ring_axis) * s
     sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
     mask = jnp.tril(jnp.ones((s, s), jnp.bool_))[None, :, :]
     mask = jnp.broadcast_to(mask, (b, s, s))

@@ -1,0 +1,88 @@
+"""Ring attention: causal attention with the sequence axis sharded over the
+device mesh (context parallelism for long inputs).
+
+No reference counterpart (SURVEY §5 "long-context: absent") — designed for
+TPU from the ring-attention / blockwise-attention pattern: each device holds
+one sequence block of Q/K/V; K/V blocks rotate around the ring via
+``lax.ppermute`` (ICI neighbour exchange) while a numerically-stable online
+softmax (flash-attention style m/l accumulators, fp32) folds in one block's
+contribution per step. Peak memory per device is O(S/n · S/n) scores instead
+of O(S²), and the K/V transfer overlaps with the block matmul under XLA's
+async collectives.
+
+Runs inside ``shard_map`` (parallel.sp wraps the model forward); the axis
+name arrives via ``ModelConfig.ring_axis``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from langstream_tpu.models.configs import ModelConfig
+
+_NEG = jnp.float32(-1e30)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, Sl, H, D] local query block
+    k: jax.Array,  # [B, Sl, Hkv, D] local key block
+    v: jax.Array,  # [B, Sl, Hkv, D] local value block
+    config: ModelConfig,
+) -> jax.Array:
+    """Causal GQA attention over the ring axis → [B, Sl, H*D] local output.
+
+    Must be called under shard_map with ``config.ring_axis`` mapped; block b
+    on device b covers global positions [b·Sl, (b+1)·Sl).
+    """
+    axis = config.ring_axis
+    assert axis is not None, "ring_attention requires config.ring_axis"
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+
+    h, hkv = config.n_heads, config.n_kv_heads
+    group = h // hkv
+    b, sl, _, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+
+    qg = q.reshape(b, sl, hkv, group, d)
+    q_pos = my * sl + jnp.arange(sl)  # global positions of local queries
+
+    # fp32 online-softmax state (pvary: the carry becomes device-varying on
+    # the ring axis the moment block data folds in)
+    m0 = lax.pvary(jnp.full((b, hkv, group, sl), _NEG, jnp.float32), (axis,))
+    l0 = lax.pvary(jnp.zeros((b, hkv, group, sl), jnp.float32), (axis,))
+    acc0 = lax.pvary(jnp.zeros((b, sl, hkv, group, d), jnp.float32), (axis,))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my - i) % n  # which device's block we hold at this step
+        kv_pos = src * sl + jnp.arange(sl)
+
+        scores = jnp.einsum("bshgd,bthd->bhgst", qg, k_blk).astype(jnp.float32) * scale
+        if config.attn_logit_softcap is not None:
+            cap = jnp.float32(config.attn_logit_softcap)
+            scores = jnp.tanh(scores / cap) * cap
+        causal = kv_pos[None, :] <= q_pos[:, None]  # [Sl, T]
+        scores = jnp.where(causal[None, None, None, :, :], scores, _NEG)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])  # [B,h,g,Sl,T]
+        # fully-masked blocks: scores=-1e30, m_new=-1e30 → p=1 — zero them
+        p = jnp.where(scores <= _NEG, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhgst,bthd->bshgd", p.astype(v_blk.dtype), v_blk).astype(
+            jnp.float32
+        )
+        acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + pv
+
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return k_blk, v_blk, m_new, l, acc
+
+    _, _, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return out.astype(q.dtype).reshape(b, sl, h * d)

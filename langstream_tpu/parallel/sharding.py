@@ -56,6 +56,26 @@ def kv_cache_specs() -> dict[str, P]:
     return {"k": spec, "v": spec}
 
 
+def serving_cache_specs(n_kv_heads: int, mesh: Mesh) -> dict[str, P]:
+    """Engine KV cache: kv heads on "model", batch REPLICATED — the engine
+    scatters into individual slots at runtime indices, which must not cross
+    shard boundaries (data parallelism in serving = more agent replicas,
+    each with its own engine, not a sharded batch).  When the model axis
+    outnumbers the kv heads (GQA with high TP) the cache replicates across
+    the extra ways — same as Megatron's kv-head replication."""
+    model_ways = int(mesh.shape.get("model", 1))
+    if model_ways > 1 and n_kv_heads % model_ways == 0:
+        spec = P(None, None, None, "model", None)
+    else:
+        spec = P()
+    return {"k": spec, "v": spec}
+
+
+def shard_serving_cache(cache: dict, mesh: Mesh) -> dict:
+    n_kv_heads = cache["k"].shape[3]
+    return jax.device_put(cache, _named(mesh, serving_cache_specs(n_kv_heads, mesh)))
+
+
 def data_spec() -> P:
     return P("data", None)
 

@@ -1,0 +1,49 @@
+"""Sequence/context parallelism entry points (shard_map wrappers).
+
+``sequence_parallel_forward`` runs the full-sequence forward with the
+sequence dimension sharded over the mesh's "seq" axis and ring attention
+exchanging K/V blocks over ICI — the long-context path (SURVEY §5: absent in
+the reference, first-class here). Params are replicated across the seq axis
+(combine with TP by also sharding params over "model" outside).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from langstream_tpu.models.configs import ModelConfig
+from langstream_tpu.models.transformer import Params, forward
+
+
+def sequence_parallel_forward(
+    params: Params,
+    tokens: jax.Array,  # [B, S] with S divisible by mesh axis "seq"
+    config: ModelConfig,
+    mesh: Mesh,
+    axis: str = "seq",
+) -> jax.Array:
+    """Logits [B, S, V]; S sharded over ``axis`` during compute."""
+    n = mesh.shape[axis]
+    if tokens.shape[1] % n != 0:
+        raise ValueError(
+            f"sequence length {tokens.shape[1]} must be divisible by the "
+            f"'{axis}' axis size {n} (pad the batch)"
+        )
+    ring_config = dataclasses.replace(config, ring_axis=axis)
+
+    fwd = shard_map(
+        functools.partial(forward, config=ring_config),
+        mesh=mesh,
+        in_specs=(P(), P(None, axis)),
+        out_specs=P(None, axis, None),
+    )
+    return fwd(params, tokens)

@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from langstream_tpu.models.configs import GenerationOptions, ModelConfig
 from langstream_tpu.models.transformer import decode_step, make_kv_cache, prefill
@@ -76,12 +77,29 @@ class _Slot:
         return self.request is not None
 
 
-@functools.partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def _decode_and_sample(params, tokens, positions, cache, key, temp, top_k, top_p, config):
-    logits, cache = decode_step(params, tokens, positions, cache, config)
-    key, sub = jax.random.split(key)
-    next_tokens = sample(logits, sub, temp, top_k, top_p)
-    return next_tokens, cache, key
+@functools.partial(
+    jax.jit, static_argnames=("steps", "config"), donate_argnames=("cache",)
+)
+def _decode_chunk(params, tokens, positions, cache, key, temp, top_k, top_p, steps, config):
+    """``steps`` fused decode+sample iterations in ONE dispatch (lax.scan).
+
+    Per-step host round trips are the latency killer (a dispatch+fetch costs
+    hundreds of ms through a TPU tunnel vs ~tens of ms of decode compute);
+    scanning K steps on-device amortizes that overhead K-fold, and the
+    engine additionally pipelines: chunk k+1 is dispatched from chunk k's
+    DEVICE outputs before chunk k's tokens are fetched to the host."""
+
+    def body(carry, _):
+        tokens, positions, cache, key = carry
+        logits, cache = decode_step(params, tokens, positions, cache, config)
+        key, sub = jax.random.split(key)
+        next_tokens = sample(logits, sub, temp, top_k, top_p)
+        return (next_tokens, positions + 1, cache, key), next_tokens
+
+    (tokens, positions, cache, key), chunk = lax.scan(
+        body, (tokens, positions, cache, key), None, length=steps
+    )
+    return chunk, tokens, positions, cache, key
 
 
 @functools.partial(
@@ -124,9 +142,17 @@ class ServingEngine:
         eos_token_id: Optional[int] = None,
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048),
         rng_seed: int = 0,
+        mesh: Optional[Any] = None,
+        decode_chunk: int = 8,
     ) -> None:
+        """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
+        ``params`` must already be sharded over it (parallel.sharding);
+        the KV cache is sharded to match (kv heads on "model") so every
+        decode step partitions over ICI with XLA-inserted collectives —
+        one psum per layer, the Megatron schedule."""
         self.config = config
         self.params = params
+        self.mesh = mesh
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len or config.max_seq_len
         self.eos_token_id = eos_token_id
@@ -136,6 +162,10 @@ class ServingEngine:
         self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(maxsize=max_batch * 4)
         self._slots = [_Slot() for _ in range(max_batch)]
         self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
+        if mesh is not None:
+            from langstream_tpu.parallel.sharding import shard_serving_cache
+
+            self._cache = shard_serving_cache(self._cache, mesh)
         self._insert = _make_insert()
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
@@ -145,6 +175,14 @@ class ServingEngine:
         self._temp = np.zeros(max_batch, np.float32)
         self._top_k = np.zeros(max_batch, np.int32)
         self._top_p = np.ones(max_batch, np.float32)
+        # device-resident decode chain: last sampled token + next write
+        # position per slot (kept on device so chunk k+1 can be dispatched
+        # from chunk k's outputs without a host sync)
+        self._tokens_dev = jnp.zeros(max_batch, jnp.int32)
+        self._positions_dev = jnp.zeros(max_batch, jnp.int32)
+        # decode chunk size (tokens per dispatch per slot); clamped to
+        # powers of two to bound recompiles
+        self.decode_chunk = max(1, int(decode_chunk))
         # stats
         self.total_generated = 0
         self.total_requests = 0
@@ -212,17 +250,36 @@ class ServingEngine:
     # -- engine thread ------------------------------------------------------
 
     def _run(self) -> None:
+        pending: list[tuple] = []
         try:
             while not self._stop.is_set():
-                admitted = self._admit()
-                if not any(s.active for s in self._slots):
-                    if not admitted:
-                        time.sleep(0.001)
-                    continue
-                self._decode_iteration()
+                new_pending = self._admit()  # deferred prefill first-token fetches
+                if any(s.active for s in self._slots):
+                    new_pending.append(self._dispatch_chunk())
+                elif not new_pending and not pending:
+                    time.sleep(0.001)
+                # fetching round k's tokens overlaps with round k+1's compute
+                for entry in pending:
+                    self._process_entry(entry)
+                pending = new_pending
+            for entry in pending:
+                self._process_entry(entry)
         except BaseException as e:  # noqa: BLE001 — fail every pending request
             log.exception("serving engine loop crashed")
             self._fail_all(e)
+
+    def _process_entry(self, entry: tuple) -> None:
+        kind = entry[0]
+        if kind == "prefill":
+            _, first_dev, idx, request = entry
+            slot = self._slots[idx]
+            if slot.request is not request:
+                return
+            slot.first_token_at = time.monotonic()
+            self._deliver_token(idx, int(jax.device_get(first_dev)[0]))
+        else:
+            _, chunk, snapshot, steps = entry
+            self._process_chunk(chunk, snapshot, steps)
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -230,9 +287,11 @@ class ServingEngine:
                 return b
         return self.prefill_buckets[-1]
 
-    def _admit(self) -> bool:
-        """Move queued requests into free slots (prefill path)."""
-        admitted = False
+    def _admit(self) -> list[tuple]:
+        """Move queued requests into free slots (prefill path); returns the
+        deferred first-token fetch entries (processed after the next chunk
+        dispatch, so the fetch overlaps device compute)."""
+        entries: list[tuple] = []
         for idx, slot in enumerate(self._slots):
             if slot.active:
                 continue
@@ -241,7 +300,7 @@ class ServingEngine:
             except queue.Empty:
                 break
             try:
-                self._prefill_into_slot(idx, request)
+                entries.append(self._prefill_into_slot(idx, request))
             except Exception as e:  # noqa: BLE001 — fail THIS request, not the engine
                 log.exception("prefill failed for one request")
                 request._result = GenerationResult(
@@ -250,10 +309,9 @@ class ServingEngine:
                 )
                 request._done.set()
                 continue
-            admitted = True
-        return admitted
+        return entries
 
-    def _prefill_into_slot(self, idx: int, request: GenerationRequest) -> None:
+    def _prefill_into_slot(self, idx: int, request: GenerationRequest) -> tuple:
         slot = self._slots[idx]
         prompt = request.prompt_tokens
         n = len(prompt)
@@ -261,6 +319,10 @@ class ServingEngine:
         tokens = np.zeros((1, width), np.int32)
         tokens[0, :n] = prompt
         local_cache = make_kv_cache(self.config, 1, width)
+        if self.mesh is not None:
+            from langstream_tpu.parallel.sharding import shard_serving_cache
+
+            local_cache = shard_serving_cache(local_cache, self.mesh)
         opts = request.options
         started = time.monotonic()
         first, local_cache, self._key = _prefill_and_sample(
@@ -275,47 +337,67 @@ class ServingEngine:
             self.config,
         )
         self._cache = self._insert(self._cache, local_cache, idx)
-        first_token = int(jax.device_get(first)[0])
+        # splice this slot into the device-resident decode chain
+        self._tokens_dev = self._tokens_dev.at[idx].set(first[0])
+        self._positions_dev = self._positions_dev.at[idx].set(n)
 
         slot.request = request
         slot.position = n  # first generated token goes to position n
         slot.generated = []
         slot.started_at = started
-        slot.first_token_at = time.monotonic()
+        slot.first_token_at = 0.0  # stamped when the deferred fetch lands
         self._temp[idx] = opts.temperature
         self._top_k[idx] = opts.top_k
         self._top_p[idx] = opts.top_p
         self.total_requests += 1
-        self._deliver_token(idx, first_token)
+        return ("prefill", first, idx, request)
 
-    def _decode_iteration(self) -> None:
-        """One decode step for every slot (inactive slots run masked junk —
-        static shapes keep XLA happy; their outputs are ignored)."""
-        tokens = np.zeros(self.max_batch, np.int32)
-        positions = np.zeros(self.max_batch, np.int32)
-        for i, slot in enumerate(self._slots):
-            if slot.active:
-                # current token = last delivered; it sits at position-1... the
-                # NEXT token is produced by feeding the last token at `position`
-                tokens[i] = slot.generated[-1]
-                positions[i] = slot.position
-        next_tokens, self._cache, self._key = _decode_and_sample(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            self._cache,
-            self._key,
-            jnp.asarray(self._temp),
-            jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
-            self.config,
+    def _chunk_steps(self) -> int:
+        """Power-of-two chunk bounded by every active slot's cache headroom
+        (scattering past max_seq_len would silently drop writes)."""
+        headroom = min(
+            self.max_seq_len - 1 - s.position for s in self._slots if s.active
         )
-        host_tokens = np.asarray(jax.device_get(next_tokens))
-        self._busy_steps += 1
-        for i, slot in enumerate(self._slots):
-            if slot.active:
+        steps = 1
+        while steps * 2 <= min(self.decode_chunk, max(1, headroom)):
+            steps *= 2
+        return steps
+
+    def _dispatch_chunk(self) -> tuple:
+        """Dispatch one multi-step decode; returns (device tokens,
+        per-slot request snapshot, steps) for deferred host processing."""
+        steps = self._chunk_steps()
+        chunk, self._tokens_dev, self._positions_dev, self._cache, self._key = (
+            _decode_chunk(
+                self.params,
+                self._tokens_dev,
+                self._positions_dev,
+                self._cache,
+                self._key,
+                jnp.asarray(self._temp),
+                jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p),
+                steps,
+                self.config,
+            )
+        )
+        snapshot = [
+            (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
+        ]
+        self._busy_steps += steps
+        return ("chunk", chunk, snapshot, steps)
+
+    def _process_chunk(self, chunk, snapshot, steps: int) -> None:
+        host = np.asarray(jax.device_get(chunk))  # [steps, B]
+        for idx, request in snapshot:
+            slot = self._slots[idx]
+            if slot.request is not request:  # freed/reassigned meanwhile
+                continue
+            for s in range(steps):
                 slot.position += 1
-                self._deliver_token(i, int(host_tokens[i]))
+                self._deliver_token(idx, int(host[s, idx]))
+                if slot.request is not request:  # finished mid-chunk
+                    break
 
     def _deliver_token(self, idx: int, token: int) -> None:
         slot = self._slots[idx]
