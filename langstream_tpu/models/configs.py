@@ -42,6 +42,9 @@ class ModelConfig:
     # shard_map axis (sequence/context parallelism for long inputs); set via
     # parallel.sp.sequence_parallel_forward, never directly in presets
     ring_axis: Optional[str] = None
+    # attention kernel choice: "auto" (pallas on TPU when shapes fit),
+    # "pallas" (force, interpret-mode off-TPU), "jnp" (reference path)
+    attention_impl: str = "auto"
 
     @property
     def resolved_head_dim(self) -> int:

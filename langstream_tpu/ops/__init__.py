@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops (flash prefill attention, ragged
+decode attention). The transformer dispatches here when shapes fit the TPU
+tiling constraints; the jnp reference path remains the fallback everywhere
+else (CPU tests run the kernels in interpret mode)."""

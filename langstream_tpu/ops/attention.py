@@ -1,0 +1,316 @@
+"""Flash-attention Pallas kernels.
+
+Two hot paths, both GQA-aware (queries grouped per kv head so K/V blocks are
+read once per group, not once per query head):
+
+- ``flash_prefill_attention``: causal blocked attention with fp32
+  online-softmax scratch accumulators — O(block_q x block_k) VMEM instead of
+  the O(S^2) masked score tensor the jnp path materializes.
+- ``ragged_decode_attention``: one query per sequence against a KV cache,
+  skipping cache blocks past each row's true length (the continuous batcher
+  packs rows of very different lengths into one step, so the dense masked
+  read wastes bandwidth proportional to max_len - mean_len).
+
+No reference counterpart (the reference's compute is remote HTTP calls);
+kernel structure follows the public flash/paged-attention pattern from the
+Pallas TPU guide.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from langstream_tpu.models.configs import ModelConfig
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Prefill: causal blocked flash attention
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(
+    q_ref,  # [1, block_q, 1, G, D]
+    k_ref,  # [1, block_k, 1, D]
+    v_ref,  # [1, block_k, 1, D]
+    o_ref,  # [1, block_q, 1, G, D]
+    m_scr,  # [G, block_q, 128] f32
+    l_scr,  # [G, block_q, 128] f32
+    acc_scr,  # [G, block_q, D] f32
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+    softcap,
+):
+    i = pl.program_id(2)  # query block
+    j = pl.program_id(3)  # key block
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = i * block_q
+    k_start = j * block_k
+
+    # causal: skip key blocks strictly above the diagonal
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _body():
+        q = q_ref[0, :, 0, :, :].astype(jnp.float32)  # [block_q, G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [block_q, G, block_k]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1, block_k), 2)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+        s = s.transpose(1, 0, 2)  # [G, block_q, block_k]
+
+        m_prev = m_scr[:, :, 0]  # [G, block_q]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G, block_q, D]
+        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
+        m_scr[:, :, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]  # [G, block_q, 1]
+        out = (acc_scr[...] / l).transpose(1, 0, 2)  # [block_q, G, D]
+        o_ref[0, :, 0, :, :] = out.astype(o_ref.dtype)
+
+
+def flash_prefill_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    config: ModelConfig,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal GQA attention → [B, S, H*D]."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, "caller gates divisibility"
+    qg = q.reshape(b, s, hkv, group, d)
+
+    kernel = functools.partial(
+        _prefill_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, hkv, s // block_q, s // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, 1, group, d), lambda b, h, i, j: (b, i, h, 0, 0)
+            ),
+            pl.BlockSpec((1, block_k, 1, d), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, block_k, 1, d), lambda b, h, i, j: (b, j, h, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, 1, group, d), lambda b, h, i, j: (b, i, h, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, s, hkv, group, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qg, k, v)
+    return out.reshape(b, s, h * d)
+
+
+# ---------------------------------------------------------------------------
+# Decode: one query per row against a ragged KV cache
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    lengths_ref,  # scalar-prefetch [B]
+    q_ref,  # [1, 1, G, D]
+    k_ref,  # [1, block_k, 1, D]
+    v_ref,  # [1, block_k, 1, D]
+    o_ref,  # [1, 1, G, D]
+    m_scr,  # [G, 128] f32
+    l_scr,  # [G, 128] f32
+    acc_scr,  # [G, D] f32
+    *,
+    block_k: int,
+    scale: float,
+    softcap,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    length = lengths_ref[b]
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip cache blocks entirely past this row's written length
+    @pl.when(k_start < length)
+    def _body():
+        q = q_ref[0, 0, 0, :, :].astype(jnp.float32)  # [G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [G, block_k]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        s = jnp.where(k_pos < length, s, _NEG)
+
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, 0] = l_scr[:, 0] * corr + p.sum(axis=-1)
+        pv = jnp.dot(p, v, preferred_element_type=jnp.float32)  # [G, D]
+        acc_scr[...] = acc_scr[...] * corr[:, None] + pv
+        m_scr[:, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+        o_ref[0, 0, 0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def ragged_decode_attention(
+    q: jax.Array,  # [B, H, D] single query per row
+    k: jax.Array,  # [B, T, Hkv, D] cache
+    v: jax.Array,  # [B, T, Hkv, D]
+    lengths: jax.Array,  # [B] int32 — valid cache prefix per row
+    config: ModelConfig,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA decode attention → [B, H*D]."""
+    b, h, d = q.shape
+    t = k.shape[1]
+    hkv = k.shape[2]
+    group = h // hkv
+    block_k = min(block_k, t)
+    assert t % block_k == 0, "caller gates divisibility"
+    qg = q.reshape(b, 1, hkv, group, d)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        block_k=block_k,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+    def kv_index(b, h, j, lens):
+        # paged-attention trick: clamp the block index at this row's last
+        # valid block, so grid steps past the length re-reference the SAME
+        # block and Pallas elides the HBM→VMEM copy — the DMA skip is where
+        # the ragged bandwidth saving actually comes from (the pl.when only
+        # skips the FLOPs)
+        last = jnp.maximum(pl.cdiv(lens[b], block_k) - 1, 0)
+        return (b, jnp.minimum(j, last), h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, t // block_k),
+        in_specs=[
+            # index maps receive the scalar-prefetch ref as a trailing arg
+            pl.BlockSpec((1, 1, 1, group, d), lambda b, h, j, lens: (b, 0, h, 0, 0)),
+            pl.BlockSpec((1, block_k, 1, d), kv_index),
+            pl.BlockSpec((1, block_k, 1, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, group, d), lambda b, h, j, lens: (b, 0, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, hkv, group, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qg, k, v)
+    return out.reshape(b, h * d)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch gate
+# ---------------------------------------------------------------------------
+
+
+def pallas_ok(config: ModelConfig, seq_len: int, cache_len: int | None = None) -> bool:
+    """True when the pallas kernels apply; no ring axis (ring attention owns
+    the sequence-parallel path).
+
+    ``attention_impl="pallas"`` forces the kernels (interpret mode off-TPU,
+    for tests) gated only on block divisibility; ``"auto"`` additionally
+    requires a real TPU backend and lane-aligned (128) head dim / lengths —
+    the engine's prefill buckets and cache widths guarantee those in
+    production."""
+    if config.attention_impl == "jnp":
+        return False
+    if config.ring_axis is not None:
+        return False
+    force = config.attention_impl == "pallas"
+    if force:
+        ok_seq = seq_len == 1 or seq_len % min(128, seq_len) == 0
+        ok_cache = cache_len is None or cache_len % min(128, cache_len) == 0
+        return ok_seq and ok_cache
+    if jax.default_backend() != "tpu":
+        return False
+    if config.resolved_head_dim % 128 != 0:
+        return False
+    if seq_len > 1 and seq_len % 128 != 0:
+        return False
+    if cache_len is not None and cache_len % 128 != 0:
+        return False
+    return True

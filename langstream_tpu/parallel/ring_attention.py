@@ -49,39 +49,58 @@ def ring_attention(
     qg = q.reshape(b, sl, hkv, group, d)
     q_pos = my * sl + jnp.arange(sl)  # global positions of local queries
 
-    # fp32 online-softmax state (pvary: the carry becomes device-varying on
-    # the ring axis the moment block data folds in)
-    m0 = lax.pvary(jnp.full((b, hkv, group, sl), _NEG, jnp.float32), (axis,))
-    l0 = lax.pvary(jnp.zeros((b, hkv, group, sl), jnp.float32), (axis,))
-    acc0 = lax.pvary(jnp.zeros((b, sl, hkv, group, d), jnp.float32), (axis,))
+    def _varying(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis,), to="varying")
+        return lax.pvary(x, (axis,))  # older jax
+
+    # fp32 online-softmax state (cast device-varying on the ring axis: the
+    # carry becomes varying the moment block data folds in)
+    m0 = _varying(jnp.full((b, hkv, group, sl), _NEG, jnp.float32))
+    l0 = _varying(jnp.zeros((b, hkv, group, sl), jnp.float32))
+    acc0 = _varying(jnp.zeros((b, sl, hkv, group, d), jnp.float32))
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def step(i, carry):
         k_blk, v_blk, m, l, acc = carry
         src = (my - i) % n  # which device's block we hold at this step
-        kv_pos = src * sl + jnp.arange(sl)
 
-        scores = jnp.einsum("bshgd,bthd->bhgst", qg, k_blk).astype(jnp.float32) * scale
-        if config.attn_logit_softcap is not None:
-            cap = jnp.float32(config.attn_logit_softcap)
-            scores = jnp.tanh(scores / cap) * cap
-        causal = kv_pos[None, :] <= q_pos[:, None]  # [Sl, T]
-        scores = jnp.where(causal[None, None, None, :, :], scores, _NEG)
+        def fold(operand):
+            k_blk, m, l, acc = operand
+            kv_pos = src * sl + jnp.arange(sl)
+            scores = (
+                jnp.einsum("bshgd,bthd->bhgst", qg, k_blk).astype(jnp.float32) * scale
+            )
+            if config.attn_logit_softcap is not None:
+                cap = jnp.float32(config.attn_logit_softcap)
+                scores = jnp.tanh(scores / cap) * cap
+            causal = kv_pos[None, :] <= q_pos[:, None]  # [Sl, T]
+            scores = jnp.where(causal[None, None, None, :, :], scores, _NEG)
 
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        p = jnp.exp(scores - m_new[..., None])  # [B,h,g,Sl,T]
-        # fully-masked blocks: scores=-1e30, m_new=-1e30 → p=1 — zero them
-        p = jnp.where(scores <= _NEG, 0.0, p)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhgst,bthd->bshgd", p.astype(v_blk.dtype), v_blk).astype(
-            jnp.float32
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            p = jnp.exp(scores - m_new[..., None])  # [B,h,g,Sl,T]
+            # fully-masked rows: scores=-1e30, m_new=-1e30 → p=1 — zero them
+            p = jnp.where(scores <= _NEG, 0.0, p)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + p.sum(axis=-1)
+            pv = jnp.einsum(
+                "bhgst,bthd->bshgd", p.astype(v_blk.dtype), v_blk
+            ).astype(jnp.float32)
+            acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + pv
+            return m_new, l, acc
+
+        # causal block skip: when the held block is entirely in this device's
+        # future (src > my), every score is masked — skip both matmuls. The
+        # cond is per-device control flow (shard_map), so on average each
+        # device folds (n+1)/2 of the n blocks instead of all of them; the
+        # ppermute below stays OUTSIDE the cond (all devices must participate)
+        m, l, acc = lax.cond(
+            src <= my, fold, lambda op: (op[1], op[2], op[3]), (k_blk, m, l, acc)
         )
-        acc = acc * corr.transpose(0, 3, 1, 2)[..., None] + pv
 
         k_blk = lax.ppermute(k_blk, axis, perm)
         v_blk = lax.ppermute(v_blk, axis, perm)
-        return k_blk, v_blk, m_new, l, acc
+        return k_blk, v_blk, m, l, acc
 
     _, _, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]

@@ -183,6 +183,8 @@ class ServingEngine:
         # decode chunk size (tokens per dispatch per slot); clamped to
         # powers of two to bound recompiles
         self.decode_chunk = max(1, int(decode_chunk))
+        # steps of the currently in-flight (dispatched, unfetched) chunk
+        self._inflight_steps = 0
         # stats
         self.total_generated = 0
         self.total_requests = 0
@@ -253,6 +255,11 @@ class ServingEngine:
         pending: list[tuple] = []
         try:
             while not self._stop.is_set():
+                # the chunk dispatched last iteration is still unfetched when
+                # this iteration's dispatch computes its headroom bound
+                self._inflight_steps = next(
+                    (e[3] for e in pending if e[0] == "chunk"), 0
+                )
                 new_pending = self._admit()  # deferred prefill first-token fetches
                 if any(s.active for s in self._slots):
                     new_pending.append(self._dispatch_chunk())
@@ -353,10 +360,16 @@ class ServingEngine:
         return ("prefill", first, idx, request)
 
     def _chunk_steps(self) -> int:
-        """Power-of-two chunk bounded by every active slot's cache headroom
-        (scattering past max_seq_len would silently drop writes)."""
+        """Power-of-two chunk bounded by every active slot's cache headroom.
+
+        Host positions lag the device by the one in-flight pipelined chunk
+        (its results are fetched AFTER the next dispatch), so the bound
+        subtracts that chunk's steps — otherwise the tail of a long request
+        burns whole chunks on out-of-bounds scatters that XLA drops."""
         headroom = min(
-            self.max_seq_len - 1 - s.position for s in self._slots if s.active
+            self.max_seq_len - 1 - s.position - self._inflight_steps
+            for s in self._slots
+            if s.active
         )
         steps = 1
         while steps * 2 <= min(self.decode_chunk, max(1, headroom)):

@@ -1,0 +1,128 @@
+"""Pallas kernel correctness (interpret mode on CPU) vs the jnp reference
+attention, plus end-to-end forward/prefill/decode equivalence with the
+kernels forced on."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.configs import MODEL_PRESETS, ModelConfig
+from langstream_tpu.models.transformer import (
+    attention,
+    decode_step,
+    forward,
+    init_params,
+    make_kv_cache,
+    prefill,
+)
+from langstream_tpu.ops.attention import (
+    flash_prefill_attention,
+    pallas_ok,
+    ragged_decode_attention,
+)
+
+CFG = ModelConfig(
+    name="k", vocab_size=128, d_model=64, n_layers=1, n_heads=8, n_kv_heads=4,
+    d_ff=64, dtype="float32",
+)
+SOFTCAP_CFG = dataclasses.replace(CFG, attn_logit_softcap=30.0)
+
+
+def rand(key, *shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.float32)
+
+
+def test_flash_prefill_matches_reference():
+    b, s, h, hkv, d = 2, 64, 8, 4, 8
+    q, k, v = rand(0, b, s, h, d), rand(1, b, s, hkv, d), rand(2, b, s, hkv, d)
+    causal = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), jnp.bool_))[None], (b, s, s))
+    for config in (CFG, SOFTCAP_CFG):
+        ref = attention(q, k, v, causal, config)
+        out = flash_prefill_attention(q, k, v, config, block_q=16, block_k=16, interpret=True)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5, atol=1e-5)
+
+
+def test_ragged_decode_matches_reference():
+    b, t, h, hkv, d = 4, 64, 8, 4, 8
+    q = rand(0, b, 1, h, d)
+    k, v = rand(1, b, t, hkv, d), rand(2, b, t, hkv, d)
+    lengths = jnp.asarray([1, 17, 40, 64], jnp.int32)
+    kv_pos = jnp.arange(t)[None, None, :]
+    mask = kv_pos < lengths[:, None, None]
+    for config in (CFG, SOFTCAP_CFG):
+        ref = attention(q, k, v, mask, config)[:, 0]
+        out = ragged_decode_attention(
+            q[:, 0], k, v, lengths, config, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5, atol=1e-5)
+
+
+def test_forward_with_pallas_matches_jnp():
+    base = dataclasses.replace(
+        MODEL_PRESETS["tiny-test"], dtype="float32", attention_impl="jnp"
+    )
+    forced = dataclasses.replace(base, attention_impl="pallas")
+    params = init_params(base, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, base.vocab_size)
+    ref = forward(params, tokens, base)
+    out = forward(params, tokens, forced)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
+def test_prefill_decode_with_pallas_matches_jnp():
+    base = dataclasses.replace(
+        MODEL_PRESETS["tiny-test"], dtype="float32", attention_impl="jnp"
+    )
+    forced = dataclasses.replace(base, attention_impl="pallas")
+    params = init_params(base, jax.random.PRNGKey(0))
+    b, s, t = 2, 16, 64
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 1, base.vocab_size)
+    lengths = jnp.asarray([s, s - 5], jnp.int32)
+
+    logits_ref, cache_ref = prefill(params, tokens, lengths, make_kv_cache(base, b, t), base)
+    logits_out, cache_out = prefill(
+        params, tokens, lengths, make_kv_cache(forced, b, t), forced
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_ref), np.asarray(logits_out), rtol=2e-4, atol=2e-4
+    )
+
+    nxt = jnp.argmax(logits_ref, axis=-1).astype(jnp.int32)
+    d_ref, _ = decode_step(params, nxt, lengths, cache_ref, base)
+    d_out, _ = decode_step(params, nxt, lengths, cache_out, forced)
+    np.testing.assert_allclose(
+        np.asarray(d_ref), np.asarray(d_out), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_encode_never_uses_causal_kernel():
+    """Embeddings use bidirectional attention; pallas flash is causal-only,
+    so encode must stay on the jnp path even when forced."""
+    from langstream_tpu.models.transformer import encode
+
+    base = dataclasses.replace(
+        MODEL_PRESETS["tiny-test"], dtype="float32", attention_impl="jnp"
+    )
+    forced = dataclasses.replace(base, attention_impl="pallas")
+    params = init_params(base, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 1, base.vocab_size)
+    lengths = jnp.asarray([32, 20], jnp.int32)
+    ref = encode(params, tokens, lengths, base)
+    out = encode(params, tokens, lengths, forced)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_ok_gating():
+    tpu = jax.default_backend() == "tpu"
+    # jnp impl always refuses
+    assert not pallas_ok(dataclasses.replace(CFG, attention_impl="jnp"), 128)
+    # ring axis owns SP
+    assert not pallas_ok(dataclasses.replace(CFG, ring_axis="seq"), 128)
+    # auto on CPU refuses; forced accepts divisible shapes
+    assert pallas_ok(dataclasses.replace(CFG, attention_impl="pallas"), 64)
+    # auto requires BOTH a tpu backend and a lane-aligned head dim
+    assert pallas_ok(CFG, 128) == (tpu and CFG.resolved_head_dim % 128 == 0)
+    wide = dataclasses.replace(CFG, head_dim=128)
+    assert pallas_ok(wide, 128) == tpu
