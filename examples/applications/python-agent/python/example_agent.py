@@ -1,0 +1,15 @@
+"""User agent code — runs in its own subprocess, crash-isolated from the
+runtime; implement the SDK ABCs from langstream_tpu.api.agent."""
+
+from typing import Any
+
+from langstream_tpu.api.agent import SingleRecordProcessor
+from langstream_tpu.api.record import Record, SimpleRecord
+
+
+class Exclaim(SingleRecordProcessor):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.suffix = configuration.get("suffix", "!")
+
+    async def process_record(self, record: Record) -> list[Record]:
+        return [SimpleRecord.of(f"{record.value}{self.suffix}", key=record.key)]

@@ -91,6 +91,11 @@ class AgentContext(abc.ABC):
     def get_service_provider_registry(self) -> Any:
         """AI ServiceProvider registry (completions/embeddings backends)."""
 
+    def get_code_directory(self) -> Optional[str]:
+        """Source-package directory when known; ``<dir>/python`` goes on the
+        path of python-agent subprocesses (reference PYTHONPATH injection)."""
+        return None
+
     @abc.abstractmethod
     def critical_failure(self, error: BaseException) -> None:
         """Crash-only escape hatch (reference SimpleAgentContext.criticalFailure:1115)."""

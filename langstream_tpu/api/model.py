@@ -442,6 +442,10 @@ class Application:
     gateways: list[Gateway] = field(default_factory=list)
     instance: Instance = field(default_factory=Instance)
     secrets: Secrets = field(default_factory=Secrets)
+    # where the source package lives on disk (when known); the runtime adds
+    # <code_directory>/python to python-agent subprocess paths (reference
+    # PythonGrpcServer.java:61-76 PYTHONPATH injection)
+    code_directory: Optional[str] = None
 
     def get_module(self, module_id: str) -> Module:
         mod = self.modules.get(module_id)

@@ -59,6 +59,16 @@ PIPELINE_FILE_KEYS = {
 }
 
 
+def is_pipeline_document(rel_path: str) -> bool:
+    """True for files that parse as application documents (YAML); user code
+    (python/, requirements, binaries) travels via code storage instead.
+    The single predicate shared by stores, services, and the k8s executor."""
+    from pathlib import PurePosixPath
+
+    name = PurePosixPath(rel_path).name
+    return name.endswith((".yaml", ".yml")) and name not in (".yaml", ".yml")
+
+
 class ModelParseError(ValueError):
     """Raised on malformed application YAML."""
 
@@ -130,7 +140,9 @@ class ModelBuilder:
                 files[rel] = p.read_text()
         instance_text = Path(instance_path).read_text() if instance_path else None
         secrets_text = Path(secrets_path).read_text() if secrets_path else None
-        return ModelBuilder.build_application_from_files(files, instance_text, secrets_text)
+        pkg = ModelBuilder.build_application_from_files(files, instance_text, secrets_text)
+        pkg.application.code_directory = str(app_dir)
+        return pkg
 
     # -- pipeline files -----------------------------------------------------
 

@@ -158,10 +158,19 @@ class _GrpcAgentBase:
         class_name = configuration.get("className") or configuration.get("class-name")
         if not class_name:
             raise ValueError("python agents require configuration.className")
+        python_path = configuration.get("pythonPath") or configuration.get("python-path")
+        if python_path is None and self.context is not None:
+            # default: the app package's python/ dir (reference PYTHONPATH
+            # injection, PythonGrpcServer.java:61-76)
+            code_dir = self.context.get_code_directory()
+            if code_dir:
+                candidate = os.path.join(code_dir, "python")
+                if os.path.isdir(candidate):
+                    python_path = candidate
         self.server = PythonGrpcServer(
             class_name,
             configuration.get("configuration", configuration),
-            python_path=configuration.get("pythonPath") or configuration.get("python-path"),
+            python_path=python_path,
             agent_id=getattr(self, "agent_id", ""),
             agent_type=getattr(self, "agent_type", ""),
         )

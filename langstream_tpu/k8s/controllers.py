@@ -83,8 +83,10 @@ class InProcessJobExecutor:
         from langstream_tpu.core.planner import ClusterRuntime
         from langstream_tpu.core.resolver import resolve_placeholders
 
+        from langstream_tpu.core.parser import is_pipeline_document
+
         pkg = ModelBuilder.build_application_from_files(
-            {k: v for k, v in app.package_files.items() if k.endswith((".yaml", ".yml"))},
+            {k: v for k, v in app.package_files.items() if is_pipeline_document(k)},
             app.instance_text,
             self._secrets_text(app),
         )

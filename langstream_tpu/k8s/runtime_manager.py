@@ -32,8 +32,17 @@ class KubernetesRuntimeManager:
     async def deploy_application(
         self, tenant: str, application_id: str, stored: StoredApplication
     ) -> None:
+        from langstream_tpu.core.parser import is_pipeline_document
+
         namespace = tenant_namespace(tenant)
-        files = self.store.get_package_files(tenant, application_id)
+        # the CR carries only the pipeline DOCUMENTS; user code (python/,
+        # binaries) travels via code_archive_id + the code-download init
+        # container (reference design) — inlining it would bloat etcd objects
+        files = {
+            rel: text
+            for rel, text in self.store.get_package_files(tenant, application_id).items()
+            if is_pipeline_document(rel)
+        }
         instance_text, secrets_text = self.store.get_raw_documents(tenant, application_id)
         secrets_ref: Optional[str] = None
         if secrets_text is not None:

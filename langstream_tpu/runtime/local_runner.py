@@ -82,6 +82,7 @@ class LocalApplicationRunner:
                     else None,
                     service_registry=self._service_registry,
                     on_critical_failure=self._on_critical_failure,
+                    code_directory=self.application.code_directory,
                 )
                 runner = AgentRunner(node, self._topic_runtime, context, replica)
                 await runner.setup()

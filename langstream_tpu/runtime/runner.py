@@ -89,6 +89,7 @@ class SimpleAgentContext(AgentContext):
         state_dir: Optional[Path] = None,
         service_registry: Any = None,
         on_critical_failure: Optional[Callable[[BaseException], None]] = None,
+        code_directory: Optional[str] = None,
     ) -> None:
         self._global_agent_id = global_agent_id
         self._tenant = tenant
@@ -98,6 +99,10 @@ class SimpleAgentContext(AgentContext):
         self._service_registry = service_registry
         self._on_critical_failure = on_critical_failure
         self._producers: dict[str, Any] = {}
+        self._code_directory = code_directory
+
+    def get_code_directory(self) -> Optional[str]:
+        return self._code_directory
 
     def get_global_agent_id(self) -> str:
         return self._global_agent_id

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 from pathlib import Path
 from typing import Any, Optional
 
@@ -125,7 +126,20 @@ class ControlPlaneServer:
 
     # -- applications --------------------------------------------------------
 
+    _NAME_RE = re.compile(r"^[a-z0-9][a-z0-9-]{0,62}$")
+
+    @classmethod
+    def _check_name(cls, kind: str, name: str) -> str:
+        """DNS-label style names only (reference K8s naming constraints) —
+        also forecloses path traversal through ids used in storage paths."""
+        if not cls._NAME_RE.match(name):
+            raise ApplicationServiceError(
+                f"invalid {kind} name {name!r}: must match {cls._NAME_RE.pattern}"
+            )
+        return name
+
     def _check_tenant(self, tenant: str) -> None:
+        self._check_name("tenant", tenant)
         if not self.tenants.exists(tenant):
             raise ApplicationServiceError(f"tenant {tenant!r} not found", status=404)
 
@@ -150,7 +164,7 @@ class ControlPlaneServer:
 
     async def _deploy(self, request: web.Request) -> web.Response:
         tenant = request.match_info["tenant"]
-        name = request.match_info["name"]
+        name = self._check_name("application", request.match_info["name"])
         self._check_tenant(tenant)
         archive, instance, secrets, dry_run = await self._read_deploy_form(request)
         result = await self.applications.deploy(
@@ -160,7 +174,7 @@ class ControlPlaneServer:
 
     async def _update(self, request: web.Request) -> web.Response:
         tenant = request.match_info["tenant"]
-        name = request.match_info["name"]
+        name = self._check_name("application", request.match_info["name"])
         self._check_tenant(tenant)
         archive, instance, secrets, dry_run = await self._read_deploy_form(request)
         result = await self.applications.deploy(
@@ -201,7 +215,7 @@ class ControlPlaneServer:
     # -- tenants -------------------------------------------------------------
 
     async def _tenant_put(self, request: web.Request) -> web.Response:
-        name = request.match_info["name"]
+        name = self._check_name("tenant", request.match_info["name"])
         body: dict[str, Any] = {}
         if request.can_read_body:
             try:

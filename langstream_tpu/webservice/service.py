@@ -162,6 +162,7 @@ class ApplicationService:
 
             if archive_bytes is None:
                 raise ApplicationServiceError("application package is required")
+            all_files = extract_package_from_zip(archive_bytes)
             # an update that omits instance/secrets keeps the stored ones
             # (otherwise the redeployed app would silently lose its
             # environment while the store kept the stale documents)
@@ -173,14 +174,16 @@ class ApplicationService:
                     instance_text = stored_instance
                 if secrets_text is None:
                     secrets_text = stored_secrets
-            package_files = {
+            from langstream_tpu.core.parser import is_pipeline_document
+
+            yaml_files = {
                 rel: text
-                for rel, text in extract_package_from_zip(archive_bytes).items()
-                if rel.endswith((".yaml", ".yml"))
+                for rel, text in all_files.items()
+                if is_pipeline_document(rel)
             }
             try:
                 pkg = ModelBuilder.build_application_from_files(
-                    package_files, instance_text, secrets_text
+                    yaml_files, instance_text, secrets_text
                 )
             except ModelParseError as e:
                 raise ApplicationServiceError(str(e)) from e
@@ -218,7 +221,7 @@ class ApplicationService:
                 stored = self.store.put_package(
                     tenant,
                     application_id,
-                    package_files,
+                    all_files,  # full package: python/ user code travels too
                     instance_text,
                     secrets_text,
                     code_archive_id,
@@ -229,6 +232,9 @@ class ApplicationService:
                 assert stored is not None
 
             if self.runtime is not None:
+                resolved.code_directory = self._materialize_code_dir(
+                    tenant, application_id, all_files
+                )
                 resolved_stored = StoredApplication(
                     application_id=application_id,
                     application=resolved,
@@ -237,6 +243,39 @@ class ApplicationService:
                 )
                 await self.runtime.deploy_application(tenant, application_id, resolved_stored)
             return {"application-id": application_id, "code-archive-id": code_archive_id}
+
+    @classmethod
+    def _code_dir_root(cls, tenant: str, application_id: str) -> "Path":
+        import tempfile
+        from pathlib import Path
+
+        base = Path(tempfile.gettempdir()) / "langstream-tpu-code"
+        root = (base / tenant / application_id).resolve()
+        # names are validated at the API layer; this is defense in depth
+        # against traversal via crafted tenant/app ids
+        if not root.is_relative_to(base.resolve()) or root == base.resolve():
+            raise ApplicationServiceError("invalid tenant/application name")
+        return root
+
+    @classmethod
+    def _materialize_code_dir(
+        cls, tenant: str, application_id: str, files: dict[str, str]
+    ) -> str:
+        """Write the package to a stable on-disk dir so python-agent
+        subprocesses can import from <dir>/python (the code-download
+        init-container's job in the reference)."""
+        import shutil
+
+        root = cls._code_dir_root(tenant, application_id)
+        if root.exists():
+            shutil.rmtree(root)
+        for rel, text in files.items():
+            target = (root / rel).resolve()
+            if not target.is_relative_to(root):
+                raise ApplicationServiceError(f"package path escapes the package: {rel}")
+            target.parent.mkdir(parents=True, exist_ok=True)
+            target.write_text(text)
+        return str(root)
 
     async def delete(self, tenant: str, application_id: str) -> None:
         async with self._lock:
@@ -253,6 +292,15 @@ class ApplicationService:
                 except Exception:  # noqa: BLE001
                     log.exception("failed to delete code archive")
             self.store.delete(tenant, application_id)
+            # remove the materialized user-code dir (it can hold credentials)
+            try:
+                import shutil
+
+                root = self._code_dir_root(tenant, application_id)
+                if root.exists():
+                    shutil.rmtree(root)
+            except Exception:  # noqa: BLE001
+                log.exception("failed to remove materialized code dir")
 
     # -- read ---------------------------------------------------------------
 

@@ -24,7 +24,7 @@ from langstream_tpu.api.storage import (
     GlobalMetadataStore,
     StoredApplication,
 )
-from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.core.parser import ModelBuilder, is_pipeline_document
 
 
 class InMemoryApplicationStore(ApplicationStore):
@@ -46,7 +46,9 @@ class InMemoryApplicationStore(ApplicationStore):
         code_archive_id: Optional[str],
     ) -> StoredApplication:
         pkg = ModelBuilder.build_application_from_files(
-            package_files, instance_text, secrets_text
+            {k: v for k, v in package_files.items() if is_pipeline_document(k)},
+            instance_text,
+            secrets_text,
         )
         self.put(tenant, application_id, pkg.application, code_archive_id)
         self._raw[(tenant, application_id)] = (instance_text, secrets_text)
@@ -186,8 +188,11 @@ class LocalDiskApplicationStore(ApplicationStore):
             return None
         files: dict[str, str] = {}
         for p in sorted(pkg_dir.rglob("*")):
-            if p.is_file():
-                files[str(p.relative_to(pkg_dir))] = p.read_text()
+            # only pipeline documents parse; python/ user code etc. is
+            # carried by get_package_files / the code archive
+            rel = str(p.relative_to(pkg_dir))
+            if p.is_file() and is_pipeline_document(rel):
+                files[rel] = p.read_text()
         instance_file = app_dir / "instance.yaml"
         secrets_file = app_dir / "secrets.yaml"
         pkg = ModelBuilder.build_application_from_files(
