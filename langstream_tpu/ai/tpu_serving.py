@@ -169,11 +169,12 @@ class _StreamState:
             self.threshold = min(self.threshold * 2, self.min_chunks)
 
     def _flush(self, last: bool) -> None:
-        text = self.tokenizer.decode(self.tokens)
-        if not last:
+        if last:
+            text = self.tokenizer.decode(self.tokens)
+        else:
             # a token boundary may split a multibyte char: hold back the
             # undecodable tail so the next flush re-emits it whole
-            text = text.rstrip("�")
+            text = self.tokenizer.decode_stream_prefix(self.tokens)
             if not text.startswith(self.emitted_text):
                 # decode prefix not stable yet (mid-grapheme) — wait
                 self.pending = 0

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from langstream_tpu.api.record import Header, Record
+from langstream_tpu.native import OffsetTracker, key_partition
 from langstream_tpu.api.topics import (
     TopicAdmin,
     TopicConnectionsRuntime,
@@ -120,7 +121,7 @@ class MemoryBroker:
         topic = self._get_or_create(topic_name)
         n = len(topic.partitions)
         if record.key is not None:
-            part = hash(str(record.key)) % n
+            part = key_partition(record.key, n)
         else:
             part = getattr(self, "_rr", 0) % n
             self._rr = part + 1
@@ -192,7 +193,9 @@ class MemoryTopicConsumer(TopicConsumer):
         self.max_records = max_records
         self._assigned: list[int] = []
         self._fetch_pos: dict[int, int] = {}
-        self._pending: dict[int, set[int]] = {}  # acked-out-of-order offsets
+        # contiguous-prefix commit bookkeeping per partition (C++ fast path
+        # when the native extension is built; langstream_tpu.native)
+        self._trackers: dict[int, OffsetTracker] = {}
         self._total_out = 0
         self._started = False
 
@@ -211,7 +214,10 @@ class MemoryTopicConsumer(TopicConsumer):
         self._fetch_pos = {
             p: topic.committed.get((self.group, p), 0) for p in self._assigned
         }
-        self._pending = {p: set() for p in self._assigned}
+        self._trackers = {
+            p: OffsetTracker(topic.committed.get((self.group, p), 0))
+            for p in self._assigned
+        }
 
     async def read(self) -> list[Record]:
         out = self._poll()
@@ -240,13 +246,11 @@ class MemoryTopicConsumer(TopicConsumer):
         for r in records:
             if not isinstance(r, ConsumedRecord):
                 continue
-            self._pending.setdefault(r.partition, set()).add(r.offset)
-        for p, acked in self._pending.items():
-            committed = topic.committed.get((self.group, p), 0)
-            while committed in acked:
-                acked.remove(committed)
-                committed += 1
-            topic.committed[(self.group, p)] = committed
+            tracker = self._trackers.get(r.partition)
+            if tracker is None:
+                tracker = OffsetTracker(topic.committed.get((self.group, r.partition), 0))
+                self._trackers[r.partition] = tracker
+            topic.committed[(self.group, r.partition)] = tracker.ack(r.offset)
 
     def get_info(self) -> dict[str, Any]:
         topic = self.broker._get_or_create(self.topic_name)

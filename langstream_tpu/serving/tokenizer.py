@@ -11,6 +11,8 @@ from __future__ import annotations
 import abc
 from typing import Optional
 
+from langstream_tpu.native import utf8_incomplete_tail_len
+
 
 class Tokenizer(abc.ABC):
     eos_token_id: Optional[int] = None
@@ -25,6 +27,13 @@ class Tokenizer(abc.ABC):
     @property
     @abc.abstractmethod
     def vocab_size(self) -> int: ...
+
+    def decode_stream_prefix(self, tokens: list[int]) -> str:
+        """Decode for incremental streaming: return only text that cannot
+        change as more tokens arrive (hold back bytes of an incomplete
+        multibyte character). Default: decode and strip a trailing
+        replacement char (lossy for models that emit U+FFFD themselves)."""
+        return self.decode(tokens).rstrip("�")
 
 
 class ByteTokenizer(Tokenizer):
@@ -47,6 +56,15 @@ class ByteTokenizer(Tokenizer):
     def decode(self, tokens: list[int]) -> str:
         data = bytes(t for t in tokens if 0 <= t < 256)
         return data.decode("utf-8", "replace")
+
+    def decode_stream_prefix(self, tokens: list[int]) -> str:
+        """Exact incremental decode: hold back only a trailing incomplete
+        multibyte sequence; earlier garbage becomes U+FFFD (errors=replace)
+        so a bad sampled byte neither raises nor freezes the stream, and a
+        genuine U+FFFD emitted by the model survives."""
+        data = bytes(t for t in tokens if 0 <= t < 256)
+        tail = utf8_incomplete_tail_len(data)
+        return data[: len(data) - tail].decode("utf-8", "replace")
 
 
 class HFTokenizer(Tokenizer):

@@ -1,0 +1,259 @@
+// _lsnative — C++ hot-path host utilities for the langstream_tpu runtime.
+//
+// The reference is pure JVM (SURVEY §2: no native code anywhere); this is
+// the rebuild's native layer for per-record host work that sits on the
+// broker/runtime fast path:
+//   - OffsetTracker: contiguous-prefix commit watermark (the TreeSet
+//     bookkeeping of KafkaConsumerWrapper.commit:159-190, O(1) amortized)
+//   - fnv1a64: stable cross-process key hash for partition routing
+//     (Python's built-in str hash is salted per process — replicas would
+//     disagree on key→partition and break per-key ordering)
+//   - utf8_valid_prefix_len: longest valid UTF-8 prefix, for incremental
+//     detokenization of streamed chunks
+//
+// Pure CPython C API (no pybind11 in the image). langstream_tpu/native.py
+// holds the Python fallbacks with identical semantics; parity is enforced
+// by tests/test_native.py.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <unordered_set>
+
+// ---------------------------------------------------------------------------
+// OffsetTracker
+// ---------------------------------------------------------------------------
+
+typedef struct {
+    PyObject_HEAD
+    int64_t watermark;                     // next offset expected to commit
+    std::unordered_set<int64_t> *pending;  // acked offsets > watermark
+} OffsetTrackerObject;
+
+static PyObject *OffsetTracker_new(PyTypeObject *type, PyObject *args, PyObject *kwds) {
+    OffsetTrackerObject *self = (OffsetTrackerObject *)type->tp_alloc(type, 0);
+    if (self != nullptr) {
+        self->watermark = 0;
+        // allocate in tp_new so ack() is safe even if __init__ never ran
+        self->pending = new std::unordered_set<int64_t>();
+    }
+    return (PyObject *)self;
+}
+
+static int OffsetTracker_init(OffsetTrackerObject *self, PyObject *args, PyObject *kwds) {
+    long long start = 0;
+    static const char *kwlist[] = {"start", nullptr};
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "|L", (char **)kwlist, &start)) {
+        return -1;
+    }
+    self->watermark = (int64_t)start;
+    delete self->pending;
+    self->pending = new std::unordered_set<int64_t>();
+    return 0;
+}
+
+static void OffsetTracker_dealloc(OffsetTrackerObject *self) {
+    delete self->pending;
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *OffsetTracker_ack(OffsetTrackerObject *self, PyObject *arg) {
+    long long offset = PyLong_AsLongLong(arg);
+    if (offset == -1 && PyErr_Occurred()) {
+        return nullptr;
+    }
+    if (offset >= self->watermark) {
+        self->pending->insert((int64_t)offset);
+        // advance over the contiguous prefix
+        while (self->pending->erase(self->watermark) > 0) {
+            self->watermark += 1;
+        }
+    }
+    return PyLong_FromLongLong(self->watermark);
+}
+
+static PyObject *OffsetTracker_get_watermark(OffsetTrackerObject *self, void *closure) {
+    return PyLong_FromLongLong(self->watermark);
+}
+
+static PyObject *OffsetTracker_get_pending(OffsetTrackerObject *self, void *closure) {
+    return PyLong_FromSize_t(self->pending ? self->pending->size() : 0);
+}
+
+static PyMethodDef OffsetTracker_methods[] = {
+    {"ack", (PyCFunction)OffsetTracker_ack, METH_O,
+     "Ack one offset; returns the new contiguous-prefix watermark."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static PyGetSetDef OffsetTracker_getset[] = {
+    {"watermark", (getter)OffsetTracker_get_watermark, nullptr,
+     "next offset expected (committed offset)", nullptr},
+    {"pending_count", (getter)OffsetTracker_get_pending, nullptr,
+     "acked offsets still gapped", nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr},
+};
+
+static PyTypeObject OffsetTrackerType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "_lsnative.OffsetTracker",        /* tp_name */
+    sizeof(OffsetTrackerObject),      /* tp_basicsize */
+};
+
+// ---------------------------------------------------------------------------
+// fnv1a64
+// ---------------------------------------------------------------------------
+
+static PyObject *py_fnv1a64(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) {
+        return nullptr;
+    }
+    const unsigned char *data = (const unsigned char *)view.buf;
+    uint64_t h = 14695981039346656037ULL;
+    for (Py_ssize_t i = 0; i < view.len; i++) {
+        h ^= (uint64_t)data[i];
+        h *= 1099511628211ULL;
+    }
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+// ---------------------------------------------------------------------------
+// utf8 helpers (STRICT — match CPython's utf-8 codec: no overlongs, no
+// surrogates, nothing above U+10FFFF)
+// ---------------------------------------------------------------------------
+
+// bytes a sequence starting at a lead byte needs in total (0 = invalid lead)
+static inline int utf8_seq_len(unsigned char c) {
+    if (c < 0x80) return 1;
+    if (c >= 0xC2 && c <= 0xDF) return 2;   // C0/C1 are overlong
+    if (c >= 0xE0 && c <= 0xEF) return 3;
+    if (c >= 0xF0 && c <= 0xF4) return 4;   // F5+ exceeds U+10FFFF
+    return 0;
+}
+
+// valid range for the SECOND byte of a sequence, given the lead
+static inline bool utf8_second_ok(unsigned char lead, unsigned char c2) {
+    if (lead == 0xE0) return c2 >= 0xA0 && c2 <= 0xBF;  // overlong 3-byte
+    if (lead == 0xED) return c2 >= 0x80 && c2 <= 0x9F;  // surrogates
+    if (lead == 0xF0) return c2 >= 0x90 && c2 <= 0xBF;  // overlong 4-byte
+    if (lead == 0xF4) return c2 >= 0x80 && c2 <= 0x8F;  // > U+10FFFF
+    return (c2 & 0xC0) == 0x80;
+}
+
+static PyObject *py_utf8_valid_prefix_len(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) {
+        return nullptr;
+    }
+    const unsigned char *b = (const unsigned char *)view.buf;
+    Py_ssize_t n = view.len;
+    Py_ssize_t i = 0;
+    Py_ssize_t last_good = 0;
+    while (i < n) {
+        int len = utf8_seq_len(b[i]);
+        if (len == 0) {
+            break;  // invalid lead byte: prefix ends here
+        }
+        if (i + len > n) {
+            break;  // sequence truncated at the end: hold back
+        }
+        bool ok = true;
+        for (Py_ssize_t j = 1; j < len; j++) {
+            unsigned char c = b[i + j];
+            if (j == 1 ? !utf8_second_ok(b[i], c) : (c & 0xC0) != 0x80) {
+                ok = false;
+                break;
+            }
+        }
+        if (!ok) {
+            break;
+        }
+        i += len;
+        last_good = i;
+    }
+    PyBuffer_Release(&view);
+    return PyLong_FromSsize_t(last_good);
+}
+
+// length of a trailing INCOMPLETE (but so-far-valid) sequence; 0 when the
+// buffer ends on a complete boundary or in garbage that can never complete
+static PyObject *py_utf8_incomplete_tail_len(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) {
+        return nullptr;
+    }
+    const unsigned char *b = (const unsigned char *)view.buf;
+    Py_ssize_t n = view.len;
+    Py_ssize_t tail = 0;
+    for (Py_ssize_t back = 1; back <= 3 && back <= n; back++) {
+        Py_ssize_t p = n - back;
+        int len = utf8_seq_len(b[p]);
+        if (len <= 1) {
+            if (len == 1) break;  // ascii: boundary; nothing incomplete
+            continue;             // continuation/invalid: look further back
+        }
+        if (len > back) {
+            // sequence would extend past the end — check the partial bytes
+            bool ok = true;
+            for (Py_ssize_t j = 1; j < back; j++) {
+                unsigned char c = b[p + j];
+                if (j == 1 ? !utf8_second_ok(b[p], c) : (c & 0xC0) != 0x80) {
+                    ok = false;
+                    break;
+                }
+            }
+            if (ok) tail = back;
+        }
+        break;  // found a lead byte: decided either way
+    }
+    PyBuffer_Release(&view);
+    return PyLong_FromSsize_t(tail);
+}
+
+// ---------------------------------------------------------------------------
+// module
+// ---------------------------------------------------------------------------
+
+static PyMethodDef module_methods[] = {
+    {"fnv1a64", py_fnv1a64, METH_O,
+     "Stable 64-bit FNV-1a hash of a bytes-like object."},
+    {"utf8_valid_prefix_len", py_utf8_valid_prefix_len, METH_O,
+     "Length of the longest strictly-valid UTF-8 prefix of a bytes-like object."},
+    {"utf8_incomplete_tail_len", py_utf8_incomplete_tail_len, METH_O,
+     "Bytes of a trailing incomplete-but-plausible UTF-8 sequence (0 if none)."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+static struct PyModuleDef lsnative_module = {
+    PyModuleDef_HEAD_INIT, "_lsnative",
+    "C++ hot-path utilities for langstream_tpu (offset tracking, stable "
+    "hashing, utf8 incremental decode).",
+    -1, module_methods,
+};
+
+PyMODINIT_FUNC PyInit__lsnative(void) {
+    OffsetTrackerType.tp_dealloc = (destructor)OffsetTracker_dealloc;
+    OffsetTrackerType.tp_flags = Py_TPFLAGS_DEFAULT;
+    OffsetTrackerType.tp_doc = "Contiguous-prefix offset commit tracker.";
+    OffsetTrackerType.tp_methods = OffsetTracker_methods;
+    OffsetTrackerType.tp_getset = OffsetTracker_getset;
+    OffsetTrackerType.tp_init = (initproc)OffsetTracker_init;
+    OffsetTrackerType.tp_new = OffsetTracker_new;
+    if (PyType_Ready(&OffsetTrackerType) < 0) {
+        return nullptr;
+    }
+    PyObject *m = PyModule_Create(&lsnative_module);
+    if (m == nullptr) {
+        return nullptr;
+    }
+    Py_INCREF(&OffsetTrackerType);
+    if (PyModule_AddObject(m, "OffsetTracker", (PyObject *)&OffsetTrackerType) < 0) {
+        Py_DECREF(&OffsetTrackerType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}
