@@ -1,0 +1,20 @@
+#!/bin/sh
+# Role dispatch for the single runtime image (reference entrypoint.sh +
+# Main.java role switch).
+set -e
+ROLE="${1:-agent-runtime}"
+shift 2>/dev/null || true
+
+case "$ROLE" in
+  run-local)
+    exec python -m langstream_tpu.cli run local "$@"
+    ;;
+  control-plane|gateway|agent-runtime|deployer-runtime|application-setup)
+    # served through the python entry points; agent pods read their
+    # RuntimePodConfiguration from the mounted secret (POD_CONFIGURATION)
+    exec python -m langstream_tpu.entrypoint "$ROLE" "$@"
+    ;;
+  *)
+    exec "$ROLE" "$@"
+    ;;
+esac

@@ -434,8 +434,9 @@ def run() -> None:
 @click.option("--name", default="local-app")
 @click.option("--gateway-port", default=8091)
 @click.option("--control-plane-port", default=8090)
+@click.option("--metrics-port", default=8080, help="/metrics + /info port (-1 disables)")
 @click.option("--once", is_flag=True, hidden=True, help="start and exit (tests)")
-def run_local(app_dir, instance, secrets, name, gateway_port, control_plane_port, once) -> None:
+def run_local(app_dir, instance, secrets, name, gateway_port, control_plane_port, metrics_port, once) -> None:
     """Whole platform in one process: control plane + runtime + gateway
     (reference `langstream docker run` / runtime-tester)."""
 
@@ -460,10 +461,17 @@ def run_local(app_dir, instance, secrets, name, gateway_port, control_plane_port
         provider.put("default", name, runner.application, runner.topic_runtime)
         gateway_server = GatewayServer(provider, port=gateway_port)
         await gateway_server.start()
+        metrics_server = None
+        if metrics_port >= 0:
+            metrics_server = await runner.serve_metrics(port=metrics_port)
         click.echo(f"control plane: {control_plane.url}")
         click.echo(f"gateway:       {gateway_server.url}")
+        if metrics_server is not None:
+            click.echo(f"metrics:       {metrics_server.url}/metrics")
         click.echo(f"application:   {name} (tenant default)")
         if once:
+            if metrics_server is not None:
+                await metrics_server.stop()
             await gateway_server.stop()
             await runtime.close()
             await control_plane.stop()
@@ -474,6 +482,8 @@ def run_local(app_dir, instance, secrets, name, gateway_port, control_plane_port
         except (KeyboardInterrupt, asyncio.CancelledError):
             pass
         finally:
+            if metrics_server is not None:
+                await metrics_server.stop()
             await gateway_server.stop()
             await runtime.close()
             await control_plane.stop()

@@ -1,0 +1,210 @@
+"""Pod entry point — role dispatch for the runtime image.
+
+Parity: reference ``runtime/Main.java:42-45`` (``agent-runtime |
+agent-code-download | deployer-runtime | application-setup``) plus the
+control-plane/gateway roles the reference runs as separate Spring apps.
+
+Roles that run standalone here:
+- ``agent-runtime``: one physical agent replica driven by the
+  RuntimePodConfiguration JSON the deployer wrote into the pod Secret
+  (mounted at ``$POD_CONFIGURATION``); serves /metrics + /info on :8080.
+- ``control-plane``: REST control plane over a disk-backed store
+  (``$STORAGE_ROOT``), with the gateway embedded.
+- ``run-local``: whole platform in one process (delegates to the CLI).
+
+``deployer-runtime`` / ``application-setup`` / ``agent-code-download`` need
+a Kubernetes API client, which this image does not ship — they fail with an
+explicit message (same gating pattern as the kafka/pulsar broker runtimes).
+
+Usage: ``python -m langstream_tpu.entrypoint <role> [args...]``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import sys
+from typing import Any
+
+log = logging.getLogger(__name__)
+
+
+def build_agent_node(pod: dict[str, Any]):
+    """RuntimePodConfiguration ``agent`` section → AgentNode."""
+    from langstream_tpu.api.model import ErrorsSpec, ResourcesSpec
+    from langstream_tpu.api.planner import AgentNode, Connection
+
+    def conn(section):
+        if not section:
+            return None
+        return Connection.to_topic(section["topic"])
+
+    def build(agent: dict[str, Any]) -> AgentNode:
+        return AgentNode(
+            id=agent["agentId"],
+            agent_type=agent["agentType"],
+            component_type=agent.get("componentType", "processor"),
+            module_id=agent.get("module", "default"),
+            pipeline_id=agent.get("pipeline", "default"),
+            configuration=dict(agent.get("configuration", {})),
+            resources=ResourcesSpec.from_dict(agent.get("resources")) or ResourcesSpec(),
+            errors=ErrorsSpec.from_dict(agent.get("errors")) or ErrorsSpec(),
+            input=conn(agent.get("input")),
+            output=conn(agent.get("output")),
+            disk=bool(agent.get("disk", False)),
+            composite=[build(child) for child in agent.get("composite", [])],
+        )
+
+    return build(pod["agent"])
+
+
+async def run_agent_runtime(pod: dict[str, Any]) -> None:
+    from pathlib import Path
+
+    from langstream_tpu.api.metrics import MetricsReporter
+    from langstream_tpu.api.model import Application, Resource
+    from langstream_tpu.messaging.registry import get_topic_connections_runtime
+    from langstream_tpu.runtime.http_server import RuntimeHttpServer
+    from langstream_tpu.runtime.runner import AgentRunner, SimpleAgentContext
+
+    node = build_agent_node(pod)
+    streaming = pod.get("streamingCluster", {"type": "memory", "configuration": {}})
+    topic_runtime = get_topic_connections_runtime(streaming.get("type", "memory"))
+    await topic_runtime.init(streaming.get("configuration", {}))
+
+    # resources (AI providers, datasources) declared by the application
+    app = Application()
+    for rid, resource in (pod.get("resources") or {}).items():
+        app.resources[rid] = Resource(
+            id=rid,
+            name=resource.get("name", rid),
+            type=resource["type"],
+            configuration=dict(resource.get("configuration", {})),
+        )
+    from langstream_tpu.ai.provider import ServiceProviderRegistry
+
+    registry = ServiceProviderRegistry(app)
+
+    metrics = MetricsReporter()
+    # StatefulSet pods end in "-<ordinal>"; anything else (docker hex ids,
+    # bare hostnames) falls back to replica 0
+    try:
+        replica = int(
+            os.environ.get("REPLICA")
+            or os.environ.get("HOSTNAME", "0").rsplit("-", 1)[-1]
+        )
+    except ValueError:
+        replica = 0
+    state_dir = os.environ.get("PERSISTENT_STATE_DIR", "/persistent-state")
+    context = SimpleAgentContext(
+        global_agent_id=f"{pod.get('applicationId', 'app')}-{node.id}-{replica}",
+        tenant=pod.get("tenant", "default"),
+        topic_runtime=topic_runtime,
+        metrics=metrics,
+        state_dir=Path(state_dir) if node.disk else None,
+        service_registry=registry,
+        on_critical_failure=lambda e: os._exit(1),  # crash-only (reference)
+        code_directory=os.environ.get("APP_CODE_DIR"),
+    )
+    runner = AgentRunner(node, topic_runtime, context, replica)
+    await runner.setup()
+    await runner.start()
+
+    http = RuntimeHttpServer(
+        metrics_text=metrics.prometheus_text,
+        agents_info=lambda: [runner.info()],
+        host=os.environ.get("HTTP_HOST", "0.0.0.0"),
+        port=int(pod.get("httpPort", os.environ.get("HTTP_PORT", "8080"))),
+    )
+    await http.start()
+    log.info("agent runtime up: %s", node.id)
+    try:
+        await runner.run()
+    finally:
+        await http.stop()
+        try:
+            await runner.close()
+        except Exception:  # noqa: BLE001 — shutdown best-effort
+            log.exception("agent close failed")
+
+
+async def run_control_plane() -> None:
+    from langstream_tpu.webservice.server import ControlPlaneServer
+    from langstream_tpu.webservice.service import make_local_service
+
+    root = os.environ.get("STORAGE_ROOT", "/var/lib/langstream-tpu")
+    applications, tenants, runtime = make_local_service(root)
+    server = ControlPlaneServer(
+        applications,
+        tenants,
+        host="0.0.0.0",
+        port=int(os.environ.get("CONTROL_PLANE_PORT", "8090")),
+        auth_token=os.environ.get("ADMIN_TOKEN") or None,
+        archetypes_path=os.environ.get("ARCHETYPES_PATH") or None,
+    )
+    await server.start()
+    log.info("control plane up on %s", server.url)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runtime.close()
+        await server.stop()
+
+
+async def run_gateway() -> None:
+    """Standalone gateway over the control plane's disk store (shared PVC)."""
+    from langstream_tpu.gateway.server import GatewayServer, StoreApplicationProvider
+    from langstream_tpu.webservice.stores import LocalDiskApplicationStore
+
+    root = os.environ.get("STORAGE_ROOT", "/var/lib/langstream-tpu")
+    store = LocalDiskApplicationStore(f"{root}/apps")
+    server = GatewayServer(
+        StoreApplicationProvider(store),
+        host="0.0.0.0",
+        port=int(os.environ.get("GATEWAY_PORT", "8091")),
+    )
+    await server.start()
+    log.info("gateway up on %s", server.url)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    argv = argv if argv is not None else sys.argv[1:]
+    role = argv[0] if argv else "agent-runtime"
+    if role == "agent-runtime":
+        config_path = os.environ.get("POD_CONFIGURATION", "/app-config/pod-configuration")
+        with open(config_path) as f:
+            pod = json.load(f)
+        asyncio.run(run_agent_runtime(pod))
+        return 0
+    if role == "control-plane":
+        asyncio.run(run_control_plane())
+        return 0
+    if role == "gateway":
+        asyncio.run(run_gateway())
+        return 0
+    if role == "run-local":
+        from langstream_tpu.cli.main import cli
+
+        cli(["run", "local", *argv[1:]], standalone_mode=True, obj={})
+        return 0
+    if role in ("operator", "deployer-runtime", "application-setup", "agent-code-download"):
+        print(
+            f"role {role!r} drives the Kubernetes API and requires a k8s client "
+            "library, which this image does not ship; in local mode the "
+            "in-process executor performs this work (langstream_tpu.k8s)",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"unknown role {role!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

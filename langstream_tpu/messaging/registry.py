@@ -28,19 +28,27 @@ class TopicConnectionsRuntimeRegistry:
             raise ValueError(f"unknown streaming cluster type {type_!r}; known: {known}")
         return factory()
 
+    # type → (module, class); gated runtimes register only when their client
+    # library imports (the image ships none of the broker clients)
+    _BUILTINS = (
+        ("memory", "langstream_tpu.messaging.memory", "MemoryTopicConnectionsRuntime"),
+        ("kafka", "langstream_tpu.messaging.kafka", "KafkaTopicConnectionsRuntime"),
+        ("pulsar", "langstream_tpu.messaging.pulsar", "PulsarTopicConnectionsRuntime"),
+        ("pravega", "langstream_tpu.messaging.pravega", "PravegaTopicConnectionsRuntime"),
+    )
+
     @classmethod
     def _ensure_builtins(cls) -> None:
-        if "memory" not in cls._factories:
-            from langstream_tpu.messaging.memory import MemoryTopicConnectionsRuntime
+        import importlib
 
-            cls._factories["memory"] = MemoryTopicConnectionsRuntime
-        if "kafka" not in cls._factories:
+        for type_, module_name, class_name in cls._BUILTINS:
+            if type_ in cls._factories:
+                continue
             try:
-                from langstream_tpu.messaging.kafka import KafkaTopicConnectionsRuntime
-
-                cls._factories["kafka"] = KafkaTopicConnectionsRuntime
+                module = importlib.import_module(module_name)
             except ImportError:
-                pass
+                continue
+            cls._factories[type_] = getattr(module, class_name)
 
 
 def get_topic_connections_runtime(type_: str) -> TopicConnectionsRuntime:

@@ -1,0 +1,69 @@
+"""Per-runtime observability HTTP server.
+
+Parity: reference ``AgentRunner.java:96-110`` — Jetty on :8080 serving
+``/metrics`` (Prometheus text, MetricsHttpServlet) and ``/info`` (per-agent
+status JSON, AgentInfoServlet) — surfaced by the control plane's status and
+logs endpoints.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional
+
+from aiohttp import web
+
+log = logging.getLogger(__name__)
+
+
+class RuntimeHttpServer:
+    def __init__(
+        self,
+        metrics_text: Callable[[], str],
+        agents_info: Callable[[], list[dict[str, Any]]],
+        host: str = "127.0.0.1",
+        port: int = 8080,
+    ) -> None:
+        self._metrics_text = metrics_text
+        self._agents_info = agents_info
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/metrics", self._metrics),
+                web.get("/info", self._info),
+                web.get("/healthz", self._healthz),
+            ]
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self._metrics_text(), content_type="text/plain", charset="utf-8"
+        )
+
+    async def _info(self, request: web.Request) -> web.Response:
+        return web.json_response(self._agents_info())
+
+    async def _healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "OK"})
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            for s in self._runner.sites:
+                self.port = s._server.sockets[0].getsockname()[1]  # noqa: SLF001
+        log.info("runtime http server on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -94,6 +94,20 @@ class LocalApplicationRunner:
         """The app's topic-connections runtime (available after deploy())."""
         return self._topic_runtime
 
+    async def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the /metrics + /info observability server (reference
+        AgentRunner.java:96-110 Jetty on :8080)."""
+        from langstream_tpu.runtime.http_server import RuntimeHttpServer
+
+        server = RuntimeHttpServer(
+            metrics_text=self.metrics.prometheus_text,
+            agents_info=self.agents_info,
+            host=host,
+            port=port,
+        )
+        await server.start()
+        return server
+
     async def serve_gateway(self, host: str = "127.0.0.1", port: int = 0):
         """Start an API gateway bound to this application (the embedded
         gateway of reference LocalApplicationRunner / `langstream docker run`)."""

@@ -1,0 +1,94 @@
+"""Pod entrypoint tests: the deployer-written RuntimePodConfiguration must
+boot a standalone agent pod (the reference Main agent-runtime path)."""
+
+import asyncio
+import json
+
+from langstream_tpu.k8s.controllers import AppController, InProcessJobExecutor
+from langstream_tpu.k8s.crds import ApplicationCustomResource
+from langstream_tpu.k8s.fake import FakeKubeServer
+
+PIPELINE = """
+module: default
+id: p
+name: echo
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: upper
+    type: compute
+    input: input-topic
+    output: output-topic
+    configuration:
+      fields:
+        - name: value
+          expression: "fn:uppercase(value)"
+"""
+
+INSTANCE = """
+instance:
+  streamingCluster:
+    type: memory
+  computeCluster:
+    type: kubernetes
+"""
+
+
+def test_deployer_pod_config_boots_agent_runtime(run):
+    kube = FakeKubeServer()
+    controller = AppController(kube, InProcessJobExecutor(kube))
+    app = ApplicationCustomResource(
+        name="podtest",
+        namespace="langstream-default",
+        tenant="default",
+        package_files={"pipeline.yaml": PIPELINE},
+        instance_text=INSTANCE,
+    )
+    kube.apply(app.to_manifest())
+    status = controller.reconcile(app.to_manifest())
+    assert status["phase"] == "DEPLOYED"
+
+    # the deployer wrote a FULL pod configuration into the agent Secret
+    agents = kube.list("Agent", app.namespace)
+    assert len(agents) == 1
+    secret = kube.get("Secret", app.namespace, agents[0]["spec"]["configSecretRef"])
+    pod = json.loads(secret["stringData"]["pod-configuration"])
+    assert pod["agent"]["agentType"] == "compute"
+    assert pod["agent"]["input"]["topic"] == "input-topic"
+    assert pod["streamingCluster"]["type"] == "memory"
+
+    # boot the agent runtime from that config (what the pod's entrypoint
+    # does) and push a record through the shared memory broker
+    from langstream_tpu.entrypoint import run_agent_runtime
+    from langstream_tpu.messaging.memory import MemoryTopicConnectionsRuntime
+    from langstream_tpu.api.record import SimpleRecord
+    from langstream_tpu.api.topics import TopicOffsetPosition
+
+    async def scenario():
+        task = asyncio.create_task(run_agent_runtime({**pod, "httpPort": 0}))
+        runtime = MemoryTopicConnectionsRuntime()
+        await runtime.init({})
+        reader = runtime.create_reader(
+            "output-topic", TopicOffsetPosition(position="earliest")
+        )
+        await reader.start()
+        producer = runtime.create_producer("test", "input-topic")
+        await producer.start()
+        await producer.write(SimpleRecord.of("hello pod"))
+        got = []
+        for _ in range(200):
+            result = await reader.read()
+            got.extend(result.records)
+            if got:
+                break
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+        assert got and got[0].value == "HELLO POD"
+
+    run(scenario())

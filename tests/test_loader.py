@@ -1,0 +1,57 @@
+"""Checkpoint loader tests: HF-naming round trip for dense, gemma-style,
+and MoE configs, plus shape validation errors."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from langstream_tpu.models.configs import MODEL_PRESETS, ModelConfig
+from langstream_tpu.models.loader import load_params, save_params_hf
+from langstream_tpu.models.transformer import forward, init_params
+
+DENSE = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+MOE = dataclasses.replace(MODEL_PRESETS["tiny-moe-test"], dtype="float32")
+GEMMA_TINY = ModelConfig(
+    name="tiny-gemma", vocab_size=256, d_model=32, n_layers=2, n_heads=4,
+    n_kv_heads=1, d_ff=64, activation="gelu", tie_embeddings=True,
+    embedding_scale=True, dtype="float32",
+)
+
+
+def assert_trees_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6),
+        a,
+        b,
+    )
+
+
+@pytest.mark.parametrize("config", [DENSE, GEMMA_TINY, MOE], ids=lambda c: c.name)
+def test_hf_roundtrip(config, tmp_path):
+    params = init_params(config, jax.random.PRNGKey(0))
+    save_params_hf(params, config, tmp_path)
+    loaded = load_params(tmp_path, config)
+    assert_trees_equal(params, loaded)
+    # loaded weights actually run
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, config.vocab_size)
+    out_a = forward(params, tokens, config)
+    out_b = forward(loaded, tokens, config)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-6)
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    params = init_params(DENSE, jax.random.PRNGKey(0))
+    save_params_hf(params, DENSE, tmp_path)
+    wrong = dataclasses.replace(DENSE, d_ff=256)  # different width
+    with pytest.raises((ValueError, KeyError)):
+        load_params(tmp_path, wrong)
+
+
+def test_missing_tensor_message(tmp_path):
+    params = init_params(DENSE, jax.random.PRNGKey(0))
+    save_params_hf(params, DENSE, tmp_path)
+    deeper = dataclasses.replace(DENSE, n_layers=4)
+    with pytest.raises(KeyError, match="layers.2"):
+        load_params(tmp_path, deeper)

@@ -393,3 +393,45 @@ pipeline:
         assert sorted(x.value for x in collected) == ["A", "B"]
 
     run(main())
+
+
+def test_metrics_info_http_server(run):
+    """/metrics (prometheus) + /info (agent status) server
+    (reference AgentRunner Jetty on :8080)."""
+    import aiohttp
+
+    from langstream_tpu.core.parser import ModelBuilder
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pipeline = (
+        "module: default\nid: p\nname: m\ntopics:\n"
+        "  - name: input-topic\n  - name: output-topic\n"
+        "pipeline:\n  - name: echo\n    type: identity\n"
+        "    input: input-topic\n    output: output-topic\n"
+    )
+    instance = "instance:\n  streamingCluster: {type: memory}\n  computeCluster: {type: local}\n"
+
+    async def scenario():
+        pkg = ModelBuilder.build_application_from_files(
+            {"pipeline.yaml": pipeline}, instance, None
+        )
+        runner = LocalApplicationRunner("metrics-test", pkg.application)
+        await runner.deploy()
+        await runner.start()
+        server = await runner.serve_metrics()
+        try:
+            await runner.produce("input-topic", "x")
+            await runner.consume("output-topic", n=1, timeout=10)
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{server.url}/metrics") as resp:
+                    assert resp.status == 200
+                    body = await resp.text()
+                    assert "# TYPE" in body
+                async with session.get(f"{server.url}/info") as resp:
+                    info = await resp.json()
+                    assert info and info[0]["agent-id"]
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
