@@ -107,6 +107,16 @@ class StoreApplicationProvider:
             from langstream_tpu.messaging.registry import get_topic_connections_runtime
 
             streaming = stored.application.instance.streaming_cluster
+            if streaming.type == "memory":
+                # the in-memory broker is process-local: a standalone gateway
+                # cannot reach the agents' broker in another process — this
+                # topology needs a real broker (kafka/pulsar/pravega)
+                raise KeyError(
+                    f"application {tenant}/{application_id} uses the in-memory "
+                    "broker, which a standalone gateway process cannot reach; "
+                    "use `run local` (embedded gateway) or a broker-backed "
+                    "streamingCluster"
+                )
             runtime = get_topic_connections_runtime(streaming.type)
             await runtime.init(streaming.configuration)
             self._runtimes[key] = runtime

@@ -28,10 +28,9 @@ class TopicConnectionsRuntimeRegistry:
             raise ValueError(f"unknown streaming cluster type {type_!r}; known: {known}")
         return factory()
 
-    # type → (module, class); gated runtimes register only when their client
+    # type → (module, class); these register only when their broker client
     # library imports (the image ships none of the broker clients)
-    _BUILTINS = (
-        ("memory", "langstream_tpu.messaging.memory", "MemoryTopicConnectionsRuntime"),
+    _GATED_BUILTINS = (
         ("kafka", "langstream_tpu.messaging.kafka", "KafkaTopicConnectionsRuntime"),
         ("pulsar", "langstream_tpu.messaging.pulsar", "PulsarTopicConnectionsRuntime"),
         ("pravega", "langstream_tpu.messaging.pravega", "PravegaTopicConnectionsRuntime"),
@@ -41,7 +40,13 @@ class TopicConnectionsRuntimeRegistry:
     def _ensure_builtins(cls) -> None:
         import importlib
 
-        for type_, module_name, class_name in cls._BUILTINS:
+        if "memory" not in cls._factories:
+            # always required — an import failure here is a real bug and must
+            # surface, not be masked as "unknown streaming cluster type"
+            from langstream_tpu.messaging.memory import MemoryTopicConnectionsRuntime
+
+            cls._factories["memory"] = MemoryTopicConnectionsRuntime
+        for type_, module_name, class_name in cls._GATED_BUILTINS:
             if type_ in cls._factories:
                 continue
             try:

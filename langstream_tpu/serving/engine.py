@@ -112,14 +112,18 @@ def _prefill_and_sample(params, tokens, length, local_cache, key, temp, top_k, t
     return first, local_cache, key
 
 
-def _make_insert():
+def _make_insert_group():
     @functools.partial(jax.jit, donate_argnames=("cache",))
-    def insert(cache, local_cache, slot):
-        # local_cache leaves: [L, 1, W, Hkv, D] → write into cache[:, slot, :W]
+    def insert_group(cache, local_cache, slots):
+        """Scatter a whole prefill batch into the big cache in ONE op —
+        per-slot inserts each rewrote the full cache when buffer donation
+        degrades to copies (remote/tunneled devices). ``slots`` entries that
+        are out of bounds (padding rows) are dropped by the scatter."""
 
         def put(big, small):
-            return jax.lax.dynamic_update_slice(
-                big, small.astype(big.dtype), (0, slot, 0, 0, 0)
+            w = small.shape[2]
+            return big.at[:, slots, :w].set(
+                small.astype(big.dtype), mode="drop"
             )
 
         return {
@@ -127,11 +131,14 @@ def _make_insert():
             "v": put(cache["v"], local_cache["v"]),
         }
 
-    return insert
+    return insert_group
 
 
 class ServingEngine:
     """One engine per model per agent replica; owns the device loop."""
+
+    # rows per prefill call — fixed so each width bucket compiles ONCE
+    PREFILL_BATCH = 8
 
     def __init__(
         self,
@@ -166,7 +173,7 @@ class ServingEngine:
             from langstream_tpu.parallel.sharding import shard_serving_cache
 
             self._cache = shard_serving_cache(self._cache, mesh)
-        self._insert = _make_insert()
+        self._insert_group = _make_insert_group()
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -297,67 +304,111 @@ class ServingEngine:
     def _admit(self) -> list[tuple]:
         """Move queued requests into free slots (prefill path); returns the
         deferred first-token fetch entries (processed after the next chunk
-        dispatch, so the fetch overlaps device compute)."""
-        entries: list[tuple] = []
-        for idx, slot in enumerate(self._slots):
-            if slot.active:
-                continue
+        dispatch, so the fetch overlaps device compute).
+
+        Prefills are BATCHED per prompt bucket: admitting K requests costs
+        one forward at batch K (memory-bound: ~the cost of batch 1), not K
+        serial dispatches — serial prefill dominated wall-clock when a burst
+        filled a large slot pool."""
+        free = [i for i, slot in enumerate(self._slots) if not slot.active]
+        pairs: list[tuple[int, GenerationRequest]] = []
+        for idx in free:
             try:
-                request = self._queue.get_nowait()
+                pairs.append((idx, self._queue.get_nowait()))
             except queue.Empty:
                 break
-            try:
-                entries.append(self._prefill_into_slot(idx, request))
-            except Exception as e:  # noqa: BLE001 — fail THIS request, not the engine
-                log.exception("prefill failed for one request")
-                request._result = GenerationResult(
-                    tokens=[], finish_reason="error", prompt_tokens=0,
-                    ttft_s=0, total_s=0, error=e,
-                )
-                request._done.set()
-                continue
+        if not pairs:
+            return []
+        groups: dict[int, list[tuple[int, GenerationRequest]]] = {}
+        for idx, request in pairs:
+            width = self._bucket(len(request.prompt_tokens))
+            groups.setdefault(width, []).append((idx, request))
+        entries: list[tuple] = []
+        for width, group in sorted(groups.items()):
+            # fixed sub-batch size: each distinct (batch, width) shape is a
+            # separate XLA compile (expensive through a TPU tunnel), so every
+            # prefill call uses exactly PREFILL_BATCH rows
+            for start in range(0, len(group), self.PREFILL_BATCH):
+                sub = group[start : start + self.PREFILL_BATCH]
+                try:
+                    entries.extend(self._prefill_group(width, sub))
+                except Exception as e:  # noqa: BLE001 — fail the group, not the engine
+                    log.exception("prefill failed for a batch of %d requests", len(sub))
+                    for _, request in sub:
+                        request._result = GenerationResult(
+                            tokens=[], finish_reason="error", prompt_tokens=0,
+                            ttft_s=0, total_s=0, error=e,
+                        )
+                        request._done.set()
         return entries
 
-    def _prefill_into_slot(self, idx: int, request: GenerationRequest) -> tuple:
-        slot = self._slots[idx]
-        prompt = request.prompt_tokens
-        n = len(prompt)
-        width = self._bucket(n)
-        tokens = np.zeros((1, width), np.int32)
-        tokens[0, :n] = prompt
-        local_cache = make_kv_cache(self.config, 1, width)
+    def _prefill_group(
+        self, width: int, group: list[tuple[int, GenerationRequest]]
+    ) -> list[tuple]:
+        """One batched prefill for every (slot, request) pair of one prompt
+        bucket; always padded to PREFILL_BATCH rows (single compiled shape
+        per width bucket)."""
+        n_pad = self.PREFILL_BATCH
+        assert len(group) <= n_pad
+        tokens = np.zeros((n_pad, width), np.int32)
+        lengths = np.ones(n_pad, np.int32)
+        temps = np.zeros(n_pad, np.float32)
+        top_ks = np.zeros(n_pad, np.int32)
+        top_ps = np.ones(n_pad, np.float32)
+        started = time.monotonic()
+        for j, (_, request) in enumerate(group):
+            prompt = request.prompt_tokens
+            tokens[j, : len(prompt)] = prompt
+            lengths[j] = len(prompt)
+            temps[j] = request.options.temperature
+            top_ks[j] = request.options.top_k
+            top_ps[j] = request.options.top_p
+
+        local_cache = make_kv_cache(self.config, n_pad, width)
         if self.mesh is not None:
             from langstream_tpu.parallel.sharding import shard_serving_cache
 
             local_cache = shard_serving_cache(local_cache, self.mesh)
-        opts = request.options
-        started = time.monotonic()
         first, local_cache, self._key = _prefill_and_sample(
             self.params,
             jnp.asarray(tokens),
-            jnp.asarray([n], jnp.int32),
+            jnp.asarray(lengths),
             local_cache,
             self._key,
-            jnp.asarray([opts.temperature], jnp.float32),
-            jnp.asarray([opts.top_k], jnp.int32),
-            jnp.asarray([opts.top_p], jnp.float32),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
             self.config,
         )
-        self._cache = self._insert(self._cache, local_cache, idx)
-        # splice this slot into the device-resident decode chain
-        self._tokens_dev = self._tokens_dev.at[idx].set(first[0])
-        self._positions_dev = self._positions_dev.at[idx].set(n)
 
-        slot.request = request
-        slot.position = n  # first generated token goes to position n
-        slot.generated = []
-        slot.started_at = started
-        slot.first_token_at = 0.0  # stamped when the deferred fetch lands
-        self._temp[idx] = opts.temperature
-        self._top_k[idx] = opts.top_k
-        self._top_p[idx] = opts.top_p
-        self.total_requests += 1
-        return ("prefill", first, idx, request)
+        # one scatter for the whole group; padding rows point out of bounds
+        # and are dropped
+        slots = np.full(n_pad, self.max_batch, np.int32)
+        for j, (idx, _) in enumerate(group):
+            slots[j] = idx
+        slots_dev = jnp.asarray(slots)
+        self._cache = self._insert_group(self._cache, local_cache, slots_dev)
+        # splice the group into the device-resident decode chain (padding
+        # rows dropped by the same out-of-bounds rule)
+        self._tokens_dev = self._tokens_dev.at[slots_dev].set(first, mode="drop")
+        self._positions_dev = self._positions_dev.at[slots_dev].set(
+            jnp.asarray(lengths), mode="drop"
+        )
+
+        entries: list[tuple] = []
+        for j, (idx, request) in enumerate(group):
+            slot = self._slots[idx]
+            slot.request = request
+            slot.position = len(request.prompt_tokens)  # next write position
+            slot.generated = []
+            slot.started_at = started
+            slot.first_token_at = 0.0  # stamped when the deferred fetch lands
+            self._temp[idx] = request.options.temperature
+            self._top_k[idx] = request.options.top_k
+            self._top_p[idx] = request.options.top_p
+            self.total_requests += 1
+            entries.append(("prefill", first[j : j + 1], idx, request))
+        return entries
 
     def _chunk_steps(self) -> int:
         """Power-of-two chunk bounded by every active slot's cache headroom.
