@@ -16,6 +16,7 @@ def _register_all() -> None:
     from langstream_tpu.agents import web  # noqa: F401
     from langstream_tpu.agents import storage  # noqa: F401
     from langstream_tpu.agents import python_agents  # noqa: F401
+    from langstream_tpu.agents import connect  # noqa: F401
 
 
 _register_all()

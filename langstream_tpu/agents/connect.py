@@ -1,0 +1,109 @@
+"""Connector adapter agent types (gated).
+
+Parity: reference ``kafkaconnect/KafkaConnectSinkAgent.java`` /
+``KafkaConnectSourceAgent.java`` (types ``sink`` / ``source`` — run stock
+Kafka Connect connectors as agents) and ``CamelSource.java``
+(``camel-source`` — any Apache Camel endpoint as a source).
+
+Both depend on JVM connector runtimes the image does not ship; the planner
+accepts and validates these types (so apps referencing them parse, plan, and
+document — the reference's planner-metadata layer), but starting one raises
+with an explicit gating message, matching the kafka/pulsar broker-runtime
+pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from langstream_tpu.api.agent import AgentSink, AgentSource, ComponentType
+from langstream_tpu.api.doc import ConfigModel, ConfigProperty
+from langstream_tpu.api.record import Record
+from langstream_tpu.core.registry import REGISTRY, AgentTypeInfo
+
+_GATE_MESSAGE = (
+    "{kind} adapters embed a JVM connector runtime that this image does not "
+    "ship; run the connector natively against the broker, or use a built-in "
+    "agent type"
+)
+
+
+class KafkaConnectSinkAgent(AgentSink):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        raise NotImplementedError(_GATE_MESSAGE.format(kind="Kafka Connect sink"))
+
+    async def write(self, record: Record) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class KafkaConnectSourceAgent(AgentSource):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        raise NotImplementedError(_GATE_MESSAGE.format(kind="Kafka Connect source"))
+
+    async def read(self) -> list[Record]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class CamelSourceAgent(AgentSource):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        raise NotImplementedError(_GATE_MESSAGE.format(kind="Apache Camel source"))
+
+    async def read(self) -> list[Record]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _register() -> None:
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="sink",
+            component_type=ComponentType.SINK,
+            factory=KafkaConnectSinkAgent,
+            description="Stock Kafka Connect sink connector (gated: JVM runtime).",
+            config_model=ConfigModel(
+                type="sink",
+                allow_unknown=True,
+                properties={
+                    "connector.class": ConfigProperty(
+                        "connector.class", "Connect connector class", required=True
+                    )
+                },
+            ),
+        )
+    )
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="source",
+            component_type=ComponentType.SOURCE,
+            factory=KafkaConnectSourceAgent,
+            description="Stock Kafka Connect source connector (gated: JVM runtime).",
+            config_model=ConfigModel(
+                type="source",
+                allow_unknown=True,
+                properties={
+                    "connector.class": ConfigProperty(
+                        "connector.class", "Connect connector class", required=True
+                    )
+                },
+            ),
+        )
+    )
+    REGISTRY.register_agent(
+        AgentTypeInfo(
+            type="camel-source",
+            component_type=ComponentType.SOURCE,
+            factory=CamelSourceAgent,
+            description="Apache Camel endpoint as a source (gated: JVM runtime).",
+            config_model=ConfigModel(
+                type="camel-source",
+                allow_unknown=True,
+                properties={
+                    "component-uri": ConfigProperty(
+                        "component-uri", "Camel endpoint URI", required=True
+                    )
+                },
+            ),
+        )
+    )
+
+
+_register()

@@ -301,6 +301,15 @@ def archetypes_deploy(ctx, archetype_id, name, params) -> None:
         raise click.ClickException(str(e)) from e
 
 
+@cli.command()
+def docs() -> None:
+    """Dump the agent/resource/asset configuration catalog as JSON
+    (reference DocumentationGeneratorStarter)."""
+    from langstream_tpu.webservice.docs import generate_documentation_model
+
+    _echo_json(generate_documentation_model())
+
+
 # -- gateway -----------------------------------------------------------------
 
 

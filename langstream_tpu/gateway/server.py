@@ -88,6 +88,11 @@ class DictApplicationProvider:
         return self._service_uris.get((tenant, application_id, agent_id))
 
 
+class UnsupportedTopologyError(Exception):
+    """The application exists but its configuration cannot be served from
+    this process (maps to HTTP 400, not 404)."""
+
+
 class StoreApplicationProvider:
     """Resolves applications from a control-plane ApplicationStore (the
     standalone-gateway deployment: gateway pod + control plane share the
@@ -111,7 +116,7 @@ class StoreApplicationProvider:
                 # the in-memory broker is process-local: a standalone gateway
                 # cannot reach the agents' broker in another process — this
                 # topology needs a real broker (kafka/pulsar/pravega)
-                raise KeyError(
+                raise UnsupportedTopologyError(
                     f"application {tenant}/{application_id} uses the in-memory "
                     "broker, which a standalone gateway process cannot reach; "
                     "use `run local` (embedded gateway) or a broker-backed "
@@ -196,6 +201,8 @@ class GatewayServer:
         gateway_id = request.match_info["gateway"]
         try:
             gw_app = await self.provider.get_application(tenant, application_id)
+        except UnsupportedTopologyError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from e
         except KeyError as e:
             raise web.HTTPNotFound(reason=str(e)) from e
         gateway = self._find_gateway(gw_app.application, gateway_id, expected_type)

@@ -34,9 +34,21 @@ class RuntimeHttpServer:
             [
                 web.get("/metrics", self._metrics),
                 web.get("/info", self._info),
+                web.get("/traces", self._traces),
                 web.get("/healthz", self._healthz),
             ]
         )
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        from langstream_tpu.tracing import TRACER
+
+        try:
+            limit = int(request.query.get("limit", "200"))
+        except ValueError:
+            raise web.HTTPBadRequest(reason="limit must be an integer") from None
+        if limit <= 0:
+            return web.json_response([])
+        return web.json_response(TRACER.spans(limit))
 
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(

@@ -292,7 +292,15 @@ class AgentRunner:
                 continue
             self._records_in += len(records)
             self._m_in.count(len(records))
-            results = await self.processor.process(records)
+            from langstream_tpu.tracing import TRACER, record_trace_id
+
+            with TRACER.span(
+                f"agent.{self.node.id}.process",
+                trace_id=record_trace_id(records[0]),
+                agent_type=self.node.agent_type,
+                records=len(records),
+            ):
+                results = await self.processor.process(records)
             await self._handle_results(results)
 
     async def _handle_results(self, results: list[ProcessorResult]) -> None:
@@ -324,12 +332,38 @@ class AgentRunner:
         self.errors_handler.forget(record)
         await self._write_result(result)
 
+    @staticmethod
+    def _with_trace_header(out, trace_id: str):
+        """Propagate the trace id downstream: outputs re-wrap as
+        SimpleRecord with the header appended (key/value/headers/origin/
+        timestamp preserved — the Record protocol carries nothing else)."""
+        from langstream_tpu.api.record import Header, SimpleRecord
+        from langstream_tpu.tracing import TRACE_HEADER, record_trace_id
+
+        if record_trace_id(out) is not None:
+            return out
+        return SimpleRecord.copy_from(
+            out, headers=tuple(out.headers) + (Header(TRACE_HEADER, trace_id),)
+        )
+
     async def _write_result(self, result: ProcessorResult) -> None:
+        import uuid as _uuid
+
+        from langstream_tpu.tracing import record_trace_id
+
         record = result.source_record
         assert self.tracker is not None
         if not result.records or self.sink is None:
             await self.tracker.commit_empty(record)
             return
+        # records entering the pipeline without a trace id get one here, so
+        # the whole downstream path stitches into a single trace
+        trace_id = record_trace_id(record) or _uuid.uuid4().hex[:16]
+        result = ProcessorResult(
+            source_record=record,
+            records=[self._with_trace_header(o, trace_id) for o in result.records],
+            error=result.error,
+        )
         self.tracker.track(record, len(result.records))
         for out in result.records:
             written = False

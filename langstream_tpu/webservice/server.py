@@ -74,9 +74,15 @@ class ControlPlaneServer:
                     "/api/archetypes/{tenant}/{id}/applications/{name}",
                     self._archetype_deploy,
                 ),
+                web.get("/api/docs", self._docs),
                 web.get("/healthz", self._healthz),
             ]
         )
+
+    async def _docs(self, request: web.Request) -> web.Response:
+        from langstream_tpu.webservice.docs import generate_documentation_model
+
+        return web.json_response(generate_documentation_model())
 
     # -- middlewares ---------------------------------------------------------
 
