@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import uuid
 from pathlib import Path
 from typing import Any, Callable, Optional
 
@@ -26,7 +27,8 @@ from langstream_tpu.api.agent import (
 )
 from langstream_tpu.api.metrics import MetricsReporter
 from langstream_tpu.api.planner import AgentNode, Connection
-from langstream_tpu.api.record import Record
+from langstream_tpu.api.record import Header, Record, SimpleRecord
+from langstream_tpu.tracing import TRACE_HEADER, TRACER, record_trace_id
 from langstream_tpu.api.topics import TopicConnectionsRuntime
 from langstream_tpu.core.registry import REGISTRY
 from langstream_tpu.runtime.composite import CompositeAgentProcessor
@@ -65,6 +67,14 @@ class _LazyStartProducer:
     async def write(self, record: Record) -> None:
         if not self._started:
             await self.start()
+        # stream-to-topic writes happen inside the agent's process span
+        # (contextvars flow through the asyncio task), so side-channel
+        # records — e.g. completion chunks — join the record's trace too
+        trace_id = TRACER.current_trace_id()
+        if trace_id is not None and record_trace_id(record) is None:
+            record = SimpleRecord.copy_from(record).with_headers(
+                [(TRACE_HEADER, trace_id)]
+            )
         await self._producer.write(record)
 
     async def close(self) -> None:
@@ -292,22 +302,28 @@ class AgentRunner:
                 continue
             self._records_in += len(records)
             self._m_in.count(len(records))
-            from langstream_tpu.tracing import TRACER, record_trace_id
-
+            # a batch-level span joins the FIRST record's trace (per-record
+            # spans would serialize the batch); records without a trace id
+            # get this one stamped on their outputs so the path stitches
+            trace_id = record_trace_id(records[0]) or uuid.uuid4().hex[:16]
             with TRACER.span(
                 f"agent.{self.node.id}.process",
-                trace_id=record_trace_id(records[0]),
+                trace_id=trace_id,
                 agent_type=self.node.agent_type,
                 records=len(records),
             ):
                 results = await self.processor.process(records)
-            await self._handle_results(results)
+            await self._handle_results(results, trace_id)
 
-    async def _handle_results(self, results: list[ProcessorResult]) -> None:
+    async def _handle_results(
+        self, results: list[ProcessorResult], trace_id: Optional[str] = None
+    ) -> None:
         for result in results:
-            await self._handle_result(result)
+            await self._handle_result(result, trace_id)
 
-    async def _handle_result(self, result: ProcessorResult) -> None:
+    async def _handle_result(
+        self, result: ProcessorResult, trace_id: Optional[str] = None
+    ) -> None:
         """Per-record outcome routing (reference :703-718, :750-768, :856-943)."""
         record = result.source_record
         while result.error is not None:
@@ -330,35 +346,26 @@ class AgentRunner:
             self._last_error = result.error
             raise PermanentFailureError(record, result.error)
         self.errors_handler.forget(record)
-        await self._write_result(result)
+        await self._write_result(result, trace_id)
 
     @staticmethod
     def _with_trace_header(out, trace_id: str):
-        """Propagate the trace id downstream: outputs re-wrap as
-        SimpleRecord with the header appended (key/value/headers/origin/
-        timestamp preserved — the Record protocol carries nothing else)."""
-        from langstream_tpu.api.record import Header, SimpleRecord
-        from langstream_tpu.tracing import TRACE_HEADER, record_trace_id
-
+        """Propagate the trace id downstream (no-op when already traced)."""
         if record_trace_id(out) is not None:
             return out
-        return SimpleRecord.copy_from(
-            out, headers=tuple(out.headers) + (Header(TRACE_HEADER, trace_id),)
-        )
+        return SimpleRecord.copy_from(out).with_headers([(TRACE_HEADER, trace_id)])
 
-    async def _write_result(self, result: ProcessorResult) -> None:
-        import uuid as _uuid
-
-        from langstream_tpu.tracing import record_trace_id
-
+    async def _write_result(
+        self, result: ProcessorResult, trace_id: Optional[str] = None
+    ) -> None:
         record = result.source_record
         assert self.tracker is not None
         if not result.records or self.sink is None:
             await self.tracker.commit_empty(record)
             return
-        # records entering the pipeline without a trace id get one here, so
-        # the whole downstream path stitches into a single trace
-        trace_id = record_trace_id(record) or _uuid.uuid4().hex[:16]
+        # the id minted before the process span (or carried by the source
+        # record) stamps every output, so the downstream path stitches
+        trace_id = record_trace_id(record) or trace_id or uuid.uuid4().hex[:16]
         result = ProcessorResult(
             source_record=record,
             records=[self._with_trace_header(o, trace_id) for o in result.records],

@@ -1,0 +1,109 @@
+"""End-to-end over REAL processes (SURVEY §4 tier 4 analogue): the run-local
+platform in a subprocess, driven by the actual CLI binary, including the
+shipped archetype through the control plane."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).parent.parent
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def platform_proc(tmp_path):
+    cp_port, gw_port = free_port(), free_port()
+    env = dict(
+        os.environ,
+        PYTHONPATH=str(REPO),
+        JAX_PLATFORMS="cpu",
+        LANGSTREAM_TPU_CONFIG=str(tmp_path / "cfg.json"),
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "langstream_tpu.cli", "run", "local",
+            str(REPO / "examples" / "applications" / "tpu-completions"),
+            "-i", str(REPO / "examples" / "instances" / "local-memory.yaml"),
+            "--name", "e2e-app",
+            "--control-plane-port", str(cp_port),
+            "--gateway-port", str(gw_port),
+            "--metrics-port", "-1",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    base = f"http://127.0.0.1:{cp_port}"
+    for _ in range(120):
+        if proc.poll() is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            pytest.fail(f"platform died: {out[-2000:]}")
+        try:
+            urllib.request.urlopen(f"{base}/healthz", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.5)
+    yield {"cp": cp_port, "gw": gw_port, "env": env}
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def cli(env, cp_port, *args, timeout=60):
+    full_env = dict(env)
+    result = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.cli", *args],
+        env=full_env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    return result
+
+
+def test_cli_end_to_end(platform_proc, tmp_path):
+    env, cp, gw = platform_proc["env"], platform_proc["cp"], platform_proc["gw"]
+    # point the CLI profile at the live platform
+    for key, value in (
+        ("webServiceUrl", f"http://127.0.0.1:{cp}"),
+        ("apiGatewayUrl", f"http://127.0.0.1:{gw}"),
+    ):
+        r = cli(env, cp, "configure", key, value)
+        assert r.returncode == 0, r.stderr
+
+    r = cli(env, cp, "apps", "list")
+    assert r.returncode == 0 and "e2e-app" in r.stdout
+
+    r = cli(env, cp, "apps", "get", "e2e-app")
+    desc = json.loads(r.stdout)
+    assert desc["status"]["status"] == "DEPLOYED"
+
+    # the docs catalog over REST
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{cp}/api/docs", timeout=5
+    ).read()
+    assert "ai-chat-completions" in json.loads(body)["agents"]
+
+    # chat through the real websocket gateway via the CLI REPL
+    r = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.cli", "gateway", "chat",
+         "e2e-app", "-g", "chat", "-p", "sessionId=e2e"],
+        env=env, input="hello\n", capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert "<" in r.stdout  # received an answer chunk

@@ -74,3 +74,17 @@ def test_python_agent_example_end_to_end(run):
             await runner.stop()
 
     run(scenario())
+
+
+def test_shipped_archetype_parses():
+    arch = EXAMPLES / "archetypes" / "chat-bot"
+    pkg = ModelBuilder.build_application_from_path(
+        arch / "application", instance_path=arch / "instance.yaml"
+    )
+    resolved = resolve_placeholders(pkg.application)
+    plan = ClusterRuntime().build_execution_plan("arch", resolved)
+    assert plan.agent_sequence()
+    import yaml
+
+    meta = yaml.safe_load((arch / "archetype.yaml").read_text())
+    assert meta["archetype"]["title"]

@@ -74,8 +74,10 @@ pipeline:
                 async with session.get(f"{http.url}/traces") as resp:
                     spans = await resp.json()
             matching = [s for s in spans if s["traceId"] == trace_id]
-            # agent b processed under the propagated trace id
-            assert any("agent" in s["name"] for s in matching)
+            # BOTH agents' process spans stitch under the one trace id —
+            # including the entry agent that minted it
+            agent_spans = {s["name"] for s in matching if s["name"].startswith("agent.")}
+            assert len(agent_spans) >= 2, matching
         finally:
             await http.stop()
             await runner.stop()
