@@ -12,8 +12,11 @@ Resource configuration:
   model: preset name (models.configs.MODEL_PRESETS) — gemma-2b, llama-3-8b, …
   tokenizer: "byte" (default) | "hf:<local path>"
   weights: "random" (default) | path to HF safetensors dir (models.loader)
-  max-batch / max-seq-len / prefill-buckets: engine knobs
+  max-batch / max-seq-len / prefill-buckets / decode-chunk: engine knobs
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
+  quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
+    models stage on the host so the bf16 tree never needs device HBM)
+  hbm-bytes: device HBM budget for that staging decision (default 16GiB)
 
 Streaming follows the reference's growth batching (OpenAICompletionService:
 "start from 1 chunk, then double the size until min-chunks-per-message"), so
@@ -84,16 +87,43 @@ class _EngineHolder:
         import jax
 
         if self._params is None:
+            import contextlib
+
             from langstream_tpu.models.transformer import init_params
 
             weights = self.config.get("weights", "random")
             mc = self.model_config()
-            if weights in (None, "random"):
-                params = init_params(mc, jax.random.PRNGKey(0))
-            else:
-                from langstream_tpu.models.loader import load_params
+            quant_mode = str(self.config.get("quantization", "") or "").lower()
+            if quant_mode not in ("", "none", "int8", "w8"):
+                raise ValueError(
+                    f"unknown quantization {quant_mode!r}; supported: int8"
+                )
+            quantize = quant_mode in ("int8", "w8")
+            # models whose full-precision tree would not fit device HBM are
+            # built + quantized on the HOST and shipped int8 (host init is
+            # slower, so small models stay on-device)
+            hbm_budget = int(self.config.get("hbm-bytes", 16 * 1024**3))
+            needs_host = quantize and mc.approx_params * 2 > hbm_budget // 2
+            scope = (
+                jax.default_device(jax.devices("cpu")[0])
+                if needs_host
+                else contextlib.nullcontext()
+            )
+            with scope:
+                if weights in (None, "random"):
+                    params = init_params(mc, jax.random.PRNGKey(0))
+                else:
+                    from langstream_tpu.models.loader import load_params
 
-                params = load_params(weights, mc)
+                    params = load_params(weights, mc)
+                if quantize:
+                    from langstream_tpu.models.quant import quantize_params
+
+                    params = quantize_params(params, mc)
+            if needs_host and self.mesh() is None:
+                # no mesh: move the int8 tree onto the accelerator ourselves
+                # (with a mesh, shard_params below owns placement)
+                params = jax.device_put(params, jax.devices()[0])
             mesh = self.mesh()
             if mesh is not None:
                 from langstream_tpu.parallel.sharding import shard_params

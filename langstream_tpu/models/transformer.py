@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from langstream_tpu.models.configs import ModelConfig
+from langstream_tpu.models.quant import dequantize_weight, is_quantized, quantized_matmul
 
 Params = dict
 KVCache = dict
@@ -188,8 +189,8 @@ def _activation(x: jax.Array, kind: str) -> jax.Array:
 
 
 def dense_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
-    gate = _activation(x @ lp["w_gate"], config.activation)
-    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    gate = _activation(quantized_matmul(x, lp["w_gate"]), config.activation)
+    return quantized_matmul(gate * quantized_matmul(x, lp["w_up"]), lp["w_down"])
 
 
 def moe_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
@@ -240,10 +241,16 @@ def moe_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
         * weights[..., None, None]
     ).sum(axis=1)
 
+    def expert_w(name: str) -> jax.Array:
+        w = lp[name]
+        return dequantize_weight(w, xf.dtype) if is_quantized(w) else w
+
     expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)  # [E, C, D]
-    gate = _activation(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]), config.activation)
-    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"])
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_down"])  # [E, C, D]
+    gate = _activation(
+        jnp.einsum("ecd,edf->ecf", expert_in, expert_w("w_gate")), config.activation
+    )
+    up = jnp.einsum("ecd,edf->ecf", expert_in, expert_w("w_up"))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, expert_w("w_down"))  # [E, C, D]
     out = jnp.einsum("tec,ecd->td", combine.astype(xf.dtype), expert_out)
     return out.reshape(b, s, d)
 
@@ -270,9 +277,9 @@ def _layer(
     hd = config.resolved_head_dim
 
     attn_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-    q = (attn_in @ lp["wq"]).reshape(b, s, config.n_heads, hd)
-    k = (attn_in @ lp["wk"]).reshape(b, s, config.n_kv_heads, hd)
-    v = (attn_in @ lp["wv"]).reshape(b, s, config.n_kv_heads, hd)
+    q = quantized_matmul(attn_in, lp["wq"]).reshape(b, s, config.n_heads, hd)
+    k = quantized_matmul(attn_in, lp["wk"]).reshape(b, s, config.n_kv_heads, hd)
+    v = quantized_matmul(attn_in, lp["wv"]).reshape(b, s, config.n_kv_heads, hd)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
 
@@ -293,11 +300,12 @@ def _layer(
         # causal mask is derived from global block positions inside
         from langstream_tpu.parallel.ring_attention import ring_attention
 
-        attn_out = ring_attention(q, k_all, v_all, config) @ lp["wo"]
+        attn_out = quantized_matmul(ring_attention(q, k_all, v_all, config), lp["wo"])
     else:
-        attn_out = _dispatch_attention(
-            q, k_all, v_all, mask, config, cache_positions, causal
-        ) @ lp["wo"]
+        attn_out = quantized_matmul(
+            _dispatch_attention(q, k_all, v_all, mask, config, cache_positions, causal),
+            lp["wo"],
+        )
     x = x + attn_out
 
     ffn_in = rms_norm(x, lp["ffn_norm"], config.rms_norm_eps)
@@ -309,7 +317,13 @@ def _layer(
 
 
 def _embed(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array:
-    x = params["embed"][tokens]
+    table = params["embed"]
+    if is_quantized(table):
+        x = (
+            table["q"][tokens].astype(jnp.float32) * table["s"][tokens]
+        ).astype(_dtype(config))
+    else:
+        x = table[tokens]
     if config.embedding_scale:
         x = x * jnp.sqrt(jnp.float32(config.d_model)).astype(x.dtype)
     return x
@@ -317,8 +331,14 @@ def _embed(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array:
 
 def _unembed(params: Params, x: jax.Array, config: ModelConfig) -> jax.Array:
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    if config.tie_embeddings:
+        table = params["embed"]
+        head = (
+            dequantize_weight(table, x.dtype) if is_quantized(table) else table
+        ).T
+        logits = (x @ head).astype(jnp.float32)
+    else:
+        logits = quantized_matmul(x, params["lm_head"]).astype(jnp.float32)
     return _softcap(logits, config.final_logit_softcap)
 
 

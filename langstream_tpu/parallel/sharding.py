@@ -89,8 +89,15 @@ def _named(mesh: Mesh, tree: Any) -> Any:
 
 
 def shard_params(params: Params, mesh: Mesh, config: ModelConfig) -> Params:
-    """Place a param tree onto the mesh with TP/EP shardings."""
-    return jax.device_put(params, _named(mesh, param_specs(config)))
+    """Place a param tree onto the mesh with TP/EP shardings (int8-quantized
+    trees get mirrored specs: q keeps the weight's spec, scales drop the
+    contracted axis)."""
+    from langstream_tpu.models.quant import is_quantized, quantize_specs_for_params
+
+    specs = param_specs(config)
+    if is_quantized(params.get("layers", {}).get("wq")):
+        specs = quantize_specs_for_params(specs, params)
+    return jax.device_put(params, _named(mesh, specs))
 
 
 def shard_kv_cache(cache: dict, mesh: Mesh) -> dict:

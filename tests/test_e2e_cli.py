@@ -56,6 +56,10 @@ def platform_proc(tmp_path):
             break
         except Exception:
             time.sleep(0.5)
+    else:
+        proc.terminate()
+        out = proc.stdout.read() if proc.stdout else ""
+        pytest.fail(f"platform never became healthy: {out[-2000:]}")
     yield {"cp": cp_port, "gw": gw_port, "env": env}
     proc.terminate()
     try:
@@ -64,16 +68,14 @@ def platform_proc(tmp_path):
         proc.kill()
 
 
-def cli(env, cp_port, *args, timeout=60):
-    full_env = dict(env)
-    result = subprocess.run(
+def cli(env, *args, timeout=60):
+    return subprocess.run(
         [sys.executable, "-m", "langstream_tpu.cli", *args],
-        env=full_env,
+        env=dict(env),
         capture_output=True,
         text=True,
         timeout=timeout,
     )
-    return result
 
 
 def test_cli_end_to_end(platform_proc, tmp_path):
@@ -83,13 +85,13 @@ def test_cli_end_to_end(platform_proc, tmp_path):
         ("webServiceUrl", f"http://127.0.0.1:{cp}"),
         ("apiGatewayUrl", f"http://127.0.0.1:{gw}"),
     ):
-        r = cli(env, cp, "configure", key, value)
+        r = cli(env, "configure", key, value)
         assert r.returncode == 0, r.stderr
 
-    r = cli(env, cp, "apps", "list")
+    r = cli(env, "apps", "list")
     assert r.returncode == 0 and "e2e-app" in r.stdout
 
-    r = cli(env, cp, "apps", "get", "e2e-app")
+    r = cli(env, "apps", "get", "e2e-app")
     desc = json.loads(r.stdout)
     assert desc["status"]["status"] == "DEPLOYED"
 

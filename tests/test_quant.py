@@ -1,0 +1,93 @@
+"""int8 weight-only quantization: error bounds, forward agreement, TP
+sharding of quantized trees, engine integration."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.configs import MODEL_PRESETS
+from langstream_tpu.models.quant import (
+    dequantize_weight,
+    quantize_params,
+    quantize_weight,
+)
+from langstream_tpu.models.transformer import forward, init_params
+
+DENSE = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+MOE = dataclasses.replace(MODEL_PRESETS["tiny-moe-test"], dtype="float32")
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 128), jnp.float32)
+    qw = quantize_weight(w)
+    assert qw["q"].dtype == jnp.int8
+    deq = dequantize_weight(qw, jnp.float32)
+    # symmetric int8: |err| <= scale/2 per output channel
+    err = np.abs(np.asarray(w) - np.asarray(deq))
+    bound = np.asarray(qw["s"])[0] / 2 + 1e-7
+    assert (err <= bound[None, :]).all()
+
+
+def test_forward_top1_agreement():
+    for config in (DENSE, MOE):
+        params = init_params(config, jax.random.PRNGKey(0))
+        qparams = quantize_params(params, config)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, config.vocab_size)
+        ref = np.asarray(forward(params, tokens, config))
+        out = np.asarray(forward(qparams, tokens, config))
+        top_ref = ref.argmax(-1)
+        top_q = out.argmax(-1)
+        agreement = (top_ref == top_q).mean()
+        assert agreement >= 0.9, f"{config.name}: top-1 agreement {agreement}"
+
+
+def test_quantized_tp_sharding_matches():
+    from langstream_tpu.parallel.mesh import build_mesh
+    from langstream_tpu.parallel.sharding import shard_params
+
+    params = quantize_params(init_params(DENSE, jax.random.PRNGKey(0)), DENSE)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, DENSE.vocab_size)
+    ref = np.asarray(forward(params, tokens, DENSE))
+    mesh = build_mesh({"model": 8})
+    sharded = shard_params(params, mesh, DENSE)
+    out = np.asarray(forward(sharded, tokens, DENSE))
+    np.testing.assert_allclose(ref, out, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_with_quantized_weights():
+    from langstream_tpu.models.configs import GenerationOptions
+    from langstream_tpu.serving.engine import ServingEngine
+
+    params = quantize_params(init_params(DENSE, jax.random.PRNGKey(0)), DENSE)
+    engine = ServingEngine(DENSE, params, max_batch=2, max_seq_len=128)
+    engine.start()
+    try:
+        result = engine.generate(
+            list(range(5, 25)), GenerationOptions(max_new_tokens=8, temperature=0.0),
+            timeout=120,
+        )
+        assert len(result.tokens) == 8
+    finally:
+        engine.stop()
+
+
+def test_tpu_serving_quantization_config(run):
+    async def scenario():
+        from langstream_tpu.ai.tpu_serving import TpuServingProvider
+
+        provider = TpuServingProvider(
+            {"model": "tiny-test", "tokenizer": "byte", "max-seq-len": 64,
+             "quantization": "int8"}
+        )
+        service = provider.get_completions_service({})
+        from langstream_tpu.ai.provider import ChatMessage
+
+        result = await service.get_chat_completions(
+            [ChatMessage(role="user", content="hi")], {"max-new-tokens": 4}
+        )
+        assert isinstance(result.content, str)
+        await provider.close()
+
+    run(scenario())
