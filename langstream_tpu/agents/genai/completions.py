@@ -50,6 +50,29 @@ class _BaseCompletionsStep(Step):
         if self.stream_to_topic:
             self._producer = context.get_topic_producer(self.stream_to_topic)
             await self._producer.start()
+        # serving gauges (SURVEY §5: "same shape, plus tokens/sec, TTFT,
+        # batch occupancy" — counters match the reference's
+        # openai_*_num_calls_total naming scheme)
+        # per-agent scope (multiple completions agents share one registry)
+        metrics = context.get_metrics_reporter().with_prefix(
+            f"agent_{context.get_global_agent_id()}_completions"
+        )
+        self._m_calls = metrics.counter("num_calls_total", "completion calls")
+        self._m_tokens = metrics.counter("completion_tokens_total", "generated tokens")
+        self._m_prompt = metrics.counter("prompt_tokens_total", "prompt tokens")
+        self._m_ttft = metrics.gauge("last_ttft_ms", "last time-to-first-token")
+        self._m_rate = metrics.gauge("last_tokens_per_sec", "last request decode rate")
+
+    def _record_metrics(self, result: Any) -> None:
+        self._m_calls.count()
+        self._m_tokens.count(result.completion_tokens)
+        self._m_prompt.count(result.prompt_tokens)
+        ttft_ms = result.ttft_ms or 0.0
+        if ttft_ms:
+            self._m_ttft.set(round(ttft_ms, 3))
+        decode_s = max((result.total_ms or 0.0) - ttft_ms, 0.0) / 1000.0
+        if decode_s > 0 and result.completion_tokens:
+            self._m_rate.set(round(result.completion_tokens / decode_s, 2))
 
     async def close(self) -> None:
         if self._producer is not None:
@@ -113,6 +136,7 @@ class _BaseCompletionsStep(Step):
                 record, asyncio.get_running_loop(), chunk_futures
             )
         result = await self._complete(record, options, chunks_consumer)
+        self._record_metrics(result)
         if chunk_futures:
             # all chunks reach the stream topic before the final record commits
             await asyncio.gather(*(asyncio.wrap_future(f) for f in chunk_futures))

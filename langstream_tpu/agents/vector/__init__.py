@@ -218,7 +218,11 @@ class LocalVectorDataSource(DataSource):
         idx["meta"].append(meta)
 
     def search(
-        self, index: str, vector: list[float], top_k: int = 5
+        self,
+        index: str,
+        vector: list[float],
+        top_k: int = 5,
+        include_vectors: bool = False,
     ) -> list[dict[str, Any]]:
         idx = self._index(index)
         n = len(idx["ids"])
@@ -237,7 +241,14 @@ class LocalVectorDataSource(DataSource):
             if not math.isfinite(float(s)):
                 continue
             r = int(r)
-            out.append({"id": idx["ids"][r], "similarity": float(s), **idx["meta"][r]})
+            row = {"id": idx["ids"][r], "similarity": float(s), **idx["meta"][r]}
+            if include_vectors:
+                # opt-in (query "include-vectors": true): re-rankers need the
+                # stored vector, but by default it would bloat every record
+                # (and prompt) with dim floats per hit; placed AFTER the meta
+                # spread so a stale meta "vector" cannot shadow it
+                row["vector"] = idx["matrix"][r].tolist()
+            out.append(row)
         return out[:top_k]
 
     # -- DataSource contract (JSON dialect) ---------------------------------
@@ -250,7 +261,12 @@ class LocalVectorDataSource(DataSource):
         vector = q.get("vector")
         if vector is None:
             raise ValueError("local-vector query requires a 'vector' field")
-        return self.search(index, vector, int(q.get("topK", q.get("top-k", 5))))
+        return self.search(
+            index,
+            vector,
+            int(q.get("topK", q.get("top-k", 5))),
+            include_vectors=bool(q.get("include-vectors", False)),
+        )
 
     async def close(self) -> None:
         if self._path:
@@ -436,6 +452,10 @@ class ReRankAgent(SingleRecordProcessor):
         self.algorithm = configuration.get("algorithm", "MMR")
         self.lambda_ = float(configuration.get("lambda", 0.5))
         self.max = int(configuration.get("max", 5))
+        # "documents" (default) writes the ranked doc dicts; "text" writes
+        # only each doc's text — what prompt templates actually interpolate
+        # (full dicts drag retrieval vectors into the prompt)
+        self.output_mode = configuration.get("output-mode", "documents")
 
     async def process_record(self, record: Record) -> list[Record]:
         ctx = MutableRecord.from_record(record)
@@ -443,7 +463,7 @@ class ReRankAgent(SingleRecordProcessor):
         query_vec = el.evaluate(self.query_embeddings, ctx)
         self.processed(1)
         if not docs or query_vec is None:
-            ctx.set_field(self.output_field, docs)
+            ctx.set_field(self.output_field, self._project(docs, ctx))
             return [ctx.to_record()]
         q = np.asarray(query_vec, dtype=np.float32)
         vecs = []
@@ -459,8 +479,16 @@ class ReRankAgent(SingleRecordProcessor):
                 key=lambda i: -(_cosine(vecs[i], q) if vecs[i] is not None else -1.0),
             )
             ranked = [docs[i] for i in scored[: self.max]]
-        ctx.set_field(self.output_field, ranked)
+        ctx.set_field(self.output_field, self._project(ranked, ctx))
         return [ctx.to_record()]
+
+    def _project(self, docs: list, ctx: MutableRecord) -> list:
+        if self.output_mode != "text":
+            return docs
+        return [
+            str(el.evaluate(self.text_field, ctx, extra={"record": d}) or "")
+            for d in docs
+        ]
 
     def _mmr(self, docs: list, vecs: list, q: np.ndarray) -> list:
         selected: list[int] = []
@@ -643,6 +671,7 @@ def _register() -> None:
                     ConfigProperty("embeddings-field", "EL for a doc's vector (record bound)"),
                     ConfigProperty("text-field", "EL for a doc's text (record bound)"),
                     ConfigProperty("algorithm", "MMR|cosine", default="MMR"),
+                    ConfigProperty("output-mode", "documents|text", default="documents"),
                     ConfigProperty("lambda", "MMR relevance/diversity trade-off", type="number", default=0.5),
                     ConfigProperty("max", "documents to keep", type="integer", default=5),
                 ),

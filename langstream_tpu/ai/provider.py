@@ -49,6 +49,7 @@ class ChatCompletionsResult:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     ttft_ms: float = 0.0
+    total_ms: float = 0.0
     extra: dict[str, Any] = field(default_factory=dict)
 
 

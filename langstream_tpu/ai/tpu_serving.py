@@ -298,6 +298,7 @@ class TpuCompletionsService(CompletionsService):
             prompt_tokens=result.prompt_tokens,
             completion_tokens=len(result.tokens),
             ttft_ms=result.ttft_s * 1000.0,
+            total_ms=result.total_s * 1000.0,
         )
 
 

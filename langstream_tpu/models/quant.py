@@ -30,10 +30,11 @@ def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and set(leaf) == {"q", "s"}
 
 
-def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
-    """Symmetric per-output-channel int8 over the last axis."""
+def quantize_weight(w: jax.Array, axis: int = -2) -> dict[str, jax.Array]:
+    """Symmetric int8 with the amax reduced over ``axis`` — the default -2
+    gives per-output-channel scales for [in, out] matmul weights."""
     wf = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # [..., 1, out]
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "s": scale}
@@ -54,11 +55,7 @@ def quantized_matmul(x: jax.Array, w: Any) -> jax.Array:
 def quantize_row_wise(w: jax.Array) -> dict[str, jax.Array]:
     """Symmetric per-ROW int8 (embedding tables: rows are vocab entries, and
     the tied unembed's output channels are exactly those rows)."""
-    wf = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(wf), axis=-1, keepdims=True)  # [V, 1]
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
-    return {"q": q, "s": scale}
+    return quantize_weight(w, axis=-1)
 
 
 def quantize_params(params: Params, config: ModelConfig) -> Params:
