@@ -1,0 +1,4 @@
+#!/bin/sh
+# Real-chip serving bench (one JSON line; ~3-6 min incl. compiles).
+cd "$(dirname "$0")/.."
+exec python bench.py

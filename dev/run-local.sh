@@ -1,0 +1,7 @@
+#!/bin/sh
+# Whole platform in one process against the north-star example
+# (reference dev/* local-run loops).
+set -e
+cd "$(dirname "$0")/.."
+exec python -m langstream_tpu.cli run local examples/applications/tpu-completions \
+    -i examples/instances/local-memory.yaml "$@"

@@ -62,6 +62,8 @@ class _BaseCompletionsStep(Step):
         self._m_prompt = metrics.counter("prompt_tokens_total", "prompt tokens")
         self._m_ttft = metrics.gauge("last_ttft_ms", "last time-to-first-token")
         self._m_rate = metrics.gauge("last_tokens_per_sec", "last request decode rate")
+        self._m_active = metrics.gauge("engine_active_slots", "busy KV-cache slots")
+        self._m_queued = metrics.gauge("engine_queued_requests", "requests waiting for a slot")
 
     def _record_metrics(self, result: Any) -> None:
         self._m_calls.count()
@@ -73,6 +75,11 @@ class _BaseCompletionsStep(Step):
         decode_s = max((result.total_ms or 0.0) - ttft_ms, 0.0) / 1000.0
         if decode_s > 0 and result.completion_tokens:
             self._m_rate.set(round(result.completion_tokens / decode_s, 2))
+        # batch occupancy (SURVEY §5): engine-backed services report slots
+        stats = getattr(self._service, "engine_stats", lambda: None)() or {}
+        # always set: stale occupancy must decay to 0, not freeze
+        self._m_active.set(stats.get("active-slots", 0))
+        self._m_queued.set(stats.get("queued", 0))
 
     async def close(self) -> None:
         if self._producer is not None:

@@ -227,6 +227,12 @@ class TpuCompletionsService(CompletionsService):
         self.holder = holder
         self.step_config = step_config
 
+    def engine_stats(self) -> dict[str, Any]:
+        """Batch occupancy etc. for the serving gauges (only meaningful once
+        the engine exists — never force a build just to report zeros)."""
+        engine = self.holder._engine
+        return engine.stats() if engine is not None else {}
+
     def _render_prompt(self, messages: list[ChatMessage]) -> str:
         tok = self.holder.tokenizer()
         hf = getattr(tok, "_tok", None)

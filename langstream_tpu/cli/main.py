@@ -474,6 +474,7 @@ def run_local(app_dir, instance, secrets, name, gateway_port, control_plane_port
         if metrics_port >= 0:
             metrics_server = await runner.serve_metrics(port=metrics_port)
         click.echo(f"control plane: {control_plane.url}")
+        click.echo(f"web ui:        {control_plane.url}/ui?gateway={gateway_server.url}")
         click.echo(f"gateway:       {gateway_server.url}")
         if metrics_server is not None:
             click.echo(f"metrics:       {metrics_server.url}/metrics")

@@ -75,9 +75,15 @@ class ControlPlaneServer:
                     self._archetype_deploy,
                 ),
                 web.get("/api/docs", self._docs),
+                web.get("/ui", self._ui),
                 web.get("/healthz", self._healthz),
             ]
         )
+
+    async def _ui(self, request: web.Request) -> web.Response:
+        from langstream_tpu.webservice.ui import UI_HTML
+
+        return web.Response(text=UI_HTML, content_type="text/html")
 
     async def _docs(self, request: web.Request) -> web.Response:
         from langstream_tpu.webservice.docs import generate_documentation_model
@@ -88,7 +94,7 @@ class ControlPlaneServer:
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        if self.auth_token is not None and request.path != "/healthz":
+        if self.auth_token is not None and request.path not in ("/healthz", "/ui"):
             header = request.headers.get("Authorization", "")
             if header != f"Bearer {self.auth_token}":
                 return web.json_response({"error": "unauthorized"}, status=401)

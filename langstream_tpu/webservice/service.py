@@ -332,7 +332,8 @@ class ApplicationService:
                 t.name for m in app.modules.values() for t in m.topics.values()
             ],
             "gateways": [
-                {"id": g.id, "type": g.type} for g in app.gateways
+                {"id": g.id, "type": g.type, "parameters": list(g.parameters)}
+                for g in app.gateways
             ],
             "code-archive-id": stored.code_archive_id,
             "status": status,

@@ -308,3 +308,19 @@ def test_archetypes(run, tmp_path):
             await server.stop()
 
     run(scenario())
+
+
+def test_ui_served(run):
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{server.url}/ui") as resp:
+                    assert resp.status == 200
+                    body = await resp.text()
+                    assert "langstream-tpu" in body and "/v1/chat/" in body
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
