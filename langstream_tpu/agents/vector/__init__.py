@@ -191,11 +191,22 @@ class LocalVectorDataSource(DataSource):
     def create_index(self, name: str, dim: int) -> None:
         self._index(name, dim)
 
+    def delete_index(self, name: str) -> None:
+        self._indexes.pop(name, None)
+        if self._path:
+            from pathlib import Path
+
+            for suffix in (".npz", ".json"):
+                f = Path(self._path) / f"{name}{suffix}"
+                if f.exists():
+                    f.unlink()
+
+    def flush(self) -> None:
+        if self._path:
+            self._save()
+
     def has_index(self, name: str) -> bool:
         return name in self._indexes
-
-    def drop_index(self, name: str) -> None:
-        self._indexes.pop(name, None)
 
     def upsert(self, index: str, id_: str, vector: list[float], meta: dict[str, Any]) -> None:
         idx = self._index(index, dim=len(vector))
@@ -549,6 +560,58 @@ class FlareControllerAgent(SingleRecordProcessor):
 # ---------------------------------------------------------------------------
 
 
+class VectorIndexAssetManager(AssetManager):
+    """`vector-index` asset: declaratively create/drop a local-vector index
+    (the embedded analogue of the reference's per-DB index/table assets).
+
+    Opens its own store instance, so in-memory stores won't share state with
+    the pipeline — use a persistent `path` in BOTH the asset's datasource
+    config and the `vector-database` resource (same caveat as jdbc-table's
+    shared-cache URI)."""
+
+    def __init__(self) -> None:
+        self._asset = None
+        self._name = ""
+        self._path = None
+        self._ds_config: dict[str, Any] = {}
+        self._store: Optional[LocalVectorDataSource] = None
+
+    async def initialize(self, asset) -> None:
+        self._asset = asset
+        name = asset.config.get("index-name")
+        if not name:
+            raise ValueError("vector-index asset requires config.index-name")
+        self._name = str(name)
+        ds_config = asset.config.get("datasource", {})
+        if isinstance(ds_config, dict):
+            ds_config = ds_config.get("configuration", ds_config)
+        self._ds_config = dict(ds_config)
+        self._path = self._ds_config.get("path")
+
+    def _get_store(self) -> LocalVectorDataSource:
+        # constructed lazily: loading a persistent store deserializes every
+        # index, which an existence check must not pay
+        if self._store is None:
+            self._store = LocalVectorDataSource(self._ds_config)
+        return self._store
+
+    async def asset_exists(self) -> bool:
+        if self._path:
+            from pathlib import Path
+
+            return (Path(self._path) / f"{self._name}.json").exists()
+        return self._get_store().has_index(self._name)
+
+    async def deploy_asset(self) -> None:
+        assert self._asset is not None
+        store = self._get_store()
+        store.create_index(self._name, int(self._asset.config.get("dimension", 0)))
+        store.flush()
+
+    async def delete_asset(self) -> None:
+        self._get_store().delete_index(self._name)
+
+
 class JdbcTableAssetManager(AssetManager):
     """`jdbc-table` asset: create/drop a table via DDL statements in the
     asset config (reference JdbcAssetsManagerProvider)."""
@@ -694,6 +757,22 @@ def _register() -> None:
                     ConfigProperty("retrieve-query-field", "where the retrieval query lands"),
                     ConfigProperty("loop-topic", "topic for another RAG round"),
                 ),
+            ),
+        )
+    )
+    REGISTRY.register_asset(
+        AssetTypeInfo(
+            type="vector-index",
+            factory=VectorIndexAssetManager,
+            description="Create/drop a local-vector index declaratively.",
+            config_model=ConfigModel(
+                type="vector-index",
+                properties=props(
+                    ConfigProperty("index-name", "index to manage", required=True),
+                    ConfigProperty("dimension", "vector dimension", type="integer", required=True),
+                    ConfigProperty("datasource", "datasource config", type="object"),
+                ),
+                allow_unknown=True,
             ),
         )
     )

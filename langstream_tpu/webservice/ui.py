@@ -50,7 +50,9 @@ UI_HTML = """<!DOCTYPE html>
 const tenant = new URLSearchParams(location.search).get("tenant") || "default";
 document.getElementById("tenant").textContent = tenant;
 const gatewayBase = new URLSearchParams(location.search).get("gateway") ||
-  location.origin.replace(/:\\d+$/, ":8091");
+  (/:\\d+$/.test(location.origin)
+    ? location.origin.replace(/:\\d+$/, ":8091")
+    : location.origin + ":8091");
 let selected = null, ws = null;
 const esc = s => String(s).replace(/[&<>"']/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));

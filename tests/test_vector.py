@@ -323,3 +323,37 @@ def test_flare_controller(run):
         assert header_value(out[0], DESTINATION_HEADER) == "retry-t"
 
     run(main())
+
+
+def test_vector_index_asset(run, tmp_path):
+    """Declarative vector-index asset: created at setup, visible to a store
+    sharing the same persistence path."""
+    from langstream_tpu.api.model import AssetDefinition
+    from langstream_tpu.core.registry import REGISTRY
+
+    path = str(tmp_path / "vecs")
+    asset = AssetDefinition(
+        id="idx",
+        asset_type="vector-index",
+        creation_mode="create-if-not-exists",
+        config={
+            "index-name": "docs",
+            "dimension": 4,
+            "datasource": {"configuration": {"path": path}},
+        },
+    )
+
+    async def scenario():
+        info = REGISTRY.asset("vector-index")
+        manager = info.factory()
+        await manager.initialize(asset)
+        assert not await manager.asset_exists()
+        await manager.deploy_asset()
+        assert await manager.asset_exists()
+        # a fresh store over the same path sees the index
+        fresh = LocalVectorDataSource({"path": path})
+        assert fresh.has_index("docs")
+        await manager.delete_asset()
+        assert not await manager.asset_exists()
+
+    run(scenario())
