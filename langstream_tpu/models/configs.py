@@ -45,6 +45,13 @@ class ModelConfig:
     # attention kernel choice: "auto" (pallas on TPU when shapes fit),
     # "pallas" (force, interpret-mode off-TPU), "jnp" (reference path)
     attention_impl: str = "auto"
+    # llama-3.1-style NTK rope scaling (HF rope_scaling type "llama3"):
+    # frequencies below the low-freq wavelength threshold are divided by
+    # ``factor``; a smooth ramp interpolates through the transition band
+    rope_scaling_factor: Optional[float] = None
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max_seq_len: int = 8192
 
     @property
     def resolved_head_dim(self) -> int:
@@ -134,6 +141,23 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         rope_theta=500000.0,
         rms_norm_eps=1e-5,
         max_seq_len=8192,
+    ),
+    "llama-3.1-8b": _preset(
+        # llama-3-8b widths + NTK rope scaling → 128k context
+        name="llama-3.1-8b",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        max_seq_len=131072,
+        rope_scaling_factor=8.0,
+        rope_scaling_low_freq_factor=1.0,
+        rope_scaling_high_freq_factor=4.0,
+        rope_scaling_original_max_seq_len=8192,
     ),
     "mixtral-8x7b": _preset(
         name="mixtral-8x7b",

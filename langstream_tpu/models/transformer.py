@@ -96,12 +96,40 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (normed * weight.astype(jnp.float32)).astype(x.dtype)
 
 
-def _rope_freqs(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+def _rope_freqs(
+    positions: jax.Array, config: ModelConfig
+) -> tuple[jax.Array, jax.Array]:
     # positions: [B, S] → sin/cos [B, S, head_dim/2], fp32
-    half = head_dim // 2
-    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    half = config.resolved_head_dim // 2
+    freqs = config.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if config.rope_scaling_factor:
+        freqs = _llama3_rope_scale(freqs, config)
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, S, half]
     return jnp.sin(angles), jnp.cos(angles)
+
+
+def _llama3_rope_scale(freqs: jax.Array, config: ModelConfig) -> jax.Array:
+    """NTK-by-parts scaling (HF rope_scaling type "llama3", used by
+    llama-3.1+): low-frequency components slow down by ``factor``; a smooth
+    ramp interpolates through the transition wavelength band."""
+    factor = jnp.float32(config.rope_scaling_factor)
+    low = jnp.float32(config.rope_scaling_low_freq_factor)
+    high = jnp.float32(config.rope_scaling_high_freq_factor)
+    original = jnp.float32(config.rope_scaling_original_max_seq_len)
+
+    wavelen = 2.0 * jnp.pi / freqs
+    low_wavelen = original / low
+    high_wavelen = original / high
+    # 0 → keep, 1 → fully scaled; linear in inverse wavelength through the band
+    smooth = (original / wavelen - low) / (high - low)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = freqs / factor
+    interpolated = (1.0 - smooth) * scaled + smooth * freqs
+    return jnp.where(
+        wavelen > low_wavelen,
+        scaled,
+        jnp.where(wavelen < high_wavelen, freqs, interpolated),
+    )
 
 
 def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
@@ -385,7 +413,7 @@ def forward(params: Params, tokens: jax.Array, config: ModelConfig) -> jax.Array
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     if config.ring_axis is not None:
         positions = positions + lax.axis_index(config.ring_axis) * s
-    sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
+    sin, cos = _rope_freqs(positions, config)
     mask = jnp.tril(jnp.ones((s, s), jnp.bool_))[None, :, :]
     mask = jnp.broadcast_to(mask, (b, s, s))
     x = _embed(params, tokens, config)
@@ -407,7 +435,7 @@ def encode(
     """
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
+    sin, cos = _rope_freqs(positions, config)
     valid = positions < lengths[:, None]  # [B, S]
     mask = valid[:, None, :] & valid[:, :, None]  # full attention over real tokens
     x = _embed(params, tokens, config)
@@ -436,7 +464,7 @@ def prefill(
     real token of each prompt ([B, V])."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
-    sin, cos = _rope_freqs(positions, config.resolved_head_dim, config.rope_theta)
+    sin, cos = _rope_freqs(positions, config)
     t = cache["k"].shape[2]
     # causal over the prompt, nothing beyond; cache cols ≥ S are masked out
     q_pos = positions  # [B, S]
@@ -465,7 +493,7 @@ def decode_step(
     b = tokens.shape[0]
     t = cache["k"].shape[2]
     pos2 = positions[:, None]  # [B, 1]
-    sin, cos = _rope_freqs(pos2, config.resolved_head_dim, config.rope_theta)
+    sin, cos = _rope_freqs(pos2, config)
     kv_pos = jnp.arange(t)[None, None, :]
     mask = kv_pos <= pos2[:, :, None]  # attend to everything written ≤ position
     x = _embed(params, tokens[:, None], config)

@@ -169,3 +169,44 @@ def test_sharded_decode_path():
     )
     assert logits2.shape == (2, CFG.vocab_size)
     assert bool(jnp.isfinite(logits2).all())
+
+
+def test_llama3_rope_scaling():
+    """NTK-by-parts (HF rope_scaling type llama3): high-frequency components
+    untouched, low-frequency slowed by `factor`, smooth band between."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, ModelConfig
+    from langstream_tpu.models.transformer import _llama3_rope_scale
+
+    config = ModelConfig(
+        name="s", vocab_size=8, d_model=8, n_layers=1, n_heads=1, n_kv_heads=1,
+        d_ff=8, rope_theta=500000.0, rope_scaling_factor=8.0,
+        rope_scaling_low_freq_factor=1.0, rope_scaling_high_freq_factor=4.0,
+        rope_scaling_original_max_seq_len=8192,
+    )
+    half = 64
+    freqs = 500000.0 ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    scaled = np.asarray(_llama3_rope_scale(freqs, config))
+    freqs = np.asarray(freqs)
+    wavelen = 2 * np.pi / freqs
+    hi = wavelen < 8192 / 4.0  # high frequency: untouched
+    lo = wavelen > 8192 / 1.0  # low frequency: divided by factor
+    np.testing.assert_allclose(scaled[hi], freqs[hi], rtol=1e-6)
+    np.testing.assert_allclose(scaled[lo], freqs[lo] / 8.0, rtol=1e-6)
+    band = ~(hi | lo)
+    assert ((scaled[band] > freqs[band] / 8.0) & (scaled[band] < freqs[band])).all()
+    # preset sanity: forward runs with scaling enabled on a tiny clone
+    tiny = dataclasses.replace(
+        MODEL_PRESETS["tiny-test"], dtype="float32", rope_scaling_factor=8.0
+    )
+    from langstream_tpu.models.transformer import forward, init_params
+    import jax
+
+    params = init_params(tiny, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    out = forward(params, tokens, tiny)
+    assert bool(jnp.isfinite(out).all())
