@@ -4,8 +4,10 @@ TPU-first design notes:
 - layer params are STACKED on a leading axis and the layer loop is a
   `lax.scan` — one compiled layer body regardless of depth (fast compiles,
   XLA pipelining across layers);
-- all shapes static; KV cache is a fixed [L, B, Smax, Hkv, D] buffer with
-  per-slot lengths and masked attention (paged attention kernel: ops/);
+- all shapes static; KV cache is a fixed [L, B, Hkv, Smax, D] buffer
+  (head-major: the kv-head axis stays out of the last-two tiled dims so the
+  Pallas kernels can block over (Smax, D) directly) with per-slot lengths and
+  masked attention (paged attention kernel: ops/);
 - GQA via einsum grouping; bf16 activations/params, fp32 softmax/norms;
 - MoE uses the dispatch/combine einsum pattern (GShard-style) so the expert
   axis shards cleanly over an ICI mesh ("expert" axis) with `pjit`;
@@ -151,8 +153,8 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
 
 def attention(
     q: jax.Array,  # [B, S, H, D]
-    k: jax.Array,  # [B, T, Hkv, D]
-    v: jax.Array,  # [B, T, Hkv, D]
+    k: jax.Array,  # [B, Hkv, T, D] (head-major — cache layout)
+    v: jax.Array,  # [B, Hkv, T, D]
     mask: jax.Array,  # [B, S, T] bool — True = attend
     config: ModelConfig,
 ) -> jax.Array:
@@ -160,20 +162,19 @@ def attention(
     h, hkv = config.n_heads, config.n_kv_heads
     group = h // hkv
     b, s, _, d = q.shape
-    t = k.shape[1]
     qg = q.reshape(b, s, hkv, group, d)
-    scores = jnp.einsum("bshgd,bthd->bhgst", qg, k).astype(jnp.float32)
+    scores = jnp.einsum("bshgd,bhtd->bhgst", qg, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(d))
     scores = _softcap(scores, config.attn_logit_softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhgst,bthd->bshgd", probs, v)
+    out = jnp.einsum("bhgst,bhtd->bshgd", probs, v)
     return out.reshape(b, s, h * d)
 
 
 def _dispatch_attention(
     q: jax.Array,  # [B, S, H, D]
-    k_all: jax.Array,  # [B, T, Hkv, D] (cache width or S)
+    k_all: jax.Array,  # [B, Hkv, T, D] (cache width or S)
     v_all: jax.Array,
     mask: jax.Array,
     config: ModelConfig,
@@ -189,7 +190,7 @@ def _dispatch_attention(
     )
 
     b, s, _, _ = q.shape
-    t = k_all.shape[1]
+    t = k_all.shape[2]
     interpret = jax.default_backend() != "tpu"
     # decode: the ragged kernel only wins when block DMAs can be skipped;
     # measured on v5e (gemma-2b, B=32) XLA's fused masked path is ~9% faster,
@@ -205,7 +206,7 @@ def _dispatch_attention(
     if s > 1 and causal and pallas_ok(config, s):
         # prefill/full forward: causal over the first s cache columns
         return flash_prefill_attention(
-            q, k_all[:, :s], v_all[:, :s], config, interpret=interpret
+            q, k_all[:, :, :s], v_all[:, :, :s], config, interpret=interpret
         )
     return attention(q, k_all, v_all, mask, config)
 
@@ -313,22 +314,26 @@ def _layer(
 
     new_cache = None
     if cache_kv is not None:
-        ck, cv = cache_kv
+        ck, cv = cache_kv  # [B, Hkv, T, D] head-major
         # scatter this step's k/v into the cache at cache_positions [B, S]
-        bidx = jnp.arange(b)[:, None]
-        ck = ck.at[bidx, cache_positions].set(k)
-        cv = cv.at[bidx, cache_positions].set(v)
+        hkv = config.n_kv_heads
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(hkv)[None, :, None]
+        pidx = cache_positions[:, None, :]  # [B, 1, S]
+        ck = ck.at[bidx, hidx, pidx].set(k.transpose(0, 2, 1, 3))
+        cv = cv.at[bidx, hidx, pidx].set(v.transpose(0, 2, 1, 3))
         new_cache = (ck, cv)
         k_all, v_all = ck, cv
     else:
-        k_all, v_all = k, v
+        k_all, v_all = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
 
     if config.ring_axis is not None and cache_kv is None:
         # sequence-parallel path: K/V blocks rotate around the ring; the
-        # causal mask is derived from global block positions inside
+        # causal mask is derived from global block positions inside (ring
+        # keeps the [B, Sl, Hkv, D] layout — blocks ppermute whole)
         from langstream_tpu.parallel.ring_attention import ring_attention
 
-        attn_out = quantized_matmul(ring_attention(q, k_all, v_all, config), lp["wo"])
+        attn_out = quantized_matmul(ring_attention(q, k, v, config), lp["wo"])
     else:
         attn_out = quantized_matmul(
             _dispatch_attention(q, k_all, v_all, mask, config, cache_positions, causal),
@@ -447,8 +452,10 @@ def encode(
 
 
 def make_kv_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> KVCache:
+    """Head-major cache: [L, B, Hkv, T, D] — (T, D) are the tiled trailing
+    dims, so Pallas kv blocks are (block_k, D) slices with no relayout."""
     dtype = dtype or _dtype(config)
-    shape = (config.n_layers, batch, max_len, config.n_kv_heads, config.resolved_head_dim)
+    shape = (config.n_layers, batch, config.n_kv_heads, max_len, config.resolved_head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -465,7 +472,7 @@ def prefill(
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     sin, cos = _rope_freqs(positions, config)
-    t = cache["k"].shape[2]
+    t = cache["k"].shape[3]
     # causal over the prompt, nothing beyond; cache cols ≥ S are masked out
     q_pos = positions  # [B, S]
     kv_pos = jnp.arange(t)[None, None, :]  # [1, 1, T]
@@ -491,7 +498,7 @@ def decode_step(
 ) -> tuple[jax.Array, KVCache]:
     """One decode step for every active slot → logits [B, V], updated cache."""
     b = tokens.shape[0]
-    t = cache["k"].shape[2]
+    t = cache["k"].shape[3]
     pos2 = positions[:, None]  # [B, 1]
     sin, cos = _rope_freqs(pos2, config)
     kv_pos = jnp.arange(t)[None, None, :]

@@ -1,7 +1,11 @@
 """Flash-attention Pallas kernels.
 
 Two hot paths, both GQA-aware (queries grouped per kv head so K/V blocks are
-read once per group, not once per query head):
+read once per group, not once per query head). K/V come in HEAD-MAJOR layout
+[B, Hkv, T, D] — the kv-head axis stays out of the trailing two dims, so the
+Mosaic TPU lowering's (8, 128) block-tiling constraint falls on (T, D) where
+blocks are naturally aligned, and a per-head kv block is a contiguous
+(block_k, D) slice (no relayout per grid step).
 
 - ``flash_prefill_attention``: causal blocked attention with fp32
   online-softmax scratch accumulators — O(block_q x block_k) VMEM instead of
@@ -36,10 +40,10 @@ _NEG = -1e30
 
 
 def _prefill_kernel(
-    q_ref,  # [1, block_q, 1, G, D]
-    k_ref,  # [1, block_k, 1, D]
-    v_ref,  # [1, block_k, 1, D]
-    o_ref,  # [1, block_q, 1, G, D]
+    q_ref,  # [1, 1, G, block_q, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    o_ref,  # [1, 1, G, block_q, D]
     m_scr,  # [G, block_q, 128] f32
     l_scr,  # [G, block_q, 128] f32
     acc_scr,  # [G, block_q, D] f32
@@ -65,9 +69,9 @@ def _prefill_kernel(
     # causal: skip key blocks strictly above the diagonal
     @pl.when(k_start <= q_start + block_q - 1)
     def _body():
-        q = q_ref[0, :, 0, :, :].astype(jnp.float32)  # [block_q, G, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :, :].astype(jnp.float32)  # [G, block_q, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = (
             jax.lax.dot_general(
                 q,
@@ -76,13 +80,12 @@ def _prefill_kernel(
                 preferred_element_type=jnp.float32,
             )
             * scale
-        )  # [block_q, G, block_k]
+        )  # [G, block_q, block_k]
         if softcap is not None:
             s = jnp.tanh(s / softcap) * softcap
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1, block_k), 0)
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1, block_k), 2)
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 1)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 2)
         s = jnp.where(k_pos <= q_pos, s, _NEG)
-        s = s.transpose(1, 0, 2)  # [G, block_q, block_k]
 
         m_prev = m_scr[:, :, 0]  # [G, block_q]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
@@ -102,14 +105,13 @@ def _prefill_kernel(
     @pl.when(j == nk - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]  # [G, block_q, 1]
-        out = (acc_scr[...] / l).transpose(1, 0, 2)  # [block_q, G, D]
-        o_ref[0, :, 0, :, :] = out.astype(o_ref.dtype)
+        o_ref[0, 0, :, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
 def flash_prefill_attention(
     q: jax.Array,  # [B, S, H, D]
-    k: jax.Array,  # [B, S, Hkv, D]
-    v: jax.Array,  # [B, S, Hkv, D]
+    k: jax.Array,  # [B, Hkv, S, D] head-major
+    v: jax.Array,  # [B, Hkv, S, D]
     config: ModelConfig,
     block_q: int = 128,
     block_k: int = 128,
@@ -117,12 +119,13 @@ def flash_prefill_attention(
 ) -> jax.Array:
     """Causal GQA attention → [B, S, H*D]."""
     b, s, h, d = q.shape
-    hkv = k.shape[2]
+    hkv = k.shape[1]
     group = h // hkv
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, "caller gates divisibility"
-    qg = q.reshape(b, s, hkv, group, d)
+    # head-major queries: [B, Hkv, G, S, D] so the blocked dims are (S, D)
+    qg = q.reshape(b, s, hkv, group, d).transpose(0, 2, 3, 1, 4)
 
     kernel = functools.partial(
         _prefill_kernel,
@@ -136,15 +139,15 @@ def flash_prefill_attention(
         grid=(b, hkv, s // block_q, s // block_k),
         in_specs=[
             pl.BlockSpec(
-                (1, block_q, 1, group, d), lambda b, h, i, j: (b, i, h, 0, 0)
+                (1, 1, group, block_q, d), lambda b, h, i, j: (b, h, 0, i, 0)
             ),
-            pl.BlockSpec((1, block_k, 1, d), lambda b, h, i, j: (b, j, h, 0)),
-            pl.BlockSpec((1, block_k, 1, d), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, block_q, 1, group, d), lambda b, h, i, j: (b, i, h, 0, 0)
+            (1, 1, group, block_q, d), lambda b, h, i, j: (b, h, 0, i, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((b, s, hkv, group, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, s, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((group, block_q, 128), jnp.float32),
             pltpu.VMEM((group, block_q, 128), jnp.float32),
@@ -152,7 +155,8 @@ def flash_prefill_attention(
         ],
         interpret=interpret,
     )(qg, k, v)
-    return out.reshape(b, s, h * d)
+    # [B, Hkv, G, S, D] → [B, S, H*D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, h * d)
 
 
 # ---------------------------------------------------------------------------
@@ -163,8 +167,8 @@ def flash_prefill_attention(
 def _decode_kernel(
     lengths_ref,  # scalar-prefetch [B]
     q_ref,  # [1, 1, G, D]
-    k_ref,  # [1, block_k, 1, D]
-    v_ref,  # [1, block_k, 1, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
     o_ref,  # [1, 1, G, D]
     m_scr,  # [G, 128] f32
     l_scr,  # [G, 128] f32
@@ -189,9 +193,9 @@ def _decode_kernel(
     # skip cache blocks entirely past this row's written length
     @pl.when(k_start < length)
     def _body():
-        q = q_ref[0, 0, 0, :, :].astype(jnp.float32)  # [G, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)  # [G, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
         s = (
             jax.lax.dot_general(
                 q,
@@ -219,13 +223,13 @@ def _decode_kernel(
     @pl.when(j == nk - 1)
     def _finalize():
         l = jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
-        o_ref[0, 0, 0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+        o_ref[0, 0, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
 
 
 def ragged_decode_attention(
     q: jax.Array,  # [B, H, D] single query per row
-    k: jax.Array,  # [B, T, Hkv, D] cache
-    v: jax.Array,  # [B, T, Hkv, D]
+    k: jax.Array,  # [B, Hkv, T, D] cache (head-major)
+    v: jax.Array,  # [B, Hkv, T, D]
     lengths: jax.Array,  # [B] int32 — valid cache prefix per row
     config: ModelConfig,
     block_k: int = 128,
@@ -233,12 +237,12 @@ def ragged_decode_attention(
 ) -> jax.Array:
     """GQA decode attention → [B, H*D]."""
     b, h, d = q.shape
-    t = k.shape[1]
-    hkv = k.shape[2]
+    hkv = k.shape[1]
+    t = k.shape[2]
     group = h // hkv
     block_k = min(block_k, t)
     assert t % block_k == 0, "caller gates divisibility"
-    qg = q.reshape(b, 1, hkv, group, d)
+    qg = q.reshape(b, hkv, group, d)
 
     kernel = functools.partial(
         _decode_kernel,
@@ -253,19 +257,19 @@ def ragged_decode_attention(
         # the ragged bandwidth saving actually comes from (the pl.when only
         # skips the FLOPs)
         last = jnp.maximum(pl.cdiv(lens[b], block_k) - 1, 0)
-        return (b, jnp.minimum(j, last), h, 0)
+        return (b, h, jnp.minimum(j, last), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, hkv, t // block_k),
         in_specs=[
             # index maps receive the scalar-prefetch ref as a trailing arg
-            pl.BlockSpec((1, 1, 1, group, d), lambda b, h, j, lens: (b, 0, h, 0, 0)),
-            pl.BlockSpec((1, block_k, 1, d), kv_index),
-            pl.BlockSpec((1, block_k, 1, d), kv_index),
+            pl.BlockSpec((1, 1, group, d), lambda b, h, j, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, 1, group, d), lambda b, h, j, lens: (b, 0, h, 0, 0)
+            (1, 1, group, d), lambda b, h, j, lens: (b, h, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((group, 128), jnp.float32),
@@ -276,7 +280,7 @@ def ragged_decode_attention(
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, 1, hkv, group, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), qg, k, v)
     return out.reshape(b, h * d)

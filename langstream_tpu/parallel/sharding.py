@@ -51,8 +51,8 @@ def param_specs(config: ModelConfig) -> Params:
 
 
 def kv_cache_specs() -> dict[str, P]:
-    # [L, B, T, Hkv, D] — slots on data, kv heads on model
-    spec = P(None, "data", None, "model", None)
+    # [L, B, Hkv, T, D] head-major — slots on data, kv heads on model
+    spec = P(None, "data", "model", None, None)
     return {"k": spec, "v": spec}
 
 
@@ -65,14 +65,14 @@ def serving_cache_specs(n_kv_heads: int, mesh: Mesh) -> dict[str, P]:
     the extra ways — same as Megatron's kv-head replication."""
     model_ways = int(mesh.shape.get("model", 1))
     if model_ways > 1 and n_kv_heads % model_ways == 0:
-        spec = P(None, None, None, "model", None)
+        spec = P(None, None, "model", None, None)
     else:
         spec = P()
     return {"k": spec, "v": spec}
 
 
 def shard_serving_cache(cache: dict, mesh: Mesh) -> dict:
-    n_kv_heads = cache["k"].shape[3]
+    n_kv_heads = cache["k"].shape[2]
     return jax.device_put(cache, _named(mesh, serving_cache_specs(n_kv_heads, mesh)))
 
 

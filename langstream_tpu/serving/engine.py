@@ -121,8 +121,8 @@ def _make_insert_group():
         are out of bounds (padding rows) are dropped by the scatter."""
 
         def put(big, small):
-            w = small.shape[2]
-            return big.at[:, slots, :w].set(
+            w = small.shape[3]  # [L, B, Hkv, T, D] — T is the bucket width
+            return big.at[:, slots, :, :w].set(
                 small.astype(big.dtype), mode="drop"
             )
 

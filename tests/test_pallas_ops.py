@@ -36,7 +36,9 @@ def rand(key, *shape):
 
 def test_flash_prefill_matches_reference():
     b, s, h, hkv, d = 2, 64, 8, 4, 8
-    q, k, v = rand(0, b, s, h, d), rand(1, b, s, hkv, d), rand(2, b, s, hkv, d)
+    q = rand(0, b, s, h, d)
+    # head-major K/V [B, Hkv, S, D] — the cache layout
+    k, v = rand(1, b, hkv, s, d), rand(2, b, hkv, s, d)
     causal = jnp.broadcast_to(jnp.tril(jnp.ones((s, s), jnp.bool_))[None], (b, s, s))
     for config in (CFG, SOFTCAP_CFG):
         ref = attention(q, k, v, causal, config)
@@ -47,7 +49,7 @@ def test_flash_prefill_matches_reference():
 def test_ragged_decode_matches_reference():
     b, t, h, hkv, d = 4, 64, 8, 4, 8
     q = rand(0, b, 1, h, d)
-    k, v = rand(1, b, t, hkv, d), rand(2, b, t, hkv, d)
+    k, v = rand(1, b, hkv, t, d), rand(2, b, hkv, t, d)
     lengths = jnp.asarray([1, 17, 40, 64], jnp.int32)
     kv_pos = jnp.arange(t)[None, None, :]
     mask = kv_pos < lengths[:, None, None]
