@@ -1,0 +1,79 @@
+"""Decode-path profiling: isolate device compute from engine overhead.
+
+Times (a) one fused decode chunk on-device with block_until_ready, at
+several batch sizes and chunk lengths, (b) prefill, (c) device_put /
+fetch costs — to find where the engine's 800 tok/s (vs ~8k roofline)
+actually goes. Run on the real chip: `python dev/profile_decode.py`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from langstream_tpu.models.configs import MODEL_PRESETS
+    from langstream_tpu.models.transformer import init_params, make_kv_cache
+    from langstream_tpu.serving.engine import _decode_chunk
+
+    config = MODEL_PRESETS["gemma-2b"]
+    print("backend:", jax.default_backend())
+    params = init_params(config, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+
+    max_seq = 1024
+    for batch in (32, 64):
+        cache = make_kv_cache(config, batch, max_seq)
+        tokens = jnp.ones(batch, jnp.int32)
+        positions = jnp.full(batch, 40, jnp.int32)
+        key = jax.random.PRNGKey(0)
+        temp = jnp.zeros(batch, jnp.float32)
+        top_k = jnp.zeros(batch, jnp.int32)
+        top_p = jnp.ones(batch, jnp.float32)
+        for steps in (8, 32):
+            # compile
+            chunk, tokens, positions, cache, key = _decode_chunk(
+                params, tokens, positions, cache, key, temp, top_k, top_p, steps, config
+            )
+            jax.block_until_ready(chunk)
+            n_iter = 6
+            t0 = time.monotonic()
+            for _ in range(n_iter):
+                chunk, tokens, positions, cache, key = _decode_chunk(
+                    params, tokens, positions, cache, key, temp, top_k, top_p, steps, config
+                )
+            jax.block_until_ready(chunk)
+            dt = (time.monotonic() - t0) / n_iter
+            per_step_ms = dt / steps * 1e3
+            toks = batch * steps / dt
+            print(
+                f"B={batch} steps={steps}: chunk={dt*1e3:.1f}ms "
+                f"per-step={per_step_ms:.2f}ms device-tok/s={toks:.0f}"
+            )
+
+        # dispatch-only latency: time to enqueue without waiting
+        t0 = time.monotonic()
+        chunk, tokens, positions, cache, key = _decode_chunk(
+            params, tokens, positions, cache, key, temp, top_k, top_p, 32, config
+        )
+        t1 = time.monotonic()
+        jax.block_until_ready(chunk)
+        t2 = time.monotonic()
+        print(f"B={batch}: dispatch={((t1-t0))*1e3:.1f}ms wait={(t2-t1)*1e3:.1f}ms")
+
+        # fetch latency for the chunk tokens
+        t0 = time.monotonic()
+        np.asarray(jax.device_get(chunk))
+        print(f"B={batch}: device_get(chunk)={(time.monotonic()-t0)*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

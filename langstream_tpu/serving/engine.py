@@ -178,15 +178,21 @@ class ServingEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._dead: Optional[BaseException] = None
-        # device-side per-slot sampling params, rebuilt on admit
-        self._temp = np.zeros(max_batch, np.float32)
-        self._top_k = np.zeros(max_batch, np.int32)
-        self._top_p = np.ones(max_batch, np.float32)
+        # per-slot sampling params, DEVICE-resident: re-uploading them on
+        # every chunk dispatch costs 3 host→device puts through the device
+        # tunnel (~100ms latency each) — they only change on admit
+        self._temp_dev = jnp.zeros(max_batch, jnp.float32)
+        self._top_k_dev = jnp.zeros(max_batch, jnp.int32)
+        self._top_p_dev = jnp.ones(max_batch, jnp.float32)
         # device-resident decode chain: last sampled token + next write
         # position per slot (kept on device so chunk k+1 can be dispatched
         # from chunk k's outputs without a host sync)
         self._tokens_dev = jnp.zeros(max_batch, jnp.int32)
         self._positions_dev = jnp.zeros(max_batch, jnp.int32)
+        # slots freed since the last dispatch: their device temp must be
+        # zeroed, else sample()'s batch-wide any_sample/any_filter predicates
+        # keep paying the full-vocab sort for a slot that no longer exists
+        self._freed_slots: list[int] = []
         # decode chunk size (tokens per dispatch per slot); clamped to
         # powers of two to bound recompiles
         self.decode_chunk = max(1, int(decode_chunk))
@@ -285,12 +291,17 @@ class ServingEngine:
     def _process_entry(self, entry: tuple) -> None:
         kind = entry[0]
         if kind == "prefill":
-            _, first_dev, idx, request = entry
-            slot = self._slots[idx]
-            if slot.request is not request:
-                return
-            slot.first_token_at = time.monotonic()
-            self._deliver_token(idx, int(jax.device_get(first_dev)[0]))
+            # ONE fetch for the whole prefill group — per-request 1-token
+            # fetches cost a full tunnel round trip each (~100ms)
+            _, first_dev, group = entry
+            first = np.asarray(jax.device_get(first_dev))
+            now = time.monotonic()
+            for j, (idx, request) in enumerate(group):
+                slot = self._slots[idx]
+                if slot.request is not request:
+                    continue
+                slot.first_token_at = now
+                self._deliver_token(idx, int(first[j]))
         else:
             _, chunk, snapshot, steps = entry
             self._process_chunk(chunk, snapshot, steps)
@@ -394,21 +405,19 @@ class ServingEngine:
         self._positions_dev = self._positions_dev.at[slots_dev].set(
             jnp.asarray(lengths), mode="drop"
         )
+        self._temp_dev = self._temp_dev.at[slots_dev].set(jnp.asarray(temps), mode="drop")
+        self._top_k_dev = self._top_k_dev.at[slots_dev].set(jnp.asarray(top_ks), mode="drop")
+        self._top_p_dev = self._top_p_dev.at[slots_dev].set(jnp.asarray(top_ps), mode="drop")
 
-        entries: list[tuple] = []
-        for j, (idx, request) in enumerate(group):
+        for idx, request in group:
             slot = self._slots[idx]
             slot.request = request
             slot.position = len(request.prompt_tokens)  # next write position
             slot.generated = []
             slot.started_at = started
             slot.first_token_at = 0.0  # stamped when the deferred fetch lands
-            self._temp[idx] = request.options.temperature
-            self._top_k[idx] = request.options.top_k
-            self._top_p[idx] = request.options.top_p
             self.total_requests += 1
-            entries.append(("prefill", first[j : j + 1], idx, request))
-        return entries
+        return [("prefill", first, list(group))]
 
     def _chunk_steps(self) -> int:
         """Power-of-two chunk bounded by every active slot's cache headroom.
@@ -431,6 +440,18 @@ class ServingEngine:
         """Dispatch one multi-step decode; returns (device tokens,
         per-slot request snapshot, steps) for deferred host processing."""
         steps = self._chunk_steps()
+        if self._freed_slots:
+            # skip slots re-admitted since they freed (admit runs before
+            # dispatch and already wrote their fresh params)
+            stale = [i for i in set(self._freed_slots) if not self._slots[i].active]
+            self._freed_slots.clear()
+            if stale:
+                # fixed-size index buffer (padding rows out of bounds →
+                # dropped) so this stays ONE compiled shape regardless of
+                # how many freed
+                idxs = np.full(self.max_batch, self.max_batch, np.int32)
+                idxs[: len(stale)] = stale
+                self._temp_dev = self._temp_dev.at[jnp.asarray(idxs)].set(0.0, mode="drop")
         chunk, self._tokens_dev, self._positions_dev, self._cache, self._key = (
             _decode_chunk(
                 self.params,
@@ -438,9 +459,9 @@ class ServingEngine:
                 self._positions_dev,
                 self._cache,
                 self._key,
-                jnp.asarray(self._temp),
-                jnp.asarray(self._top_k),
-                jnp.asarray(self._top_p),
+                self._temp_dev,
+                self._top_k_dev,
+                self._top_p_dev,
                 steps,
                 self.config,
             )
@@ -502,6 +523,7 @@ class ServingEngine:
             slot.request = None
             slot.generated = []
             slot.position = 0
+            self._freed_slots.append(idx)
 
     def _fail_all(self, error: BaseException) -> None:
         self._dead = error

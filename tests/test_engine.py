@@ -58,6 +58,37 @@ def test_concurrent_requests_interleave():
         engine.stop()
 
 
+def test_freed_slot_resets_device_temperature():
+    """After a sampled (temperature>0) request finishes, its slot's
+    device-resident temperature must return to 0 so sample()'s batch-wide
+    any_sample predicate stops paying the sampling path for a dead slot —
+    and a freed-then-readmitted slot must keep its fresh params."""
+    import numpy as np
+
+    engine = make_engine(max_batch=2, max_seq_len=64, decode_chunk=4)
+    try:
+        engine.generate(
+            [3, 4, 5],
+            GenerationOptions(max_new_tokens=6, temperature=0.9, top_k=4, seed=1),
+            timeout=120,
+        )
+        # a follow-up greedy request forces at least one dispatch, which
+        # flushes the freed-slot reset
+        engine.generate([1, 2], GenerationOptions(max_new_tokens=2), timeout=120)
+        assert float(np.max(np.asarray(jax.device_get(engine._temp_dev)))) == 0.0
+
+        # freed then immediately re-admitted with sampling on: temp sticks
+        # while active (we only observe the final state: after IT frees, the
+        # reset applies again on the next dispatch)
+        engine.generate(
+            [9, 9], GenerationOptions(max_new_tokens=3, temperature=0.5), timeout=120
+        )
+        engine.generate([1, 2], GenerationOptions(max_new_tokens=2), timeout=120)
+        assert float(np.max(np.asarray(jax.device_get(engine._temp_dev)))) == 0.0
+    finally:
+        engine.stop()
+
+
 def test_stats_shape():
     engine = make_engine(max_batch=2, max_seq_len=64)
     try:
