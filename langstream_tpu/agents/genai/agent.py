@@ -65,20 +65,30 @@ class GenAIToolKitAgent(AgentProcessor):
             await step.close()
 
     async def process(self, records: list[Record]) -> list[ProcessorResult]:
-        results: list[ProcessorResult] = []
-        for record in records:
-            try:
-                mutable = MutableRecord.from_record(record)
-                for step in self.steps:
-                    await step.apply(mutable, self.context)
-                    if mutable.dropped:
-                        break
-                out = [] if mutable.dropped else [mutable.to_record()]
-                results.append(ProcessorResult.ok(record, out))
-                self.processed(1)
-            except Exception as e:  # noqa: BLE001 — per-record error routing
-                results.append(ProcessorResult.failed(record, e))
-        return results
+        # records fan out CONCURRENTLY (reference GenAIToolKitAgent processes
+        # each record on its own CompletableFuture chain): with an
+        # engine-backed completions step this is what fills the continuous
+        # batcher's slots — a sequential await would serialize the whole
+        # batch through one KV-cache slot. gather preserves input order;
+        # ordering is enforced at COMMIT time by the tracker, not here.
+        import asyncio
+
+        return list(
+            await asyncio.gather(*(self._process_one(r) for r in records))
+        )
+
+    async def _process_one(self, record: Record) -> ProcessorResult:
+        try:
+            mutable = MutableRecord.from_record(record)
+            for step in self.steps:
+                await step.apply(mutable, self.context)
+                if mutable.dropped:
+                    break
+            out = [] if mutable.dropped else [mutable.to_record()]
+            self.processed(1)
+            return ProcessorResult.ok(record, out)
+        except Exception as e:  # noqa: BLE001 — per-record error routing
+            return ProcessorResult.failed(record, e)
 
 
 def _make_factory(step_type: str):

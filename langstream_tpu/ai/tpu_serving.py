@@ -16,6 +16,9 @@ Resource configuration:
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
   quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
     models stage on the host so the bf16 tree never needs device HBM)
+  kv-cache-quantization: "int8" → int8 KV cache with per-token per-head
+    scales (int8×int8 MXU attention; ~halves decode cache bandwidth —
+    the lever that matters for GQA models like llama, see PERF.md)
   hbm-bytes: device HBM budget for that staging decision (default 16GiB)
 
 Streaming follows the reference's growth batching (OpenAICompletionService:
@@ -73,7 +76,18 @@ class _EngineHolder:
                 raise ValueError(
                     f"unknown model preset {name!r}; known: {sorted(MODEL_PRESETS)}"
                 )
-            self._model_config = MODEL_PRESETS[name]
+            mc = MODEL_PRESETS[name]
+            kv_mode = str(self.config.get("kv-cache-quantization", "") or "").lower()
+            if kv_mode not in ("", "none", "int8"):
+                raise ValueError(
+                    f"unknown kv-cache-quantization {kv_mode!r}; "
+                    "supported: int8, none"
+                )
+            if kv_mode == "int8":
+                import dataclasses
+
+                mc = dataclasses.replace(mc, kv_cache_dtype="int8")
+            self._model_config = mc
         return self._model_config
 
     def tokenizer(self):
@@ -283,12 +297,28 @@ class TpuCompletionsService(CompletionsService):
             )
             on_token = stream_state.on_token
 
-        request = GenerationRequest(
-            prompt_tokens=tokenizer.encode(prompt), options=gen_options, on_token=on_token
-        )
         loop = asyncio.get_running_loop()
-        await loop.run_in_executor(None, engine.submit, request)  # may block: backpressure
-        result = await loop.run_in_executor(None, request.result, 600.0)
+        done: asyncio.Future = loop.create_future()
+
+        def _on_done(res) -> None:  # engine thread → event loop
+            loop.call_soon_threadsafe(
+                lambda: done.done() or done.set_result(res)
+            )
+
+        request = GenerationRequest(
+            prompt_tokens=tokenizer.encode(prompt),
+            options=gen_options,
+            on_token=on_token,
+            on_done=_on_done,
+        )
+        # submit may block on a full queue (backpressure) → executor; the
+        # WAIT is a loop future resolved by on_done, so an in-flight
+        # generation holds no thread and agent fan-out isn't capped by the
+        # executor pool size
+        await loop.run_in_executor(None, engine.submit, request)
+        result = await asyncio.wait_for(done, 600.0)
+        if result.error is not None:
+            raise result.error
         if stream_state is not None:
             stream_state.finish()
 

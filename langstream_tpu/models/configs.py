@@ -45,6 +45,10 @@ class ModelConfig:
     # attention kernel choice: "auto" (pallas on TPU when shapes fit),
     # "pallas" (force, interpret-mode off-TPU), "jnp" (reference path)
     attention_impl: str = "auto"
+    # KV cache storage: "model" (activation dtype) | "int8" (per-token
+    # per-head symmetric quant — halves decode's cache read stream; the
+    # dequant fuses into the attention einsum's operand load)
+    kv_cache_dtype: str = "model"
     # llama-3.1-style NTK rope scaling (HF rope_scaling type "llama3"):
     # frequencies below the low-freq wavelength threshold are divided by
     # ``factor``; a smooth ramp interpolates through the transition band

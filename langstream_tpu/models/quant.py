@@ -75,6 +75,61 @@ def quantize_params(params: Params, config: ModelConfig) -> Params:
     return out
 
 
+def init_random_quantized_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Random int8 params built DIRECTLY on device (shape-identical to
+    ``quantize_params(init_params(...))``) — benchmarking big models whose
+    bf16 tree would not fit HBM, without a slow host-staged init. Scales are
+    sized so dequantized weights look ~N(0, 1/in_features), keeping softmax
+    finite."""
+    import jax.numpy as jnp
+
+    d, h, hkv = config.d_model, config.n_heads, config.n_kv_heads
+    hd = config.resolved_head_dim
+    f, L, v = config.d_ff, config.n_layers, config.vocab_size
+    dtype = jnp.dtype(config.dtype)
+    keys = iter(jax.random.split(key, 16))
+
+    def qw(*shape, scale_of=None):
+        fan_in = scale_of if scale_of is not None else shape[-2]
+        q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
+        s = jnp.full(shape[:-2] + (1, shape[-1]), fan_in**-0.5 / 127.0, jnp.float32)
+        return {"q": q, "s": s}
+
+    layers: Params = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "wq": qw(L, d, h * hd),
+        "wk": qw(L, d, hkv * hd),
+        "wv": qw(L, d, hkv * hd),
+        "wo": qw(L, h * hd, d),
+        "ffn_norm": jnp.ones((L, d), dtype),
+    }
+    if config.is_moe:
+        e = config.n_experts
+        layers["router"] = (
+            jax.random.normal(next(keys), (L, d, e), jnp.float32) * d**-0.5
+        ).astype(dtype)
+        layers["w_gate"] = qw(L, e, d, f)
+        layers["w_up"] = qw(L, e, d, f)
+        layers["w_down"] = qw(L, e, f, d)
+    else:
+        layers["w_gate"] = qw(L, d, f)
+        layers["w_up"] = qw(L, d, f)
+        layers["w_down"] = qw(L, f, d)
+
+    params: Params = {"layers": layers, "final_norm": jnp.ones((d,), dtype)}
+    if config.tie_embeddings:
+        # row-quantized table (quantize_row_wise layout: scale per vocab row)
+        q = jax.random.randint(next(keys), (v, d), -127, 128, jnp.int8)
+        s = jnp.full((v, 1), d**-0.5 / 127.0, jnp.float32)
+        params["embed"] = {"q": q, "s": s}
+    else:
+        params["embed"] = (
+            jax.random.normal(next(keys), (v, d), jnp.float32) * d**-0.5
+        ).astype(dtype)
+        params["lm_head"] = qw(d, v)
+    return params
+
+
 def quantize_specs(specs: Params) -> Params:
     """Mirror quantize_params over a PartitionSpec tree: ``q`` keeps the
     weight's spec; ``s`` drops the contracted (second-to-last) axis."""

@@ -151,31 +151,78 @@ def _softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
     return jnp.tanh(x / cap) * cap
 
 
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., D] → int8 values + fp32 scale per leading index (symmetric)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_kv(c, dtype) -> jax.Array:
+    """int8 cache dict → values; XLA fuses the convert+mul into the
+    attention einsum's operand load, so HBM traffic stays int8."""
+    if isinstance(c, dict):
+        return (c["q"].astype(jnp.float32) * c["s"][..., None]).astype(dtype)
+    return c
+
+
+def cache_width(cache: KVCache) -> int:
+    leaf = cache["k"]
+    return (leaf["q"] if isinstance(leaf, dict) else leaf).shape[3]
+
+
 def attention(
     q: jax.Array,  # [B, S, H, D]
-    k: jax.Array,  # [B, Hkv, T, D] (head-major — cache layout)
-    v: jax.Array,  # [B, Hkv, T, D]
+    k,  # [B, Hkv, T, D] head-major array, or int8 {"q","s"} cache entry
+    v,
     mask: jax.Array,  # [B, S, T] bool — True = attend
     config: ModelConfig,
 ) -> jax.Array:
-    """GQA attention, fp32 softmax. S=query len, T=key len (cache width)."""
+    """GQA attention, fp32 softmax. S=query len, T=key len (cache width).
+
+    int8 caches: the per-token scales are hoisted OUT of the [.., T, D]
+    operands onto the [.., T]-shaped scores/probs (D-times less scale math;
+    the bare int8→bf16 convert fuses into the MXU operand load) — the
+    product is mathematically identical to dequantize-then-matmul."""
     h, hkv = config.n_heads, config.n_kv_heads
     group = h // hkv
     b, s, _, d = q.shape
     qg = q.reshape(b, s, hkv, group, d)
-    scores = jnp.einsum("bshgd,bhtd->bhgst", qg, k).astype(jnp.float32)
+    if isinstance(k, dict):
+        # int8×int8 MXU path: quantize q per-vector, dot in s8 (s32 accum),
+        # apply both scales on the [.., T]-shaped scores — the int8 cache is
+        # read raw, no bf16 materialization
+        qq, qs = _quantize_kv(qg)  # [B,S,Hkv,G,D] int8, [B,S,Hkv,G] f32
+        scores = jnp.einsum(
+            "bshgd,bhtd->bhgst", qq, k["q"], preferred_element_type=jnp.int32
+        ).astype(jnp.float32)
+        scores = scores * qs.transpose(0, 2, 3, 1)[:, :, :, :, None]
+        scores = scores * k["s"][:, :, None, None, :]
+    else:
+        scores = jnp.einsum("bshgd,bhtd->bhgst", qg, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(d))
     scores = _softcap(scores, config.attn_logit_softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhgst,bhtd->bshgd", probs, v)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if isinstance(v, dict):
+        # fold v's per-token scale into probs (it rides the contraction),
+        # re-quantize the weighted probs per-row, dot in s8
+        pv = probs * v["s"][:, :, None, None, :]
+        pq, ps = _quantize_kv(pv)  # int8 [B,Hkv,G,S,T], f32 [B,Hkv,G,S]
+        out = jnp.einsum(
+            "bhgst,bhtd->bshgd", pq, v["q"], preferred_element_type=jnp.int32
+        ).astype(jnp.float32)
+        out = (out * ps.transpose(0, 3, 1, 2)[..., None]).astype(q.dtype)
+    else:
+        out = jnp.einsum("bhgst,bhtd->bshgd", probs.astype(q.dtype), v)
     return out.reshape(b, s, h * d)
 
 
 def _dispatch_attention(
     q: jax.Array,  # [B, S, H, D]
-    k_all: jax.Array,  # [B, Hkv, T, D] (cache width or S)
-    v_all: jax.Array,
+    k_all,  # [B, Hkv, T, D] array, or int8 {"q","s"} dict (cache width or S)
+    v_all,
     mask: jax.Array,
     config: ModelConfig,
     cache_positions: Optional[jax.Array],
@@ -190,12 +237,15 @@ def _dispatch_attention(
     )
 
     b, s, _, _ = q.shape
-    t = k_all.shape[2]
+    quantized = isinstance(k_all, dict)
+    t = (k_all["q"] if quantized else k_all).shape[2]
     interpret = jax.default_backend() != "tpu"
     # decode: the ragged kernel only wins when block DMAs can be skipped;
-    # measured on v5e (gemma-2b, B=32) XLA's fused masked path is ~9% faster,
-    # so "auto" keeps jnp for decode and the kernel stays opt-in ("pallas")
-    use_decode_kernel = config.attention_impl == "pallas"
+    # measured on v5e (gemma-2b, B=96, fast sampler) XLA's fused masked path
+    # still beats it (~10.4 vs 11.3ms/step — kv=1 makes the per-block DMAs
+    # tiny), so "auto" keeps jnp for decode; the kernel stays opt-in
+    # ("pallas", bf16 caches only — it reads raw arrays)
+    use_decode_kernel = config.attention_impl == "pallas" and not quantized
     if s == 1 and use_decode_kernel and cache_positions is not None and pallas_ok(config, s, t):
         # decode: single query per row, ragged valid prefix = position + 1
         lengths = cache_positions[:, 0] + 1
@@ -204,10 +254,19 @@ def _dispatch_attention(
         )
         return out[:, None, :]
     if s > 1 and causal and pallas_ok(config, s):
-        # prefill/full forward: causal over the first s cache columns
+        # prefill/full forward: causal over the first s cache columns (int8
+        # caches dequantize just the prompt-wide slice — prefill is
+        # compute-bound, the materialized slice is small)
+        ksl = jax.tree.map(lambda x: x[:, :, :s], k_all)
+        vsl = jax.tree.map(lambda x: x[:, :, :s], v_all)
         return flash_prefill_attention(
-            q, k_all[:, :, :s], v_all[:, :, :s], config, interpret=interpret
+            q,
+            _dequantize_kv(ksl, q.dtype),
+            _dequantize_kv(vsl, q.dtype),
+            config,
+            interpret=interpret,
         )
+    # jnp path handles int8 cache dicts natively (hoisted-scale einsums)
     return attention(q, k_all, v_all, mask, config)
 
 
@@ -314,14 +373,27 @@ def _layer(
 
     new_cache = None
     if cache_kv is not None:
-        ck, cv = cache_kv  # [B, Hkv, T, D] head-major
+        ck, cv = cache_kv  # [B, Hkv, T, D] head-major (maybe int8-quantized)
         # scatter this step's k/v into the cache at cache_positions [B, S]
         hkv = config.n_kv_heads
         bidx = jnp.arange(b)[:, None, None]
         hidx = jnp.arange(hkv)[None, :, None]
         pidx = cache_positions[:, None, :]  # [B, 1, S]
-        ck = ck.at[bidx, hidx, pidx].set(k.transpose(0, 2, 1, 3))
-        cv = cv.at[bidx, hidx, pidx].set(v.transpose(0, 2, 1, 3))
+        kt, vt = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+        if isinstance(ck, dict):  # int8 cache: per-(token, head) scales
+            kq, ks = _quantize_kv(kt)
+            vq, vs = _quantize_kv(vt)
+            ck = {
+                "q": ck["q"].at[bidx, hidx, pidx].set(kq),
+                "s": ck["s"].at[bidx, hidx, pidx].set(ks),
+            }
+            cv = {
+                "q": cv["q"].at[bidx, hidx, pidx].set(vq),
+                "s": cv["s"].at[bidx, hidx, pidx].set(vs),
+            }
+        else:
+            ck = ck.at[bidx, hidx, pidx].set(kt)
+            cv = cv.at[bidx, hidx, pidx].set(vt)
         new_cache = (ck, cv)
         k_all, v_all = ck, cv
     else:
@@ -453,9 +525,20 @@ def encode(
 
 def make_kv_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> KVCache:
     """Head-major cache: [L, B, Hkv, T, D] — (T, D) are the tiled trailing
-    dims, so Pallas kv blocks are (block_k, D) slices with no relayout."""
+    dims, so Pallas kv blocks are (block_k, D) slices with no relayout.
+
+    With ``config.kv_cache_dtype == "int8"`` each k/v entry is an int8 dict
+    ``{"q": int8 [L,B,Hkv,T,D], "s": f32 [L,B,Hkv,T]}`` (per-token per-head
+    symmetric scales; ~2x less decode cache bandwidth).
+    """
     dtype = dtype or _dtype(config)
     shape = (config.n_layers, batch, config.n_kv_heads, max_len, config.resolved_head_dim)
+    if config.kv_cache_dtype == "int8":
+        entry = lambda: {  # noqa: E731
+            "q": jnp.zeros(shape, jnp.int8),
+            "s": jnp.full(shape[:-1], 1e-8 / 127.0, jnp.float32),
+        }
+        return {"k": entry(), "v": entry()}
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -472,7 +555,7 @@ def prefill(
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     sin, cos = _rope_freqs(positions, config)
-    t = cache["k"].shape[3]
+    t = cache_width(cache)
     # causal over the prompt, nothing beyond; cache cols ≥ S are masked out
     q_pos = positions  # [B, S]
     kv_pos = jnp.arange(t)[None, None, :]  # [1, 1, T]
@@ -498,7 +581,7 @@ def decode_step(
 ) -> tuple[jax.Array, KVCache]:
     """One decode step for every active slot → logits [B, V], updated cache."""
     b = tokens.shape[0]
-    t = cache["k"].shape[3]
+    t = cache_width(cache)
     pos2 = positions[:, None]  # [B, 1]
     sin, cos = _rope_freqs(pos2, config)
     kv_pos = jnp.arange(t)[None, None, :]

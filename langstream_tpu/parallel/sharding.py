@@ -50,10 +50,19 @@ def param_specs(config: ModelConfig) -> Params:
     return specs
 
 
-def kv_cache_specs() -> dict[str, P]:
+def _kv_entry_specs(spec: P, quantized: bool):
+    """int8 cache entries are {"q": [L,B,Hkv,T,D], "s": [L,B,Hkv,T]} — the
+    scale tree shards like the values minus the trailing head-dim axis."""
+    if not quantized:
+        return spec
+    return {"q": spec, "s": P(*list(spec)[:-1])}
+
+
+def kv_cache_specs(quantized: bool = False) -> dict:
     # [L, B, Hkv, T, D] head-major — slots on data, kv heads on model
     spec = P(None, "data", "model", None, None)
-    return {"k": spec, "v": spec}
+    entry = _kv_entry_specs(spec, quantized)
+    return {"k": entry, "v": entry}
 
 
 def serving_cache_specs(n_kv_heads: int, mesh: Mesh) -> dict[str, P]:
@@ -67,13 +76,19 @@ def serving_cache_specs(n_kv_heads: int, mesh: Mesh) -> dict[str, P]:
     if model_ways > 1 and n_kv_heads % model_ways == 0:
         spec = P(None, None, "model", None, None)
     else:
-        spec = P()
+        spec = P(None, None, None, None, None)
     return {"k": spec, "v": spec}
 
 
 def shard_serving_cache(cache: dict, mesh: Mesh) -> dict:
-    n_kv_heads = cache["k"].shape[2]
-    return jax.device_put(cache, _named(mesh, serving_cache_specs(n_kv_heads, mesh)))
+    quantized = isinstance(cache["k"], dict)
+    values = cache["k"]["q"] if quantized else cache["k"]
+    specs = serving_cache_specs(values.shape[2], mesh)
+    if quantized:
+        specs = {
+            key: _kv_entry_specs(spec, True) for key, spec in specs.items()
+        }
+    return jax.device_put(cache, _named(mesh, specs))
 
 
 def data_spec() -> P:
@@ -101,7 +116,9 @@ def shard_params(params: Params, mesh: Mesh, config: ModelConfig) -> Params:
 
 
 def shard_kv_cache(cache: dict, mesh: Mesh) -> dict:
-    return jax.device_put(cache, _named(mesh, kv_cache_specs()))
+    return jax.device_put(
+        cache, _named(mesh, kv_cache_specs(quantized=isinstance(cache["k"], dict)))
+    )
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:

@@ -41,6 +41,11 @@ class GenerationRequest:
     options: GenerationOptions
     # called from the engine thread with each new token id (stream path)
     on_token: Optional[Callable[[int], None]] = None
+    # called from the engine thread once, with the final GenerationResult —
+    # lets async callers await completion WITHOUT parking a thread on
+    # result() (the executor-thread-per-request pattern capped agent
+    # fan-out at the thread-pool size)
+    on_done: Optional[Callable[["GenerationResult"], None]] = None
     submitted_at: float = field(default_factory=time.monotonic)
     _done: threading.Event = field(default_factory=threading.Event)
     _result: Optional["GenerationResult"] = None
@@ -52,6 +57,15 @@ class GenerationRequest:
         if self._result.error is not None:
             raise self._result.error
         return self._result
+
+    def _finish(self, result: "GenerationResult") -> None:
+        self._result = result
+        self._done.set()
+        if self.on_done is not None:
+            try:
+                self.on_done(result)
+            except Exception:  # noqa: BLE001 — callback must not kill the loop
+                log.exception("on_done callback failed")
 
 
 @dataclass
@@ -121,15 +135,14 @@ def _make_insert_group():
         are out of bounds (padding rows) are dropped by the scatter."""
 
         def put(big, small):
-            w = small.shape[3]  # [L, B, Hkv, T, D] — T is the bucket width
+            # [L, B, Hkv, T, ...] — T (dim 3) is the bucket width for both
+            # the value arrays and the int8 cache's rank-4 scale arrays
+            w = small.shape[3]
             return big.at[:, slots, :, :w].set(
                 small.astype(big.dtype), mode="drop"
             )
 
-        return {
-            "k": put(cache["k"], local_cache["k"]),
-            "v": put(cache["v"], local_cache["v"]),
-        }
+        return jax.tree.map(put, cache, local_cache)
 
     return insert_group
 
@@ -346,11 +359,10 @@ class ServingEngine:
                 except Exception as e:  # noqa: BLE001 — fail the group, not the engine
                     log.exception("prefill failed for a batch of %d requests", len(sub))
                     for _, request in sub:
-                        request._result = GenerationResult(
+                        request._finish(GenerationResult(
                             tokens=[], finish_reason="error", prompt_tokens=0,
                             ttft_s=0, total_s=0, error=e,
-                        )
-                        request._done.set()
+                        ))
         return entries
 
     def _prefill_group(
@@ -512,14 +524,13 @@ class ServingEngine:
 
         if finished_reason is not None:
             now = time.monotonic()
-            request._result = GenerationResult(
+            request._finish(GenerationResult(
                 tokens=list(slot.generated),
                 finish_reason=finished_reason,
                 prompt_tokens=len(request.prompt_tokens),
                 ttft_s=slot.first_token_at - request.submitted_at,
                 total_s=now - request.submitted_at,
-            )
-            request._done.set()
+            ))
             slot.request = None
             slot.generated = []
             slot.position = 0
@@ -529,19 +540,17 @@ class ServingEngine:
         self._dead = error
         for slot in self._slots:
             if slot.request is not None:
-                slot.request._result = GenerationResult(
+                slot.request._finish(GenerationResult(
                     tokens=[], finish_reason="error", prompt_tokens=0,
                     ttft_s=0, total_s=0, error=error,
-                )
-                slot.request._done.set()
+                ))
                 slot.request = None
         while True:
             try:
                 request = self._queue.get_nowait()
             except queue.Empty:
                 break
-            request._result = GenerationResult(
+            request._finish(GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=error,
-            )
-            request._done.set()
+            ))
