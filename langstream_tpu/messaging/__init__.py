@@ -1,9 +1,10 @@
 """L2 — messaging runtimes (broker transports between agents).
 
-The in-memory broker is the reference implementation (plays the role Kafka
-plays in the reference: SURVEY §2.3); `kafka.py` is an optional runtime gated
-on an installed kafka client. Intra-agent device communication is NOT here —
-that's `parallel/` (ICI collectives), mirroring the reference's L2/L4 split.
+The in-memory broker is the local/default transport; `kafka.py` is a real
+Kafka data plane over a dependency-free asyncio wire-protocol client
+(`kafka_protocol.py`), testable against the protocol-level fake broker
+(`kafka_fake.py`). Intra-agent device communication is NOT here — that's
+`parallel/` (ICI collectives), mirroring the reference's L2/L4 split.
 """
 
 from langstream_tpu.messaging.registry import (

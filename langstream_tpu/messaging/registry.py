@@ -1,8 +1,10 @@
 """Streaming-cluster runtime registry (reference TopicConnectionsRuntimeRegistry).
 
 Maps `instance.streamingCluster.type` → TopicConnectionsRuntime. The kafka
-runtime registers itself only when a client library is importable (the image
-ships none; the memory broker is the default transport).
+runtime is dependency-free (pure-asyncio wire protocol, kafka.py) and always
+registers; pulsar/pravega register only when their client library is
+importable (the image ships neither; the memory broker is the default local
+transport).
 """
 
 from __future__ import annotations
@@ -29,9 +31,9 @@ class TopicConnectionsRuntimeRegistry:
         return factory()
 
     # type → (module, class); these register only when their broker client
-    # library imports (the image ships none of the broker clients)
+    # library is installed (kafka is NOT here — it is dependency-free and
+    # imports unconditionally above)
     _GATED_BUILTINS = (
-        ("kafka", "langstream_tpu.messaging.kafka", "KafkaTopicConnectionsRuntime"),
         ("pulsar", "langstream_tpu.messaging.pulsar", "PulsarTopicConnectionsRuntime"),
         ("pravega", "langstream_tpu.messaging.pravega", "PravegaTopicConnectionsRuntime"),
     )
@@ -46,6 +48,12 @@ class TopicConnectionsRuntimeRegistry:
             from langstream_tpu.messaging.memory import MemoryTopicConnectionsRuntime
 
             cls._factories["memory"] = MemoryTopicConnectionsRuntime
+        if "kafka" not in cls._factories:
+            # dependency-free (stdlib asyncio wire client): import
+            # unconditionally so real regressions surface as tracebacks
+            from langstream_tpu.messaging.kafka import KafkaTopicConnectionsRuntime
+
+            cls._factories["kafka"] = KafkaTopicConnectionsRuntime
         for type_, module_name, class_name in cls._GATED_BUILTINS:
             if type_ in cls._factories:
                 continue

@@ -162,6 +162,8 @@ class LocalApplicationRunner:
             await runner.close()
         if self._service_registry is not None:
             await self._service_registry.close()
+        if self._topic_runtime is not None:
+            await self._topic_runtime.close()
         if self._failed is not None:
             raise RuntimeError(f"application failed: {self._failed}") from self._failed
 

@@ -1,0 +1,400 @@
+"""Kafka runtime over the pure-Python wire protocol, against the
+protocol-level fake broker — the same contract the memory broker passes
+(partitioning, contiguous-prefix commit, restart redelivery, reader
+positions), plus codec round-trips and a full-platform e2e run with
+`streamingCluster.type: kafka`."""
+
+import asyncio
+import json
+
+import pytest
+
+from langstream_tpu.api.record import Header, SimpleRecord
+from langstream_tpu.api.topics import TopicOffsetPosition
+from langstream_tpu.messaging import kafka_protocol as wire
+from langstream_tpu.messaging.kafka import KafkaTopicConnectionsRuntime
+from langstream_tpu.messaging.kafka_fake import FakeKafkaBroker
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert wire.crc32c(b"") == 0
+    assert wire.crc32c(b"123456789") == 0xE3069283
+    assert wire.crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_varint_zigzag_roundtrip():
+    for v in (0, 1, -1, 63, -64, 300, -301, 2**30, -(2**30)):
+        data = wire.Writer().varint(v).build()
+        assert wire.Reader(data).varint() == v
+
+
+def test_record_batch_roundtrip():
+    records = [
+        wire.WireRecord(key=b"k0", value=b"v0", headers=[("h", b"x")], timestamp_ms=1000),
+        wire.WireRecord(key=None, value="résumé".encode(), headers=[], timestamp_ms=1005),
+        wire.WireRecord(key=b"k2", value=None, headers=[("a", b""), ("b", b"2")], timestamp_ms=999),
+    ]
+    data = wire.encode_record_batch(records, base_offset=7)
+    out = wire.decode_record_batches(data)
+    assert [r.offset for r in out] == [7, 8, 9]
+    assert [r.key for r in out] == [b"k0", None, b"k2"]
+    assert [r.value for r in out] == [b"v0", "résumé".encode(), None]
+    assert out[0].headers == [("h", b"x")]
+    assert out[2].headers == [("a", b""), ("b", b"2")]
+    assert [r.timestamp_ms for r in out] == [1000, 1005, 999]
+    # decoder tolerates a truncated trailing batch (broker max_bytes cut)
+    assert len(wire.decode_record_batches(data + data[: len(data) // 2])) == 3
+
+
+# ---------------------------------------------------------------------------
+# runtime contract vs the fake broker
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def kafka(run):
+    """(broker, runtime) against a live fake broker socket."""
+
+    class Ctx:
+        def __init__(self):
+            self.broker = None
+            self.runtime = None
+
+        async def start(self):
+            self.broker = await FakeKafkaBroker().start()
+            self.runtime = KafkaTopicConnectionsRuntime()
+            await self.runtime.init(
+                {"admin": {"bootstrap.servers": self.broker.bootstrap}}
+            )
+            return self.broker, self.runtime
+
+        async def stop(self):
+            if self.runtime:
+                await self.runtime.close()
+            if self.broker:
+                await self.broker.stop()
+
+    return Ctx()
+
+
+def test_publish_and_consume(kafka, run):
+    async def main():
+        broker, rt = await kafka.start()
+        try:
+            consumer = rt.create_consumer("agent-1", "t")
+            await consumer.start()
+            producer = rt.create_producer("agent-1", "t")
+            await producer.start()
+            for i in range(5):
+                await producer.write(SimpleRecord.of(str(i)))
+            records = await consumer.read()
+            assert [r.value for r in records] == ["0", "1", "2", "3", "4"]
+            await consumer.commit(records)
+            assert consumer.get_info()["committed"]["0"] == 5
+            # the commit is broker-side, not just client bookkeeping
+            assert broker.committed[("agent-1", "t", 0)] == 5
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+def test_headers_and_values_roundtrip(kafka, run):
+    async def main():
+        _, rt = await kafka.start()
+        try:
+            consumer = rt.create_consumer("a", "t")
+            await consumer.start()
+            producer = rt.create_producer("a", "t")
+            await producer.start()
+            rec = SimpleRecord(
+                key="k1",
+                value=json.dumps({"q": "hi"}),
+                headers=(Header("session-id", "s1"), Header("n", "2")),
+            )
+            await producer.write(rec)
+            (got,) = await consumer.read()
+            assert got.key == "k1"
+            assert json.loads(got.value) == {"q": "hi"}
+            hdrs = {h.key: h.value for h in got.headers}
+            assert hdrs == {"session-id": "s1", "n": "2"}
+            assert got.origin == "t"
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+def test_contiguous_prefix_commit(kafka, run):
+    async def main():
+        broker, rt = await kafka.start()
+        try:
+            consumer = rt.create_consumer("a", "t")
+            await consumer.start()
+            producer = rt.create_producer("a", "t")
+            await producer.start()
+            for i in range(4):
+                await producer.write(SimpleRecord.of(str(i)))
+            records = await consumer.read()
+            # ack out of order: offsets 1,2 first — committed must stay 0
+            await consumer.commit([records[1], records[2]])
+            assert consumer.get_info()["committed"]["0"] == 0
+            assert broker.committed.get(("a", "t", 0), -1) in (-1, 0)
+            # ack offset 0 — committed jumps over the whole prefix to 3
+            await consumer.commit([records[0]])
+            assert consumer.get_info()["committed"]["0"] == 3
+            assert broker.committed[("a", "t", 0)] == 3
+            await consumer.commit([records[3]])
+            assert broker.committed[("a", "t", 0)] == 4
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+def test_redelivery_after_restart(kafka, run):
+    async def main():
+        _, rt = await kafka.start()
+        try:
+            producer = rt.create_producer("a", "t")
+            await producer.start()
+            for i in range(6):
+                await producer.write(SimpleRecord.of(str(i)))
+
+            consumer = rt.create_consumer("a", "t")
+            await consumer.start()
+            records = await consumer.read()
+            await consumer.commit(records[:3])  # offsets 0..2
+            await consumer.close()
+
+            # a NEW consumer in the same group resumes from the commit
+            consumer2 = rt.create_consumer("a", "t")
+            await consumer2.start()
+            redelivered = await consumer2.read()
+            assert [r.value for r in redelivered] == ["3", "4", "5"]
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+def test_key_partitioning_multi_partition(kafka, run):
+    async def main():
+        _, rt = await kafka.start()
+        try:
+            admin = rt.create_topic_admin()
+            await admin.create_topic("mp", partitions=4)
+            producer = rt.create_producer("a", "mp")
+            await producer.start()
+            for i in range(20):
+                await producer.write(SimpleRecord(key=f"k{i % 5}", value=str(i)))
+            consumer = rt.create_consumer("a", "mp")
+            await consumer.start()
+            assert sorted(consumer.get_info()["assigned-partitions"]) == [0, 1, 2, 3]
+            got = []
+            for _ in range(10):
+                got.extend(await consumer.read())
+                if len(got) >= 20:
+                    break
+            assert len(got) == 20
+            # same key → same partition, order preserved within key
+            by_key: dict = {}
+            for r in got:
+                by_key.setdefault(r.key, []).append(r)
+            for key, recs in by_key.items():
+                assert len({r.partition for r in recs}) == 1
+                values = [int(r.value) for r in recs]
+                assert values == sorted(values)
+            await consumer.commit(got)
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+def test_reader_positions(kafka, run):
+    async def main():
+        _, rt = await kafka.start()
+        try:
+            producer = rt.create_producer("a", "t")
+            await producer.start()
+            for i in range(3):
+                await producer.write(SimpleRecord.of(str(i)))
+
+            earliest = rt.create_reader("t", TopicOffsetPosition(position="earliest"))
+            await earliest.start()
+            result = await earliest.read()
+            assert [r.value for r in result.records] == ["0", "1", "2"]
+            assert result.record_offsets is not None
+            # resume after the SECOND record → only the third redelivers
+            resume = rt.create_reader(
+                "t", TopicOffsetPosition.absolute(result.record_offsets[1])
+            )
+            await resume.start()
+            again = await resume.read()
+            assert [r.value for r in again.records] == ["2"]
+
+            latest = rt.create_reader("t", TopicOffsetPosition(position="latest"))
+            await latest.start()
+            await producer.write(SimpleRecord.of("new"))
+            tail = await latest.read()
+            assert [r.value for r in tail.records] == ["new"]
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+def test_admin_create_delete_exists(kafka, run):
+    async def main():
+        broker, rt = await kafka.start()
+        try:
+            admin = rt.create_topic_admin()
+            assert not await admin.topic_exists("adm")
+            await admin.create_topic("adm", partitions=2)
+            assert await admin.topic_exists("adm")
+            await admin.create_topic("adm", partitions=2)  # idempotent
+            await admin.delete_topic("adm")
+            assert not await admin.topic_exists("adm")
+        finally:
+            await kafka.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# full platform over the kafka wire
+# ---------------------------------------------------------------------------
+
+
+def test_platform_end_to_end_over_kafka(run):
+    """The whole platform (deployer, composite agents, gateway-visible
+    topics) runs with `streamingCluster.type: kafka` against the fake broker
+    socket — nothing in the data plane touches the memory broker."""
+    import yaml
+
+    from langstream_tpu.core.parser import ModelBuilder
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pipeline = """
+module: default
+id: app
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: convert
+    type: document-to-json
+    input: input-topic
+    configuration:
+      text-field: q
+  - name: extract
+    type: compute
+    output: output-topic
+    configuration:
+      fields:
+        - name: value
+          expression: value.q
+"""
+
+    async def main():
+        broker = await FakeKafkaBroker().start()
+        try:
+            import tempfile
+            from pathlib import Path
+
+            app_dir = Path(tempfile.mkdtemp(prefix="kafka-e2e-"))
+            (app_dir / "pipeline.yaml").write_text(pipeline)
+            instance = app_dir / "instance.yaml"
+            instance.write_text(
+                yaml.safe_dump(
+                    {
+                        "instance": {
+                            "streamingCluster": {
+                                "type": "kafka",
+                                "configuration": {
+                                    "admin": {"bootstrap.servers": broker.bootstrap}
+                                },
+                            },
+                            "computeCluster": {"type": "local"},
+                        }
+                    }
+                )
+            )
+            pkg = ModelBuilder.build_application_from_path(app_dir, instance_path=instance)
+            runner = LocalApplicationRunner("app", pkg.application)
+            await runner.deploy()
+            await runner.start()
+            try:
+                await runner.produce("input-topic", "hello kafka")
+                out = await runner.consume("output-topic", n=1, timeout=15)
+                assert out[0].value == "hello kafka"
+                # records actually traversed the wire: the fake broker's log
+                # for both topics is non-empty
+                assert broker.topics["input-topic"][0].next_offset >= 1
+                assert broker.topics["output-topic"][0].next_offset >= 1
+            finally:
+                await runner.stop()
+        finally:
+            await broker.stop()
+
+    run(main())
+
+
+def test_parse_bootstrap_forms():
+    from langstream_tpu.messaging.kafka import _parse_bootstrap
+
+    assert _parse_bootstrap("k0:9092,k1:9093") == [("k0", 9092), ("k1", 9093)]
+    assert _parse_bootstrap("k0") == [("k0", 9092)]
+    assert _parse_bootstrap(" k0:19092 ") == [("k0", 19092)]
+    with pytest.raises(ValueError):
+        _parse_bootstrap("")
+
+
+def test_hot_partition_does_not_starve(kafka, run):
+    """max_records caps a read; the partition rotation must still drain the
+    cold partitions while a hot one stays saturated."""
+
+    # configure a tiny max_records via the runtime config path instead
+    async def main2():
+        _, rt = await kafka.start()
+        try:
+            admin = rt.create_topic_admin()
+            await admin.create_topic("hot", partitions=2)
+            producer = rt.create_producer("a", "hot")
+            await producer.start()
+            # keyed writes: pick keys that land on partitions 0 and 1
+            from langstream_tpu.native import key_partition
+
+            k0 = next(k for k in ("a", "b", "c", "d") if key_partition(k, 2) == 0)
+            k1 = next(k for k in ("a", "b", "c", "d") if key_partition(k, 2) == 1)
+            for i in range(30):
+                await producer.write(SimpleRecord(key=k0, value=f"hot{i}"))
+            for i in range(3):
+                await producer.write(SimpleRecord(key=k1, value=f"cold{i}"))
+            consumer = rt.create_consumer("a", "hot", {"max-records": 8})
+            await consumer.start()
+            seen_cold = 0
+            for _ in range(12):
+                records = await consumer.read()
+                seen_cold += sum(1 for r in records if str(r.value).startswith("cold"))
+                await consumer.commit(records)
+                # keep partition 0 saturated
+                for i in range(10):
+                    await producer.write(SimpleRecord(key=k0, value=f"more{i}"))
+                if seen_cold >= 3:
+                    break
+            assert seen_cold == 3, "cold partition starved"
+            await consumer.close()
+        finally:
+            await kafka.stop()
+
+    run(main2())
