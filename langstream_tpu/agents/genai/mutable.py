@@ -15,22 +15,28 @@ from typing import Any, Optional
 from langstream_tpu.api.record import Header, Record, SimpleRecord
 
 
-def _parse_side(raw: Any) -> tuple[Any, bool]:
-    """Parse a record side (key or value). JSON objects/arrays become dicts/
-    lists (was_json=True → serialised back to JSON on materialise)."""
+def _parse_side(raw: Any) -> tuple[Any, bool, Any]:
+    """Parse a record side (key or value) → (parsed, was_json, avro_schema).
+    JSON objects/arrays become dicts/lists (was_json=True → serialised back
+    to JSON on materialise); Avro values become their JSON-compatible datum
+    with the schema remembered for re-encoding (AvroUtil analog)."""
+    from langstream_tpu.api.avro import AvroValue, datum_to_json
+
+    if isinstance(raw, AvroValue):
+        return datum_to_json(raw.data), False, raw.schema
     if isinstance(raw, (bytes, bytearray)):
         try:
             raw = raw.decode("utf-8")
         except UnicodeDecodeError:
-            return raw, False
+            return raw, False, None
     if isinstance(raw, str):
         s = raw.strip()
         if s.startswith("{") or s.startswith("["):
             try:
-                return json.loads(s), True
+                return json.loads(s), True, None
             except (json.JSONDecodeError, ValueError):
-                return raw, False
-    return raw, False
+                return raw, False, None
+    return raw, False, None
 
 
 @dataclass
@@ -44,13 +50,17 @@ class MutableRecord:
     dropped: bool = False
     _key_was_json: bool = False
     _value_was_json: bool = False
+    # Avro provenance: the side re-encodes under this schema on materialise
+    # (falls back to JSON if the mutated shape no longer fits the schema)
+    _key_avro_schema: Any = None
+    _value_avro_schema: Any = None
 
     @staticmethod
     def from_record(record: Record) -> "MutableRecord":
         from langstream_tpu.runtime.topic_adapters import DESTINATION_HEADER
 
-        key, key_json = _parse_side(record.key)
-        value, value_json = _parse_side(record.value)
+        key, key_json, key_schema = _parse_side(record.key)
+        value, value_json, value_schema = _parse_side(record.value)
         properties = {h.key: h.value for h in record.headers}
         destination = properties.pop(DESTINATION_HEADER, None)
         return MutableRecord(
@@ -62,6 +72,8 @@ class MutableRecord:
             destination_topic=destination,
             _key_was_json=key_json,
             _value_was_json=value_json,
+            _key_avro_schema=key_schema,
+            _value_avro_schema=value_schema,
         )
 
     # -- field-path access ("value", "value.a.b", "key.x", "properties.p",
@@ -155,7 +167,21 @@ class MutableRecord:
 
     # -- materialisation ----------------------------------------------------
 
-    def _serialise(self, side: Any, was_json: bool) -> Any:
+    def _serialise(self, side: Any, was_json: bool, avro_schema: Any) -> Any:
+        if avro_schema is not None:
+            from langstream_tpu.api.avro import AvroError, AvroValue, encode, json_to_datum
+
+            try:
+                # strict: mutated-in fields the schema lacks must NOT be
+                # silently dropped — they force the JSON fallback below
+                datum = json_to_datum(avro_schema, side, strict=True)
+                encode(avro_schema, datum)  # validates the mutated shape
+                return AvroValue(avro_schema, datum)
+            except AvroError:
+                # schema no longer fits (field added/dropped): degrade to JSON
+                if isinstance(side, (dict, list)):
+                    return json.dumps(side)
+                return side
         if was_json and isinstance(side, (dict, list)):
             return json.dumps(side)
         return side
@@ -167,8 +193,10 @@ class MutableRecord:
 
             headers.append(Header(DESTINATION_HEADER, self.destination_topic))
         return SimpleRecord(
-            key=self._serialise(self.key, self._key_was_json),
-            value=self._serialise(self.value, self._value_was_json),
+            key=self._serialise(self.key, self._key_was_json, self._key_avro_schema),
+            value=self._serialise(
+                self.value, self._value_was_json, self._value_avro_schema
+            ),
             headers=tuple(headers),
             origin=self.origin,
             timestamp=self.timestamp,

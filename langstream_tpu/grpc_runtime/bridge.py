@@ -30,7 +30,7 @@ from langstream_tpu.api.agent import (
 )
 from langstream_tpu.api.record import Record
 from langstream_tpu.grpc_runtime import agent_pb2 as pb
-from langstream_tpu.grpc_runtime.convert import from_grpc_record, method, to_grpc_record
+from langstream_tpu.grpc_runtime.convert import SchemaCodec, method
 
 log = logging.getLogger(__name__)
 
@@ -153,6 +153,9 @@ class PythonGrpcServer:
 class _GrpcAgentBase:
     def __init__(self) -> None:
         self.server: Optional[PythonGrpcServer] = None
+        # per-stream schema interning; reset whenever a stream is recreated
+        # (the peer's table dies with its stream/process)
+        self.codec = SchemaCodec()
 
     async def init(self, configuration: dict[str, Any]) -> None:
         class_name = configuration.get("className") or configuration.get("class-name")
@@ -209,6 +212,7 @@ class GrpcAgentProcessor(_GrpcAgentBase, AgentProcessor):
         if self._call is None:
             stub = self.server.stream_stream("process", pb.ProcessorRequest, pb.ProcessorResponse)
             self._call = stub()
+            self.codec.reset()
 
     async def process(self, records: list[Record]) -> list[ProcessorResult]:
         async with self._lock:  # one in-flight batch per stream
@@ -228,11 +232,12 @@ class GrpcAgentProcessor(_GrpcAgentBase, AgentProcessor):
         assert self._call is not None
         by_id: dict[int, Record] = {}
         out = []
+        schemas: list[pb.Schema] = []
         for record in records:
             self._next_id += 1
             by_id[self._next_id] = record
-            out.append(to_grpc_record(record, self._next_id))
-        await self._call.write(pb.ProcessorRequest(records=out))
+            out.append(self.codec.to_grpc_record(record, self._next_id, schemas))
+        await self._call.write(pb.ProcessorRequest(records=out, schemas=schemas))
         results: dict[int, ProcessorResult] = {}
         while len(results) < len(by_id):
             response = await self._call.read()
@@ -243,6 +248,7 @@ class GrpcAgentProcessor(_GrpcAgentBase, AgentProcessor):
                     trailing_metadata=grpc.aio.Metadata(),
                     details="process stream closed by agent",
                 )
+            self.codec.register(response.schemas)
             for result in response.results:
                 source = by_id.get(result.record_id)
                 if source is None:
@@ -253,7 +259,7 @@ class GrpcAgentProcessor(_GrpcAgentBase, AgentProcessor):
                     )
                 else:
                     results[result.record_id] = ProcessorResult.ok(
-                        source, [from_grpc_record(m) for m in result.records]
+                        source, [self.codec.from_grpc_record(m) for m in result.records]
                     )
         self.processed(len(records))
         return [results[rid] for rid in by_id]
@@ -273,6 +279,7 @@ class GrpcAgentSource(_GrpcAgentBase, AgentSource):
         if self._call is None:
             stub = self.server.stream_stream("read", pb.SourceRequest, pb.SourceResponse)
             self._call = stub()
+            self.codec.reset()
 
     async def read(self) -> list[Record]:
         await self._ensure_stream()
@@ -285,9 +292,10 @@ class GrpcAgentSource(_GrpcAgentBase, AgentSource):
         if response is grpc.aio.EOF:
             self._call = None
             return []
+        self.codec.register(response.schemas)
         records = []
         for message in response.records:
-            record = from_grpc_record(message)
+            record = self.codec.from_grpc_record(message)
             self._ids[id(record)] = message.record_id
             records.append(record)
         return records
@@ -328,6 +336,7 @@ class GrpcAgentSink(_GrpcAgentBase, AgentSink):
         if self._call is None:
             stub = self.server.stream_stream("write", pb.SinkRequest, pb.SinkResponse)
             self._call = stub()
+            self.codec.reset()
 
     async def write(self, record: Record) -> None:
         async with self._lock:
@@ -335,8 +344,10 @@ class GrpcAgentSink(_GrpcAgentBase, AgentSink):
             assert self._call is not None
             self._next_id += 1
             try:
+                schemas: list[pb.Schema] = []
+                grpc_record = self.codec.to_grpc_record(record, self._next_id, schemas)
                 await self._call.write(
-                    pb.SinkRequest(record=to_grpc_record(record, self._next_id))
+                    pb.SinkRequest(record=grpc_record, schemas=schemas)
                 )
                 response = await self._call.read()
             except grpc.aio.AioRpcError as e:

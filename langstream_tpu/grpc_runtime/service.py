@@ -35,9 +35,8 @@ from langstream_tpu.grpc_runtime import agent_pb2 as pb
 from langstream_tpu.grpc_runtime.convert import (
     RPCS,
     SERVICE_NAME,
+    SchemaCodec,
     error_text,
-    from_grpc_record,
-    to_grpc_record,
 )
 
 log = logging.getLogger(__name__)
@@ -62,19 +61,20 @@ def load_agent_class(class_name: str, python_path: Optional[str] = None) -> Agen
 
 class _TopicProducerBuffer:
     """Records the agent emits to arbitrary topics; drained by the
-    get_topic_producer_records stream (reference topic_producer path)."""
+    get_topic_producer_records stream (reference topic_producer path).
+    Queued RAW — the draining stream encodes with its own per-stream codec,
+    so a reconnecting consumer always receives the schemas it needs."""
 
     def __init__(self) -> None:
-        self.queue: "asyncio.Queue[pb.TopicProducerRecord]" = asyncio.Queue()
+        self.queue: "asyncio.Queue[tuple[str, Record]]" = asyncio.Queue()
         self._next_id = 0
 
     async def write(self, topic: str, record: Record) -> None:
+        await self.queue.put((topic, record))
+
+    def next_id(self) -> int:
         self._next_id += 1
-        await self.queue.put(
-            pb.TopicProducerRecord(
-                topic=topic, record=to_grpc_record(record, self._next_id)
-            )
-        )
+        return self._next_id
 
 
 class AgentServiceServer:
@@ -119,6 +119,7 @@ class AgentServiceServer:
                         )
 
         consumer = asyncio.ensure_future(handle_requests())
+        codec = SchemaCodec()  # fresh intern table per stream
         try:
             while not consumer.done():
                 records = await agent.read()
@@ -126,11 +127,12 @@ class AgentServiceServer:
                     await asyncio.sleep(0.01)
                     continue
                 out = []
+                schemas: list[pb.Schema] = []
                 for record in records:
                     self._next_record_id += 1
                     self._source_records[self._next_record_id] = record
-                    out.append(to_grpc_record(record, self._next_record_id))
-                yield pb.SourceResponse(records=out)
+                    out.append(codec.to_grpc_record(record, self._next_record_id, schemas))
+                yield pb.SourceResponse(records=out, schemas=schemas)
             # commit-stream ended or failed: propagate errors
             consumer.result()
         finally:
@@ -140,8 +142,10 @@ class AgentServiceServer:
         self, requests: AsyncIterator[pb.ProcessorRequest], context
     ) -> AsyncIterator[pb.ProcessorResponse]:
         assert isinstance(self.agent, AgentProcessor)
+        codec = SchemaCodec()
         async for request in requests:
-            records = [from_grpc_record(m) for m in request.records]
+            codec.register(request.schemas)
+            records = [codec.from_grpc_record(m) for m in request.records]
             ids = [m.record_id for m in request.records]
             try:
                 results = await self.agent.process(records)
@@ -154,6 +158,7 @@ class AgentServiceServer:
                 )
                 continue
             out = []
+            schemas: list[pb.Schema] = []
             for rid, result in zip(ids, results):
                 if result.error is not None:
                     out.append(
@@ -163,19 +168,24 @@ class AgentServiceServer:
                     out.append(
                         pb.ProcessorResult(
                             record_id=rid,
-                            records=[to_grpc_record(r, rid) for r in result.records],
+                            records=[
+                                codec.to_grpc_record(r, rid, schemas)
+                                for r in result.records
+                            ],
                         )
                     )
-            yield pb.ProcessorResponse(results=out)
+            yield pb.ProcessorResponse(results=out, schemas=schemas)
 
     async def write(
         self, requests: AsyncIterator[pb.SinkRequest], context
     ) -> AsyncIterator[pb.SinkResponse]:
         assert isinstance(self.agent, AgentSink)
+        codec = SchemaCodec()
         async for request in requests:
+            codec.register(request.schemas)
             rid = request.record.record_id
             try:
-                await self.agent.write(from_grpc_record(request.record))
+                await self.agent.write(codec.from_grpc_record(request.record))
                 yield pb.SinkResponse(record_id=rid)
             except BaseException as e:  # noqa: BLE001
                 yield pb.SinkResponse(record_id=rid, error=error_text(e))
@@ -188,9 +198,17 @@ class AgentServiceServer:
                 pass  # write acks; failures crash the runtime side
 
         consumer = asyncio.ensure_future(drain_results())
+        codec = SchemaCodec()  # fresh intern table per stream
         try:
             while True:
-                yield await self.topic_producer.queue.get()
+                topic, record = await self.topic_producer.queue.get()
+                schemas: list[pb.Schema] = []
+                grpc_record = codec.to_grpc_record(
+                    record, self.topic_producer.next_id(), schemas
+                )
+                yield pb.TopicProducerRecord(
+                    topic=topic, record=grpc_record, schemas=schemas
+                )
         finally:
             consumer.cancel()
 

@@ -45,6 +45,16 @@ from langstream_tpu.messaging.memory import ConsumedRecord
 from langstream_tpu.native import OffsetTracker, key_partition
 
 
+class OffsetOutOfRange(RuntimeError):
+    """Fetch offset fell outside the partition's log (retention truncated
+    past a committed position); carries where so callers can reset."""
+
+    def __init__(self, topic: str, partition: int) -> None:
+        super().__init__(f"offset out of range for {topic}/{partition}")
+        self.topic = topic
+        self.partition = partition
+
+
 def _parse_bootstrap(bootstrap: str) -> list[tuple[str, int]]:
     """'host1:9092,host2:9093' / 'host' → [(host, port)] (default port 9092)."""
     out: list[tuple[str, int]] = []
@@ -69,6 +79,12 @@ def _encode_datum(v: Any) -> Optional[bytes]:
         return v
     if isinstance(v, str):
         return v.encode()
+    from langstream_tpu.api.avro import AvroValue, datum_to_json
+
+    if isinstance(v, AvroValue):
+        # no schema registry on the wire yet: Avro values degrade to their
+        # JSON datum (in-process paths keep the schema; see api/avro.py)
+        return json.dumps(datum_to_json(v.data), separators=(",", ":")).encode()
     return json.dumps(v, separators=(",", ":")).encode()
 
 
@@ -259,6 +275,8 @@ class KafkaClient:
                 base_offset = r.int64()
                 r.int64()  # log_append_time
                 if err != wire.NONE:
+                    # leader may have moved: evict so the next call re-resolves
+                    self._leaders.pop((topic, partition), None)
                     raise RuntimeError(f"produce to {topic}/{partition}: error {err}")
         r.int32()  # throttle
         return base_offset
@@ -310,7 +328,10 @@ class KafkaClient:
                     r.int64()  # last stable
                     r.array(lambda rr: (rr.int64(), rr.int64()))  # aborted txns
                     data = r.bytes_() or b""
+                    if err == wire.OFFSET_OUT_OF_RANGE:
+                        raise OffsetOutOfRange(topic, partition)
                     if err != wire.NONE:
+                        self._leaders.pop((topic, partition), None)
                         raise RuntimeError(f"fetch {topic}/{partition}: error {err}")
                     want = offsets[(topic, partition)]
                     recs = [
@@ -340,6 +361,7 @@ class KafkaClient:
                 r.int64()  # timestamp
                 offset = r.int64()
                 if err != wire.NONE:
+                    self._leaders.pop((topic, partition), None)
                     raise RuntimeError(f"list_offsets {topic}/{partition}: error {err}")
         return offset
 
@@ -454,7 +476,9 @@ def _to_wire(record: Record) -> wire.WireRecord:
     return wire.WireRecord(
         key=_encode_datum(record.key),
         value=_encode_datum(record.value),
-        headers=[(h.key, _encode_datum(h.value) or b"") for h in record.headers],
+        # None header values stay null on the wire (varint -1) so they
+        # round-trip identically to the memory transport
+        headers=[(h.key, _encode_datum(h.value)) for h in record.headers],
         timestamp_ms=int((record.timestamp or time.time()) * 1000),
     )
 
@@ -500,11 +524,22 @@ class KafkaTopicConsumer(TopicConsumer):
         await self.client.release_fetch_conns(id(self))
 
     async def read(self) -> list[Record]:
-        got = await self.client.fetch(
-            {(self.topic_name, p): self._fetch_pos[p] for p in self._assigned},
-            max_wait_ms=int(self.poll_timeout * 1000),
-            conn_key=id(self),
-        )
+        try:
+            got = await self.client.fetch(
+                {(self.topic_name, p): self._fetch_pos[p] for p in self._assigned},
+                max_wait_ms=int(self.poll_timeout * 1000),
+                conn_key=id(self),
+            )
+        except OffsetOutOfRange as e:
+            # retention truncated past our position: reset to earliest (the
+            # standard auto.offset.reset recovery) and poll again next loop
+            earliest = await self.client.list_offsets(
+                e.topic, e.partition, wire.EARLIEST_TIMESTAMP
+            )
+            self._fetch_pos[e.partition] = earliest
+            self._trackers[e.partition] = OffsetTracker(earliest)
+            self._committed[e.partition] = earliest
+            return []
         # rotate the partition start each read so a hot partition can't
         # starve the others under the max_records cap
         self._rr_start = (self._rr_start + 1) % max(len(self._assigned), 1)
@@ -612,11 +647,17 @@ class KafkaTopicReader(TopicReader):
                 )
 
     async def read(self) -> TopicReadResult:
-        got = await self.client.fetch(
-            {(self.topic_name, p): pos for p, pos in self._pos.items()},
-            max_wait_ms=int(self.poll_timeout * 1000),
-            conn_key=id(self),
-        )
+        try:
+            got = await self.client.fetch(
+                {(self.topic_name, p): pos for p, pos in self._pos.items()},
+                max_wait_ms=int(self.poll_timeout * 1000),
+                conn_key=id(self),
+            )
+        except OffsetOutOfRange as e:
+            self._pos[e.partition] = await self.client.list_offsets(
+                e.topic, e.partition, wire.EARLIEST_TIMESTAMP
+            )
+            return TopicReadResult([], dict(self._pos), record_offsets=[])
         out: list[Record] = []
         offsets: list[dict[int, int]] = []
         for (topic, partition), recs in sorted(got.items()):

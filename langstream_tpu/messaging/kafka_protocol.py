@@ -55,30 +55,9 @@ EARLIEST_TIMESTAMP = -2
 LATEST_TIMESTAMP = -1
 
 
-# ---------------------------------------------------------------------------
-# CRC32C (Castagnoli, reflected poly 0x82F63B78) — record batch v2 checksum
-# ---------------------------------------------------------------------------
-
-
-def _make_crc32c_table() -> list[int]:
-    table = []
-    for n in range(256):
-        c = n
-        for _ in range(8):
-            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
-        table.append(c)
-    return table
-
-
-_CRC32C_TABLE = _make_crc32c_table()
-
-
-def crc32c(data: bytes) -> int:
-    crc = 0xFFFFFFFF
-    table = _CRC32C_TABLE
-    for b in data:
-        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
+# CRC32C (Castagnoli) — record batch v2 checksum; C++ on the produce hot
+# path with a pure-Python fallback (langstream_tpu.native)
+from langstream_tpu.native import crc32c  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,8 @@ What lives here and why it's native:
   disagree on key→partition placement and break per-key ordering.
 - ``utf8_valid_prefix_len`` — longest valid UTF-8 prefix, for incremental
   detokenization of streamed completion chunks.
+- ``crc32c`` — Kafka record-batch v2 checksum on the produce hot path
+  (messaging.kafka_protocol).
 """
 
 from __future__ import annotations
@@ -40,6 +42,27 @@ class PyOffsetTracker:
     @property
     def pending_count(self) -> int:
         return len(self._pending)
+
+def _make_crc32c_table() -> list:
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _make_crc32c_table()
+
+
+def py_crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in bytes(data):
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
 
 def py_fnv1a64(data: bytes) -> int:
     h = 14695981039346656037
@@ -131,6 +154,7 @@ def py_utf8_incomplete_tail_len(data: bytes) -> int:
 try:  # pragma: no cover — exercised when `make -C native` has run
     from langstream_tpu._lsnative import (  # type: ignore[import-not-found]
         OffsetTracker,
+        crc32c,
         fnv1a64,
         utf8_incomplete_tail_len,
         utf8_valid_prefix_len,
@@ -140,6 +164,7 @@ try:  # pragma: no cover — exercised when `make -C native` has run
 except ImportError:
     OffsetTracker = PyOffsetTracker  # type: ignore[assignment,misc]
     fnv1a64 = py_fnv1a64
+    crc32c = py_crc32c
     utf8_valid_prefix_len = py_utf8_valid_prefix_len
     utf8_incomplete_tail_len = py_utf8_incomplete_tail_len
     NATIVE = False

@@ -121,6 +121,40 @@ static PyObject *py_fnv1a64(PyObject *self, PyObject *arg) {
 }
 
 // ---------------------------------------------------------------------------
+// crc32c (Castagnoli, reflected 0x82F63B78) — Kafka record-batch checksum on
+// the produce hot path (kafka_protocol.encode_record_batch)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[256];
+static bool crc32c_ready = false;
+
+static void crc32c_init() {
+    for (uint32_t n = 0; n < 256; n++) {
+        uint32_t c = n;
+        for (int k = 0; k < 8; k++) {
+            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+        }
+        crc32c_table[n] = c;
+    }
+    crc32c_ready = true;
+}
+
+static PyObject *py_crc32c(PyObject *self, PyObject *arg) {
+    if (!crc32c_ready) crc32c_init();
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) {
+        return nullptr;
+    }
+    const unsigned char *data = (const unsigned char *)view.buf;
+    uint32_t crc = 0xFFFFFFFFu;
+    for (Py_ssize_t i = 0; i < view.len; i++) {
+        crc = crc32c_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+    }
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLong(crc ^ 0xFFFFFFFFu);
+}
+
+// ---------------------------------------------------------------------------
 // utf8 helpers (STRICT — match CPython's utf-8 codec: no overlongs, no
 // surrogates, nothing above U+10FFFF)
 // ---------------------------------------------------------------------------
@@ -220,6 +254,8 @@ static PyObject *py_utf8_incomplete_tail_len(PyObject *self, PyObject *arg) {
 static PyMethodDef module_methods[] = {
     {"fnv1a64", py_fnv1a64, METH_O,
      "Stable 64-bit FNV-1a hash of a bytes-like object."},
+    {"crc32c", py_crc32c, METH_O,
+     "CRC-32C (Castagnoli) of a bytes-like object."},
     {"utf8_valid_prefix_len", py_utf8_valid_prefix_len, METH_O,
      "Length of the longest strictly-valid UTF-8 prefix of a bytes-like object."},
     {"utf8_incomplete_tail_len", py_utf8_incomplete_tail_len, METH_O,

@@ -59,3 +59,17 @@ class FileSink(AgentSink):
     async def write(self, record: Record) -> None:
         with open(self.path, "a") as f:
             f.write(f"{record.value}\n")
+
+
+class AvroAgeBump(SingleRecordProcessor):
+    """Receives an AvroValue record, bumps a field, returns it with the SAME
+    schema — exercises the interned-schema path over the wire."""
+
+    async def process_record(self, record: Record) -> list[Record]:
+        from langstream_tpu.api.avro import AvroValue
+
+        value = record.value
+        assert isinstance(value, AvroValue), f"expected AvroValue, got {type(value)}"
+        data = dict(value.data)
+        data["age"] = data["age"] + 1
+        return [SimpleRecord.of(AvroValue(value.schema, data), key=record.key)]

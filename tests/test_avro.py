@@ -1,0 +1,245 @@
+"""Avro: binary codec round-trips against reference-shaped schemas, schema
+interning over the gRPC agent wire, and Avro↔JSON in MutableRecord
+(reference AvroUtil + agent.proto:37-48 parity)."""
+
+import grpc
+import pytest
+
+from langstream_tpu.api import avro
+from langstream_tpu.api.avro import AvroError, AvroValue, parse_schema
+from langstream_tpu.api.record import SimpleRecord
+
+USER_SCHEMA = """
+{
+  "type": "record", "name": "User", "namespace": "com.example",
+  "fields": [
+    {"name": "name", "type": "string"},
+    {"name": "age", "type": "int"},
+    {"name": "email", "type": ["null", "string"], "default": null},
+    {"name": "score", "type": "double"},
+    {"name": "tags", "type": {"type": "array", "items": "string"}},
+    {"name": "attrs", "type": {"type": "map", "values": "long"}},
+    {"name": "kind", "type": {"type": "enum", "name": "Kind",
+                              "symbols": ["FREE", "PRO"]}},
+    {"name": "blob", "type": "bytes"},
+    {"name": "digest", "type": {"type": "fixed", "name": "MD5", "size": 4}}
+  ]
+}
+"""
+
+USER = {
+    "name": "ada",
+    "age": 36,
+    "email": "ada@example.com",
+    "score": 0.75,
+    "tags": ["x", "y"],
+    "attrs": {"logins": 9},
+    "kind": "PRO",
+    "blob": b"\x00\xff",
+    "digest": b"abcd",
+}
+
+
+def test_record_roundtrip():
+    schema = parse_schema(USER_SCHEMA)
+    data = avro.encode(schema, USER)
+    assert avro.decode(schema, data) == USER
+
+
+def test_union_null_branch_and_default():
+    schema = parse_schema(USER_SCHEMA)
+    user = dict(USER)
+    del user["email"]  # default null applies
+    out = avro.decode(schema, avro.encode(schema, user))
+    assert out["email"] is None
+
+
+def test_primitives_and_negative_zigzag():
+    for typ, values in {
+        "long": [0, -1, 1, 2**40, -(2**40)],
+        "int": [0, -64, 8191],
+        "string": ["", "héllo"],
+        "boolean": [True, False],
+        "double": [0.5, -2.25],
+        "bytes": [b"", b"\x80\x81"],
+    }.items():
+        schema = parse_schema(typ)
+        for v in values:
+            assert avro.decode(schema, avro.encode(schema, v)) == v
+
+
+def test_recursive_schema():
+    schema = parse_schema(
+        """
+        {"type": "record", "name": "Node", "fields": [
+          {"name": "v", "type": "int"},
+          {"name": "next", "type": ["null", "Node"], "default": null}
+        ]}
+        """
+    )
+    datum = {"v": 1, "next": {"v": 2, "next": None}}
+    assert avro.decode(schema, avro.encode(schema, datum)) == datum
+
+
+def test_nested_record_and_errors():
+    schema = parse_schema(
+        """
+        {"type": "record", "name": "Outer", "fields": [
+          {"name": "inner", "type": {"type": "record", "name": "Inner",
+            "fields": [{"name": "x", "type": "long"}]}}
+        ]}
+        """
+    )
+    datum = {"inner": {"x": 7}}
+    assert avro.decode(schema, avro.encode(schema, datum)) == datum
+    with pytest.raises(AvroError):
+        avro.encode(schema, {"inner": {}})  # missing field, no default
+    with pytest.raises(AvroError):
+        parse_schema('{"type": "record", "name": "B", "fields": '
+                     '[{"name": "r", "type": "Missing"}]}')
+
+
+def test_canonical_fingerprint_stable_and_distinct():
+    a1 = parse_schema(USER_SCHEMA)
+    # same schema with extraneous attributes and different key order
+    a2 = parse_schema(USER_SCHEMA.replace('"type": "record",', '"doc": "d", "type": "record",'))
+    b = parse_schema('{"type": "record", "name": "Other", "fields": []}')
+    assert a1.canonical() == a2.canonical()
+    assert a1.fingerprint() == a2.fingerprint()
+    assert a1.fingerprint() != b.fingerprint()
+
+
+def test_json_datum_helpers():
+    schema = parse_schema(USER_SCHEMA)
+    j = avro.datum_to_json(USER)
+    assert j["blob"] == "\x00ÿ"
+    back = avro.json_to_datum(schema, j)
+    assert back == USER
+
+
+# ---------------------------------------------------------------------------
+# gRPC interning
+# ---------------------------------------------------------------------------
+
+
+def test_schema_codec_interns_once():
+    from langstream_tpu.grpc_runtime.convert import SchemaCodec
+
+    sender, receiver = SchemaCodec(), SchemaCodec()
+    schema = parse_schema(USER_SCHEMA)
+    av = AvroValue(schema, USER)
+
+    new1: list = []
+    v1 = sender.to_value(av, new1)
+    new2: list = []
+    v2 = sender.to_value(av, new2)
+    assert len(new1) == 1 and not new2  # schema shipped exactly once
+    assert v1.schema_id == v2.schema_id
+
+    receiver.register(new1)
+    out = receiver.from_value(v2)
+    assert isinstance(out, AvroValue)
+    assert out.data == USER
+    # unknown schema id is an explicit error, not silent garbage
+    with pytest.raises(ValueError):
+        SchemaCodec().from_value(v1)
+
+
+def test_avro_over_grpc_subprocess_wire(run):
+    """AvroValues cross the real gRPC boundary: schema interned per stream,
+    datum decoded in the agent subprocess-side server, re-interned on the
+    way back."""
+    from pathlib import Path
+
+    from langstream_tpu.grpc_runtime import agent_pb2 as pb
+    from langstream_tpu.grpc_runtime.convert import SchemaCodec, method
+    from langstream_tpu.grpc_runtime.service import AgentServiceServer, load_agent_class
+
+    tests_dir = str(Path(__file__).parent)
+
+    async def scenario():
+        agent = load_agent_class("grpc_user_agents.AvroAgeBump", tests_dir)
+        server = AgentServiceServer(agent, {})
+        port = await server.start()
+        channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+        stub = channel.stream_stream(
+            method("process"),
+            request_serializer=pb.ProcessorRequest.SerializeToString,
+            response_deserializer=pb.ProcessorResponse.FromString,
+        )
+        call = stub()
+        codec = SchemaCodec()
+        schema = parse_schema(USER_SCHEMA)
+        try:
+            for i in (1, 2):
+                schemas: list = []
+                rec = codec.to_grpc_record(
+                    SimpleRecord.of(AvroValue(schema, USER)), i, schemas
+                )
+                assert bool(schemas) == (i == 1)  # interned on first send only
+                await call.write(pb.ProcessorRequest(records=[rec], schemas=schemas))
+                response = await call.read()
+                codec.register(response.schemas)
+                (result,) = response.results
+                assert not result.HasField("error"), result.error
+                out = codec.from_grpc_record(result.records[0]).value
+                assert isinstance(out, AvroValue)
+                assert out.data["age"] == USER["age"] + 1
+                assert out.data["name"] == "ada"
+        finally:
+            await call.done_writing()
+            await channel.close()
+            await server.stop()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# MutableRecord Avro↔JSON
+# ---------------------------------------------------------------------------
+
+
+def test_mutable_record_preserves_avro_schema():
+    from langstream_tpu.agents.genai.mutable import MutableRecord
+
+    schema = parse_schema(USER_SCHEMA)
+    record = SimpleRecord.of(AvroValue(schema, USER))
+    mutable = MutableRecord.from_record(record)
+    # steps see the JSON-compatible datum
+    assert mutable.get_field("value.name") == "ada"
+    mutable.set_field("value.age", 37)
+    out = mutable.to_record()
+    assert isinstance(out.value, AvroValue)
+    assert out.value.data["age"] == 37
+    assert out.value.schema.canonical() == schema.canonical()
+
+
+def test_mutable_record_avro_falls_back_to_json_when_shape_changes():
+    import json
+
+    from langstream_tpu.agents.genai.mutable import MutableRecord
+
+    schema = parse_schema(USER_SCHEMA)
+    record = SimpleRecord.of(AvroValue(schema, USER))
+    mutable = MutableRecord.from_record(record)
+    mutable.set_field("value.brand_new_field", "x")
+    mutable.drop_field("value.age")
+    out = mutable.to_record()
+    # the schema no longer fits — value degrades to a JSON document
+    assert isinstance(out.value, str)
+    assert json.loads(out.value)["brand_new_field"] == "x"
+
+
+def test_mutable_record_added_field_alone_forces_json_fallback():
+    """A mutated-in field the schema lacks must not be silently dropped."""
+    import json
+
+    from langstream_tpu.agents.genai.mutable import MutableRecord
+
+    schema = parse_schema(USER_SCHEMA)
+    record = SimpleRecord.of(AvroValue(schema, USER))
+    mutable = MutableRecord.from_record(record)
+    mutable.set_field("value.extra", "kept")  # all schema fields still present
+    out = mutable.to_record()
+    assert isinstance(out.value, str)
+    assert json.loads(out.value)["extra"] == "kept"
