@@ -1,0 +1,189 @@
+"""JWT verification shared by the gateway and the control plane.
+
+Parity: reference ``langstream-auth-jwt`` (AuthenticationProviderToken +
+JwksUriSigningKeyResolver.java) — HS256 via a shared secret, RS256 via a
+configured PEM public key, or RS256 via a JWKS endpoint resolved by ``kid``
+with caching. RSA signature verification uses the installed ``cryptography``
+package.
+
+Configuration keys (all providers pick the first that applies):
+  secret-key        HS256 shared secret
+  public-key        RS256 PEM public key (inline, ``-----BEGIN ...``)
+  jwks-uri          RS256 JWKS endpoint; keys cached, refreshed on unknown kid
+  audience / issuer optional claim checks (audience accepts list claims)
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Any, Optional
+
+
+class JwtError(ValueError):
+    pass
+
+
+def _b64d(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def decode_unverified(token: str) -> tuple[dict, dict, bytes, bytes]:
+    """(header, payload, signature, signed_bytes) — no verification."""
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64d(header_b64))
+        payload = json.loads(_b64d(payload_b64))
+        signature = _b64d(sig_b64)
+    except Exception as e:  # noqa: BLE001 — any malformation is the same error
+        raise JwtError(f"malformed JWT: {e}") from e
+    return header, payload, signature, f"{header_b64}.{payload_b64}".encode()
+
+
+def _rsa_key_from_jwk(jwk: dict):
+    from cryptography.hazmat.primitives.asymmetric.rsa import RSAPublicNumbers
+
+    n = int.from_bytes(_b64d(jwk["n"]), "big")
+    e = int.from_bytes(_b64d(jwk["e"]), "big")
+    return RSAPublicNumbers(e, n).public_key()
+
+
+def _rsa_key_from_pem(pem: str):
+    from cryptography.hazmat.primitives.asymmetric.rsa import RSAPublicKey
+    from cryptography.hazmat.primitives.serialization import load_pem_public_key
+
+    key = load_pem_public_key(pem.encode())
+    if not isinstance(key, RSAPublicKey):
+        # fail fast at CONFIG time: an EC/Ed25519 key would otherwise raise
+        # TypeError on every RS256 verify call
+        raise ValueError(
+            f"public-key must be an RSA public key, got {type(key).__name__}"
+        )
+    return key
+
+
+def _verify_rs256(key, signature: bytes, signed: bytes) -> bool:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import padding
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    try:
+        key.verify(signature, signed, padding.PKCS1v15(), SHA256())
+        return True
+    except InvalidSignature:
+        return False
+
+
+class JwtVerifier:
+    """Verifies bearer JWTs per the configuration (see module docstring)."""
+
+    def __init__(self, configuration: dict[str, Any]) -> None:
+        self._secret: Optional[str] = configuration.get("secret-key")
+        self._public_key_pem: Optional[str] = configuration.get("public-key")
+        self._jwks_uri: Optional[str] = configuration.get("jwks-uri")
+        self._audience = configuration.get("audience")
+        self._issuer = configuration.get("issuer")
+        if not (self._secret or self._public_key_pem or self._jwks_uri):
+            raise ValueError(
+                "jwt verification requires one of secret-key / public-key / jwks-uri"
+            )
+        self._pem_key = (
+            _rsa_key_from_pem(self._public_key_pem) if self._public_key_pem else None
+        )
+        self._jwks_keys: dict[str, Any] = {}  # kid → rsa public key
+
+    async def _resolve_jwks_key(self, kid: Optional[str]):
+        """kid → key, fetching/refreshing the JWKS on a miss
+        (JwksUriSigningKeyResolver semantics)."""
+        if kid in self._jwks_keys:
+            return self._jwks_keys[kid]
+        import asyncio
+
+        import aiohttp
+
+        assert self._jwks_uri is not None
+        try:
+            timeout = aiohttp.ClientTimeout(total=10)
+            async with aiohttp.ClientSession(timeout=timeout) as session:
+                async with session.get(self._jwks_uri) as resp:
+                    if resp.status != 200:
+                        raise JwtError(f"jwks fetch failed: HTTP {resp.status}")
+                    doc = await resp.json(content_type=None)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            # network faults must fail AUTH, not escape as raw exceptions
+            raise JwtError(f"jwks fetch failed: {e}") from e
+        for jwk in doc.get("keys", []):
+            if jwk.get("kty") == "RSA":
+                self._jwks_keys[jwk.get("kid")] = _rsa_key_from_jwk(jwk)
+        if kid not in self._jwks_keys:
+            if kid is None and len(self._jwks_keys) == 1:
+                # kid-less issuer with a single key: cache under None so the
+                # hot path stops refetching the document per verification
+                self._jwks_keys[None] = next(iter(self._jwks_keys.values()))
+                return self._jwks_keys[None]
+            raise JwtError(f"no JWKS key for kid {kid!r}")
+        return self._jwks_keys[kid]
+
+    async def verify(self, token: str) -> dict[str, Any]:
+        """Returns the validated claims; raises JwtError otherwise."""
+        header, payload, signature, signed = decode_unverified(token)
+        alg = header.get("alg")
+        if alg == "HS256":
+            if not self._secret:
+                raise JwtError("HS256 token but no secret-key configured")
+            expected = hmac.new(self._secret.encode(), signed, hashlib.sha256).digest()
+            if not hmac.compare_digest(signature, expected):
+                raise JwtError("bad signature")
+        elif alg == "RS256":
+            if self._pem_key is not None:
+                key = self._pem_key
+            elif self._jwks_uri:
+                key = await self._resolve_jwks_key(header.get("kid"))
+            else:
+                raise JwtError("RS256 token but no public-key / jwks-uri configured")
+            if not _verify_rs256(key, signature, signed):
+                raise JwtError("bad signature")
+        else:
+            raise JwtError(f"unsupported alg {alg!r}")
+
+        now = time.time()
+
+        def numeric(claim: str) -> Optional[float]:
+            if claim not in payload:
+                return None
+            try:
+                return float(payload[claim])
+            except (TypeError, ValueError) as e:
+                raise JwtError(f"non-numeric {claim} claim") from e
+
+        exp, nbf = numeric("exp"), numeric("nbf")
+        if exp is not None and now > exp:
+            raise JwtError("token expired")
+        if nbf is not None and now < nbf:
+            raise JwtError("token not yet valid")
+        if self._audience is not None:
+            aud = payload.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self._audience not in auds:
+                raise JwtError("bad audience")
+        if self._issuer is not None:
+            issuers = (
+                self._issuer if isinstance(self._issuer, list) else [self._issuer]
+            )
+            if payload.get("iss") not in issuers:
+                raise JwtError("bad issuer")
+        return payload
+
+
+def claims_to_principal(payload: dict[str, Any]) -> dict[str, str]:
+    """Flatten string-ish claims into principal values for header mappings
+    and consume filters (value-from-authentication)."""
+    values = {
+        k: str(v) for k, v in payload.items() if isinstance(v, (str, int, float))
+    }
+    if "sub" in payload:
+        values.setdefault("subject", str(payload["sub"]))
+    return values

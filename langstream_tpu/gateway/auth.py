@@ -1,8 +1,9 @@
 """Gateway authentication SPI + built-in providers.
 
 Parity: reference ``api/gateway/GatewayAuthenticationProvider.java`` and the
-``langstream-api-gateway-auth`` plugin modules (jwt / http webhook / test
-credentials via ``GatewayRequestHandler``).
+``langstream-api-gateway-auth`` plugin modules (jwt incl. RS256/JWKS, http
+webhook, google id-token, github access-token; test credentials via
+``GatewayRequestHandler``).
 
 A gateway declares ``authentication: {provider, configuration,
 allow-test-mode}``; clients pass ``credentials`` (or ``test-credentials``)
@@ -14,11 +15,6 @@ via ``value-from-authentication``.
 from __future__ import annotations
 
 import abc
-import base64
-import hashlib
-import hmac
-import json
-import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -56,52 +52,109 @@ class NoAuthProvider(GatewayAuthenticationProvider):
         return GatewayAuthenticationResult.success()
 
 
-class HmacJwtAuthProvider(GatewayAuthenticationProvider):
-    """HS256 JWT validation (reference auth-jwt AuthenticationProviderToken,
-    dependency-free: RS256/JWKS needs a crypto lib the image doesn't ship).
-
-    configuration: ``secret-key`` (required), ``audience`` / ``issuer``
-    (optional checks).  Principal values = all string claims.
-    """
+class JwtAuthProvider(GatewayAuthenticationProvider):
+    """JWT validation (reference auth-jwt AuthenticationProviderToken +
+    JwksUriSigningKeyResolver): HS256 via ``secret-key``, RS256 via a PEM
+    ``public-key`` or a ``jwks-uri`` resolved by ``kid``; ``audience`` /
+    ``issuer`` optional checks. Principal values = all string claims."""
 
     def initialize(self, configuration: dict[str, Any]) -> None:
-        self._secret = str(configuration.get("secret-key", ""))
-        self._audience = configuration.get("audience")
-        self._issuer = configuration.get("issuer")
-        if not self._secret:
-            raise ValueError("jwt auth requires configuration.secret-key")
+        from langstream_tpu.auth import JwtVerifier
+
+        self._verifier = JwtVerifier(configuration)
 
     async def authenticate(self, credentials: str) -> GatewayAuthenticationResult:
-        try:
-            header_b64, payload_b64, sig_b64 = credentials.split(".")
-        except ValueError:
-            return GatewayAuthenticationResult.failure("malformed JWT")
-
-        def b64d(s: str) -> bytes:
-            return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+        from langstream_tpu.auth import JwtError, claims_to_principal
 
         try:
-            header = json.loads(b64d(header_b64))
-            payload = json.loads(b64d(payload_b64))
-            signature = b64d(sig_b64)
-        except Exception:
-            return GatewayAuthenticationResult.failure("undecodable JWT")
-        if header.get("alg") != "HS256":
-            return GatewayAuthenticationResult.failure("only HS256 supported")
-        expected = hmac.new(
-            self._secret.encode(), f"{header_b64}.{payload_b64}".encode(), hashlib.sha256
-        ).digest()
-        if not hmac.compare_digest(signature, expected):
-            return GatewayAuthenticationResult.failure("bad signature")
-        if "exp" in payload and time.time() > float(payload["exp"]):
-            return GatewayAuthenticationResult.failure("token expired")
-        if self._audience is not None and payload.get("aud") != self._audience:
-            return GatewayAuthenticationResult.failure("bad audience")
-        if self._issuer is not None and payload.get("iss") != self._issuer:
-            return GatewayAuthenticationResult.failure("bad issuer")
-        values = {k: str(v) for k, v in payload.items() if isinstance(v, (str, int, float))}
-        if "sub" in payload:
-            values.setdefault("subject", str(payload["sub"]))
+            payload = await self._verifier.verify(credentials)
+        except JwtError as e:
+            return GatewayAuthenticationResult.failure(str(e))
+        return GatewayAuthenticationResult.success(claims_to_principal(payload))
+
+
+class GoogleAuthProvider(GatewayAuthenticationProvider):
+    """Google sign-in: the credential is a Google ID token, verified RS256
+    against Google's JWKS with the OAuth client id as audience (reference
+    langstream-api-gateway-auth GoogleAuthenticationProvider).
+
+    configuration: ``client-id`` (required); ``certs-uri`` overrides the
+    Google JWKS endpoint (tests point it at a local stub, the reference's
+    WireMock pattern)."""
+
+    GOOGLE_CERTS = "https://www.googleapis.com/oauth2/v3/certs"
+    GOOGLE_ISSUERS = ["https://accounts.google.com", "accounts.google.com"]
+
+    def initialize(self, configuration: dict[str, Any]) -> None:
+        from langstream_tpu.auth import JwtVerifier
+
+        client_id = configuration.get("client-id")
+        if not client_id:
+            raise ValueError("google auth requires configuration.client-id")
+        self._verifier = JwtVerifier(
+            {
+                "jwks-uri": configuration.get("certs-uri", self.GOOGLE_CERTS),
+                "audience": client_id,
+                "issuer": configuration.get("issuer", self.GOOGLE_ISSUERS),
+            }
+        )
+
+    async def authenticate(self, credentials: str) -> GatewayAuthenticationResult:
+        from langstream_tpu.auth import JwtError, claims_to_principal
+
+        try:
+            payload = await self._verifier.verify(credentials)
+        except JwtError as e:
+            return GatewayAuthenticationResult.failure(str(e))
+        values = claims_to_principal(payload)
+        if "email" in payload:
+            values.setdefault("login", str(payload["email"]))
+        return GatewayAuthenticationResult.success(values)
+
+
+class GitHubAuthProvider(GatewayAuthenticationProvider):
+    """GitHub OAuth: the credential is an access token, validated by calling
+    the user API (reference GitHubAuthenticationProvider).
+
+    configuration: ``api-url`` overrides https://api.github.com (local stub
+    in tests); ``allowed-organizations`` optionally restricts access by org
+    membership (checked via /user/orgs)."""
+
+    def initialize(self, configuration: dict[str, Any]) -> None:
+        self._api = str(configuration.get("api-url", "https://api.github.com")).rstrip("/")
+        self._allowed_orgs = set(configuration.get("allowed-organizations", []) or [])
+
+    async def authenticate(self, credentials: str) -> GatewayAuthenticationResult:
+        import aiohttp
+
+        headers = {
+            "Authorization": f"Bearer {credentials}",
+            "Accept": "application/vnd.github+json",
+        }
+        timeout = aiohttp.ClientTimeout(total=10)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(f"{self._api}/user", headers=headers) as resp:
+                if resp.status != 200:
+                    return GatewayAuthenticationResult.failure(
+                        f"github user lookup returned {resp.status}"
+                    )
+                user = await resp.json(content_type=None)
+            if self._allowed_orgs:
+                async with session.get(
+                    f"{self._api}/user/orgs", headers=headers
+                ) as resp:
+                    orgs = await resp.json(content_type=None) if resp.status == 200 else []
+                names = {o.get("login") for o in orgs if isinstance(o, dict)}
+                if not names & self._allowed_orgs:
+                    return GatewayAuthenticationResult.failure(
+                        "user not in an allowed organization"
+                    )
+        values = {
+            k: str(v)
+            for k, v in user.items()
+            if isinstance(v, (str, int)) and k in ("login", "id", "name", "email")
+        }
+        values.setdefault("subject", values.get("login", ""))
         return GatewayAuthenticationResult.success(values)
 
 
@@ -120,7 +173,8 @@ class HttpWebhookAuthProvider(GatewayAuthenticationProvider):
         import aiohttp
 
         url = self._base_url.rstrip("/") + self._path
-        async with aiohttp.ClientSession() as session:
+        timeout = aiohttp.ClientTimeout(total=10)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
             async with session.post(
                 url,
                 headers={"Authorization": f"Bearer {credentials}", **self._headers},
@@ -160,5 +214,7 @@ class GatewayAuthenticationRegistry:
     @classmethod
     def _ensure_builtins(cls) -> None:
         cls._factories.setdefault("none", NoAuthProvider)
-        cls._factories.setdefault("jwt", HmacJwtAuthProvider)
+        cls._factories.setdefault("jwt", JwtAuthProvider)
         cls._factories.setdefault("http", HttpWebhookAuthProvider)
+        cls._factories.setdefault("google", GoogleAuthProvider)
+        cls._factories.setdefault("github", GitHubAuthProvider)

@@ -36,6 +36,20 @@ from langstream_tpu.gateway.auth import GatewayAuthenticationRegistry
 
 log = logging.getLogger(__name__)
 
+# provider instances are cached per (name, config) so per-provider state —
+# notably the JwtVerifier's JWKS kid cache — survives across requests
+# instead of being rebuilt (and refetched) per WS connect / HTTP produce
+_auth_provider_cache: dict = {}
+
+
+def _cached_auth_provider(name: str, configuration: dict):
+    key = (name, json.dumps(configuration or {}, sort_keys=True, default=str))
+    provider = _auth_provider_cache.get(key)
+    if provider is None:
+        provider = GatewayAuthenticationRegistry.load(name, configuration)
+        _auth_provider_cache[key] = provider
+    return provider
+
 class AuthFailedException(Exception):
     pass
 
@@ -143,8 +157,13 @@ async def authenticate_and_validate(
             principal = test_mode_principal_values(credentials)
             principal.update(result.principal_values)
         else:
-            provider = GatewayAuthenticationRegistry.load(auth.provider, auth.configuration)
-            result = await provider.authenticate(credentials)
+            provider = _cached_auth_provider(auth.provider, auth.configuration)
+            try:
+                result = await provider.authenticate(credentials)
+            except Exception as e:  # noqa: BLE001 — IdP outages are auth
+                # failures (401 with a reason), never unhandled 500s
+                log.warning("auth provider %s errored: %s", auth.provider, e)
+                raise AuthFailedException(f"authentication error: {e}") from e
             if not result.authenticated:
                 raise AuthFailedException(result.reason or "authentication failed")
             principal = result.principal_values

@@ -46,12 +46,22 @@ class ControlPlaneServer:
         port: int = 8090,
         auth_token: Optional[str] = None,
         archetypes_path: Optional[str] = None,
+        auth_jwt: Optional[dict] = None,
     ) -> None:
+        """``auth_jwt``: JWT bearer verification config (secret-key /
+        public-key / jwks-uri + audience/issuer — langstream_tpu.auth,
+        reference langstream-auth-jwt on the control plane). May be combined
+        with ``auth_token`` (either credential is accepted)."""
         self.applications = applications
         self.tenants = tenants
         self.host = host
         self.port = port
         self.auth_token = auth_token
+        self.jwt_verifier = None
+        if auth_jwt:
+            from langstream_tpu.auth import JwtVerifier
+
+            self.jwt_verifier = JwtVerifier(auth_jwt)
         self.archetypes_path = Path(archetypes_path) if archetypes_path else None
         self._runner: Optional[web.AppRunner] = None
         self.app = web.Application(middlewares=[self._auth_middleware, self._error_middleware])
@@ -94,11 +104,28 @@ class ControlPlaneServer:
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        if self.auth_token is not None and request.path not in ("/healthz", "/ui"):
+        protected = (self.auth_token is not None or self.jwt_verifier is not None)
+        if protected and request.path not in ("/healthz", "/ui"):
             header = request.headers.get("Authorization", "")
-            if header != f"Bearer {self.auth_token}":
+            if not await self._authorized(header):
                 return web.json_response({"error": "unauthorized"}, status=401)
         return await handler(request)
+
+    async def _authorized(self, header: str) -> bool:
+        if not header.startswith("Bearer "):
+            return False
+        token = header[len("Bearer ") :]
+        if self.auth_token is not None and token == self.auth_token:
+            return True
+        if self.jwt_verifier is not None:
+            from langstream_tpu.auth import JwtError
+
+            try:
+                await self.jwt_verifier.verify(token)
+                return True
+            except JwtError:
+                return False
+        return False
 
     @web.middleware
     async def _error_middleware(self, request: web.Request, handler):

@@ -1,0 +1,315 @@
+"""JWT verification (HS256 / RS256-PEM / JWKS), google + github gateway
+auth providers against local HTTP stubs (the reference's WireMock pattern),
+and control-plane JWT bearer auth."""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.auth import JwtError, JwtVerifier
+
+
+def b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip("=")
+
+
+def make_hs256(payload: dict, secret: str = "s3cret") -> str:
+    header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = b64(json.dumps(payload).encode())
+    sig = b64(hmac.new(secret.encode(), f"{header}.{body}".encode(), hashlib.sha256).digest())
+    return f"{header}.{body}.{sig}"
+
+
+@pytest.fixture(scope="module")
+def rsa_key():
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def make_rs256(payload: dict, key, kid: str = "k1") -> str:
+    from cryptography.hazmat.primitives.asymmetric import padding
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    header = b64(json.dumps({"alg": "RS256", "typ": "JWT", "kid": kid}).encode())
+    body = b64(json.dumps(payload).encode())
+    sig = key.sign(f"{header}.{body}".encode(), padding.PKCS1v15(), SHA256())
+    return f"{header}.{body}.{b64(sig)}"
+
+
+def pem_public(key) -> str:
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    return key.public_key().public_bytes(
+        Encoding.PEM, PublicFormat.SubjectPublicKeyInfo
+    ).decode()
+
+
+def jwk_of(key, kid: str = "k1") -> dict:
+    numbers = key.public_key().public_numbers()
+
+    def be(n: int) -> str:
+        return b64(n.to_bytes((n.bit_length() + 7) // 8, "big"))
+
+    return {"kty": "RSA", "kid": kid, "alg": "RS256", "n": be(numbers.n), "e": be(numbers.e)}
+
+
+async def serve(routes: dict):
+    """Tiny stub server: path → handler or JSON-able object."""
+    app = web.Application()
+
+    def handler_for(value):
+        if callable(value):
+            return value
+
+        async def respond(request):
+            return web.json_response(value)
+
+        return respond
+
+    for path, value in routes.items():
+        app.router.add_get(path, handler_for(value))
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+# ---------------------------------------------------------------------------
+# JwtVerifier
+# ---------------------------------------------------------------------------
+
+
+def test_hs256_verify(run):
+    async def main():
+        verifier = JwtVerifier({"secret-key": "s3cret", "issuer": "me"})
+        claims = await verifier.verify(make_hs256({"sub": "u1", "iss": "me"}))
+        assert claims["sub"] == "u1"
+        with pytest.raises(JwtError, match="bad signature"):
+            await verifier.verify(make_hs256({"sub": "u1", "iss": "me"}, secret="wrong"))
+        with pytest.raises(JwtError, match="bad issuer"):
+            await verifier.verify(make_hs256({"sub": "u1", "iss": "other"}))
+        with pytest.raises(JwtError, match="expired"):
+            await verifier.verify(
+                make_hs256({"sub": "u1", "iss": "me", "exp": time.time() - 10})
+            )
+
+    run(main())
+
+
+def test_rs256_pem_verify(run, rsa_key):
+    async def main():
+        verifier = JwtVerifier({"public-key": pem_public(rsa_key), "audience": "app1"})
+        token = make_rs256({"sub": "u2", "aud": ["app1", "other"]}, rsa_key)
+        claims = await verifier.verify(token)
+        assert claims["sub"] == "u2"
+        # tampered payload fails
+        head, body, sig = token.split(".")
+        tampered = f"{head}.{b64(json.dumps({'sub': 'evil', 'aud': 'app1'}).encode())}.{sig}"
+        with pytest.raises(JwtError, match="bad signature"):
+            await verifier.verify(tampered)
+        with pytest.raises(JwtError, match="bad audience"):
+            await verifier.verify(make_rs256({"sub": "u2", "aud": "zzz"}, rsa_key))
+
+    run(main())
+
+
+def test_jwks_resolution_and_cache(run, rsa_key):
+    calls = {"n": 0}
+
+    async def jwks(request):
+        calls["n"] += 1
+        return web.json_response({"keys": [jwk_of(rsa_key, "kid-9")]})
+
+    async def main():
+        runner, base = await serve({"/certs": jwks})
+        try:
+            verifier = JwtVerifier({"jwks-uri": f"{base}/certs"})
+            token = make_rs256({"sub": "u3"}, rsa_key, kid="kid-9")
+            assert (await verifier.verify(token))["sub"] == "u3"
+            assert (await verifier.verify(token))["sub"] == "u3"
+            assert calls["n"] == 1  # cached by kid after the first fetch
+            with pytest.raises(JwtError, match="no JWKS key"):
+                await verifier.verify(make_rs256({"sub": "x"}, rsa_key, kid="unknown"))
+            assert calls["n"] == 2  # unknown kid forces a refresh
+        finally:
+            await runner.cleanup()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# gateway providers
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_jwt_provider_rs256(run, rsa_key):
+    from langstream_tpu.gateway.auth import GatewayAuthenticationRegistry
+
+    async def main():
+        provider = GatewayAuthenticationRegistry.load(
+            "jwt", {"public-key": pem_public(rsa_key)}
+        )
+        result = await provider.authenticate(make_rs256({"sub": "dev"}, rsa_key))
+        assert result.authenticated
+        assert result.principal_values["subject"] == "dev"
+        bad = await provider.authenticate("not-a-token")
+        assert not bad.authenticated
+
+    run(main())
+
+
+def test_google_provider_against_stub(run, rsa_key):
+    from langstream_tpu.gateway.auth import GatewayAuthenticationRegistry
+
+    async def main():
+        runner, base = await serve({"/certs": {"keys": [jwk_of(rsa_key, "g1")]}})
+        try:
+            provider = GatewayAuthenticationRegistry.load(
+                "google",
+                {"client-id": "client-1", "certs-uri": f"{base}/certs",
+                 "issuer": ["https://accounts.google.com", "accounts.google.com"]},
+            )
+            token = make_rs256(
+                {"sub": "115", "aud": "client-1", "iss": "accounts.google.com",
+                 "email": "dev@example.com"},
+                rsa_key, kid="g1",
+            )
+            result = await provider.authenticate(token)
+            assert result.authenticated, result.reason
+            assert result.principal_values["login"] == "dev@example.com"
+            # wrong audience (another oauth app's token) is rejected
+            wrong = make_rs256(
+                {"sub": "115", "aud": "other", "iss": "accounts.google.com"},
+                rsa_key, kid="g1",
+            )
+            assert not (await provider.authenticate(wrong)).authenticated
+        finally:
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_github_provider_against_stub(run):
+    from langstream_tpu.gateway.auth import GatewayAuthenticationRegistry
+
+    async def user(request):
+        if request.headers.get("Authorization") != "Bearer good-token":
+            return web.json_response({"message": "Bad credentials"}, status=401)
+        return web.json_response({"login": "octo", "id": 77, "name": "Octo Cat"})
+
+    async def orgs(request):
+        return web.json_response([{"login": "my-org"}])
+
+    async def main():
+        runner, base = await serve({"/user": user, "/user/orgs": orgs})
+        try:
+            provider = GatewayAuthenticationRegistry.load("github", {"api-url": base})
+            result = await provider.authenticate("good-token")
+            assert result.authenticated
+            assert result.principal_values["login"] == "octo"
+            assert result.principal_values["subject"] == "octo"
+            assert not (await provider.authenticate("bad")).authenticated
+
+            org_gate = GatewayAuthenticationRegistry.load(
+                "github", {"api-url": base, "allowed-organizations": ["my-org"]}
+            )
+            assert (await org_gate.authenticate("good-token")).authenticated
+            deny = GatewayAuthenticationRegistry.load(
+                "github", {"api-url": base, "allowed-organizations": ["elsewhere"]}
+            )
+            assert not (await deny.authenticate("good-token")).authenticated
+        finally:
+            await runner.cleanup()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# control plane
+# ---------------------------------------------------------------------------
+
+
+def test_webservice_jwt_bearer(run, rsa_key, tmp_path):
+    import aiohttp
+
+    from langstream_tpu.webservice.server import ControlPlaneServer
+    from langstream_tpu.webservice.service import make_local_service
+
+    async def main():
+        applications, tenants, _runtime = make_local_service()
+        server = ControlPlaneServer(
+            applications,
+            tenants,
+            port=0,
+            auth_jwt={"public-key": pem_public(rsa_key)},
+        )
+        await server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/api/tenants") as resp:
+                    assert resp.status == 401
+                token = make_rs256({"sub": "admin"}, rsa_key)
+                headers = {"Authorization": f"Bearer {token}"}
+                async with session.get(f"{base}/api/tenants", headers=headers) as resp:
+                    assert resp.status == 200
+                bad = {"Authorization": "Bearer nope"}
+                async with session.get(f"{base}/api/tenants", headers=bad) as resp:
+                    assert resp.status == 401
+        finally:
+            await server.stop()
+
+    run(main())
+
+
+def test_exp_claim_garbage_is_clean_auth_failure(run):
+    async def main():
+        verifier = JwtVerifier({"secret-key": "s3cret"})
+        with pytest.raises(JwtError, match="non-numeric exp"):
+            await verifier.verify(make_hs256({"sub": "u", "exp": "tomorrow"}))
+
+    run(main())
+
+
+def test_jwks_endpoint_down_is_jwt_error(run, rsa_key):
+    async def main():
+        verifier = JwtVerifier({"jwks-uri": "http://127.0.0.1:9/certs"})
+        with pytest.raises(JwtError, match="jwks fetch failed"):
+            await verifier.verify(make_rs256({"sub": "u"}, rsa_key))
+
+    run(main())
+
+
+def test_non_rsa_public_key_fails_at_config_time():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+    from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+    pem = (
+        Ed25519PrivateKey.generate()
+        .public_key()
+        .public_bytes(Encoding.PEM, PublicFormat.SubjectPublicKeyInfo)
+        .decode()
+    )
+    with pytest.raises(ValueError, match="RSA public key"):
+        JwtVerifier({"public-key": pem})
+
+
+def test_gateway_auth_provider_is_cached():
+    from langstream_tpu.gateway.core import _cached_auth_provider
+
+    a = _cached_auth_provider("jwt", {"secret-key": "x"})
+    b = _cached_auth_provider("jwt", {"secret-key": "x"})
+    c = _cached_auth_provider("jwt", {"secret-key": "y"})
+    assert a is b
+    assert a is not c
