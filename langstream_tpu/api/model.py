@@ -47,11 +47,19 @@ class TpuSpec:
     One agent replica maps to one JAX process group over ``topology`` (e.g.
     "v5e-8"); ``mesh`` names logical axes and sizes, e.g. {"data":1,"model":8}.
     The planner validates that the mesh factorises the topology's chip count.
+
+    ``hosts > 1`` declares a MULTI-HOST slice: the replica is still ONE
+    logical broker consumer, but it spans ``hosts`` pods that form a single
+    ``jax.distributed`` process group (replica-vs-shard distinction, SURVEY
+    §7 — shard parallelism spans pods; replica parallelism multiplies
+    consumers). The k8s factory emits hosts×parallelism StatefulSet pods and
+    the entrypoint derives process_index/coordinator from the pod ordinal.
     """
 
     type: str = "v5e"
     topology: str = "1"  # chips per replica, e.g. "8" or "2x4"
     mesh: dict[str, int] = field(default_factory=dict)
+    hosts: int = 1  # pods (JAX processes) forming one logical replica
 
     @staticmethod
     def normalized_topology(topology: str) -> str:
@@ -71,6 +79,10 @@ class TpuSpec:
                 n *= int(part)
         return max(n, 1)
 
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // max(self.hosts, 1)
+
     @staticmethod
     def from_dict(d: Optional[dict]) -> Optional["TpuSpec"]:
         if d is None:
@@ -79,6 +91,7 @@ class TpuSpec:
             type=str(d.get("type", "v5e")),
             topology=str(d.get("topology", "1")),
             mesh=dict(d.get("mesh", {})),
+            hosts=int(d.get("hosts", 1)),
         )
 
 

@@ -203,7 +203,32 @@ class ClusterRuntime(ComputeClusterRuntime):
     def _validate_tpu_meshes(self, plan: ExecutionPlan) -> None:
         for node in plan.agents.values():
             tpu = node.resources.tpu
-            if tpu is None or not tpu.mesh:
+            if tpu is None:
+                continue
+            if tpu.hosts < 1:
+                raise PlanError(
+                    f"agent '{node.id}': tpu.hosts must be >= 1, got {tpu.hosts}"
+                )
+            if tpu.hosts > 1:
+                # replica-vs-shard (SURVEY §7): a multi-host slice is ONE
+                # logical consumer over hosts pods; chips must split evenly
+                # so every JAX process owns the same local device count
+                if tpu.chips % tpu.hosts != 0:
+                    raise PlanError(
+                        f"agent '{node.id}': topology '{tpu.topology}' has "
+                        f"{tpu.chips} chips, not divisible over {tpu.hosts} hosts"
+                    )
+                if node.resources.resolved_parallelism() > 1:
+                    # one StatefulSet can pin ONE process group to one slice
+                    # (required self-affinity on the slice's node pool);
+                    # several multi-host groups in one set could straddle
+                    # slices — scale by splitting agents instead
+                    raise PlanError(
+                        f"agent '{node.id}': hosts={tpu.hosts} requires "
+                        "parallelism=1 (one multi-host replica per agent; "
+                        "add more agents to scale consumers)"
+                    )
+            if not tpu.mesh:
                 continue
             prod = 1
             for v in tpu.mesh.values():
@@ -212,4 +237,5 @@ class ClusterRuntime(ComputeClusterRuntime):
                 raise PlanError(
                     f"agent '{node.id}': mesh {tpu.mesh} has {prod} devices but "
                     f"topology '{tpu.topology}' provides {tpu.chips} chips"
+                    + (f" across {tpu.hosts} hosts" if tpu.hosts > 1 else "")
                 )

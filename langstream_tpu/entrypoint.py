@@ -69,7 +69,43 @@ async def run_agent_runtime(pod: dict[str, Any]) -> None:
     from langstream_tpu.runtime.http_server import RuntimeHttpServer
     from langstream_tpu.runtime.runner import AgentRunner, SimpleAgentContext
 
+    from langstream_tpu.parallel.multihost import DistributedConfig, bootstrap
+
+    # multi-host replica? join the jax.distributed process group FIRST (must
+    # precede any jax backend touch; parallel/multihost.py for the contract)
+    dist = DistributedConfig.from_env()
+    bootstrap(dist)
+
     node = build_agent_node(pod)
+
+    if dist.is_multihost and not dist.is_leader:
+        # follower host: a mesh worker of its replica's process group — it
+        # must NOT open a broker consumer or any agent machinery ("one
+        # logical consumer, N pods"). It serves /metrics + /info and stays
+        # joined to the group; the leader-broadcast SPMD dispatch for the
+        # serving engine is the documented hardware-untested step
+        # (parallel/multihost.py caveat).
+        metrics = MetricsReporter()
+        http = RuntimeHttpServer(
+            metrics_text=metrics.prometheus_text,
+            agents_info=lambda: [
+                {"agent-id": node.id, "replica": dist.replica_index,
+                 "role": "mesh-worker", "process-index": dist.process_index}
+            ],
+            host=os.environ.get("HTTP_HOST", "0.0.0.0"),
+            port=int(pod.get("httpPort", os.environ.get("HTTP_PORT", "8080"))),
+        )
+        await http.start()
+        log.info(
+            "mesh worker up: %s process %d/%d",
+            node.id, dist.process_index, dist.num_processes,
+        )
+        try:
+            await asyncio.Event().wait()  # crash-only: leader death restarts us
+        finally:
+            await http.stop()
+        return
+
     streaming = pod.get("streamingCluster", {"type": "memory", "configuration": {}})
     topic_runtime = get_topic_connections_runtime(streaming.get("type", "memory"))
     await topic_runtime.init(streaming.get("configuration", {}))
@@ -88,15 +124,20 @@ async def run_agent_runtime(pod: dict[str, Any]) -> None:
     registry = ServiceProviderRegistry(app)
 
     metrics = MetricsReporter()
-    # StatefulSet pods end in "-<ordinal>"; anything else (docker hex ids,
-    # bare hostnames) falls back to replica 0
-    try:
-        replica = int(
-            os.environ.get("REPLICA")
-            or os.environ.get("HOSTNAME", "0").rsplit("-", 1)[-1]
-        )
-    except ValueError:
-        replica = 0
+    if dist.is_multihost:
+        # the pod's ordinal covers hosts × replicas; the broker-facing
+        # replica id is the process GROUP index
+        replica = dist.replica_index
+    else:
+        # StatefulSet pods end in "-<ordinal>"; anything else (docker hex
+        # ids, bare hostnames) falls back to replica 0
+        try:
+            replica = int(
+                os.environ.get("REPLICA")
+                or os.environ.get("HOSTNAME", "0").rsplit("-", 1)[-1]
+            )
+        except ValueError:
+            replica = 0
     state_dir = os.environ.get("PERSISTENT_STATE_DIR", "/persistent-state")
     context = SimpleAgentContext(
         global_agent_id=f"{pod.get('applicationId', 'app')}-{node.id}-{replica}",

@@ -170,6 +170,7 @@ class InProcessJobExecutor:
                     "topology": spec.topology,
                     "chips": spec.chips,
                     "mesh": dict(spec.mesh),
+                    "hosts": spec.hosts,
                 }
             agent = AgentCustomResource(
                 name=name,

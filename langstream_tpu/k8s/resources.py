@@ -25,6 +25,12 @@ TPU_ACCELERATORS = {
     "v6e": "tpu-v6e-slice",
 }
 
+def _coordinator_port() -> int:
+    from langstream_tpu.parallel.multihost import DEFAULT_COORDINATOR_PORT
+
+    return DEFAULT_COORDINATOR_PORT
+
+
 # chip-count → physical topology for v5e/v6e-style 2D slices (GKE label values)
 _DEFAULT_TOPOLOGY = {
     1: "1x1",
@@ -79,12 +85,16 @@ class AgentResourcesFactory:
 
     @staticmethod
     def tpu_scheduling(tpu: dict[str, Any]) -> tuple[dict[str, str], dict[str, str]]:
-        """(node_selector, container_resources) for one TPU slice per replica."""
+        """(node_selector, container_resources). The topology label always
+        names the FULL slice; ``google.com/tpu`` counts each POD's chips —
+        on a multi-host slice (hosts > 1) that is chips/hosts per pod, the
+        GKE multi-host TPU contract."""
         from langstream_tpu.api.model import TpuSpec
 
         gen = str(tpu.get("type", "v5e")).lower()
         accelerator = TPU_ACCELERATORS.get(gen, TPU_ACCELERATORS["v5e"])
         chips = int(tpu.get("chips", 1))
+        hosts = max(int(tpu.get("hosts", 1)), 1)
         # the GKE label value must be the bare NxM form
         topology = TpuSpec.normalized_topology(str(tpu.get("topology", "")))
         if "x" not in topology:
@@ -93,7 +103,7 @@ class AgentResourcesFactory:
             "cloud.google.com/gke-tpu-accelerator": accelerator,
             "cloud.google.com/gke-tpu-topology": topology,
         }
-        resources = {"google.com/tpu": str(chips)}
+        resources = {"google.com/tpu": str(chips // hosts)}
         return node_selector, resources
 
     # -- manifests -----------------------------------------------------------
@@ -131,6 +141,10 @@ class AgentResourcesFactory:
                 "ports": [
                     {"name": "http", "port": 8080},  # /metrics + /info
                     {"name": "service", "port": 8000},  # service agents
+                    {
+                        "name": "coordinator",  # jax.distributed
+                        "port": _coordinator_port(),
+                    },
                 ],
             },
         }
@@ -176,15 +190,36 @@ class AgentResourcesFactory:
                 "volumeMounts": list(volume_mounts),
             }
         ]
+        from langstream_tpu.parallel.multihost import DEFAULT_COORDINATOR_PORT
+
+        hosts = max(int((agent.tpu or {}).get("hosts", 1)), 1)
+        env = [
+            {"name": "POD_CONFIGURATION", "value": "/app-config/pod-configuration"},
+            {"name": "AGENT_ID", "value": agent.agent_id},
+        ]
+        if hosts > 1:
+            # multi-host replica topology (parallel/multihost.py contract):
+            # the entrypoint derives process_index + coordinator DNS from
+            # the pod ordinal, the pods-per-replica count, and the headless
+            # service that fronts this StatefulSet
+            env += [
+                {"name": "LANGSTREAM_TPU_HOSTS", "value": str(hosts)},
+                {"name": "LANGSTREAM_TPU_SERVICE", "value": agent.name},
+                {
+                    "name": "LANGSTREAM_TPU_COORDINATOR_PORT",
+                    "value": str(DEFAULT_COORDINATOR_PORT),
+                },
+                {
+                    "name": "POD_NAME",
+                    "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+                },
+            ]
         container = {
             "name": "runtime",
             "image": self.config.runtime_image,
             "imagePullPolicy": self.config.image_pull_policy,
             "command": ["langstream-tpu-runtime", "agent-runtime"],
-            "env": [
-                {"name": "POD_CONFIGURATION", "value": "/app-config/pod-configuration"},
-                {"name": "AGENT_ID", "value": agent.agent_id},
-            ],
+            "env": env,
             "ports": [{"containerPort": 8080, "name": "http"}],
             "resources": resources,
             "volumeMounts": list(volume_mounts),
@@ -217,6 +252,19 @@ class AgentResourcesFactory:
         }
         if node_selector:
             pod_spec["nodeSelector"] = node_selector
+        if hosts > 1:
+            # all pods of the (single — planner enforces parallelism=1)
+            # process group MUST land on one TPU slice: a GKE multi-host
+            # slice is exactly one node pool, so required self-affinity on
+            # the node-pool topology key pins the group together
+            pod_spec["affinity"]["podAffinity"] = {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": self.labels(agent)},
+                        "topologyKey": "cloud.google.com/gke-nodepool",
+                    }
+                ]
+            }
 
         manifest: dict[str, Any] = {
             "apiVersion": "apps/v1",
@@ -232,10 +280,11 @@ class AgentResourcesFactory:
                 },
             },
             "spec": {
-                # replicas = parallelism (reference :295,:526-556): broker
-                # consumer-group data parallelism; each replica still owns a
-                # full TPU slice (shard parallelism lives INSIDE a replica)
-                "replicas": agent.parallelism,
+                # replicas = parallelism × hosts (diverges from reference
+                # :295,:526-556 by design): parallelism multiplies broker
+                # consumers; hosts are the pods of ONE consumer's multi-host
+                # process group (pods o..o+hosts-1 form replica o//hosts)
+                "replicas": agent.parallelism * hosts,
                 "podManagementPolicy": "Parallel",
                 "serviceName": agent.name,
                 "selector": {"matchLabels": self.labels(agent)},

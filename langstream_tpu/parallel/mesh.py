@@ -27,6 +27,11 @@ def build_mesh(
 
     "model" is innermost so tensor-parallel collectives ride the fastest ICI
     links (scaling-book recipe: contract the heaviest-traffic axis last).
+
+    Multi-host replicas pass the GLOBAL device list (jax.devices() after
+    parallel.multihost.bootstrap) — it is host-major (sorted by
+    process_index, then local id), so contiguous mesh blocks land on one
+    host and the innermost axis rides intra-host ICI.
     """
     devices = list(devices if devices is not None else jax.devices())
     sizes = [int(axes.get(a, 1)) for a in AXIS_ORDER]

@@ -1,0 +1,115 @@
+"""Multi-host bootstrap: one logical agent replica spanning N pods.
+
+The replica-vs-shard distinction (SURVEY §7): ``resources.parallelism``
+multiplies broker CONSUMERS (each pod its own process, its own engine);
+``resources.tpu.hosts > 1`` splits ONE consumer's device mesh across pods
+that form a single ``jax.distributed`` process group over a multi-host TPU
+slice. The reference's analogue is the StatefulSet-per-agent assumption in
+`AgentResourcesFactory.java:526-556` — which this design must diverge from,
+because a JAX multi-host replica needs ordinal-addressed peers and a
+coordinator, not just N interchangeable pods.
+
+Topology wiring (emitted by k8s/resources.py, consumed here):
+  LANGSTREAM_TPU_HOSTS              pods per logical replica (default 1)
+  LANGSTREAM_TPU_SERVICE            headless service for peer DNS
+  LANGSTREAM_TPU_COORDINATOR_PORT   jax.distributed port (default 8476)
+  POD_NAME                          StatefulSet ordinal source (downward API)
+
+Pod ordinal o → process_index = o % hosts, replica_index = o // hosts;
+process 0 of each group is the coordinator AND the only pod that opens the
+broker consumer ("one logical consumer, N pods").
+
+HARDWARE-UNTESTED CAVEAT: no multi-host slice exists in this environment.
+What is validated on the virtual CPU mesh: the ordinal/coordinator math,
+the planner's divisibility rules, the StatefulSet topology, and the
+sharded engine on a mesh built over the full (host-major) device list.
+What is NOT validated: a live ``jax.distributed.initialize`` across
+processes, and the leader-driven SPMD dispatch the serving engine needs so
+follower hosts execute the same jitted programs (design: the leader
+broadcasts each admitted batch's control tuple via
+``multihost_utils.broadcast_one_to_all`` before dispatch; followers replay
+the identical engine step).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class DistributedConfig:
+    """One pod's place in its logical replica's process group."""
+
+    num_processes: int = 1
+    process_index: int = 0
+    replica_index: int = 0
+    coordinator: str = ""  # host:port of process 0 (empty when single-host)
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        """The pod that owns the broker consumer for this replica."""
+        return self.process_index == 0
+
+    @staticmethod
+    def from_env(env: Optional[Mapping[str, str]] = None) -> "DistributedConfig":
+        env = os.environ if env is None else env
+        hosts = int(env.get("LANGSTREAM_TPU_HOSTS", "1") or 1)
+        if hosts <= 1:
+            return DistributedConfig()
+        pod_name = env.get("POD_NAME", "")
+        base, _, tail = pod_name.rpartition("-")
+        if not tail.isdigit():
+            raise ValueError(
+                f"LANGSTREAM_TPU_HOSTS={hosts} requires a StatefulSet POD_NAME "
+                f"with an ordinal suffix, got {pod_name!r}"
+            )
+        ordinal = int(tail)
+        service = env.get("LANGSTREAM_TPU_SERVICE", "")
+        port = int(env.get("LANGSTREAM_TPU_COORDINATOR_PORT", DEFAULT_COORDINATOR_PORT))
+        group_start = (ordinal // hosts) * hosts
+        coordinator_pod = f"{base}-{group_start}"
+        host = f"{coordinator_pod}.{service}" if service else coordinator_pod
+        return DistributedConfig(
+            num_processes=hosts,
+            process_index=ordinal % hosts,
+            replica_index=ordinal // hosts,
+            coordinator=f"{host}:{port}",
+        )
+
+
+def bootstrap(config: DistributedConfig) -> None:
+    """``jax.distributed.initialize`` for a multi-host replica. Must run
+    before the first jax backend touch (entrypoint calls it first thing)."""
+    if not config.is_multihost:
+        return
+    import jax
+
+    log.info(
+        "joining process group: %d/%d via %s (replica %d)",
+        config.process_index,
+        config.num_processes,
+        config.coordinator,
+        config.replica_index,
+    )
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator,
+        num_processes=config.num_processes,
+        process_id=config.process_index,
+    )
+
+
+# Mesh construction for a multi-host replica is parallel.mesh.build_mesh
+# over the GLOBAL device list — jax.devices() after bootstrap() returns all
+# hosts' chips in host-major order, so no separate builder exists (see the
+# ordering note on build_mesh).

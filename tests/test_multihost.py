@@ -1,0 +1,182 @@
+"""Multi-host replica topology: ordinal→process-group math, planner
+replica-vs-shard validation, StatefulSet multi-host manifests, and the
+sharded serving engine on a virtual mesh built the multi-host way.
+
+A live jax.distributed.initialize across processes is hardware-untested
+here (no multi-host slice in the environment) — parallel/multihost.py
+documents the caveat; these tests pin everything that can be validated
+without one."""
+
+import dataclasses
+
+import jax
+import pytest
+
+from langstream_tpu.api.model import TpuSpec
+from langstream_tpu.parallel.mesh import build_mesh
+from langstream_tpu.parallel.multihost import (
+    DEFAULT_COORDINATOR_PORT,
+    DistributedConfig,
+)
+
+
+def env_for(pod: str, hosts: int, service: str = "my-agent") -> dict:
+    return {
+        "LANGSTREAM_TPU_HOSTS": str(hosts),
+        "LANGSTREAM_TPU_SERVICE": service,
+        "POD_NAME": pod,
+    }
+
+
+def test_single_host_default():
+    config = DistributedConfig.from_env({})
+    assert not config.is_multihost
+    assert config.is_leader
+
+
+def test_ordinal_to_process_group():
+    # 2 replicas × 4 hosts: pods 0..3 are replica 0, pods 4..7 replica 1
+    for ordinal, (proc, replica, leader) in {
+        0: (0, 0, True), 1: (1, 0, False), 3: (3, 0, False),
+        4: (0, 1, True), 6: (2, 1, False),
+    }.items():
+        config = DistributedConfig.from_env(env_for(f"app-chat-{ordinal}", 4))
+        assert config.num_processes == 4
+        assert config.process_index == proc
+        assert config.replica_index == replica
+        assert config.is_leader == leader
+        group_start = (ordinal // 4) * 4
+        assert config.coordinator == (
+            f"app-chat-{group_start}.my-agent:{DEFAULT_COORDINATOR_PORT}"
+        )
+
+
+def test_bad_pod_name_rejected():
+    with pytest.raises(ValueError, match="ordinal"):
+        DistributedConfig.from_env({"LANGSTREAM_TPU_HOSTS": "2", "POD_NAME": "nope"})
+
+
+def test_tpu_spec_hosts():
+    spec = TpuSpec.from_dict({"topology": "v5e-16", "hosts": 4, "mesh": {"model": 16}})
+    assert spec.chips == 16
+    assert spec.hosts == 4
+    assert spec.chips_per_host == 4
+
+
+def test_planner_validates_hosts_divisibility():
+    from langstream_tpu.core.parser import ModelBuilder
+    from langstream_tpu.core.planner import ClusterRuntime, PlanError
+
+    def plan_with(tpu_yaml: str):
+        pipeline = f"""
+module: default
+id: app
+topics:
+  - name: "in"
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: chat
+    type: compute
+    input: "in"
+    resources:
+      tpu:
+{tpu_yaml}
+    configuration:
+      fields: []
+"""
+        pkg = ModelBuilder.build_application_from_files(
+            {"pipeline.yaml": pipeline},
+            instance_text="instance:\n  streamingCluster:\n    type: memory\n",
+        )
+        return ClusterRuntime().build_execution_plan("app", pkg.application)
+
+    # 16 chips / 4 hosts: fine
+    plan = plan_with("        topology: v5e-16\n        hosts: 4\n        mesh: {model: 16}")
+    node = next(iter(plan.agents.values()))
+    assert node.resources.tpu.hosts == 4
+
+    # 8 chips / 3 hosts: not divisible
+    with pytest.raises(PlanError, match="not divisible"):
+        plan_with("        topology: v5e-8\n        hosts: 3")
+
+    # mesh must still factor the GLOBAL chip count
+    with pytest.raises(PlanError, match="across 4 hosts"):
+        plan_with("        topology: v5e-16\n        hosts: 4\n        mesh: {model: 4}")
+
+
+def test_statefulset_multihost_topology():
+    from langstream_tpu.k8s.crds import AgentCustomResource
+    from langstream_tpu.k8s.resources import AgentResourcesFactory
+
+    agent = AgentCustomResource(
+        name="app-chat",
+        namespace="ns",
+        tenant="t",
+        agent_id="chat",
+        application_id="app",
+        agent_type="ai-chat-completions",
+        component_type="PROCESSOR",
+        config_secret_ref="app-chat-config",
+        config_checksum="abc",
+        parallelism=1,  # the planner enforces parallelism=1 when hosts > 1
+        tpu={"type": "v5e", "topology": "4x4", "chips": 16, "hosts": 4,
+             "mesh": {"model": 16}},
+    )
+    factory = AgentResourcesFactory()
+    sts = factory.generate_stateful_set(agent)
+    # parallelism × hosts pods: one process group, ordinals 0..3
+    assert sts["spec"]["replicas"] == 4
+    container = sts["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e for e in container["env"]}
+    assert env["LANGSTREAM_TPU_HOSTS"]["value"] == "4"
+    assert env["LANGSTREAM_TPU_SERVICE"]["value"] == "app-chat"
+    assert env["LANGSTREAM_TPU_COORDINATOR_PORT"]["value"] == str(DEFAULT_COORDINATOR_PORT)
+    assert env["POD_NAME"]["valueFrom"]["fieldRef"]["fieldPath"] == "metadata.name"
+    # the process group is pinned to ONE slice: required self-affinity on
+    # the slice's node pool
+    affinity = sts["spec"]["template"]["spec"]["affinity"]["podAffinity"]
+    required = affinity["requiredDuringSchedulingIgnoredDuringExecution"][0]
+    assert required["topologyKey"] == "cloud.google.com/gke-nodepool"
+    # each pod asks for ITS chips; the topology label names the full slice
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+    selector = sts["spec"]["template"]["spec"]["nodeSelector"]
+    assert selector["cloud.google.com/gke-tpu-topology"] == "4x4"
+    # peer DNS + coordinator port ride the headless service
+    svc = factory.generate_headless_service(agent)
+    ports = {p["name"]: p["port"] for p in svc["spec"]["ports"]}
+    assert ports["coordinator"] == 8476
+
+    # single-host agents keep the compact form (replicas = parallelism)
+    agent_single = dataclasses.replace(
+        agent, parallelism=2,
+        tpu={"type": "v5e", "topology": "2x4", "chips": 8},
+    )
+    sts1 = AgentResourcesFactory().generate_stateful_set(agent_single)
+    assert sts1["spec"]["replicas"] == 2
+    env1 = {e["name"] for e in sts1["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "LANGSTREAM_TPU_HOSTS" not in env1
+    assert "podAffinity" not in sts1["spec"]["template"]["spec"]["affinity"]
+
+
+def test_sharded_engine_on_multihost_built_mesh():
+    """The serving engine runs against a mesh constructed exactly as a
+    multi-host replica builds it (global host-major device list) — here the
+    8 virtual CPU devices stand in for 2 hosts × 4 chips."""
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.parallel.sharding import shard_params
+    from langstream_tpu.serving.engine import ServingEngine
+
+    config = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+    mesh = build_mesh({"data": 2, "model": 4})
+    assert mesh.devices.size == 8
+    params = shard_params(init_params(config, jax.random.PRNGKey(0)), mesh, config)
+    engine = ServingEngine(config, params, max_batch=2, max_seq_len=64, mesh=mesh)
+    engine.start()
+    try:
+        result = engine.generate(
+            [5, 6, 7], GenerationOptions(max_new_tokens=4, temperature=0.0), timeout=120
+        )
+        assert len(result.tokens) == 4
+    finally:
+        engine.stop()
