@@ -1,0 +1,35 @@
+"""The three SDK roles (langstream_tpu.api.agent ABCs), each subprocess-
+isolated by the runtime."""
+
+from typing import Any, List
+
+from langstream_tpu.api.agent import AgentSink, AgentSource, SingleRecordProcessor
+from langstream_tpu.api.record import Record, SimpleRecord
+
+
+class CountdownSource(AgentSource):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.remaining = int(configuration.get("count", 5))
+
+    async def read(self) -> List[Record]:
+        if self.remaining <= 0:
+            return []
+        self.remaining -= 1
+        return [SimpleRecord.of(f"tick-{self.remaining}")]
+
+    async def commit(self, records: List[Record]) -> None:
+        pass
+
+
+class Shout(SingleRecordProcessor):
+    async def process_record(self, record: Record) -> List[Record]:
+        return [SimpleRecord.of(str(record.value).upper(), key=record.key)]
+
+
+class FileSink(AgentSink):
+    async def init(self, configuration: dict[str, Any]) -> None:
+        self.path = configuration.get("path", "/tmp/out.txt")
+
+    async def write(self, record: Record) -> None:
+        with open(self.path, "a") as f:
+            f.write(f"{record.value}\n")

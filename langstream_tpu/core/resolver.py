@@ -80,8 +80,15 @@ def resolve_placeholders(application: Application, env: dict[str, str] | None = 
     Secrets themselves and the instance globals are left verbatim (they are the
     sources of truth), mirroring the reference's exclusion list.
     """
+    import dataclasses
+
     context = build_context(application, env)
-    resolved = Application(
+    # dataclasses.replace: fields NOT resolved here (code_directory, any
+    # future addition) carry over automatically instead of silently
+    # dropping — rebuilding field-by-field is what once lost code_directory
+    # and broke python-agent subprocess imports
+    return dataclasses.replace(
+        application,
         modules={
             mid: resolve_value(mod, context) for mid, mod in application.modules.items()
         },
@@ -89,9 +96,5 @@ def resolve_placeholders(application: Application, env: dict[str, str] | None = 
             rid: resolve_value(r, context) for rid, r in application.resources.items()
         },
         assets=[resolve_value(a, context) for a in application.assets],
-        dependencies=list(application.dependencies),
         gateways=[resolve_value(g, context) for g in application.gateways],
-        instance=application.instance,
-        secrets=application.secrets,
     )
-    return resolved

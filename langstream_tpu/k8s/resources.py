@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Any, Optional
 
 from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+from langstream_tpu.parallel.multihost import DEFAULT_COORDINATOR_PORT
 
 # GKE accelerator names per TPU generation (public GKE node-pool labels)
 TPU_ACCELERATORS = {
@@ -24,12 +25,6 @@ TPU_ACCELERATORS = {
     "v5p": "tpu-v5p-slice",
     "v6e": "tpu-v6e-slice",
 }
-
-def _coordinator_port() -> int:
-    from langstream_tpu.parallel.multihost import DEFAULT_COORDINATOR_PORT
-
-    return DEFAULT_COORDINATOR_PORT
-
 
 # chip-count → physical topology for v5e/v6e-style 2D slices (GKE label values)
 _DEFAULT_TOPOLOGY = {
@@ -137,13 +132,17 @@ class AgentResourcesFactory:
             },
             "spec": {
                 "clusterIP": "None",
+                # coordinator DNS must resolve BEFORE pods are Ready —
+                # followers dial process 0 during jax.distributed bootstrap,
+                # which happens ahead of readiness (JobSet does the same)
+                "publishNotReadyAddresses": True,
                 "selector": self.labels(agent),
                 "ports": [
                     {"name": "http", "port": 8080},  # /metrics + /info
                     {"name": "service", "port": 8000},  # service agents
                     {
                         "name": "coordinator",  # jax.distributed
-                        "port": _coordinator_port(),
+                        "port": DEFAULT_COORDINATOR_PORT,
                     },
                 ],
             },
@@ -190,8 +189,6 @@ class AgentResourcesFactory:
                 "volumeMounts": list(volume_mounts),
             }
         ]
-        from langstream_tpu.parallel.multihost import DEFAULT_COORDINATOR_PORT
-
         hosts = max(int((agent.tpu or {}).get("hosts", 1)), 1)
         env = [
             {"name": "POD_CONFIGURATION", "value": "/app-config/pod-configuration"},
@@ -229,6 +226,16 @@ class AgentResourcesFactory:
                 "periodSeconds": 30,
             },
         }
+        if hosts > 1:
+            # group formation blocks in jax.distributed.initialize (no HTTP
+            # listener yet) until every peer's node exists — without a
+            # startup probe the liveness probe would kill pods ~100s in and
+            # the group could thrash forever while nodes provision
+            container["startupProbe"] = {
+                "httpGet": {"path": "/info", "port": 8080},
+                "periodSeconds": 10,
+                "failureThreshold": 60,  # up to 10 min of slice provisioning
+            }
         pod_spec: dict[str, Any] = {
             "serviceAccountName": f"langstream-agent-{agent.tenant}",
             "terminationGracePeriodSeconds": 60,

@@ -88,3 +88,117 @@ def test_shipped_archetype_parses():
 
     meta = yaml.safe_load((arch / "archetype.yaml").read_text())
     assert meta["archetype"]["title"]
+
+
+def _load(app_name: str):
+    pkg = ModelBuilder.build_application_from_path(
+        EXAMPLES / "applications" / app_name, instance_path=INSTANCE,
+        secrets_path=SECRETS,
+    )
+    return resolve_placeholders(pkg.application)
+
+
+def test_text_processing_end_to_end(run):
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    async def scenario():
+        runner = LocalApplicationRunner("textproc", _load("text-processing"))
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce("raw-docs", "  Hello World. This is Fine.  ")
+            out = await runner.consume("clean-chunks", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert "hello world" in value["text"]
+        finally:
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_event_routing_end_to_end(run):
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    async def scenario():
+        runner = LocalApplicationRunner("router", _load("event-routing"))
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce("events-topic", "new order placed")
+            await runner.produce("events-topic", "disk alert raised")
+            await runner.produce("events-topic", "hello")
+            orders = await runner.consume("orders-topic", n=1, timeout=30)
+            assert "order" in json.loads(orders[0].value)["body"]
+            alerts = await runner.consume("alerts-topic", n=1, timeout=30)
+            assert "alert" in json.loads(alerts[0].value)["body"]
+            other = await runner.consume("other-topic", n=1, timeout=30)
+            assert json.loads(other[0].value)["body"] == "hello"
+            audit = await runner.consume("audit-topic", n=1, timeout=30)
+            assert audit
+        finally:
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_text_completions_end_to_end(run):
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    async def scenario():
+        runner = LocalApplicationRunner("completions", _load("text-completions"))
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce("prompts-topic", "Once upon a time")
+            out = await runner.consume("completions-topic", n=1, timeout=90)
+            assert "completion" in json.loads(out[0].value)
+            chunks = await runner.consume("stream-topic", n=1, timeout=30)
+            assert chunks
+        finally:
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_python_source_sink_end_to_end(run, tmp_path):
+    """All three SDK roles through subprocess isolation (source → processor
+    → sink), with the sink writing to a file we can assert on."""
+    import yaml
+
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    app = _load("python-source-sink")
+    # point the example's sink at a per-test file
+    sink_path = str(tmp_path / "out.txt")
+    for module in app.modules.values():
+        for pipeline in module.pipelines.values():
+            for agent in pipeline.agents:
+                if agent.name == "collect":
+                    agent.configuration["path"] = sink_path
+
+    async def scenario():
+        import asyncio
+        import os
+
+        runner = LocalApplicationRunner("trio", app)
+        await runner.deploy()
+        await runner.start()
+        try:
+            out = await runner.consume("shouted-topic", n=3, timeout=60)
+            assert all(str(r.value).startswith("TICK-") for r in out)
+            # the sink has no output topic — wait on its side effect
+            deadline = asyncio.get_event_loop().time() + 60
+            while True:
+                if os.path.exists(sink_path):
+                    with open(sink_path) as f:
+                        lines = f.read().splitlines()
+                    if len(lines) >= 3:
+                        break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise TimeoutError(f"sink wrote {sink_path!r} too slowly")
+                await asyncio.sleep(0.1)
+        finally:
+            await runner.stop()
+        assert lines[0].startswith("TICK-")
+
+    run(scenario())
