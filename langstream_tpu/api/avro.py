@@ -46,12 +46,24 @@ class Schema:
     branches: tuple["Schema", ...] = ()  # union
 
     def canonical(self) -> str:
-        """Parsing-canonical-form-ish JSON (stable intern/fingerprint key)."""
-        return json.dumps(_canonical(self.source), separators=(",", ":"), sort_keys=False)
+        """Parsing-canonical-form JSON (stable intern/fingerprint key).
+        Cached on the instance — schemas are immutable and this runs per
+        record on the broker produce path."""
+        cached = self.__dict__.get("_canonical_cache")
+        if cached is None:
+            cached = json.dumps(
+                _canonical(self.source), separators=(",", ":"), sort_keys=False
+            )
+            object.__setattr__(self, "_canonical_cache", cached)
+        return cached
 
     def fingerprint(self) -> int:
         """CRC-64-AVRO of the canonical form (Avro spec fingerprinting)."""
-        return _crc64(self.canonical().encode())
+        cached = self.__dict__.get("_fingerprint_cache")
+        if cached is None:
+            cached = _crc64(self.canonical().encode())
+            object.__setattr__(self, "_fingerprint_cache", cached)
+        return cached
 
 
 @dataclass
@@ -121,19 +133,19 @@ def _parse(node: Any, named: dict[str, Schema], namespace: Optional[str]) -> Sch
             type="map", source=node, values=_parse(node["values"], named, namespace)
         )
     if t == "enum":
-        name = _fullname(node["name"], node.get("namespace") or namespace)
+        name = _fullname(node["name"], node["namespace"] if "namespace" in node else namespace)
         schema = Schema(
             type="enum", source=node, name=name, symbols=tuple(node["symbols"])
         )
         named[name] = schema
         return schema
     if t == "fixed":
-        name = _fullname(node["name"], node.get("namespace") or namespace)
+        name = _fullname(node["name"], node["namespace"] if "namespace" in node else namespace)
         schema = Schema(type="fixed", source=node, name=name, size=int(node["size"]))
         named[name] = schema
         return schema
     if t == "record" or t == "error":
-        ns = node.get("namespace") or namespace
+        ns = node["namespace"] if "namespace" in node else namespace
         name = _fullname(node["name"], ns)
         # two-phase: register a placeholder so recursive references resolve
         fields: list[tuple[str, Schema, Any]] = []
@@ -153,28 +165,41 @@ def _parse(node: Any, named: dict[str, Schema], namespace: Optional[str]) -> Sch
 _NO_DEFAULT = object()
 
 
-def _canonical(node: Any) -> Any:
+def _canonical(node: Any, namespace: Optional[str] = None) -> Any:
     """Strip non-structural attributes, order keys per the spec's
-    parsing-canonical-form field order."""
+    parsing-canonical-form field order, and apply the FULLNAMES step:
+    every name (and name reference) is resolved to namespace.name before
+    the namespace attribute is dropped — so two schemas differing only by
+    namespace get DIFFERENT fingerprints, matching spec CRC-64-AVRO."""
     if isinstance(node, str):
-        return node
+        if (
+            node in PRIMITIVES
+            or node in ("record", "error", "enum", "fixed", "array", "map")
+            or "." in node
+            or not namespace
+        ):
+            return node
+        return f"{namespace}.{node}"  # named-type reference → fullname
     if isinstance(node, list):
-        return [_canonical(b) for b in node]
+        return [_canonical(b, namespace) for b in node]
     if isinstance(node, dict):
         t = node.get("type")
         if t in PRIMITIVES and len(node) >= 1 and "name" not in node:
             return t
+        ns = node["namespace"] if "namespace" in node else namespace
         out: dict[str, Any] = {}
         for key in ("name", "type", "fields", "symbols", "items", "values", "size"):
             if key not in node:
                 continue
             v = node[key]
-            if key == "fields":
+            if key == "name":
+                out[key] = v if "." in v else (f"{ns}.{v}" if ns else v)
+            elif key == "fields":
                 out[key] = [
-                    {"name": f["name"], "type": _canonical(f["type"])} for f in v
+                    {"name": f["name"], "type": _canonical(f["type"], ns)} for f in v
                 ]
             elif key in ("items", "values", "type") and not isinstance(v, (int,)):
-                out[key] = _canonical(v)
+                out[key] = _canonical(v, ns)
             else:
                 out[key] = v
         return out

@@ -167,7 +167,15 @@ class JwtVerifier:
         if self._audience is not None:
             aud = payload.get("aud")
             auds = aud if isinstance(aud, list) else [aud]
-            if self._audience not in auds:
+            accepted = (
+                self._audience
+                if isinstance(self._audience, list)
+                else [self._audience]
+            )
+            # accept on any intersection (mirrors the issuer-list handling);
+            # equality — not set() — so a malformed unhashable aud entry
+            # still yields a clean JwtError → 401, not a TypeError
+            if not any(a in auds for a in accepted):
                 raise JwtError("bad audience")
         if self._issuer is not None:
             issuers = (

@@ -25,6 +25,7 @@ Design notes:
 from __future__ import annotations
 
 import asyncio
+import functools
 import itertools
 import json
 import time
@@ -42,7 +43,7 @@ from langstream_tpu.api.topics import (
 )
 from langstream_tpu.messaging import kafka_protocol as wire
 from langstream_tpu.messaging.memory import ConsumedRecord
-from langstream_tpu.native import OffsetTracker, key_partition
+from langstream_tpu.native import OffsetTracker
 
 
 class OffsetOutOfRange(RuntimeError):
@@ -53,6 +54,12 @@ class OffsetOutOfRange(RuntimeError):
         super().__init__(f"offset out of range for {topic}/{partition}")
         self.topic = topic
         self.partition = partition
+
+
+class CommitFenced(RuntimeError):
+    """OffsetCommit rejected by the coordinator (stale generation / unknown
+    member): this replica was rebalanced away; it must rejoin, and the
+    unacked records will be redelivered to the new partition owner."""
 
 
 def _parse_bootstrap(bootstrap: str) -> list[tuple[str, int]]:
@@ -72,6 +79,23 @@ def _parse_bootstrap(bootstrap: str) -> list[tuple[str, int]]:
     return out
 
 
+# transport headers carrying the Avro schema across the broker (schema-in-
+# header v1: no registry needed; the canonical JSON is the intern key, so a
+# downstream agent re-encodes under the ORIGINAL schema — the reference
+# round-trips schemas through its serdes, KafkaProducerWrapper.java)
+_AVRO_VALUE_SCHEMA_HEADER = "ls-avro-value-schema"
+_AVRO_KEY_SCHEMA_HEADER = "ls-avro-key-schema"
+
+
+@functools.lru_cache(maxsize=256)
+def _schema_from_header(raw: bytes):
+    """Memoized schema parse — a topic typically streams one fixed schema,
+    and re-parsing JSON per consumed record would dominate hot-path CPU."""
+    from langstream_tpu.api.avro import parse_schema
+
+    return parse_schema(raw)
+
+
 def _encode_datum(v: Any) -> Optional[bytes]:
     if v is None:
         return None
@@ -79,12 +103,10 @@ def _encode_datum(v: Any) -> Optional[bytes]:
         return v
     if isinstance(v, str):
         return v.encode()
-    from langstream_tpu.api.avro import AvroValue, datum_to_json
+    from langstream_tpu.api.avro import AvroValue
 
     if isinstance(v, AvroValue):
-        # no schema registry on the wire yet: Avro values degrade to their
-        # JSON datum (in-process paths keep the schema; see api/avro.py)
-        return json.dumps(datum_to_json(v.data), separators=(",", ":")).encode()
+        return v.encode()  # binary Avro; schema travels in the header
     return json.dumps(v, separators=(",", ":")).encode()
 
 
@@ -167,6 +189,7 @@ class KafkaClient:
         # other consumers on the shared command connection
         self._fetch_conns: dict[tuple[int, int], KafkaConnection] = {}
         self._leaders: dict[tuple[str, int], int] = {}
+        self._coordinators: dict[str, int] = {}
 
     async def close(self) -> None:
         await self._bootstrap.close()
@@ -330,6 +353,13 @@ class KafkaClient:
                     data = r.bytes_() or b""
                     if err == wire.OFFSET_OUT_OF_RANGE:
                         raise OffsetOutOfRange(topic, partition)
+                    if err in wire.RETRIABLE_FETCH_ERRORS:
+                        # routine leader movement during failover: evict the
+                        # cached route and poll again next loop (the Java
+                        # client's retry semantics), not an application error
+                        self._leaders.pop((topic, partition), None)
+                        out.setdefault((topic, partition), [])
+                        continue
                     if err != wire.NONE:
                         self._leaders.pop((topic, partition), None)
                         raise RuntimeError(f"fetch {topic}/{partition}: error {err}")
@@ -365,7 +395,14 @@ class KafkaClient:
                     raise RuntimeError(f"list_offsets {topic}/{partition}: error {err}")
         return offset
 
-    async def find_coordinator(self, group: str) -> KafkaConnection:
+    async def coordinator_node(self, group: str) -> int:
+        """Group coordinator's node id, cached per group (the Java client's
+        behavior) — heartbeats/commits must not serialize a FIND_COORDINATOR
+        round-trip behind the shared bootstrap lock on every tick. Callers
+        evict via ``invalidate_coordinator`` when a coordinator call fails."""
+        cached = self._coordinators.get(group)
+        if cached is not None:
+            return cached
         w = wire.Writer().string(group).int8(0)
         r = await self._bootstrap.call(wire.FIND_COORDINATOR, w.build())
         r.int32()  # throttle
@@ -375,17 +412,43 @@ class KafkaClient:
         if err != wire.NONE:
             raise RuntimeError(f"find_coordinator({group}): error {err}")
         self._nodes[node] = (host or "localhost", port)
+        self._coordinators[group] = node
+        return node
+
+    def invalidate_coordinator(self, group: str) -> None:
+        self._coordinators.pop(group, None)
+
+    async def find_coordinator(self, group: str) -> KafkaConnection:
+        node = await self.coordinator_node(group)
         conn = self._conns.get(node)
         if conn is None:
-            conn = KafkaConnection(host or "localhost", port, self._client_id)
+            host, port = self._nodes[node]
+            conn = KafkaConnection(host, port, self._client_id)
             self._conns[node] = conn
         return conn
 
-    async def offset_commit(self, group: str, topic: str, offsets: dict[int, int]) -> None:
+    async def coordinator_conn(self, group: str, key: int) -> KafkaConnection:
+        """Dedicated coordinator socket for one group member — JoinGroup and
+        follower SyncGroup block server-side until the rebalance completes,
+        and must never head-of-line block produce/commit traffic (or another
+        member's join!) on the shared command connection."""
+        node = await self.coordinator_node(group)
+        return self._fetch_conn(node, key)
+
+    async def offset_commit(
+        self,
+        group: str,
+        topic: str,
+        offsets: dict[int, int],
+        generation: int = -1,
+        member_id: str = "",
+    ) -> None:
+        """Commit offsets; generation -1 is the simple-consumer convention,
+        a real generation is fenced by the coordinator (CommitFenced)."""
         w = wire.Writer()
         w.string(group)
-        w.int32(-1)  # generation: simple consumer
-        w.string("")  # member id
+        w.int32(generation)
+        w.string(member_id)
         w.int64(-1)  # retention
         w.array(
             [topic],
@@ -401,8 +464,78 @@ class KafkaClient:
             for _ in range(r.int32()):
                 partition = r.int32()
                 err = r.int16()
+                if err in (wire.ILLEGAL_GENERATION, wire.UNKNOWN_MEMBER_ID):
+                    raise CommitFenced(f"offset_commit {topic}/{partition}: error {err}")
                 if err != wire.NONE:
                     raise RuntimeError(f"offset_commit {topic}/{partition}: error {err}")
+
+    # -- consumer group membership ------------------------------------------
+
+    async def join_group(
+        self,
+        conn: KafkaConnection,
+        group: str,
+        member_id: str,
+        topics: list[str],
+        session_timeout_ms: int,
+        rebalance_timeout_ms: int,
+    ) -> tuple[int, int, Optional[str], str, list[tuple[str, bytes]]]:
+        """JoinGroup v2 → (error, generation, leader, member_id, roster);
+        roster (member_id, subscription bytes) is non-empty only for the
+        elected leader, who must compute the assignment."""
+        w = wire.Writer()
+        w.string(group)
+        w.int32(session_timeout_ms)
+        w.int32(rebalance_timeout_ms)
+        w.string(member_id)
+        w.string("consumer")
+        w.array(
+            [("range", wire.encode_subscription(topics))],
+            lambda w, p: w.string(p[0]).bytes_(p[1]),
+        )
+        r = await conn.call(wire.JOIN_GROUP, w.build())
+        r.int32()  # throttle
+        err = r.int16()
+        generation = r.int32()
+        r.string()  # protocol name
+        leader = r.string()
+        me = r.string() or ""
+        roster = r.array(lambda rr: (rr.string() or "", rr.bytes_() or b""))
+        return err, generation, leader, me, roster
+
+    async def sync_group(
+        self,
+        conn: KafkaConnection,
+        group: str,
+        generation: int,
+        member_id: str,
+        assignments: list[tuple[str, bytes]],
+    ) -> tuple[int, bytes]:
+        w = wire.Writer()
+        w.string(group)
+        w.int32(generation)
+        w.string(member_id)
+        w.array(assignments, lambda w, a: w.string(a[0]).bytes_(a[1]))
+        r = await conn.call(wire.SYNC_GROUP, w.build())
+        r.int32()  # throttle
+        err = r.int16()
+        return err, r.bytes_() or b""
+
+    async def heartbeat(
+        self, conn: KafkaConnection, group: str, generation: int, member_id: str
+    ) -> int:
+        w = wire.Writer().string(group).int32(generation).string(member_id)
+        r = await conn.call(wire.HEARTBEAT, w.build())
+        r.int32()  # throttle
+        return r.int16()
+
+    async def leave_group(
+        self, conn: KafkaConnection, group: str, member_id: str
+    ) -> None:
+        w = wire.Writer().string(group).string(member_id)
+        r = await conn.call(wire.LEAVE_GROUP, w.build())
+        r.int32()  # throttle
+        r.int16()  # best-effort
 
     async def offset_fetch(self, group: str, topic: str, partitions: list[int]) -> dict[int, int]:
         w = wire.Writer()
@@ -459,12 +592,37 @@ class KafkaClient:
 
 
 def _to_consumed(topic: str, partition: int, rec: wire.WireRecord) -> ConsumedRecord:
+    value: Any = None
+    key: Any = None
+    value_schema = key_schema = None
+    headers: list[Header] = []
+    for k, v in rec.headers:
+        if k == _AVRO_VALUE_SCHEMA_HEADER:
+            value_schema = v
+        elif k == _AVRO_KEY_SCHEMA_HEADER:
+            key_schema = v
+        else:
+            headers.append(Header(k, _decode_datum(v)))
+    if value_schema is not None or key_schema is not None:
+        from langstream_tpu.api.avro import AvroValue, decode
+
+        if value_schema is not None and rec.value is not None:
+            schema = _schema_from_header(value_schema)
+            value = AvroValue(schema, decode(schema, rec.value))
+        else:
+            value = _decode_datum(rec.value)
+        if key_schema is not None and rec.key is not None:
+            schema = _schema_from_header(key_schema)
+            key = AvroValue(schema, decode(schema, rec.key))
+        else:
+            key = _decode_datum(rec.key)
+    else:
+        value = _decode_datum(rec.value)
+        key = _decode_datum(rec.key)
     return ConsumedRecord(
-        value=_decode_datum(rec.value),
-        key=_decode_datum(rec.key),
-        headers=tuple(
-            Header(k, _decode_datum(v)) for k, v in rec.headers
-        ),
+        value=value,
+        key=key,
+        headers=tuple(headers),
         origin=topic,
         timestamp=rec.timestamp_ms / 1000.0,
         partition=partition,
@@ -473,14 +631,148 @@ def _to_consumed(topic: str, partition: int, rec: wire.WireRecord) -> ConsumedRe
 
 
 def _to_wire(record: Record) -> wire.WireRecord:
+    from langstream_tpu.api.avro import AvroValue
+
+    headers = [(h.key, _encode_datum(h.value)) for h in record.headers]
+    if isinstance(record.value, AvroValue):
+        headers.append(
+            (_AVRO_VALUE_SCHEMA_HEADER, record.value.schema.canonical().encode())
+        )
+    if isinstance(record.key, AvroValue):
+        headers.append(
+            (_AVRO_KEY_SCHEMA_HEADER, record.key.schema.canonical().encode())
+        )
     return wire.WireRecord(
         key=_encode_datum(record.key),
         value=_encode_datum(record.value),
         # None header values stay null on the wire (varint -1) so they
         # round-trip identically to the memory transport
-        headers=[(h.key, _encode_datum(h.value)) for h in record.headers],
+        headers=headers,
         timestamp_ms=int((record.timestamp or time.time()) * 1000),
     )
+
+
+class KafkaGroupMembership:
+    """Dynamic consumer-group membership: JoinGroup/SyncGroup to obtain a
+    partition assignment, background Heartbeat to hold it, rejoin on any
+    coordinator signal. This is what splits a topic's partitions across the
+    planner's N pod replicas (the reference's #1 parallelism primitive —
+    KafkaConsumerWrapper.java:41-115 rebalance listener semantics).
+
+    The elected leader runs Kafka's RangeAssignor client-side (the real
+    protocol's design: the broker treats subscriptions/assignments as opaque
+    bytes and any member must be able to lead)."""
+
+    def __init__(
+        self,
+        client: KafkaClient,
+        group: str,
+        topics: list[str],
+        session_timeout: float = 10.0,
+    ) -> None:
+        self.client = client
+        self.group = group
+        self.topics = topics
+        self.session_timeout = session_timeout
+        self.member_id = ""
+        self.generation = -1
+        self.assignment: dict[str, list[int]] = {}
+        self.rejoin_needed = True
+        self._hb_task: Optional[asyncio.Task] = None
+        self._conn_key = id(self)
+
+    async def ensure_active(self) -> bool:
+        """(Re)join if flagged; True when a rejoin happened (the caller must
+        rebuild positions from committed offsets)."""
+        if not self.rejoin_needed:
+            return False
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+        await self._join()
+        self.rejoin_needed = False
+        self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        return True
+
+    async def _join(self) -> None:
+        session_ms = int(self.session_timeout * 1000)
+        rebalance_ms = session_ms * 2
+        conn_failures = 0
+        while True:
+            try:
+                conn = await self.client.coordinator_conn(self.group, self._conn_key)
+                err, generation, leader, me, roster = await self.client.join_group(
+                    conn, self.group, self.member_id, self.topics, session_ms, rebalance_ms
+                )
+            except (ConnectionError, OSError, EOFError):
+                # coordinator moved or dropped: re-resolve and retry
+                self.client.invalidate_coordinator(self.group)
+                conn_failures += 1
+                if conn_failures >= 5:
+                    raise
+                await asyncio.sleep(0.1 * conn_failures)
+                continue
+            if err == wire.UNKNOWN_MEMBER_ID:
+                self.member_id = ""
+                continue
+            if err == wire.REBALANCE_IN_PROGRESS:
+                await asyncio.sleep(0.05)
+                continue
+            if err != wire.NONE:
+                raise RuntimeError(f"join_group({self.group}): error {err}")
+            self.member_id = me
+            assignments: list[tuple[str, bytes]] = []
+            if me == leader:
+                subs = [(mid, wire.decode_subscription(meta)) for mid, meta in roster]
+                all_topics = sorted({t for _, ts in subs for t in ts})
+                meta = await self.client.metadata(all_topics)
+                parts = {t: meta.get(t, []) for t in all_topics}
+                plan = wire.range_assign(subs, parts)
+                assignments = [
+                    (mid, wire.encode_assignment(a)) for mid, a in plan.items()
+                ]
+            err2, data = await self.client.sync_group(
+                conn, self.group, generation, me, assignments
+            )
+            if err2 == wire.REBALANCE_IN_PROGRESS:
+                continue
+            if err2 in (wire.UNKNOWN_MEMBER_ID, wire.ILLEGAL_GENERATION):
+                self.member_id = ""
+                continue
+            if err2 != wire.NONE:
+                raise RuntimeError(f"sync_group({self.group}): error {err2}")
+            self.generation = generation
+            self.assignment = wire.decode_assignment(data) if data else {}
+            return
+
+    async def _heartbeat_loop(self) -> None:
+        interval = max(self.session_timeout / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                conn = await self.client.coordinator_conn(self.group, self._conn_key)
+                err = await self.client.heartbeat(
+                    conn, self.group, self.generation, self.member_id
+                )
+            except Exception:  # noqa: BLE001 — coordinator gone: rejoin
+                self.client.invalidate_coordinator(self.group)
+                self.rejoin_needed = True
+                return
+            if err != wire.NONE:
+                self.rejoin_needed = True
+                return
+
+    async def close(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            self._hb_task = None
+        if self.member_id:
+            try:
+                conn = await self.client.coordinator_conn(self.group, self._conn_key)
+                await self.client.leave_group(conn, self.group, self.member_id)
+            except Exception:  # noqa: BLE001 — best-effort goodbye
+                pass
+        await self.client.release_fetch_conns(self._conn_key)
 
 
 class KafkaTopicConsumer(TopicConsumer):
@@ -492,6 +784,7 @@ class KafkaTopicConsumer(TopicConsumer):
         poll_timeout: float = 0.1,
         max_records: int = 100,
         partitions: Optional[list[int]] = None,
+        session_timeout: float = 10.0,
     ) -> None:
         self.client = client
         self.topic_name = topic
@@ -499,6 +792,8 @@ class KafkaTopicConsumer(TopicConsumer):
         self.poll_timeout = poll_timeout
         self.max_records = max_records
         self._explicit_partitions = partitions
+        self._membership: Optional[KafkaGroupMembership] = None
+        self._session_timeout = session_timeout
         self._assigned: list[int] = []
         self._fetch_pos: dict[int, int] = {}
         self._trackers: dict[int, OffsetTracker] = {}
@@ -507,23 +802,66 @@ class KafkaTopicConsumer(TopicConsumer):
         self._rr_start = -1
 
     async def start(self) -> None:
-        meta = await self.client.ensure_topic(self.topic_name)
-        self._assigned = self._explicit_partitions or meta
-        committed = await self.client.offset_fetch(
-            self.group, self.topic_name, self._assigned
+        await self.client.ensure_topic(self.topic_name)
+        if self._explicit_partitions is not None:
+            # static assignment (operator-pinned slice): offsets only, no
+            # group membership — Kafka's "simple consumer" mode
+            self._reset_positions(
+                self._explicit_partitions,
+                await self.client.offset_fetch(
+                    self.group, self.topic_name, self._explicit_partitions
+                ),
+            )
+            return
+        self._membership = KafkaGroupMembership(
+            self.client,
+            self.group,
+            [self.topic_name],
+            session_timeout=self._session_timeout,
         )
+        await self._reassign()
+
+    def _reset_positions(self, partitions: list[int], committed: dict[int, int]) -> None:
+        self._assigned = sorted(partitions)
+        self._fetch_pos.clear()
+        self._trackers.clear()
+        self._committed.clear()
         for p in self._assigned:
             start = max(committed.get(p, 0), 0)  # -1 = no committed offset
             self._fetch_pos[p] = start
             self._trackers[p] = OffsetTracker(start)
             self._committed[p] = start
 
+    async def _reassign(self) -> None:
+        assert self._membership is not None
+        try:
+            await self._membership.ensure_active()
+            partitions = self._membership.assignment.get(self.topic_name, [])
+            self._reset_positions(
+                partitions,
+                await self.client.offset_fetch(self.group, self.topic_name, partitions),
+            )
+        except BaseException:
+            # positions were NOT rebuilt: without this flag the consumer
+            # would keep fetching its pre-rebalance partitions under a valid
+            # new generation — double consumption with unfenced commits
+            self._membership.rejoin_needed = True
+            raise
+
     async def close(self) -> None:
         # command connections are owned by the runtime's shared client;
         # this consumer's dedicated fetch sockets close with it
+        if self._membership is not None:
+            await self._membership.close()
         await self.client.release_fetch_conns(id(self))
 
     async def read(self) -> list[Record]:
+        if self._membership is not None and self._membership.rejoin_needed:
+            await self._reassign()
+        if not self._assigned:
+            # every partition is owned by other group members right now
+            await asyncio.sleep(self.poll_timeout)
+            return []
         try:
             got = await self.client.fetch(
                 {(self.topic_name, p): self._fetch_pos[p] for p in self._assigned},
@@ -556,21 +894,38 @@ class KafkaTopicConsumer(TopicConsumer):
 
     async def commit(self, records: list[Record]) -> None:
         """Contiguous-prefix commit (KafkaConsumerWrapper.commit:159-190):
-        out-of-order acks park in the tracker; only the prefix commits."""
+        out-of-order acks park in the tracker; only the prefix commits.
+        Acks for partitions revoked by a rebalance are dropped — the new
+        owner refetches from the last committed offset (at-least-once)."""
         to_commit: dict[int, int] = {}
         for r in records:
             if not isinstance(r, ConsumedRecord):
                 continue
             tracker = self._trackers.get(r.partition)
             if tracker is None:
+                if self._membership is not None:
+                    continue  # revoked partition: let the new owner redeliver
                 tracker = OffsetTracker(0)
                 self._trackers[r.partition] = tracker
             new_committed = tracker.ack(r.offset)
             if new_committed != self._committed.get(r.partition):
                 to_commit[r.partition] = new_committed
-        if to_commit:
-            await self.client.offset_commit(self.group, self.topic_name, to_commit)
-            self._committed.update(to_commit)
+        if not to_commit:
+            return
+        generation, member = -1, ""
+        if self._membership is not None:
+            generation = self._membership.generation
+            member = self._membership.member_id
+        try:
+            await self.client.offset_commit(
+                self.group, self.topic_name, to_commit, generation, member
+            )
+        except CommitFenced:
+            if self._membership is None:
+                raise
+            self._membership.rejoin_needed = True
+            return
+        self._committed.update(to_commit)
 
     def get_info(self) -> dict[str, Any]:
         return {
@@ -602,7 +957,11 @@ class KafkaTopicProducer(TopicProducer):
         assert self._partitions is not None
         n = len(self._partitions)
         if record.key is not None:
-            part = self._partitions[key_partition(record.key, n)]
+            # murmur2 (Kafka's DefaultPartitioner), NOT the platform FNV
+            # hash: keyed records must co-partition with Java/librdkafka
+            # producers sharing the topic
+            key_bytes = _encode_datum(record.key) or b""
+            part = self._partitions[wire.murmur2_partition(key_bytes, n)]
         else:
             part = self._partitions[self._rr % n]
             self._rr += 1
@@ -688,11 +1047,15 @@ class KafkaTopicAdmin(TopicAdmin):
 class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
     def __init__(self) -> None:
         self._bootstrap = "localhost:9092"
+        self._consumer_defaults: dict[str, Any] = {}
         self._client: Optional[KafkaClient] = None
 
     async def init(self, streaming_cluster_config: dict[str, Any]) -> None:
         admin = streaming_cluster_config.get("admin", {})
         self._bootstrap = admin.get("bootstrap.servers", self._bootstrap)
+        # streamingCluster.configuration.consumer: defaults merged under
+        # every create_consumer config (reference's consumer config block)
+        self._consumer_defaults = dict(streaming_cluster_config.get("consumer", {}))
 
     def client(self) -> KafkaClient:
         if self._client is None:
@@ -707,7 +1070,7 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
     def create_consumer(
         self, agent_id: str, topic: str, config: Optional[dict[str, Any]] = None
     ) -> TopicConsumer:
-        config = config or {}
+        config = {**self._consumer_defaults, **(config or {})}
         return KafkaTopicConsumer(
             self.client(),
             topic,
@@ -715,6 +1078,7 @@ class KafkaTopicConnectionsRuntime(TopicConnectionsRuntime):
             poll_timeout=float(config.get("poll-timeout", 0.1)),
             max_records=int(config.get("max-records", 100)),
             partitions=config.get("partitions"),
+            session_timeout=float(config.get("session-timeout", 10.0)),
         )
 
     def create_producer(

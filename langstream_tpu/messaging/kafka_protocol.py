@@ -30,6 +30,10 @@ METADATA = 3
 OFFSET_COMMIT = 8
 OFFSET_FETCH = 9
 FIND_COORDINATOR = 10
+JOIN_GROUP = 11
+HEARTBEAT = 12
+LEAVE_GROUP = 13
+SYNC_GROUP = 14
 CREATE_TOPICS = 19
 DELETE_TOPICS = 20
 
@@ -41,6 +45,10 @@ API_VERSIONS = {
     OFFSET_COMMIT: 2,
     OFFSET_FETCH: 1,
     FIND_COORDINATOR: 1,
+    JOIN_GROUP: 2,  # v2: adds rebalance_timeout, pre-flexible
+    HEARTBEAT: 1,
+    LEAVE_GROUP: 1,
+    SYNC_GROUP: 1,
     CREATE_TOPICS: 0,
     DELETE_TOPICS: 0,
 }
@@ -49,7 +57,18 @@ API_VERSIONS = {
 NONE = 0
 UNKNOWN_TOPIC_OR_PARTITION = 3
 OFFSET_OUT_OF_RANGE = 1
+NOT_LEADER_FOR_PARTITION = 6
+REPLICA_NOT_AVAILABLE = 9
+ILLEGAL_GENERATION = 22
+UNKNOWN_MEMBER_ID = 25
+REBALANCE_IN_PROGRESS = 27
 TOPIC_ALREADY_EXISTS = 36
+
+# fetch errors the Java client silently retries after a metadata refresh
+# (routine leader movement during broker restart/failover)
+RETRIABLE_FETCH_ERRORS = frozenset(
+    {NOT_LEADER_FOR_PARTITION, REPLICA_NOT_AVAILABLE, UNKNOWN_TOPIC_OR_PARTITION}
+)
 
 EARLIEST_TIMESTAMP = -2
 LATEST_TIMESTAMP = -1
@@ -318,6 +337,102 @@ def decode_record_batches(data: bytes) -> list[WireRecord]:
                 )
             )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Consumer protocol (the embedded metadata/assignment format the Java
+# "consumer" protocol type exchanges through JoinGroup/SyncGroup — the
+# group coordinator treats both as opaque bytes)
+# ---------------------------------------------------------------------------
+
+
+def encode_subscription(topics: list[str]) -> bytes:
+    """ConsumerProtocolSubscription v0: version, topics[], user_data."""
+    return Writer().int16(0).array(sorted(topics), lambda w, t: w.string(t)).bytes_(None).build()
+
+
+def decode_subscription(data: bytes) -> list[str]:
+    r = Reader(data)
+    r.int16()  # version
+    return [t for t in r.array(lambda rr: rr.string()) if t is not None]
+
+
+def encode_assignment(assignment: dict[str, list[int]]) -> bytes:
+    """ConsumerProtocolAssignment v0: version, [topic, partitions[]], user_data."""
+    w = Writer().int16(0)
+    w.array(
+        sorted(assignment.items()),
+        lambda w, kv: w.string(kv[0]).array(sorted(kv[1]), lambda w2, p: w2.int32(p)),
+    )
+    return w.bytes_(None).build()
+
+
+def decode_assignment(data: bytes) -> dict[str, list[int]]:
+    r = Reader(data)
+    r.int16()  # version
+    out: dict[str, list[int]] = {}
+    for _ in range(r.int32()):
+        topic = r.string() or ""
+        out[topic] = r.array(lambda rr: rr.int32())
+    return out
+
+
+def range_assign(
+    members: list[tuple[str, list[str]]], partitions: dict[str, list[int]]
+) -> dict[str, dict[str, list[int]]]:
+    """Kafka's RangeAssignor: per topic, sort subscribed members and hand
+    each a contiguous slice; the first ``extra`` members get one more.
+    member_id → topic → partition ids."""
+    out: dict[str, dict[str, list[int]]] = {m: {} for m, _ in members}
+    topics = sorted({t for _, subs in members for t in subs})
+    for topic in topics:
+        subscribers = sorted(m for m, subs in members if topic in subs)
+        parts = sorted(partitions.get(topic, []))
+        if not subscribers or not parts:
+            continue
+        per, extra = divmod(len(parts), len(subscribers))
+        pos = 0
+        for i, member in enumerate(subscribers):
+            n = per + (1 if i < extra else 0)
+            if n:
+                out[member][topic] = parts[pos : pos + n]
+            pos += n
+    return out
+
+
+def murmur2(data: bytes) -> int:
+    """Kafka's murmur2 (32-bit, seed 0x9747b28c) — the default partitioner
+    hash, so keyed records co-partition with Java/librdkafka producers."""
+    length = len(data)
+    seed = 0x9747B28C
+    m = 0x5BD1E995
+    mask = 0xFFFFFFFF
+    h = (seed ^ length) & mask
+    n = length & ~0x3
+    for i in range(0, n, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * m) & mask
+        k ^= k >> 24
+        k = (k * m) & mask
+        h = (h * m) & mask
+        h ^= k
+    rem = length & 0x3
+    if rem == 3:
+        h ^= (data[n + 2] & 0xFF) << 16
+    if rem >= 2:
+        h ^= (data[n + 1] & 0xFF) << 8
+    if rem >= 1:
+        h ^= data[n] & 0xFF
+        h = (h * m) & mask
+    h ^= h >> 13
+    h = (h * m) & mask
+    h ^= h >> 15
+    return h
+
+
+def murmur2_partition(key: bytes, num_partitions: int) -> int:
+    """toPositive(murmur2(key)) % numPartitions — DefaultPartitioner."""
+    return (murmur2(key) & 0x7FFFFFFF) % num_partitions
 
 
 # ---------------------------------------------------------------------------

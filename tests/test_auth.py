@@ -313,3 +313,35 @@ def test_gateway_auth_provider_is_cached():
     c = _cached_auth_provider("jwt", {"secret-key": "y"})
     assert a is b
     assert a is not c
+
+
+def test_audience_list_config_accepts_intersection(run):
+    """Operators may configure a LIST of acceptable audiences (like the
+    issuer check); any intersection with the token's aud claim passes."""
+    import base64
+    import hashlib
+    import hmac
+    import json as _json
+
+    from langstream_tpu.auth import JwtError, JwtVerifier
+
+    def hs256(payload: dict, secret: str) -> str:
+        def b64(b: bytes) -> str:
+            return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+        header = b64(_json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        body = b64(_json.dumps(payload).encode())
+        sig = hmac.new(secret.encode(), f"{header}.{body}".encode(), hashlib.sha256)
+        return f"{header}.{body}.{b64(sig.digest())}"
+
+    verifier = JwtVerifier({"secret-key": "s3", "audience": ["app1", "app2"]})
+
+    async def main():
+        assert (await verifier.verify(hs256({"sub": "u", "aud": "app2"}, "s3")))["sub"] == "u"
+        assert (await verifier.verify(hs256({"sub": "u", "aud": ["x", "app1"]}, "s3")))["sub"] == "u"
+        import pytest as _pytest
+
+        with _pytest.raises(JwtError, match="bad audience"):
+            await verifier.verify(hs256({"sub": "u", "aud": "other"}, "s3"))
+
+    run(main())
