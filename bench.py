@@ -72,6 +72,7 @@ configuration:
         max-batch: {max_batch}
         max-seq-len: {max_seq_len}
         decode-chunk: {decode_chunk}
+        prefill-batch: {prefill_batch}
         prefill-buckets: [64]
         {quant_line}
 """
@@ -146,8 +147,50 @@ def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
     return total_tokens / elapsed
 
 
+def bench_long_prompt(preset: str, quantize: bool, prompt_len: int,
+                      segment: int, max_seq_len: int) -> float:
+    """Chunked-prefill TTFT: one long prompt on an otherwise idle engine —
+    the latency a RAG request with a big stuffed context actually sees.
+    Returns TTFT in seconds."""
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+
+    config = MODEL_PRESETS[preset]
+    params = init_params(config, jax.random.PRNGKey(0))
+    if quantize:
+        from langstream_tpu.models.quant import quantize_params
+
+        params = jax.jit(lambda p: quantize_params(p, config))(params)
+        jax.block_until_ready(params)
+    engine = ServingEngine(
+        config,
+        params,
+        max_batch=4,
+        max_seq_len=min(max_seq_len, config.max_seq_len),
+        prefill_buckets=(segment,),
+        decode_chunk=8,
+    )
+    engine.start()
+    rng = np.random.default_rng(1)
+    opts = GenerationOptions(max_new_tokens=16, temperature=0.0)
+
+    def req() -> GenerationRequest:
+        prompt = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
+        return GenerationRequest(prompt_tokens=prompt, options=opts)
+
+    engine.submit(req()).result(timeout=1200)  # warmup: compiles
+    result = engine.submit(req()).result(timeout=1200)
+    engine.stop()
+    return result.ttft_s
+
+
 async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens: int,
-                        n_sessions: int, max_seq_len: int, decode_chunk: int) -> dict:
+                        n_sessions: int, max_seq_len: int, decode_chunk: int,
+                        prefill_batch: int) -> dict:
     """Full-platform path: app (broker + agents) + gateway WS chat."""
     import aiohttp
 
@@ -162,7 +205,7 @@ async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens:
     (app_dir / "configuration.yaml").write_text(
         CONFIGURATION.format(
             model=preset, max_batch=max_batch, max_seq_len=max_seq_len,
-            decode_chunk=decode_chunk,
+            decode_chunk=decode_chunk, prefill_batch=prefill_batch,
             quant_line="quantization: int8" if quantize else "",
         )
     )
@@ -246,14 +289,19 @@ def main() -> None:
         # CPU fallback (CI smoke): tiny config, same code paths.
         preset, quantize = "tiny-test", False
         max_batch, new_tokens, n_requests, n_sessions = 4, 32, 8, 4
-        max_seq_len, decode_chunk = 256, 8
+        max_seq_len, decode_chunk, prefill_batch = 256, 8, 4
+        long_len, long_seg, long_max_seq = 150, 32, 256
     else:
         # decode is HBM-bandwidth-bound: int8 weights halve the dominant
         # read stream; B=96 x chunk=64 measured best on v5e (B=128
-        # regresses on cache reads, chunk=128 on mid-chunk finish waste)
+        # regresses on cache reads, chunk=128 on mid-chunk finish waste).
+        # prefill_batch=96: the whole 96-session burst admits in ONE prefill
+        # dispatch (batch 96 x width 64 is still memory-bound-cheap) — serial
+        # prefill groups were the dominant term in burst TTFT
         preset, quantize = "gemma-2b", True
         max_batch, new_tokens, n_requests, n_sessions = 96, 256, 192, 96
-        max_seq_len, decode_chunk = 1024, 64
+        max_seq_len, decode_chunk, prefill_batch = 1024, 64, 96
+        long_len, long_seg, long_max_seq = 8000, 2048, 8192
 
     print(f"[bench] engine phase: {preset} quantize={quantize}", file=sys.stderr, flush=True)
     tok_s = bench_engine(
@@ -264,9 +312,16 @@ def main() -> None:
         bench_gateway(
             preset, quantize, max_batch,
             min(new_tokens, 128), n_sessions, max_seq_len, decode_chunk,
+            prefill_batch,
         )
     )
-    print(f"[bench] gateway: {extras}", file=sys.stderr, flush=True)
+    print(f"[bench] gateway: {extras}; long-prompt phase", file=sys.stderr, flush=True)
+    try:
+        long_ttft = bench_long_prompt(preset, quantize, long_len, long_seg, long_max_seq)
+        extras[f"long_prompt_{long_len}_ttft_ms"] = round(long_ttft * 1e3, 1)
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] long-prompt phase failed: {e}", file=sys.stderr, flush=True)
+    print(f"[bench] extras: {extras}", file=sys.stderr, flush=True)
     baseline = 2000.0  # BASELINE.json aggregate target
     name = f"{preset}-int8" if quantize else preset
     print(

@@ -164,6 +164,7 @@ class _EngineHolder:
                     prefill_buckets=buckets,
                     mesh=self.mesh(),
                     decode_chunk=int(self.config.get("decode-chunk", 8)),
+                    prefill_batch=self.config.get("prefill-batch"),
                 )
                 self._engine.start()
             return self._engine

@@ -12,9 +12,14 @@ Roles that run standalone here:
   (``$STORAGE_ROOT``), with the gateway embedded.
 - ``run-local``: whole platform in one process (delegates to the CLI).
 
-``deployer-runtime`` / ``application-setup`` / ``agent-code-download`` need
-a Kubernetes API client, which this image does not ship — they fail with an
-explicit message (same gating pattern as the kafka/pulsar broker runtimes).
+Real-cluster roles (reference Main.java:42-45 + the JOSDK operator app),
+all backed by the stdlib ``k8s/client.py`` API client (kubeconfig /
+in-cluster / KUBE_API_SERVER auth):
+- ``operator``: level-based reconcile loop over Application/Agent CRs.
+- ``deployer-runtime`` / ``application-setup``: the two reconcile-phase
+  Jobs, runnable as real cluster Jobs.
+- ``agent-code-download``: init-container that unpacks the app archive
+  from the control plane into the shared code volume.
 
 Usage: ``python -m langstream_tpu.entrypoint <role> [args...]``
 """
@@ -176,7 +181,13 @@ async def run_control_plane() -> None:
     from langstream_tpu.webservice.service import make_local_service
 
     root = os.environ.get("STORAGE_ROOT", "/var/lib/langstream-tpu")
-    applications, tenants, runtime = make_local_service(root)
+    code_storage = None
+    if os.environ.get("CODE_STORAGE"):
+        # JSON codeStorage block, e.g. {"type":"s3","configuration":{...}}
+        from langstream_tpu.webservice.stores import make_code_storage
+
+        code_storage = make_code_storage(json.loads(os.environ["CODE_STORAGE"]))
+    applications, tenants, runtime = make_local_service(root, code_storage)
     server = ControlPlaneServer(
         applications,
         tenants,
@@ -214,6 +225,131 @@ async def run_gateway() -> None:
         await server.stop()
 
 
+def run_operator() -> None:
+    """Level-based reconcile loop against a live API server (or the HTTP
+    fake). Polls Application/Agent CRs every OPERATOR_POLL_SECONDS and
+    reconciles whatever moved — the JOSDK operator's event loop collapsed
+    to list+reconcile, which converges identically because the reconcilers
+    are idempotent (AppController.java:92-245 two-phase flow).
+
+    OPERATOR_ONCE=true runs a single pass and exits 0 (tests / cron)."""
+    import time as _time
+
+    from langstream_tpu.k8s.client import KubeApiClient
+    from langstream_tpu.k8s.controllers import (
+        AgentController,
+        AppController,
+        InProcessJobExecutor,
+    )
+    from langstream_tpu.k8s.crds import AgentCustomResource, ApplicationCustomResource
+
+    kube = KubeApiClient.from_env()
+    namespace = os.environ.get("OPERATOR_NAMESPACE")  # None = cluster-wide
+    poll = float(os.environ.get("OPERATOR_POLL_SECONDS", "2"))
+    once = os.environ.get("OPERATOR_ONCE") == "true"
+    app_controller = AppController(kube, InProcessJobExecutor(kube))
+    agent_controller = AgentController(kube)
+    log.info("operator up against %s (namespace=%s)", kube.server, namespace or "*")
+    while True:
+        try:
+            # apps first — their deployer phase writes the Agent CRs the
+            # second list picks up, so one pass converges a fresh app
+            for manifest in kube.list(ApplicationCustomResource.KIND, namespace):
+                try:
+                    app_controller.reconcile(manifest)
+                except Exception:  # noqa: BLE001 — keep reconciling others
+                    log.exception(
+                        "application reconcile failed: %s",
+                        manifest.get("metadata", {}).get("name"),
+                    )
+            for manifest in kube.list(AgentCustomResource.KIND, namespace):
+                try:
+                    agent_controller.reconcile(manifest)
+                except Exception:  # noqa: BLE001
+                    log.exception(
+                        "agent reconcile failed: %s",
+                        manifest.get("metadata", {}).get("name"),
+                    )
+        except Exception:  # noqa: BLE001 — API server blip: retry next poll
+            log.exception("list from API server failed; retrying")
+            if once:
+                raise
+        if once:
+            return
+        _time.sleep(poll)
+
+
+def _load_application_cr():
+    """(kube, ApplicationCustomResource) for the job roles, from
+    APPLICATION_NAME + NAMESPACE env (the operator stamps these into the
+    Job pod spec; reference RuntimeDeployerConfiguration)."""
+    from langstream_tpu.k8s.client import KubeApiClient
+    from langstream_tpu.k8s.crds import ApplicationCustomResource
+
+    kube = KubeApiClient.from_env()
+    name = os.environ["APPLICATION_NAME"]
+    namespace = os.environ.get("NAMESPACE", "default")
+    manifest = kube.get(ApplicationCustomResource.KIND, namespace, name)
+    if manifest is None:
+        raise RuntimeError(f"Application CR {namespace}/{name} not found")
+    return kube, ApplicationCustomResource.from_manifest(manifest)
+
+
+def run_deployer_job() -> None:
+    """The deployer Job's work: plan the app, write one Agent CR (+ pod
+    config Secret) per physical agent (KubernetesClusterRuntime.deploy:93)."""
+    from langstream_tpu.k8s.controllers import InProcessJobExecutor
+
+    kube, app = _load_application_cr()
+    InProcessJobExecutor(kube).run_deployer(app)
+    log.info("deployer job done for %s", app.name)
+
+
+def run_setup_job() -> None:
+    """The setup Job's work: validate the plan / provision declared assets
+    before the deployer runs (AppController phase 1)."""
+    from langstream_tpu.k8s.controllers import InProcessJobExecutor
+
+    kube, app = _load_application_cr()
+    InProcessJobExecutor(kube).run_setup(app)
+    log.info("setup job done for %s", app.name)
+
+
+def run_code_download() -> None:
+    """Init-container role: fetch the application's code archive from the
+    control plane and unpack it into the shared volume the agent runtime
+    mounts (reference agent-code-download + CodeStorage download path).
+
+    Env: CONTROL_PLANE_URL, TENANT, APPLICATION_ID, TARGET_DIR
+    (+ ADMIN_TOKEN when the control plane requires auth)."""
+    import io
+    import urllib.request
+    import zipfile
+    from pathlib import Path
+
+    base = os.environ["CONTROL_PLANE_URL"].rstrip("/")
+    tenant = os.environ.get("TENANT", "default")
+    app_id = os.environ["APPLICATION_ID"]
+    target = Path(os.environ.get("TARGET_DIR", "/app-code-download"))
+    req = urllib.request.Request(
+        f"{base}/api/applications/{tenant}/{app_id}/code"
+    )
+    token = os.environ.get("ADMIN_TOKEN")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        archive = resp.read()
+    target.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(archive)) as zf:
+        for info in zf.infolist():
+            # refuse path traversal from a hostile archive
+            dest = (target / info.filename).resolve()
+            if not str(dest).startswith(str(target.resolve())):
+                raise RuntimeError(f"archive path escapes target: {info.filename}")
+        zf.extractall(target)
+    log.info("code archive for %s/%s unpacked to %s", tenant, app_id, target)
+
+
 def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(level=logging.INFO)
     argv = argv if argv is not None else sys.argv[1:]
@@ -235,14 +371,18 @@ def main(argv: list[str] | None = None) -> int:
 
         cli(["run", "local", *argv[1:]], standalone_mode=True, obj={})
         return 0
-    if role in ("operator", "deployer-runtime", "application-setup", "agent-code-download"):
-        print(
-            f"role {role!r} drives the Kubernetes API and requires a k8s client "
-            "library, which this image does not ship; in local mode the "
-            "in-process executor performs this work (langstream_tpu.k8s)",
-            file=sys.stderr,
-        )
-        return 2
+    if role == "operator":
+        run_operator()
+        return 0
+    if role == "deployer-runtime":
+        run_deployer_job()
+        return 0
+    if role == "application-setup":
+        run_setup_job()
+        return 0
+    if role == "agent-code-download":
+        run_code_download()
+        return 0
     print(f"unknown role {role!r}", file=sys.stderr)
     return 2
 

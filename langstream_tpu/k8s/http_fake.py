@@ -1,0 +1,150 @@
+"""FakeKubeServer served over real HTTP — the API-server stand-in that
+lets ``KubeApiClient`` (and therefore the operator / deployer / setup
+roles) be tested over an actual socket with the same paths and verbs a
+live cluster serves.
+
+Pattern parity: the reference tests its operator against the fabric8 mock
+KubernetesServer (SURVEY §4 tier 3) — an HTTP fake, not an object stub.
+This is the same tier for the TPU stack: ``entrypoint operator`` pointed
+at this server reconciles CRs exactly as it would against k3s.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from aiohttp import web
+
+from langstream_tpu.k8s.client import KIND_ROUTES
+from langstream_tpu.k8s.fake import FakeKubeServer
+
+_PLURAL_TO_KIND = {
+    (prefix, plural): kind for kind, (prefix, plural, _ns) in KIND_ROUTES.items()
+}
+
+
+class HttpFakeKubeServer:
+    """aiohttp app exposing a FakeKubeServer with k8s REST semantics."""
+
+    def __init__(self, store: Optional[FakeKubeServer] = None, token: Optional[str] = None) -> None:
+        self.store = store or FakeKubeServer()
+        self.token = token  # when set, requests must carry it as Bearer
+        self._runner: Optional[web.AppRunner] = None
+        self.port = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    async def start(self, port: int = 0) -> "HttpFakeKubeServer":
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- request handling ----------------------------------------------------
+
+    def _resolve(self, path: str):
+        """path → (kind, namespace, name, is_status). Supports
+        {prefix}/namespaces/{ns}/{plural}[/{name}[/status]] and
+        cluster-scoped {prefix}/{plural}[/{name}] (also the cluster-wide
+        list form of namespaced kinds)."""
+        for (prefix, plural), kind in _PLURAL_TO_KIND.items():
+            ns_base = f"{prefix}/namespaces/"
+            flat_base = f"{prefix}/{plural}"
+            if path.startswith(ns_base):
+                rest = path[len(ns_base):]
+                parts = rest.split("/")
+                if len(parts) >= 2 and parts[1] == plural:
+                    ns = parts[0]
+                    name = parts[2] if len(parts) > 2 else None
+                    is_status = len(parts) > 3 and parts[3] == "status"
+                    return kind, ns, name, is_status
+            if path == flat_base or path.startswith(flat_base + "/"):
+                rest = path[len(flat_base):].strip("/")
+                parts = rest.split("/") if rest else []
+                name = parts[0] if parts else None
+                is_status = len(parts) > 1 and parts[1] == "status"
+                return kind, None, name, is_status
+        return None
+
+    async def _handle(self, request: web.Request) -> web.Response:
+        if self.token is not None:
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self.token}":
+                return web.json_response({"message": "unauthorized"}, status=401)
+        resolved = self._resolve("/" + request.match_info["tail"])
+        if resolved is None:
+            return web.json_response({"message": "unknown path"}, status=404)
+        kind, ns, name, is_status = resolved
+        method = request.method
+
+        if is_status and method == "PATCH":
+            body = await request.json()
+            out = self.store.patch_status(
+                kind, ns or "default", name or "", body.get("status", {})
+            )
+            if out is None:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(out)
+        if method == "GET" and name is None:
+            items = self.store.list(kind, ns)
+            return web.json_response({"kind": f"{kind}List", "items": items})
+        if method == "GET":
+            obj = self.store.get(kind, ns or "default", name or "")
+            if obj is None:
+                return web.json_response({"message": "not found"}, status=404)
+            return web.json_response(obj)
+        if method == "POST" and name is None:
+            manifest = await request.json()
+            manifest.setdefault("metadata", {})
+            if ns is not None:
+                manifest["metadata"].setdefault("namespace", ns)
+            if self.store.get(
+                kind, manifest["metadata"].get("namespace", "default"),
+                manifest["metadata"].get("name", ""),
+            ) is not None:
+                return web.json_response({"message": "already exists"}, status=409)
+            return web.json_response(self.store.apply(manifest), status=201)
+        if method == "PUT" and name is not None:
+            manifest = await request.json()
+            manifest.setdefault("metadata", {})
+            if ns is not None:
+                manifest["metadata"].setdefault("namespace", ns)
+            manifest["metadata"]["name"] = name
+            return web.json_response(self.store.apply(manifest))
+        if method == "DELETE" and name is not None:
+            if self.store.delete(kind, ns or "default", name):
+                return web.json_response({"status": "Success"})
+            return web.json_response({"message": "not found"}, status=404)
+        return web.json_response({"message": f"unsupported {method}"}, status=405)
+
+
+def run_blocking(server: HttpFakeKubeServer, port: int = 0) -> None:
+    """Run the fake API server until interrupted (dev tool:
+    ``python -m langstream_tpu.k8s.http_fake``)."""
+    import asyncio
+
+    async def main() -> None:
+        await server.start(port)
+        print(json.dumps({"url": server.url}), flush=True)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(main())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    run_blocking(HttpFakeKubeServer(), int(sys.argv[1]) if len(sys.argv) > 1 else 0)

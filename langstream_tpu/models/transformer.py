@@ -227,6 +227,8 @@ def _dispatch_attention(
     config: ModelConfig,
     cache_positions: Optional[jax.Array],
     causal: bool,
+    kv_offset: Optional[jax.Array] = None,  # [B] — segment prefill at offset
+    kv_bound: Optional[int] = None,  # static cap on readable cache columns
 ) -> jax.Array:
     """Route to the Pallas kernels when shapes fit TPU tiling, else the jnp
     reference path. Semantics identical; ops/attention has the kernels."""
@@ -253,6 +255,29 @@ def _dispatch_attention(
             q[:, 0], k_all, v_all, lengths, config, interpret=interpret
         )
         return out[:, None, :]
+    if s > 1 and kv_offset is not None:
+        # chunked prefill: the segment attends to the whole written cache
+        # prefix plus its own lower triangle (global-position causal)
+        from langstream_tpu.ops.attention import flash_segment_attention
+
+        if kv_bound is not None and kv_bound < t:
+            # early segments only ever read columns < offset + S; slicing to
+            # the (static, pow2-bucketed) bound keeps int8 dequantization and
+            # kernel grid from streaming the whole mostly-unwritten cache
+            k_all = jax.tree.map(lambda x: x[:, :, :kv_bound], k_all)
+            v_all = jax.tree.map(lambda x: x[:, :, :kv_bound], v_all)
+            mask = mask[:, :, :kv_bound]
+            t = kv_bound
+        if pallas_ok(config, s, t):
+            return flash_segment_attention(
+                q,
+                _dequantize_kv(k_all, q.dtype),
+                _dequantize_kv(v_all, q.dtype),
+                kv_offset,
+                config,
+                interpret=interpret,
+            )
+        return attention(q, k_all, v_all, mask, config)
     if s > 1 and causal and pallas_ok(config, s):
         # prefill/full forward: causal over the first s cache columns (int8
         # caches dequantize just the prompt-wide slice — prefill is
@@ -358,6 +383,8 @@ def _layer(
     cache_kv: Optional[tuple[jax.Array, jax.Array]] = None,
     cache_positions: Optional[jax.Array] = None,
     causal: bool = True,
+    kv_offset: Optional[jax.Array] = None,
+    kv_bound: Optional[int] = None,
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
     """One transformer block. If cache_kv given, k/v are written at
     cache_positions and attention runs over the full cache width."""
@@ -408,7 +435,10 @@ def _layer(
         attn_out = quantized_matmul(ring_attention(q, k, v, config), lp["wo"])
     else:
         attn_out = quantized_matmul(
-            _dispatch_attention(q, k_all, v_all, mask, config, cache_positions, causal),
+            _dispatch_attention(
+                q, k_all, v_all, mask, config, cache_positions, causal,
+                kv_offset, kv_bound,
+            ),
             lp["wo"],
         )
     x = x + attn_out
@@ -448,7 +478,8 @@ def _unembed(params: Params, x: jax.Array, config: ModelConfig) -> jax.Array:
 
 
 def _scan_layers(
-    params, x, sin, cos, mask, config, cache=None, cache_positions=None, causal=True
+    params, x, sin, cos, mask, config, cache=None, cache_positions=None, causal=True,
+    kv_offset=None, kv_bound=None,
 ):
     """lax.scan over stacked layer params; carries (x, cache)."""
     layers = params["layers"]
@@ -465,7 +496,9 @@ def _scan_layers(
     def body_cached(carry, inputs):
         lp, (ck, cv) = inputs
         y, new_kv = _layer(
-            carry, lp, sin, cos, mask, config, cache_kv=(ck, cv), cache_positions=cache_positions
+            carry, lp, sin, cos, mask, config, cache_kv=(ck, cv),
+            cache_positions=cache_positions, kv_offset=kv_offset,
+            kv_bound=kv_bound,
         )
         return y, new_kv
 
@@ -567,6 +600,49 @@ def prefill(
     )
     last = jnp.clip(lengths - 1, 0, s - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    logits = _unembed(params, x_last[:, None, :], config)[:, 0]
+    return logits, cache
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "kv_bound"), donate_argnames=("cache",)
+)
+def prefill_segment(
+    params: Params,
+    tokens: jax.Array,  # [B, W] one padded prompt SEGMENT per row
+    offsets: jax.Array,  # [B] global position of each row's segment start
+    seg_lengths: jax.Array,  # [B] true token count within the segment
+    cache: KVCache,
+    config: ModelConfig,
+    kv_bound: Optional[int] = None,  # static pow2 cap ≥ offset+W (bandwidth)
+) -> tuple[jax.Array, KVCache]:
+    """Chunked prefill: process one segment of a longer prompt against a
+    cache whose columns [0, offsets) were written by earlier segments.
+    Writes the segment's K/V at global positions [offsets, offsets+W) and
+    attends causally over prefix + segment. Returns logits at the last real
+    token of the segment ([B, V]) — meaningful only on the final segment.
+
+    The reference has no counterpart (its only long-input handling is
+    TextSplitter.java chunking BEFORE the model); this is what makes the
+    128k-context presets actually servable with bounded activation memory.
+    """
+    b, s = tokens.shape
+    positions = offsets[:, None] + jnp.arange(s)[None, :]  # [B, W] global
+    sin, cos = _rope_freqs(positions, config)
+    t = cache_width(cache)
+    # causal over global positions: full prefix + lower triangle of segment.
+    # Columns beyond each row's written frontier are masked (stale zeros /
+    # padding K/V are overwritten by later segments or decode before they
+    # ever enter the mask — same invariant as the short prefill path).
+    kv_pos = jnp.arange(t)[None, None, :]
+    mask = kv_pos <= positions[:, :, None]
+    x = _embed(params, tokens, config)
+    x, cache = _scan_layers(
+        params, x, sin, cos, mask, config, cache=cache,
+        cache_positions=positions, kv_offset=offsets, kv_bound=kv_bound,
+    )
+    last = jnp.clip(seg_lengths - 1, 0, s - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
     logits = _unembed(params, x_last[:, None, :], config)[:, 0]
     return logits, cache
 

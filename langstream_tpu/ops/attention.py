@@ -160,6 +160,152 @@ def flash_prefill_attention(
 
 
 # ---------------------------------------------------------------------------
+# Chunked prefill: a prompt SEGMENT at a global offset attending to the
+# already-written cache prefix (long-context serving; the engine loops this
+# over 2k-token segments so any prompt <= max_seq_len serves with bounded
+# activation memory — the O(S^2) single-shot prefill never materializes)
+# ---------------------------------------------------------------------------
+
+
+def _segment_kernel(
+    off_ref,  # [B] int32 scalar-prefetch: global position of segment start
+    q_ref,  # [1, 1, G, block_q, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    o_ref,  # [1, 1, G, block_q, D]
+    m_scr,  # [G, block_q, 128] f32
+    l_scr,  # [G, block_q, 128] f32
+    acc_scr,  # [G, block_q, D] f32
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+    softcap,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)  # query block (within the segment)
+    j = pl.program_id(3)  # key block (over the full cache width)
+    nk = pl.num_programs(3)
+    off = off_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = off + i * block_q  # GLOBAL position of this q block's first row
+    k_start = j * block_k
+
+    # causal against global positions: the whole prefix (k < off) is visible,
+    # plus the lower triangle within the segment
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _body():
+        q = q_ref[0, 0, :, :, :].astype(jnp.float32)  # [G, block_q, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 1)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 2)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+
+        m_prev = m_scr[:, :, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
+        m_scr[:, :, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
+        o_ref[0, 0, :, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def flash_segment_attention(
+    q: jax.Array,  # [B, S, H, D] — segment queries
+    k: jax.Array,  # [B, Hkv, T, D] cache (head-major), T >= offset + S
+    v: jax.Array,  # [B, Hkv, T, D]
+    offset: jax.Array,  # [B] int32 global position of the segment start
+    config: ModelConfig,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal GQA attention of a segment against cache prefix + itself
+    → [B, S, H*D]. The segment's own K/V must already be scattered into the
+    cache at [offset, offset+S)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[1]
+    t = k.shape[2]
+    group = h // hkv
+    block_q = min(block_q, s)
+    block_k = min(block_k, t)
+    assert s % block_q == 0 and t % block_k == 0, "caller gates divisibility"
+    qg = q.reshape(b, s, hkv, group, d).transpose(0, 2, 3, 1, 4)
+
+    kernel = functools.partial(
+        _segment_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+
+    def kv_index(b, h, i, j, off):
+        # clamp past-diagonal blocks to the last block this q block needs:
+        # Pallas re-references the SAME block and elides the HBM→VMEM DMA,
+        # so early segments don't stream the whole (mostly-unwritten) cache
+        last = jnp.maximum(pl.cdiv(off[b] + (i + 1) * block_q, block_k) - 1, 0)
+        return (b, h, jnp.minimum(j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, s // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group, block_q, d), lambda b, h, i, j, off: (b, h, 0, i, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, block_q, d), lambda b, h, i, j, off: (b, h, 0, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, s, d), q.dtype),
+        interpret=interpret,
+    )(offset.astype(jnp.int32), qg, k, v)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, h * d)
+
+
+# ---------------------------------------------------------------------------
 # Decode: one query per row against a ragged KV cache
 # ---------------------------------------------------------------------------
 

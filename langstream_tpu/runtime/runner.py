@@ -292,28 +292,83 @@ class AgentRunner:
         if self.source is None:
             raise RuntimeError(f"agent {self.node.id} has no source and is not a service")
 
+        # Pipelined read/process (reference AgentRunner.java:669-729: the
+        # poll loop keeps reading while processing completes via ordered
+        # callbacks). Up to ``max-inflight-batches`` batches process
+        # concurrently; RESULTS are handled strictly in source order (the
+        # writer drains a FIFO of batch tasks), so sink writes and commits
+        # keep the reference's ordering guarantees while a slow record in
+        # batch k no longer stalls batch k+1's processing — the round-2 e2e
+        # TTFT bottleneck: records arriving mid-generation waited out the
+        # whole previous batch before the engine even saw them.
         loops = 0
-        while not self._stop.is_set():
-            if max_loops is not None and loops >= max_loops:
-                break
-            loops += 1
-            records = await self.source.read()
-            if not records:
-                continue
-            self._records_in += len(records)
-            self._m_in.count(len(records))
+        depth = max(1, int(self.node.configuration.get("max-inflight-batches", 4)))
+        pending: asyncio.Queue = asyncio.Queue(maxsize=depth)
+
+        async def process_batch(records: list[Record], trace_id: str):
             # a batch-level span joins the FIRST record's trace (per-record
             # spans would serialize the batch); records without a trace id
             # get this one stamped on their outputs so the path stitches
-            trace_id = record_trace_id(records[0]) or uuid.uuid4().hex[:16]
             with TRACER.span(
                 f"agent.{self.node.id}.process",
                 trace_id=trace_id,
                 agent_type=self.node.agent_type,
                 records=len(records),
             ):
-                results = await self.processor.process(records)
-            await self._handle_results(results, trace_id)
+                return await self.processor.process(records)
+
+        async def writer() -> None:
+            while True:
+                item = await pending.get()
+                if item is None:
+                    return
+                task, trace_id = item
+                results = await task
+                await self._handle_results(results, trace_id)
+
+        writer_task = asyncio.create_task(writer())
+        try:
+            while not self._stop.is_set():
+                if max_loops is not None and loops >= max_loops:
+                    break
+                if writer_task.done():
+                    break  # writer hit a permanent failure; surfaced below
+                loops += 1
+                # race the read against the writer so a sink/handler failure
+                # surfaces immediately instead of hanging behind a quiet topic
+                read_task = asyncio.create_task(self.source.read())
+                await asyncio.wait(
+                    {read_task, writer_task}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not read_task.done():
+                    read_task.cancel()
+                    break  # writer failed; propagated below
+                records = read_task.result()
+                if not records:
+                    continue
+                self._records_in += len(records)
+                self._m_in.count(len(records))
+                trace_id = record_trace_id(records[0]) or uuid.uuid4().hex[:16]
+                task = asyncio.create_task(process_batch(records, trace_id))
+                put = asyncio.create_task(pending.put((task, trace_id)))
+                # the put blocks at pipeline depth (backpressure toward the
+                # broker); racing it against the writer avoids a deadlock if
+                # the writer dies while the queue is full
+                await asyncio.wait({put, writer_task}, return_when=asyncio.FIRST_COMPLETED)
+                if not put.done():
+                    put.cancel()
+                    task.cancel()
+                    break
+            if not writer_task.done():
+                await pending.put(None)
+            await writer_task  # drain in-flight batches; propagate failures
+        finally:
+            if not writer_task.done():
+                writer_task.cancel()
+            while not pending.empty():
+                item = pending.get_nowait()
+                if item is not None:
+                    item[0].cancel()
 
     async def _handle_results(
         self, results: list[ProcessorResult], trace_id: Optional[str] = None

@@ -29,7 +29,12 @@ import numpy as np
 from jax import lax
 
 from langstream_tpu.models.configs import GenerationOptions, ModelConfig
-from langstream_tpu.models.transformer import decode_step, make_kv_cache, prefill
+from langstream_tpu.models.transformer import (
+    decode_step,
+    make_kv_cache,
+    prefill,
+    prefill_segment,
+)
 from langstream_tpu.serving.sampling import sample
 
 log = logging.getLogger(__name__)
@@ -126,6 +131,25 @@ def _prefill_and_sample(params, tokens, length, local_cache, key, temp, top_k, t
     return first, local_cache, key
 
 
+@functools.partial(
+    jax.jit, static_argnames=("config", "kv_bound"), donate_argnames=("local_cache",)
+)
+def _prefill_segment_and_sample(
+    params, tokens, offsets, seg_lengths, local_cache, key, temp, top_k, top_p,
+    config, kv_bound,
+):
+    """One chunked-prefill segment + a sample of its last-token logits.
+    Sampling every segment (vs only the last) keeps the compiled-shape count
+    at O(log2 segments) (the pow2 kv_bound); non-final samples are simply
+    never fetched."""
+    logits, local_cache = prefill_segment(
+        params, tokens, offsets, seg_lengths, local_cache, config, kv_bound
+    )
+    key, sub = jax.random.split(key)
+    first = sample(logits, sub, temp, top_k, top_p)
+    return first, local_cache, key
+
+
 def _make_insert_group():
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def insert_group(cache, local_cache, slots):
@@ -150,7 +174,7 @@ def _make_insert_group():
 class ServingEngine:
     """One engine per model per agent replica; owns the device loop."""
 
-    # rows per prefill call — fixed so each width bucket compiles ONCE
+    # default rows per prefill call — fixed so each width bucket compiles ONCE
     PREFILL_BATCH = 8
 
     def __init__(
@@ -164,6 +188,7 @@ class ServingEngine:
         rng_seed: int = 0,
         mesh: Optional[Any] = None,
         decode_chunk: int = 8,
+        prefill_batch: Optional[int] = None,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -211,6 +236,17 @@ class ServingEngine:
         self.decode_chunk = max(1, int(decode_chunk))
         # steps of the currently in-flight (dispatched, unfetched) chunk
         self._inflight_steps = 0
+        # rows per prefill dispatch: bigger = fewer serial prefill calls
+        # under a burst (each call costs a tunnel dispatch), at the price of
+        # one compile per (prefill_batch, width) shape
+        self.prefill_batch = int(prefill_batch or self.PREFILL_BATCH)
+        # chunked prefill (long-context): prompts wider than the largest
+        # bucket loop prefill_segment over bucket-width segments into a
+        # batch-1 local cache, one segment per engine iteration so decode
+        # keeps flowing in between
+        self._long: Optional[dict] = None
+        self._long_queue: list[GenerationRequest] = []
+        self._reserved: set[int] = set()
         # stats
         self.total_generated = 0
         self.total_requests = 0
@@ -239,11 +275,11 @@ class ServingEngine:
         toward the broker poll loop — SURVEY §7 hard parts)."""
         if self._dead is not None:
             raise RuntimeError("serving engine is stopped") from self._dead
-        limit = min(self.max_seq_len - 1, self.prefill_buckets[-1])
+        limit = self.max_seq_len - 1
         if len(request.prompt_tokens) > limit:
             raise ValueError(
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
-                f"engine limit of {limit} (largest prefill bucket / max_seq_len)"
+                f"engine limit of {limit} (max_seq_len - 1)"
             )
         self._queue.put(request)
         return request
@@ -270,6 +306,8 @@ class ServingEngine:
             "active-slots": active,
             "max-batch": self.max_batch,
             "queued": self._queue.qsize(),
+            "long-prefill-active": self._long is not None,
+            "long-prefill-queued": len(self._long_queue),
             "total-requests": self.total_requests,
             "total-generated-tokens": self.total_generated,
             "busy-steps": self._busy_steps,
@@ -286,10 +324,14 @@ class ServingEngine:
                 self._inflight_steps = next(
                     (e[3] for e in pending if e[0] == "chunk"), 0
                 )
-                new_pending = self._admit()  # deferred prefill first-token fetches
+                # long prefill FIRST: it claims a freed slot before _admit
+                # hands them all to short requests, so a long prompt can't be
+                # starved forever under sustained short traffic
+                new_pending = self._long_step()  # one segment / iteration
+                new_pending.extend(self._admit())  # deferred first-token fetches
                 if any(s.active for s in self._slots):
                     new_pending.append(self._dispatch_chunk())
-                elif not new_pending and not pending:
+                elif not new_pending and not pending and self._long is None:
                     time.sleep(0.001)
                 # fetching round k's tokens overlaps with round k+1's compute
                 for entry in pending:
@@ -327,19 +369,37 @@ class ServingEngine:
 
     def _admit(self) -> list[tuple]:
         """Move queued requests into free slots (prefill path); returns the
-        deferred first-token fetch entries (processed after the next chunk
-        dispatch, so the fetch overlaps device compute).
+        deferred first-token fetch entries of the LAST dispatched group
+        (processed after the next chunk dispatch, so the fetch overlaps
+        device compute). Earlier groups are fetched progressively — group
+        j's first tokens are delivered while group j+1 computes, so a burst
+        streams first tokens wave by wave instead of all-at-the-end.
 
         Prefills are BATCHED per prompt bucket: admitting K requests costs
         one forward at batch K (memory-bound: ~the cost of batch 1), not K
         serial dispatches — serial prefill dominated wall-clock when a burst
-        filled a large slot pool."""
-        free = [i for i, slot in enumerate(self._slots) if not slot.active]
+        filled a large slot pool. Prompts wider than the largest bucket take
+        the chunked-prefill path instead (_long_step)."""
+        free = [
+            i
+            for i, slot in enumerate(self._slots)
+            if not slot.active and i not in self._reserved
+        ]
         pairs: list[tuple[int, GenerationRequest]] = []
+        short_limit = self.prefill_buckets[-1]
         for idx in free:
-            try:
-                pairs.append((idx, self._queue.get_nowait()))
-            except queue.Empty:
+            got_short = False
+            while not got_short:
+                try:
+                    request = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if len(request.prompt_tokens) > short_limit:
+                    self._long_queue.append(request)  # chunked-prefill path
+                else:
+                    pairs.append((idx, request))
+                    got_short = True
+            if not got_short:
                 break
         if not pairs:
             return []
@@ -347,15 +407,16 @@ class ServingEngine:
         for idx, request in pairs:
             width = self._bucket(len(request.prompt_tokens))
             groups.setdefault(width, []).append((idx, request))
+        prev: list[tuple] = []
         entries: list[tuple] = []
         for width, group in sorted(groups.items()):
             # fixed sub-batch size: each distinct (batch, width) shape is a
             # separate XLA compile (expensive through a TPU tunnel), so every
-            # prefill call uses exactly PREFILL_BATCH rows
-            for start in range(0, len(group), self.PREFILL_BATCH):
-                sub = group[start : start + self.PREFILL_BATCH]
+            # prefill call uses exactly prefill_batch rows
+            for start in range(0, len(group), self.prefill_batch):
+                sub = group[start : start + self.prefill_batch]
                 try:
-                    entries.extend(self._prefill_group(width, sub))
+                    new = self._prefill_group(width, sub)
                 except Exception as e:  # noqa: BLE001 — fail the group, not the engine
                     log.exception("prefill failed for a batch of %d requests", len(sub))
                     for _, request in sub:
@@ -363,15 +424,22 @@ class ServingEngine:
                             tokens=[], finish_reason="error", prompt_tokens=0,
                             ttft_s=0, total_s=0, error=e,
                         ))
+                    continue
+                # deliver the previous group's first tokens while this
+                # group's prefill runs on device
+                for entry in prev:
+                    self._process_entry(entry)
+                prev = new
+        entries.extend(prev)
         return entries
 
     def _prefill_group(
         self, width: int, group: list[tuple[int, GenerationRequest]]
     ) -> list[tuple]:
         """One batched prefill for every (slot, request) pair of one prompt
-        bucket; always padded to PREFILL_BATCH rows (single compiled shape
+        bucket; always padded to prefill_batch rows (single compiled shape
         per width bucket)."""
-        n_pad = self.PREFILL_BATCH
+        n_pad = self.prefill_batch
         assert len(group) <= n_pad
         tokens = np.zeros((n_pad, width), np.int32)
         lengths = np.ones(n_pad, np.int32)
@@ -437,16 +505,141 @@ class ServingEngine:
         Host positions lag the device by the one in-flight pipelined chunk
         (its results are fetched AFTER the next dispatch), so the bound
         subtracts that chunk's steps — otherwise the tail of a long request
-        burns whole chunks on out-of-bounds scatters that XLA drops."""
+        burns whole chunks on out-of-bounds scatters that XLA drops.
+
+        TTFT lever: when admissible work is waiting (queued request + a free
+        slot, or a chunked prefill in flight), the chunk shrinks so the next
+        admit/segment runs within a few decode steps instead of a full
+        chunk — at decode_chunk=64 and ~15ms/step a full chunk is ~1s of
+        first-token latency for whoever just arrived. Full-size chunks
+        resume once the queue drains (or all slots are busy, when admitting
+        sooner is impossible anyway)."""
+        want = self.decode_chunk
+        if self._long is not None:
+            want = min(want, 8)
+        elif self._queue.qsize() > 0 and any(
+            not s.active and i not in self._reserved
+            for i, s in enumerate(self._slots)
+        ):
+            want = min(want, 4)
         headroom = min(
             self.max_seq_len - 1 - s.position - self._inflight_steps
             for s in self._slots
             if s.active
         )
         steps = 1
-        while steps * 2 <= min(self.decode_chunk, max(1, headroom)):
+        while steps * 2 <= min(want, max(1, headroom)):
             steps *= 2
         return steps
+
+    # -- chunked prefill (long-context) -------------------------------------
+
+    def _long_width(self, prompt_len: int) -> int:
+        """Local-cache width for a long prompt: next power of two ≥ the
+        prompt (128-aligned for the segment kernel), clamped to max_seq."""
+        w = self.prefill_buckets[-1]
+        while w < prompt_len:
+            w *= 2
+        return min(w, self.max_seq_len)
+
+    def _long_step(self) -> list[tuple]:
+        """Drive the chunked-prefill state machine: start the next queued
+        long request when a slot frees, then dispatch ONE segment per engine
+        iteration (decode chunks interleave between segments, so active
+        generations keep streaming while a 128k prompt prefills)."""
+        if self._long is None:
+            if not self._long_queue:
+                return []
+            free = next(
+                (
+                    i
+                    for i, s in enumerate(self._slots)
+                    if not s.active and i not in self._reserved
+                ),
+                None,
+            )
+            if free is None:
+                return []
+            request = self._long_queue.pop(0)
+            prompt = request.prompt_tokens
+            local_cache = make_kv_cache(
+                self.config, 1, self._long_width(len(prompt))
+            )
+            if self.mesh is not None:
+                from langstream_tpu.parallel.sharding import shard_serving_cache
+
+                local_cache = shard_serving_cache(local_cache, self.mesh)
+            self._reserved.add(free)
+            self._long = {
+                "idx": free,
+                "request": request,
+                "cache": local_cache,
+                "seg": 0,
+            }
+        st = self._long
+        request: GenerationRequest = st["request"]
+        prompt = request.prompt_tokens
+        width = self.prefill_buckets[-1]
+        s0 = st["seg"] * width
+        seg = prompt[s0 : s0 + width]
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, : len(seg)] = seg
+        opts = request.options
+        # static pow2 cap on readable cache columns: segment i never attends
+        # past offset+W, so early segments skip streaming the whole cache
+        t_long = self._long_width(len(prompt))
+        kv_bound = width
+        while kv_bound < min(s0 + width, t_long):
+            kv_bound *= 2
+        kv_bound = min(kv_bound, t_long)
+        try:
+            first, st["cache"], self._key = _prefill_segment_and_sample(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray([s0], jnp.int32),
+                jnp.asarray([len(seg)], jnp.int32),
+                st["cache"],
+                self._key,
+                jnp.asarray([opts.temperature], jnp.float32),
+                jnp.asarray([opts.top_k], jnp.int32),
+                jnp.asarray([opts.top_p], jnp.float32),
+                self.config,
+                kv_bound,
+            )
+        except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            log.exception("chunked prefill failed at segment %d", st["seg"])
+            idx = st["idx"]
+            self._reserved.discard(idx)
+            self._long = None
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=e,
+            ))
+            return []
+        st["seg"] += 1
+        if s0 + width < len(prompt):
+            return []  # more segments to go
+
+        # final segment: splice into the big cache and activate the slot
+        idx = st["idx"]
+        self._long = None
+        self._reserved.discard(idx)
+        slots = np.full(1, idx, np.int32)
+        slots_dev = jnp.asarray(slots)
+        self._cache = self._insert_group(self._cache, st["cache"], slots_dev)
+        self._tokens_dev = self._tokens_dev.at[idx].set(first[0])
+        self._positions_dev = self._positions_dev.at[idx].set(len(prompt))
+        self._temp_dev = self._temp_dev.at[idx].set(opts.temperature)
+        self._top_k_dev = self._top_k_dev.at[idx].set(opts.top_k)
+        self._top_p_dev = self._top_p_dev.at[idx].set(opts.top_p)
+        slot = self._slots[idx]
+        slot.request = request
+        slot.position = len(prompt)
+        slot.generated = []
+        slot.started_at = time.monotonic()
+        slot.first_token_at = 0.0
+        self.total_requests += 1
+        return [("prefill", first, [(idx, request)])]
 
     def _dispatch_chunk(self) -> tuple:
         """Dispatch one multi-step decode; returns (device tokens,
@@ -538,6 +731,19 @@ class ServingEngine:
 
     def _fail_all(self, error: BaseException) -> None:
         self._dead = error
+        if self._long is not None:
+            self._long["request"]._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=error,
+            ))
+            self._long = None
+        for request in self._long_queue:
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=error,
+            ))
+        self._long_queue.clear()
+        self._reserved.clear()
         for slot in self._slots:
             if slot.request is not None:
                 slot.request._finish(GenerationResult(

@@ -246,9 +246,14 @@ class ControlPlaneServer:
         return web.Response(text="\n".join(lines), content_type="text/plain")
 
     async def _code(self, request: web.Request) -> web.Response:
+        import asyncio
+
         tenant = request.match_info["tenant"]
         self._check_tenant(tenant)
-        data = self.applications.download_code(tenant, request.match_info["name"])
+        # code storage may be remote (S3): off the event loop
+        data = await asyncio.to_thread(
+            self.applications.download_code, tenant, request.match_info["name"]
+        )
         return web.Response(body=data, content_type="application/zip")
 
     # -- tenants -------------------------------------------------------------

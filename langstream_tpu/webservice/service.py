@@ -205,7 +205,11 @@ class ApplicationService:
 
             code_archive_id = None
             if self.code_storage is not None:
-                meta = self.code_storage.store(tenant, application_id, archive_bytes)
+                # storage may be remote (S3): keep its blocking I/O off the
+                # event loop, which also serves the archive endpoints
+                meta = await asyncio.to_thread(
+                    self.code_storage.store, tenant, application_id, archive_bytes
+                )
                 code_archive_id = meta.code_store_id
                 if (
                     existing is not None
@@ -213,7 +217,9 @@ class ApplicationService:
                     and existing.code_archive_id != code_archive_id
                 ):
                     try:
-                        self.code_storage.delete(tenant, existing.code_archive_id)
+                        await asyncio.to_thread(
+                            self.code_storage.delete, tenant, existing.code_archive_id
+                        )
                     except Exception:  # noqa: BLE001
                         log.exception("failed to delete superseded code archive")
 
@@ -288,7 +294,9 @@ class ApplicationService:
                 await self.runtime.delete_application(tenant, application_id)
             if self.code_storage is not None and stored.code_archive_id:
                 try:
-                    self.code_storage.delete(tenant, stored.code_archive_id)
+                    await asyncio.to_thread(
+                        self.code_storage.delete, tenant, stored.code_archive_id
+                    )
                 except Exception:  # noqa: BLE001
                     log.exception("failed to delete code archive")
             self.store.delete(tenant, application_id)
@@ -402,9 +410,12 @@ class TenantService:
 
 def make_local_service(
     root: Optional[str] = None,
+    code_storage: Optional[CodeStorage] = None,
 ) -> tuple[ApplicationService, TenantService, LocalRuntimeManager]:
     """Wire a fully local control plane: disk or memory stores + in-process
-    runtime (the `langstream docker run` topology, one process)."""
+    runtime (the `langstream docker run` topology, one process).
+    ``code_storage`` overrides the default disk/memory archive store (e.g.
+    S3CodeStorage from the ``codeStorage`` config block)."""
     from langstream_tpu.webservice.stores import (
         InMemoryCodeStorage,
         InMemoryGlobalMetadataStore,
@@ -415,11 +426,11 @@ def make_local_service(
     runtime = LocalRuntimeManager()
     if root is None:
         store: ApplicationStore = InMemoryApplicationStore()
-        code: Optional[CodeStorage] = InMemoryCodeStorage()
+        code: Optional[CodeStorage] = code_storage or InMemoryCodeStorage()
         tenants = TenantService(InMemoryGlobalMetadataStore())
     else:
         store = LocalDiskApplicationStore(f"{root}/apps")
-        code = LocalDiskCodeStorage(f"{root}/code")
+        code = code_storage or LocalDiskCodeStorage(f"{root}/code")
         tenants = TenantService(LocalDiskGlobalMetadataStore(root))
     tenants.put("default")
     return ApplicationService(store, code, runtime), tenants, runtime

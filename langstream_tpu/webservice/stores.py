@@ -359,3 +359,101 @@ class LocalDiskCodeStorage(CodeStorage):
         path = self.root / tenant / f"{code_store_id}.zip"
         if path.exists():
             path.unlink()
+
+
+class S3CodeStorage(CodeStorage):
+    """Archive store on any S3-compatible endpoint (reference
+    ``S3CodeStorage.java`` — minio in its deploy stack). Objects live at
+    ``{bucket}/{tenant}/{code_store_id}.zip``; requests are SigV4-signed
+    with the same stdlib signer the s3-source agent uses
+    (agents/storage/_sigv4_headers), no SDK."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket: str = "langstream-code-storage",
+        access_key: str = "minioadmin",
+        secret_key: str = "minioadmin",
+        region: str = "us-east-1",
+    ) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    @staticmethod
+    def from_config(config: dict[str, Any]) -> "S3CodeStorage":
+        return S3CodeStorage(
+            endpoint=config["endpoint"],
+            bucket=config.get("bucket-name", "langstream-code-storage"),
+            access_key=config.get("access-key", "minioadmin"),
+            secret_key=config.get("secret-key", "minioadmin"),
+            region=config.get("region", "us-east-1"),
+        )
+
+    def _request(self, method: str, key: str, payload: bytes = b"") -> tuple[int, bytes]:
+        import urllib.error
+        import urllib.request
+
+        from langstream_tpu.agents.storage import _sigv4_headers
+
+        url = f"{self.endpoint}/{self.bucket}/{key}"
+        headers = _sigv4_headers(
+            method, url, self.region, self.access_key, self.secret_key, payload
+        )
+        req = urllib.request.Request(
+            url, data=payload if method == "PUT" else None, method=method
+        )
+        for k, v in headers.items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _key(self, tenant: str, code_store_id: str) -> str:
+        return f"{tenant}/{code_store_id}.zip"
+
+    def store(
+        self, tenant: str, application_id: str, archive_bytes: bytes
+    ) -> CodeArchiveMetadata:
+        digest = hashlib.sha256(archive_bytes).hexdigest()
+        code_store_id = f"{application_id}-{digest[:16]}"
+        status, body = self._request(
+            "PUT", self._key(tenant, code_store_id), archive_bytes
+        )
+        if status not in (200, 201, 204):
+            raise RuntimeError(f"S3 code upload failed ({status}): {body[:200]!r}")
+        return CodeArchiveMetadata(
+            tenant=tenant,
+            code_store_id=code_store_id,
+            application_id=application_id,
+            digests={"archive": digest},
+        )
+
+    def download(self, tenant: str, code_store_id: str) -> bytes:
+        status, body = self._request("GET", self._key(tenant, code_store_id))
+        if status == 404:
+            raise FileNotFoundError(f"code archive {tenant}/{code_store_id} not found")
+        if status != 200:
+            raise RuntimeError(f"S3 code download failed ({status}): {body[:200]!r}")
+        return body
+
+    def delete(self, tenant: str, code_store_id: str) -> None:
+        self._request("DELETE", self._key(tenant, code_store_id))
+
+
+def make_code_storage(config: dict[str, Any]) -> CodeStorage:
+    """``codeStorage`` config block → implementation (reference
+    CodeStorageRegistry: type s3 | azure | local | memory)."""
+    kind = (config.get("type") or "memory").lower()
+    if kind == "s3":
+        return S3CodeStorage.from_config(config.get("configuration", config))
+    if kind in ("local", "disk"):
+        cfg = config.get("configuration", config)
+        return LocalDiskCodeStorage(cfg.get("path", "/var/lib/langstream-tpu/code"))
+    if kind in ("memory", "none"):
+        return InMemoryCodeStorage()
+    raise ValueError(f"unknown code storage type {kind!r}")

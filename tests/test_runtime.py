@@ -435,3 +435,79 @@ def test_metrics_info_http_server(run):
             await runner.stop()
 
     run(scenario())
+
+
+class GatedProcessor(SingleRecordProcessor):
+    """Parks records whose value starts with "slow" until released; records
+    the order in which processing STARTS (pipelining observability)."""
+
+    gate = None  # asyncio.Event, installed by the test
+    started: list = []
+
+    async def process_record(self, record: Record) -> list[Record]:
+        GatedProcessor.started.append(str(record.value))
+        if str(record.value).startswith("slow") and GatedProcessor.gate is not None:
+            await GatedProcessor.gate.wait()
+        return [record]
+
+
+REGISTRY.register_agent(
+    AgentTypeInfo(
+        type="gated",
+        component_type=ComponentType.PROCESSOR,
+        factory=GatedProcessor,
+        composable=False,
+        config_model=ConfigModel(type="gated", allow_unknown=True),
+    )
+)
+
+
+def test_pipelined_read_no_batch_head_of_line(run):
+    """A slow record in batch k must not stop batch k+1 from STARTING
+    (reference AgentRunner.java:669-729 keeps polling while processing
+    completes asynchronously); results still land in source order."""
+    pipeline = """
+module: default
+id: app
+topics:
+  - name: in-t
+  - name: out-t
+pipeline:
+  - name: g
+    type: gated
+    input: in-t
+    output: out-t
+"""
+
+    async def main():
+        GatedProcessor.gate = asyncio.Event()
+        GatedProcessor.started = []
+        app = make_app(pipeline)
+        runner = LocalApplicationRunner("test-app", app)
+        await runner.run()
+        try:
+            # batch 1 = the slow record (first read returns just it);
+            # batch 2 arrives while batch 1 is parked on the gate
+            await runner.produce("in-t", "slow-1")
+            for _ in range(50):
+                if "slow-1" in GatedProcessor.started:
+                    break
+                await asyncio.sleep(0.02)
+            await runner.produce("in-t", "fast-2")
+            # pipelining: fast-2's processing STARTS while slow-1 is parked
+            for _ in range(100):
+                if "fast-2" in GatedProcessor.started:
+                    break
+                await asyncio.sleep(0.02)
+            assert "fast-2" in GatedProcessor.started, (
+                "batch 2 never started while batch 1 was in flight "
+                "(head-of-line blocking is back)"
+            )
+            # nothing written yet: results are handled in source order
+            GatedProcessor.gate.set()
+            records = await runner.consume("out-t", 2, timeout=10)
+            assert [str(r.value) for r in records] == ["slow-1", "fast-2"]
+        finally:
+            await runner.stop()
+
+    run(main())

@@ -324,3 +324,120 @@ def test_ui_served(run):
             await server.stop()
 
     run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# S3-compatible code storage (reference S3CodeStorage.java)
+# ---------------------------------------------------------------------------
+
+
+def make_s3_code_stub(store):
+    """Minimal S3 REST stub: PUT/GET/DELETE objects under one bucket."""
+    from aiohttp import web
+
+    async def put_object(request):
+        assert request.headers.get("Authorization", "").startswith("AWS4-HMAC-SHA256")
+        store[request.match_info["key"]] = await request.read()
+        return web.Response(status=200)
+
+    async def get_object(request):
+        key = request.match_info["key"]
+        if key not in store:
+            return web.Response(status=404)
+        return web.Response(body=store[key])
+
+    async def delete_object(request):
+        store.pop(request.match_info["key"], None)
+        return web.Response(status=204)
+
+    app = web.Application()
+    app.add_routes(
+        [
+            web.put("/code-bucket/{key:.*}", put_object),
+            web.get("/code-bucket/{key:.*}", get_object),
+            web.delete("/code-bucket/{key:.*}", delete_object),
+        ]
+    )
+    return app
+
+
+async def start_s3_stub(store):
+    from aiohttp import web
+
+    runner = web.AppRunner(make_s3_code_stub(store))
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_s3_code_storage_roundtrip(run):
+    import asyncio
+
+    from langstream_tpu.webservice.stores import S3CodeStorage
+
+    async def main():
+        objects = {}
+        runner, base = await start_s3_stub(objects)
+        try:
+            storage = S3CodeStorage(base, bucket="code-bucket", region="us-east-1")
+
+            def drive():
+                meta = storage.store("t1", "app1", b"zip-bytes-here")
+                assert meta.tenant == "t1"
+                assert meta.application_id == "app1"
+                assert f"t1/{meta.code_store_id}.zip" in objects
+                assert storage.download("t1", meta.code_store_id) == b"zip-bytes-here"
+                storage.delete("t1", meta.code_store_id)
+                import pytest as _p
+
+                with _p.raises(FileNotFoundError):
+                    storage.download("t1", meta.code_store_id)
+
+            await asyncio.to_thread(drive)
+        finally:
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_control_plane_deploy_download_via_s3(run):
+    """Full control-plane round trip with the archive store on S3: deploy
+    uploads the zip to the bucket, the code endpoint serves it back from
+    there (reference deploy path through S3CodeStorage)."""
+    from langstream_tpu.webservice.server import ControlPlaneServer
+    from langstream_tpu.webservice.service import make_local_service
+    from langstream_tpu.webservice.stores import S3CodeStorage
+
+    async def main():
+        objects = {}
+        s3_runner, base = await start_s3_stub(objects)
+        applications, tenants, runtime = make_local_service(
+            None, S3CodeStorage(base, bucket="code-bucket")
+        )
+        server = ControlPlaneServer(applications, tenants, port=0)
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, _ = await deploy_app(session, server, name="s3app")
+                assert status in (200, 201)
+                assert len(objects) == 1  # archive landed in the bucket
+                async with session.get(
+                    f"{server.url}/api/applications/default/s3app/code"
+                ) as resp:
+                    assert resp.status == 200
+                    data = await resp.read()
+            # the download IS the stored zip
+            assert data == next(iter(objects.values()))
+            import io
+            import zipfile
+
+            names = zipfile.ZipFile(io.BytesIO(data)).namelist()
+            assert "pipeline.yaml" in names
+        finally:
+            await server.stop()
+            await runtime.close()
+            await s3_runner.cleanup()
+
+    run(main())
