@@ -107,6 +107,7 @@ FUNCTIONS: dict[str, Any] = {
     "uuid": lambda: str(uuid.uuid4()),
     "randomUUID": lambda: str(uuid.uuid4()),
     "now": lambda: time.time(),
+    "timestamp": lambda: time.time(),
     "currentTimeMillis": lambda: int(time.time() * 1000),
     "timestampAdd": _timestamp_add,
     "dateadd": _timestamp_add,

@@ -349,10 +349,9 @@ class LocalVectorWriter(VectorDatabaseWriter):
 # datasource resource resolution
 # ---------------------------------------------------------------------------
 
-_UNBUNDLED = {
-    "cassandra", "astra", "astra-vector-db", "pinecone", "milvus",
-    "opensearch", "solr",
-}
+# drivers that still need a binary/SDK client not in this image (cassandra
+# native protocol, milvus grpc); the HTTP-API DBs are bundled (remote.py)
+_UNBUNDLED = {"cassandra", "astra", "astra-vector-db", "milvus"}
 
 
 def build_datasource(config: dict[str, Any]) -> DataSource:
@@ -361,19 +360,37 @@ def build_datasource(config: dict[str, Any]) -> DataSource:
         return SqliteDataSource(config)
     if service in ("local-vector", "in-memory", "tpu-vector"):
         return LocalVectorDataSource(config)
+    if service in ("pinecone", "opensearch", "solr"):
+        from langstream_tpu.agents.vector import remote
+
+        cls = {
+            "pinecone": remote.PineconeDataSource,
+            "opensearch": remote.OpenSearchDataSource,
+            "solr": remote.SolrDataSource,
+        }[service]
+        return cls(config)
     if service in _UNBUNDLED:
         raise ValueError(
             f"datasource service {service!r} requires an external client that is "
-            f"not bundled; use 'jdbc' (sqlite) or 'local-vector'"
+            f"not bundled; use 'jdbc' (sqlite), 'local-vector', or an HTTP-API "
+            f"store (pinecone/opensearch/solr)"
         )
     raise ValueError(f"unknown datasource service {service!r}")
 
 
 def build_writer(datasource: DataSource, config: dict[str, Any]) -> VectorDatabaseWriter:
+    from langstream_tpu.agents.vector import remote
+
     if isinstance(datasource, LocalVectorDataSource):
         return LocalVectorWriter(datasource, config)
     if isinstance(datasource, SqliteDataSource):
         return JdbcTableWriter(datasource, config)
+    if isinstance(datasource, remote.PineconeDataSource):
+        return remote.PineconeWriter(datasource, config)
+    if isinstance(datasource, remote.OpenSearchDataSource):
+        return remote.OpenSearchWriter(datasource, config)
+    if isinstance(datasource, remote.SolrDataSource):
+        return remote.SolrWriter(datasource, config)
     raise ValueError(f"no vector writer for datasource {type(datasource).__name__}")
 
 

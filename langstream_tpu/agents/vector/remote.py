@@ -1,0 +1,266 @@
+"""SDK-free HTTP clients for remote vector databases.
+
+Parity: reference `langstream-vector-agents` per-DB datasources/writers —
+`pinecone/PineconeDataSource.java`, `opensearch/OpenSearchDataSource.java`
++ `OpenSearchWriter.java`, `solr/SolrDataSource.java` + writer. Each spoke
+an official SDK; here the REST APIs are driven directly with aiohttp (the
+image has no egress, so these are exercised against local HTTP stubs —
+`tests/test_vector_remote.py`, the google/github auth-provider pattern).
+
+Query convention (the reference's for non-SQL stores): the `query` string
+is a JSON document; positional `fields` values substitute `"?"`
+placeholders in order (shared `_substitute_params`).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import aiohttp
+
+from langstream_tpu.agents.genai import el
+from langstream_tpu.agents.genai.mutable import MutableRecord
+from langstream_tpu.api.storage import DataSource, VectorDatabaseWriter
+
+
+def _substitute_params(obj: Any, params: list[Any]) -> Any:
+    if isinstance(obj, dict):
+        return {k: _substitute_params(v, params) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_substitute_params(v, params) for v in obj]
+    if obj == "?" and params:
+        return params.pop(0)
+    return obj
+
+
+def _parse_query(query: str, params: list[Any]) -> dict[str, Any]:
+    try:
+        parsed = json.loads(query)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"remote vector query must be JSON: {e}") from e
+    return _substitute_params(parsed, list(params))
+
+
+class _HttpDataSource(DataSource):
+    """Shared aiohttp session + JSON request plumbing."""
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        self.config = dict(config)
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _request(
+        self, method: str, url: str, body: Optional[dict] = None,
+        headers: Optional[dict[str, str]] = None,
+    ) -> dict[str, Any]:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        async with self._session.request(
+            method, url, json=body, headers=headers or {}
+        ) as resp:
+            text = await resp.text()
+            if resp.status >= 400:
+                raise RuntimeError(f"{type(self).__name__} {method} {url}: "
+                                   f"{resp.status} {text[:300]}")
+            return json.loads(text) if text else {}
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def execute_statement(self, query: str, params: list[Any]) -> dict[str, Any]:
+        raise ValueError(f"{type(self).__name__} does not support execute mode")
+
+
+# ---------------------------------------------------------------------------
+# Pinecone
+# ---------------------------------------------------------------------------
+
+
+class PineconeDataSource(_HttpDataSource):
+    """`service: pinecone` — REST index endpoint. Query JSON mirrors the
+    reference (`PineconeDataSource.java`): {"vector": [...], "topK": N,
+    "filter": {...}, "includeMetadata": true}; rows come back as
+    {id, similarity, **metadata}."""
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        super().__init__(config)
+        # endpoint: the index host URL (https://{index}-{project}.svc...);
+        # tests point it at a local stub
+        self.endpoint = str(config.get("endpoint", "")).rstrip("/")
+        self.api_key = config.get("api-key", "")
+        if not self.endpoint:
+            raise ValueError("pinecone datasource requires 'endpoint'")
+
+    def _headers(self) -> dict[str, str]:
+        return {"Api-Key": str(self.api_key)}
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        body = _parse_query(query, params)
+        body.setdefault("topK", 10)
+        body.setdefault("includeMetadata", True)
+        out = await self._request(
+            "POST", f"{self.endpoint}/query", body, self._headers()
+        )
+        rows = []
+        for match in out.get("matches", []):
+            row = {"id": match.get("id"), "similarity": match.get("score")}
+            row.update(match.get("metadata") or {})
+            rows.append(row)
+        return rows
+
+    async def upsert(self, id_: str, vector: list[float], metadata: dict) -> None:
+        await self._request(
+            "POST",
+            f"{self.endpoint}/vectors/upsert",
+            {"vectors": [{"id": id_, "values": vector, "metadata": metadata}]},
+            self._headers(),
+        )
+
+
+class PineconeWriter(VectorDatabaseWriter):
+    def __init__(self, datasource: PineconeDataSource, config: dict[str, Any]) -> None:
+        self.datasource = datasource
+        self.id_expr = config.get("id", "fn:uuid()")
+        self.vector_expr = config.get("vector", "value.embeddings")
+        self.metadata_fields = list(config.get("fields", []))
+
+    async def upsert(self, record: Any, context: dict[str, Any]) -> None:
+        ctx = MutableRecord.from_record(record)
+        id_ = str(el.evaluate(self.id_expr, ctx))
+        vector = el.evaluate(self.vector_expr, ctx)
+        if vector is None:
+            raise ValueError(f"vector expression {self.vector_expr!r} produced None")
+        meta = {
+            f["name"]: el.evaluate(f.get("expression", "value"), ctx)
+            for f in self.metadata_fields
+        }
+        await self.datasource.upsert(id_, list(map(float, vector)), meta)
+
+
+# ---------------------------------------------------------------------------
+# OpenSearch
+# ---------------------------------------------------------------------------
+
+
+class OpenSearchDataSource(_HttpDataSource):
+    """`service: opensearch` — `_search` REST API. The query JSON is the
+    standard search DSL (knn / match / whatever); rows are the hits with
+    {id, similarity, **_source} (OpenSearchDataSource.java semantics)."""
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        super().__init__(config)
+        self.endpoint = str(config.get("endpoint", "")).rstrip("/")
+        self.index = config.get("index-name", "langstream")
+        self.username = config.get("username")
+        self.password = config.get("password")
+        if not self.endpoint:
+            raise ValueError("opensearch datasource requires 'endpoint'")
+
+    def _headers(self) -> dict[str, str]:
+        if self.username:
+            import base64
+
+            token = base64.b64encode(
+                f"{self.username}:{self.password or ''}".encode()
+            ).decode()
+            return {"Authorization": f"Basic {token}"}
+        return {}
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        body = _parse_query(query, params)
+        out = await self._request(
+            "POST", f"{self.endpoint}/{self.index}/_search", body, self._headers()
+        )
+        rows = []
+        for hit in out.get("hits", {}).get("hits", []):
+            row = {"id": hit.get("_id"), "similarity": hit.get("_score")}
+            row.update(hit.get("_source") or {})
+            rows.append(row)
+        return rows
+
+    async def index_document(self, id_: str, document: dict[str, Any]) -> None:
+        await self._request(
+            "PUT",
+            f"{self.endpoint}/{self.index}/_doc/{id_}?refresh=true",
+            document,
+            self._headers(),
+        )
+
+
+class OpenSearchWriter(VectorDatabaseWriter):
+    """vector-db-sink writer: each record becomes one document; the vector
+    lands in `vector-field` alongside the computed fields
+    (OpenSearchWriter.java's bulk-index semantics, one-at-a-time here)."""
+
+    def __init__(self, datasource: OpenSearchDataSource, config: dict[str, Any]) -> None:
+        self.datasource = datasource
+        self.id_expr = config.get("id", "fn:uuid()")
+        self.vector_expr = config.get("vector", "value.embeddings")
+        self.vector_field = config.get("vector-field", "embeddings")
+        self.metadata_fields = list(config.get("fields", []))
+
+    async def upsert(self, record: Any, context: dict[str, Any]) -> None:
+        ctx = MutableRecord.from_record(record)
+        id_ = str(el.evaluate(self.id_expr, ctx))
+        doc = {
+            f["name"]: el.evaluate(f.get("expression", "value"), ctx)
+            for f in self.metadata_fields
+        }
+        vector = el.evaluate(self.vector_expr, ctx)
+        if vector is not None:
+            doc[self.vector_field] = list(map(float, vector))
+        await self.datasource.index_document(id_, doc)
+
+
+# ---------------------------------------------------------------------------
+# Solr
+# ---------------------------------------------------------------------------
+
+
+class SolrDataSource(_HttpDataSource):
+    """`service: solr` — JSON Request API on a collection. The query JSON
+    is Solr's {"query": "...", "limit": N, ...} body (knn via
+    {!knn f=vector topK=10}); rows are the response docs
+    (SolrDataSource.java semantics)."""
+
+    def __init__(self, config: dict[str, Any]) -> None:
+        super().__init__(config)
+        self.endpoint = str(config.get("endpoint", "")).rstrip("/")
+        self.collection = config.get("collection-name", "langstream")
+        if not self.endpoint:
+            raise ValueError("solr datasource requires 'endpoint'")
+
+    async def fetch_data(self, query: str, params: list[Any]) -> list[dict[str, Any]]:
+        body = _parse_query(query, params)
+        out = await self._request(
+            "POST", f"{self.endpoint}/solr/{self.collection}/select", body
+        )
+        return list(out.get("response", {}).get("docs", []))
+
+    async def add_documents(self, docs: list[dict[str, Any]]) -> None:
+        await self._request(
+            "POST",
+            f"{self.endpoint}/solr/{self.collection}/update/json/docs?commit=true",
+            docs[0] if len(docs) == 1 else docs,  # Solr accepts either form
+        )
+
+
+class SolrWriter(VectorDatabaseWriter):
+    def __init__(self, datasource: SolrDataSource, config: dict[str, Any]) -> None:
+        self.datasource = datasource
+        self.id_expr = config.get("id", "fn:uuid()")
+        self.vector_expr = config.get("vector", "value.embeddings")
+        self.vector_field = config.get("vector-field", "embeddings")
+        self.metadata_fields = list(config.get("fields", []))
+
+    async def upsert(self, record: Any, context: dict[str, Any]) -> None:
+        ctx = MutableRecord.from_record(record)
+        doc = {"id": str(el.evaluate(self.id_expr, ctx))}
+        for f in self.metadata_fields:
+            doc[f["name"]] = el.evaluate(f.get("expression", "value"), ctx)
+        vector = el.evaluate(self.vector_expr, ctx)
+        if vector is not None:
+            doc[self.vector_field] = list(map(float, vector))
+        await self.datasource.add_documents([doc])

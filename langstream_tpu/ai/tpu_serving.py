@@ -146,27 +146,51 @@ class _EngineHolder:
             self._params = params
         return self._params
 
+    def build_engine(self, start: bool = True):
+        """Construct the (possibly SPMD) engine. ``start=False`` is the
+        multi-host follower path: the caller runs follower_loop over the
+        channel instead of the leader's device loop."""
+        from langstream_tpu.parallel.multihost import DistributedConfig
+        from langstream_tpu.serving.engine import ServingEngine
+
+        mc = self.model_config()
+        buckets = tuple(
+            self.config.get("prefill-buckets", (32, 64, 128, 256, 512, 1024, 2048))
+        )
+        max_batch = int(self.config.get("max-batch", 8))
+        prefill_batch = self.config.get("prefill-batch")
+        spmd = None
+        dist = DistributedConfig.from_env()
+        if dist.is_multihost:
+            # every process of the replica builds an IDENTICAL channel; the
+            # leader announces, followers replay (parallel/spmd_serving.py)
+            from langstream_tpu.parallel.spmd_serving import SpmdChannel
+
+            spmd = SpmdChannel(
+                prefill_batch=int(prefill_batch or ServingEngine.PREFILL_BATCH),
+                max_width=max(buckets),
+                max_batch=max_batch,
+            )
+        engine = ServingEngine(
+            mc,
+            self.params(),
+            max_batch=max_batch,
+            max_seq_len=int(self.config.get("max-seq-len", min(2048, mc.max_seq_len))),
+            eos_token_id=self.tokenizer().eos_token_id,
+            prefill_buckets=buckets,
+            mesh=self.mesh(),
+            decode_chunk=int(self.config.get("decode-chunk", 8)),
+            prefill_batch=prefill_batch,
+            spmd=spmd,
+        )
+        if start:
+            engine.start()
+        return engine
+
     def engine(self):
         with self._lock:
             if self._engine is None:
-                from langstream_tpu.serving.engine import ServingEngine
-
-                mc = self.model_config()
-                buckets = tuple(
-                    self.config.get("prefill-buckets", (32, 64, 128, 256, 512, 1024, 2048))
-                )
-                self._engine = ServingEngine(
-                    mc,
-                    self.params(),
-                    max_batch=int(self.config.get("max-batch", 8)),
-                    max_seq_len=int(self.config.get("max-seq-len", min(2048, mc.max_seq_len))),
-                    eos_token_id=self.tokenizer().eos_token_id,
-                    prefill_buckets=buckets,
-                    mesh=self.mesh(),
-                    decode_chunk=int(self.config.get("decode-chunk", 8)),
-                    prefill_batch=self.config.get("prefill-batch"),
-                )
-                self._engine.start()
+                self._engine = self.build_engine(start=True)
             return self._engine
 
     def embed_fn(self):
@@ -177,6 +201,18 @@ class _EngineHolder:
                 import jax
 
                 from langstream_tpu.models.transformer import encode
+                from langstream_tpu.parallel.multihost import DistributedConfig
+
+                if DistributedConfig.from_env().is_multihost:
+                    # followers only replay the serving engine's dispatches
+                    # (spmd_serving); an embed jit over the global mesh would
+                    # hang in its first collective waiting for peers. Fail
+                    # fast until embed ops join the SPMD channel.
+                    raise RuntimeError(
+                        "embeddings are not yet supported on a multi-host "
+                        "(tpu.hosts > 1) replica — run the embedding model "
+                        "on a single-host agent"
+                    )
 
                 self._embed_fn = functools.partial(
                     jax.jit(encode, static_argnames=("config",)),

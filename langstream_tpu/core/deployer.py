@@ -33,12 +33,37 @@ class ApplicationDeployer:
         return self.compute_runtime.build_execution_plan(application_id, app)
 
     async def setup(self, plan: ExecutionPlan) -> None:
-        """Create declarative assets (reference ApplicationSetupRunner.runSetup)."""
+        """Create declarative assets (reference ApplicationSetupRunner.runSetup).
+
+        An asset's ``datasource`` may name a `configuration.resources` entry
+        (the reference's convention) — resolve it to that resource's
+        configuration before the manager sees it."""
+        import dataclasses
+
         for asset in plan.assets:
             info = REGISTRY.asset(asset.asset_type)
             if info is None:
                 log.warning("no asset manager for type %s; skipping", asset.asset_type)
                 continue
+            ds_ref = asset.config.get("datasource")
+            if isinstance(ds_ref, str) and plan.application is not None:
+                resource = plan.application.resources.get(ds_ref) or next(
+                    (
+                        r
+                        for r in plan.application.resources.values()
+                        if r.name == ds_ref
+                    ),
+                    None,
+                )
+                if resource is None:
+                    raise ValueError(
+                        f"asset {asset.id!r} references unknown datasource "
+                        f"resource {ds_ref!r}"
+                    )
+                asset = dataclasses.replace(
+                    asset,
+                    config={**asset.config, "datasource": dict(resource.configuration)},
+                )
             manager = info.factory()
             await manager.initialize(asset)
             try:

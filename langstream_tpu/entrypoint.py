@@ -83,19 +83,42 @@ async def run_agent_runtime(pod: dict[str, Any]) -> None:
 
     node = build_agent_node(pod)
 
+    if dist.is_multihost:
+        serving_count = sum(
+            1
+            for r in (pod.get("resources") or {}).values()
+            if r.get("type") == "tpu-serving"
+        )
+        if serving_count > 1:
+            # each engine would announce on the one broadcast transport with
+            # no shared total order — reject rather than hang the replica
+            raise RuntimeError(
+                "a multi-host (tpu.hosts > 1) agent supports exactly one "
+                f"tpu-serving resource, found {serving_count}"
+            )
+
     if dist.is_multihost and not dist.is_leader:
         # follower host: a mesh worker of its replica's process group — it
         # must NOT open a broker consumer or any agent machinery ("one
-        # logical consumer, N pods"). It serves /metrics + /info and stays
-        # joined to the group; the leader-broadcast SPMD dispatch for the
-        # serving engine is the documented hardware-untested step
-        # (parallel/multihost.py caveat).
+        # logical consumer, N pods"). When the agent serves a tpu-serving
+        # model, the follower builds an IDENTICAL (unstarted) engine and
+        # replays the leader's device dispatches over the SPMD channel
+        # (parallel/spmd_serving.py); otherwise it parks serving /metrics.
         metrics = MetricsReporter()
+        serving_resource = next(
+            (
+                r
+                for r in (pod.get("resources") or {}).values()
+                if r.get("type") == "tpu-serving"
+            ),
+            None,
+        )
         http = RuntimeHttpServer(
             metrics_text=metrics.prometheus_text,
             agents_info=lambda: [
                 {"agent-id": node.id, "replica": dist.replica_index,
-                 "role": "mesh-worker", "process-index": dist.process_index}
+                 "role": "mesh-worker", "process-index": dist.process_index,
+                 "spmd-serving": serving_resource is not None}
             ],
             host=os.environ.get("HTTP_HOST", "0.0.0.0"),
             port=int(pod.get("httpPort", os.environ.get("HTTP_PORT", "8080"))),
@@ -106,7 +129,20 @@ async def run_agent_runtime(pod: dict[str, Any]) -> None:
             node.id, dist.process_index, dist.num_processes,
         )
         try:
-            await asyncio.Event().wait()  # crash-only: leader death restarts us
+            if serving_resource is not None:
+                from langstream_tpu.ai.tpu_serving import _EngineHolder
+                from langstream_tpu.parallel.spmd_serving import follower_loop
+
+                holder = _EngineHolder(
+                    dict(serving_resource.get("configuration", {}))
+                )
+                engine = holder.build_engine(start=False)
+                assert engine._spmd is not None
+                # replay until the leader announces STOP (leader restart
+                # restarts this pod via the crash-only StatefulSet policy)
+                await asyncio.to_thread(follower_loop, engine, engine._spmd)
+            else:
+                await asyncio.Event().wait()  # crash-only: leader restarts us
         finally:
             await http.stop()
         return

@@ -19,16 +19,16 @@ Pod ordinal o → process_index = o % hosts, replica_index = o // hosts;
 process 0 of each group is the coordinator AND the only pod that opens the
 broker consumer ("one logical consumer, N pods").
 
-HARDWARE-UNTESTED CAVEAT: no multi-host slice exists in this environment.
-What is validated on the virtual CPU mesh: the ordinal/coordinator math,
-the planner's divisibility rules, the StatefulSet topology, and the
-sharded engine on a mesh built over the full (host-major) device list.
-What is NOT validated: a live ``jax.distributed.initialize`` across
-processes, and the leader-driven SPMD dispatch the serving engine needs so
-follower hosts execute the same jitted programs (design: the leader
-broadcasts each admitted batch's control tuple via
-``multihost_utils.broadcast_one_to_all`` before dispatch; followers replay
-the identical engine step).
+The leader-driven SPMD serving dispatch lives in ``spmd_serving.py``: the
+leader broadcasts each device dispatch's control block via
+``multihost_utils.broadcast_one_to_all``; followers replay the identical
+jitted calls (``entrypoint.py`` follower branch). Validated by a REAL
+2-process ``jax.distributed`` run with a live coordinator
+(tests/test_spmd_serving.py: greedy output equals the single-process
+reference) and by state-equality checks on the virtual mesh
+(dryrun_multichip). HARDWARE-UNTESTED CAVEAT: no multi-host TPU slice
+exists in this environment, so the collectives have only run over the CPU
+cross-process backend, not ICI.
 """
 
 from __future__ import annotations

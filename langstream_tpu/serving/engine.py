@@ -122,16 +122,6 @@ def _decode_chunk(params, tokens, positions, cache, key, temp, top_k, top_p, ste
 
 
 @functools.partial(
-    jax.jit, static_argnames=("config",), donate_argnames=("local_cache",)
-)
-def _prefill_and_sample(params, tokens, length, local_cache, key, temp, top_k, top_p, config):
-    logits, local_cache = prefill(params, tokens, length, local_cache, config)
-    key, sub = jax.random.split(key)
-    first = sample(logits, sub, temp, top_k, top_p)
-    return first, local_cache, key
-
-
-@functools.partial(
     jax.jit, static_argnames=("config", "kv_bound"), donate_argnames=("local_cache",)
 )
 def _prefill_segment_and_sample(
@@ -148,6 +138,69 @@ def _prefill_segment_and_sample(
     key, sub = jax.random.split(key)
     first = sample(logits, sub, temp, top_k, top_p)
     return first, local_cache, key
+
+
+def _make_admit_group(mesh):
+    """Factory for the FUSED admission step: local-cache zeros + prefill +
+    first-token sample + big-cache insert + every decode-chain scatter in
+    ONE dispatch. On a tunneled device each host→device op costs ~40-50ms
+    of round-trip latency regardless of size, so the unfused path's ~14 ops
+    (7 uploads + cache alloc + prefill + insert + 5 scatters) dominated
+    burst TTFT (~780ms measured); fused + packed uploads ≈ 4 ops."""
+    @functools.partial(
+        jax.jit,
+        static_argnames=("config",),
+        donate_argnames=(
+            "cache", "tokens_dev", "positions_dev", "temp_dev",
+            "top_k_dev", "top_p_dev",
+        ),
+    )
+    def admit_group(
+        params, cache, tokens_dev, positions_dev, temp_dev, top_k_dev,
+        top_p_dev, key, tokens, meta, slots, config,
+    ):
+        # tokens [P, W] int32; meta [4, P] f32 = lengths/temps/top_ks/top_ps
+        lengths = meta[0].astype(jnp.int32)
+        temps = meta[1]
+        top_ks = meta[2].astype(jnp.int32)
+        top_ps = meta[3]
+        n, width = tokens.shape
+        local_cache = make_kv_cache(config, n, width)  # traced zeros: free
+        if mesh is not None:
+            from jax.lax import with_sharding_constraint
+            from jax.sharding import NamedSharding
+
+            from langstream_tpu.parallel.sharding import (
+                _kv_entry_specs,
+                serving_cache_specs,
+            )
+
+            quantized = isinstance(local_cache["k"], dict)
+            specs = serving_cache_specs(config.n_kv_heads, mesh)
+            if quantized:
+                specs = {k: _kv_entry_specs(s, True) for k, s in specs.items()}
+            local_cache = jax.tree.map(
+                lambda x, s: with_sharding_constraint(x, NamedSharding(mesh, s)),
+                local_cache,
+                specs,
+            )
+        logits, local_cache = prefill(params, tokens, lengths, local_cache, config)
+        key, sub = jax.random.split(key)
+        first = sample(logits, sub, temps, top_ks, top_ps)
+
+        def put(big, small):
+            w = small.shape[3]
+            return big.at[:, slots, :, :w].set(small.astype(big.dtype), mode="drop")
+
+        cache = jax.tree.map(put, cache, local_cache)
+        tokens_dev = tokens_dev.at[slots].set(first, mode="drop")
+        positions_dev = positions_dev.at[slots].set(lengths, mode="drop")
+        temp_dev = temp_dev.at[slots].set(temps, mode="drop")
+        top_k_dev = top_k_dev.at[slots].set(top_ks, mode="drop")
+        top_p_dev = top_p_dev.at[slots].set(top_ps, mode="drop")
+        return first, cache, tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev, key
+
+    return admit_group
 
 
 def _make_insert_group():
@@ -189,6 +242,7 @@ class ServingEngine:
         mesh: Optional[Any] = None,
         decode_chunk: int = 8,
         prefill_batch: Optional[int] = None,
+        spmd: Optional[Any] = None,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -212,6 +266,7 @@ class ServingEngine:
 
             self._cache = shard_serving_cache(self._cache, mesh)
         self._insert_group = _make_insert_group()
+        self._admit_group = _make_admit_group(mesh)
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -247,6 +302,13 @@ class ServingEngine:
         self._long: Optional[dict] = None
         self._long_queue: list[GenerationRequest] = []
         self._reserved: set[int] = set()
+        # long-prefill local cache, kept on self (not the state dict) so
+        # SPMD followers evolve the same attr through _dev_long_segment
+        self._long_cache: Optional[Any] = None
+        # multi-host SPMD: the leader announces every device dispatch over
+        # this channel before making it; followers replay via follower_loop
+        # (parallel/spmd_serving.py). None = single-host, zero overhead.
+        self._spmd = spmd
         # stats
         self.total_generated = 0
         self.total_requests = 0
@@ -324,11 +386,21 @@ class ServingEngine:
                 self._inflight_steps = next(
                     (e[3] for e in pending if e[0] == "chunk"), 0
                 )
+                had_active = any(s.active for s in self._slots)
                 # long prefill FIRST: it claims a freed slot before _admit
                 # hands them all to short requests, so a long prompt can't be
                 # starved forever under sustained short traffic
                 new_pending = self._long_step()  # one segment / iteration
                 new_pending.extend(self._admit())  # deferred first-token fetches
+                if new_pending and not had_active:
+                    # cold start (nothing was decoding): there is no compute
+                    # to overlap the deferred fetch with, and on a tunneled
+                    # device the fetch would otherwise queue BEHIND the first
+                    # decode chunk dispatched below (~a full chunk of extra
+                    # TTFT, measured: 700ms → ~300ms at 96-session burst)
+                    for entry in new_pending:
+                        self._process_entry(entry)
+                    new_pending = []
                 if any(s.active for s in self._slots):
                     new_pending.append(self._dispatch_chunk())
                 elif not new_pending and not pending and self._long is None:
@@ -342,6 +414,19 @@ class ServingEngine:
         except BaseException as e:  # noqa: BLE001 — fail every pending request
             log.exception("serving engine loop crashed")
             self._fail_all(e)
+        finally:
+            if self._spmd is not None:
+                # release follower processes parked in recv() — best-effort
+                # on the crash path too, else they block in the collective
+                # forever while the leader pod looks alive. Announcements
+                # only ever come from this thread, so STOP is totally
+                # ordered after every dispatch.
+                from langstream_tpu.parallel.spmd_serving import OP_STOP, ControlBlock
+
+                try:
+                    self._spmd.announce(ControlBlock(op=OP_STOP))
+                except Exception:  # noqa: BLE001 — transport may be gone too
+                    log.exception("failed to announce STOP to SPMD followers")
 
     def _process_entry(self, entry: tuple) -> None:
         kind = entry[0]
@@ -418,6 +503,12 @@ class ServingEngine:
                 try:
                     new = self._prefill_group(width, sub)
                 except Exception as e:  # noqa: BLE001 — fail the group, not the engine
+                    if self._spmd is not None:
+                        # multi-host: an announced dispatch that failed here
+                        # may have diverged (or killed) the followers —
+                        # catch-and-continue would wedge every collective.
+                        # Crash the replica; the pods restart together.
+                        raise
                     log.exception("prefill failed for a batch of %d requests", len(sub))
                     for _, request in sub:
                         request._finish(GenerationResult(
@@ -455,39 +546,20 @@ class ServingEngine:
             top_ks[j] = request.options.top_k
             top_ps[j] = request.options.top_p
 
-        local_cache = make_kv_cache(self.config, n_pad, width)
-        if self.mesh is not None:
-            from langstream_tpu.parallel.sharding import shard_serving_cache
-
-            local_cache = shard_serving_cache(local_cache, self.mesh)
-        first, local_cache, self._key = _prefill_and_sample(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
-            local_cache,
-            self._key,
-            jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
-            self.config,
-        )
-
         # one scatter for the whole group; padding rows point out of bounds
         # and are dropped
         slots = np.full(n_pad, self.max_batch, np.int32)
         for j, (idx, _) in enumerate(group):
             slots[j] = idx
-        slots_dev = jnp.asarray(slots)
-        self._cache = self._insert_group(self._cache, local_cache, slots_dev)
-        # splice the group into the device-resident decode chain (padding
-        # rows dropped by the same out-of-bounds rule)
-        self._tokens_dev = self._tokens_dev.at[slots_dev].set(first, mode="drop")
-        self._positions_dev = self._positions_dev.at[slots_dev].set(
-            jnp.asarray(lengths), mode="drop"
-        )
-        self._temp_dev = self._temp_dev.at[slots_dev].set(jnp.asarray(temps), mode="drop")
-        self._top_k_dev = self._top_k_dev.at[slots_dev].set(jnp.asarray(top_ks), mode="drop")
-        self._top_p_dev = self._top_p_dev.at[slots_dev].set(jnp.asarray(top_ps), mode="drop")
+        if self._spmd is not None:
+            from langstream_tpu.parallel.spmd_serving import OP_PREFILL, ControlBlock
+
+            self._spmd.announce(ControlBlock(
+                op=OP_PREFILL, width=width, n_rows=n_pad, tokens=tokens,
+                lengths=lengths, slots=slots, temps=temps, top_ks=top_ks,
+                top_ps=top_ps,
+            ))
+        first = self._dev_prefill(width, tokens, lengths, temps, top_ks, top_ps, slots)
 
         for idx, request in group:
             slot = self._slots[idx]
@@ -498,6 +570,39 @@ class ServingEngine:
             slot.first_token_at = 0.0  # stamped when the deferred fetch lands
             self.total_requests += 1
         return [("prefill", first, list(group))]
+
+    def _dev_prefill(self, width, tokens, lengths, temps, top_ks, top_ps, slots):
+        """Device layer of a batched prefill — runs IDENTICALLY on the
+        leader and (via follower_loop) every SPMD follower, so the sharded
+        cache and decode chain evolve in lockstep from pure host inputs."""
+        n = len(tokens)
+        assert all(len(a) == n for a in (lengths, temps, top_ks, top_ps, slots))
+        # pack the per-row scalars into one upload (per-op tunnel latency)
+        meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
+        (
+            first,
+            self._cache,
+            self._tokens_dev,
+            self._positions_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            self._key,
+        ) = self._admit_group(
+            self.params,
+            self._cache,
+            self._tokens_dev,
+            self._positions_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            self._key,
+            jnp.asarray(tokens),
+            jnp.asarray(meta),
+            jnp.asarray(slots),
+            self.config,
+        )
+        return first
 
     def _chunk_steps(self) -> int:
         """Power-of-two chunk bounded by every active slot's cache headroom.
@@ -522,6 +627,22 @@ class ServingEngine:
             for i, s in enumerate(self._slots)
         ):
             want = min(want, 4)
+        # never dispatch (much) past the longest remaining token budget: a
+        # full chunk for slots about to finish wastes its tail on device AND
+        # sits in front of whatever arrives next (a burst admission right
+        # after a lone request drains used to queue ~a full chunk behind it)
+        remaining = max(
+            (
+                s.request.options.max_new_tokens - len(s.generated)
+                for s in self._slots
+                if s.active and s.request is not None
+            ),
+            default=1,
+        )
+        cap = 1
+        while cap < remaining:
+            cap *= 2
+        want = min(want, cap)
         headroom = min(
             self.max_seq_len - 1 - s.position - self._inflight_steps
             for s in self._slots
@@ -561,21 +682,8 @@ class ServingEngine:
             if free is None:
                 return []
             request = self._long_queue.pop(0)
-            prompt = request.prompt_tokens
-            local_cache = make_kv_cache(
-                self.config, 1, self._long_width(len(prompt))
-            )
-            if self.mesh is not None:
-                from langstream_tpu.parallel.sharding import shard_serving_cache
-
-                local_cache = shard_serving_cache(local_cache, self.mesh)
             self._reserved.add(free)
-            self._long = {
-                "idx": free,
-                "request": request,
-                "cache": local_cache,
-                "seg": 0,
-            }
+            self._long = {"idx": free, "request": request, "seg": 0}
         st = self._long
         request: GenerationRequest = st["request"]
         prompt = request.prompt_tokens
@@ -592,46 +700,46 @@ class ServingEngine:
         while kv_bound < min(s0 + width, t_long):
             kv_bound *= 2
         kv_bound = min(kv_bound, t_long)
+        idx = st["idx"]
+        start = st["seg"] == 0
+        final = s0 + width >= len(prompt)
+        if self._spmd is not None:
+            from langstream_tpu.parallel.spmd_serving import OP_LONG_SEG, ControlBlock
+
+            self._spmd.announce(ControlBlock(
+                op=OP_LONG_SEG, width=width, n_rows=1, tokens=tokens,
+                s0=s0, seg_len=len(seg), kv_bound=kv_bound, t_long=t_long,
+                long_start=start, long_final=final, long_idx=idx,
+                prompt_len=len(prompt),
+                temps=np.asarray([opts.temperature], np.float32),
+                top_ks=np.asarray([opts.top_k], np.int32),
+                top_ps=np.asarray([opts.top_p], np.float32),
+            ))
         try:
-            first, st["cache"], self._key = _prefill_segment_and_sample(
-                self.params,
-                jnp.asarray(tokens),
-                jnp.asarray([s0], jnp.int32),
-                jnp.asarray([len(seg)], jnp.int32),
-                st["cache"],
-                self._key,
-                jnp.asarray([opts.temperature], jnp.float32),
-                jnp.asarray([opts.top_k], jnp.int32),
-                jnp.asarray([opts.top_p], jnp.float32),
-                self.config,
-                kv_bound,
+            first = self._dev_long_segment(
+                tokens, s0, len(seg), kv_bound, t_long,
+                opts.temperature, opts.top_k, opts.top_p,
+                start=start, final=final, idx=idx, prompt_len=len(prompt),
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            if self._spmd is not None:
+                raise  # multi-host: crash the replica (see _admit rationale)
             log.exception("chunked prefill failed at segment %d", st["seg"])
-            idx = st["idx"]
             self._reserved.discard(idx)
             self._long = None
+            self._long_cache = None
             request._finish(GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=e,
             ))
             return []
         st["seg"] += 1
-        if s0 + width < len(prompt):
+        if not final:
             return []  # more segments to go
 
-        # final segment: splice into the big cache and activate the slot
-        idx = st["idx"]
+        # final segment landed on device: activate the slot host-side
         self._long = None
         self._reserved.discard(idx)
-        slots = np.full(1, idx, np.int32)
-        slots_dev = jnp.asarray(slots)
-        self._cache = self._insert_group(self._cache, st["cache"], slots_dev)
-        self._tokens_dev = self._tokens_dev.at[idx].set(first[0])
-        self._positions_dev = self._positions_dev.at[idx].set(len(prompt))
-        self._temp_dev = self._temp_dev.at[idx].set(opts.temperature)
-        self._top_k_dev = self._top_k_dev.at[idx].set(opts.top_k)
-        self._top_p_dev = self._top_p_dev.at[idx].set(opts.top_p)
         slot = self._slots[idx]
         slot.request = request
         slot.position = len(prompt)
@@ -641,22 +749,76 @@ class ServingEngine:
         self.total_requests += 1
         return [("prefill", first, [(idx, request)])]
 
+    def _dev_long_segment(
+        self, tokens, s0, seg_len, kv_bound, t_long, temperature, top_k, top_p,
+        *, start: bool, final: bool, idx: int, prompt_len: int,
+    ):
+        """Device layer of one chunked-prefill segment (leader + SPMD
+        followers): fresh local cache on ``start``, segment forward, and on
+        ``final`` the splice into the big cache + decode-chain scatters."""
+        if start:
+            local_cache = make_kv_cache(self.config, 1, t_long)
+            if self.mesh is not None:
+                from langstream_tpu.parallel.sharding import shard_serving_cache
+
+                local_cache = shard_serving_cache(local_cache, self.mesh)
+            self._long_cache = local_cache
+        first, self._long_cache, self._key = _prefill_segment_and_sample(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray([s0], jnp.int32),
+            jnp.asarray([seg_len], jnp.int32),
+            self._long_cache,
+            self._key,
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+            self.config,
+            kv_bound,
+        )
+        if final:
+            slots_dev = jnp.asarray(np.full(1, idx, np.int32))
+            self._cache = self._insert_group(self._cache, self._long_cache, slots_dev)
+            self._long_cache = None
+            self._tokens_dev = self._tokens_dev.at[idx].set(first[0])
+            self._positions_dev = self._positions_dev.at[idx].set(prompt_len)
+            self._temp_dev = self._temp_dev.at[idx].set(temperature)
+            self._top_k_dev = self._top_k_dev.at[idx].set(top_k)
+            self._top_p_dev = self._top_p_dev.at[idx].set(top_p)
+        return first
+
     def _dispatch_chunk(self) -> tuple:
         """Dispatch one multi-step decode; returns (device tokens,
         per-slot request snapshot, steps) for deferred host processing."""
         steps = self._chunk_steps()
+        stale: list[int] = []
         if self._freed_slots:
             # skip slots re-admitted since they freed (admit runs before
             # dispatch and already wrote their fresh params)
             stale = [i for i in set(self._freed_slots) if not self._slots[i].active]
             self._freed_slots.clear()
-            if stale:
-                # fixed-size index buffer (padding rows out of bounds →
-                # dropped) so this stays ONE compiled shape regardless of
-                # how many freed
-                idxs = np.full(self.max_batch, self.max_batch, np.int32)
-                idxs[: len(stale)] = stale
-                self._temp_dev = self._temp_dev.at[jnp.asarray(idxs)].set(0.0, mode="drop")
+        if self._spmd is not None:
+            from langstream_tpu.parallel.spmd_serving import OP_DECODE, ControlBlock
+
+            self._spmd.announce(ControlBlock(
+                op=OP_DECODE, steps=steps, n_rows=len(stale),
+                slots=np.asarray(stale, np.int32),
+            ))
+        chunk = self._dev_decode(steps, stale)
+        snapshot = [
+            (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
+        ]
+        self._busy_steps += steps
+        return ("chunk", chunk, snapshot, steps)
+
+    def _dev_decode(self, steps: int, stale) -> Any:
+        """Device layer of one decode chunk (leader + SPMD followers)."""
+        if len(stale):
+            # fixed-size index buffer (padding rows out of bounds → dropped)
+            # so this stays ONE compiled shape regardless of how many freed
+            idxs = np.full(self.max_batch, self.max_batch, np.int32)
+            idxs[: len(stale)] = stale
+            self._temp_dev = self._temp_dev.at[jnp.asarray(idxs)].set(0.0, mode="drop")
         chunk, self._tokens_dev, self._positions_dev, self._cache, self._key = (
             _decode_chunk(
                 self.params,
@@ -671,11 +833,7 @@ class ServingEngine:
                 self.config,
             )
         )
-        snapshot = [
-            (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
-        ]
-        self._busy_steps += steps
-        return ("chunk", chunk, snapshot, steps)
+        return chunk
 
     def _process_chunk(self, chunk, snapshot, steps: int) -> None:
         host = np.asarray(jax.device_get(chunk))  # [steps, B]

@@ -1,0 +1,62 @@
+"""Worker script for the 2-process jax.distributed SPMD serving test.
+
+Usage: python spmd_worker.py <process_id> <num_processes> <coordinator_port>
+
+Process 0 = leader: runs the ServingEngine (broker-consumer side), submits
+one greedy request, prints the tokens. Process 1+ = followers: replay the
+leader's dispatches via follower_loop, never touching a request queue.
+Both build IDENTICAL engine state (same params seed, same mesh over the
+GLOBAL device list).
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+)
+
+import dataclasses  # noqa: E402
+
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions  # noqa: E402
+from langstream_tpu.models.transformer import init_params  # noqa: E402
+from langstream_tpu.parallel.mesh import build_mesh  # noqa: E402
+from langstream_tpu.parallel.sharding import shard_params  # noqa: E402
+from langstream_tpu.parallel.spmd_serving import SpmdChannel, follower_loop  # noqa: E402
+from langstream_tpu.serving.engine import GenerationRequest, ServingEngine  # noqa: E402
+
+CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+assert len(jax.devices()) == nproc, jax.devices()
+
+params = init_params(CFG, jax.random.PRNGKey(0))
+mesh = build_mesh({"model": nproc})
+params = shard_params(params, mesh, CFG)
+
+channel = SpmdChannel(prefill_batch=4, max_width=32, max_batch=2)
+engine = ServingEngine(
+    CFG,
+    params,
+    max_batch=2,
+    max_seq_len=64,
+    decode_chunk=4,
+    prefill_buckets=(16, 32),
+    prefill_batch=4,
+    mesh=mesh,
+    spmd=channel,
+)
+
+if pid == 0:
+    engine.start()
+    result = engine.generate(
+        [5, 6, 7, 8], GenerationOptions(max_new_tokens=6, temperature=0.0), timeout=600
+    )
+    engine.stop()
+    print(json.dumps({"role": "leader", "tokens": result.tokens}), flush=True)
+else:
+    follower_loop(engine, channel)
+    print(json.dumps({"role": "follower", "done": True}), flush=True)

@@ -226,10 +226,14 @@ def test_eos_as_first_token():
 def test_adaptive_chunk_shrinks_under_queued_work():
     """With a queued request and a free slot the next chunk is capped small
     (TTFT lever); with the queue empty it returns to full size."""
+    from langstream_tpu.serving.engine import GenerationRequest
+
     engine = make_engine(max_batch=4, max_seq_len=256, decode_chunk=64)
     engine.stop()  # drive _chunk_steps directly, no device loop
     engine._dead = None
-    engine._slots[0].request = object()  # fake an active slot
+    engine._slots[0].request = GenerationRequest(
+        prompt_tokens=[1], options=GenerationOptions(max_new_tokens=200)
+    )  # fake an active slot with plenty of budget left
     engine._slots[0].position = 10
     assert engine._chunk_steps() == 64
     engine._queue.put(object())

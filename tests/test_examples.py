@@ -202,3 +202,72 @@ def test_python_source_sink_end_to_end(run, tmp_path):
         assert lines[0].startswith("TICK-")
 
     run(scenario())
+
+
+def test_chatbot_rag_memory_end_to_end(run):
+    """Session chat-history memory: the answer round-trips AND the turn is
+    written into the SQL history so the next turn sees it."""
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pkg = ModelBuilder.build_application_from_path(
+        EXAMPLES / "applications" / "chatbot-rag-memory",
+        instance_path=INSTANCE,
+        secrets_path=SECRETS,
+    )
+    app = resolve_placeholders(pkg.application)
+
+    async def scenario():
+        import uuid
+
+        session = f"s-{uuid.uuid4().hex[:8]}"  # history db persists in /tmp
+        runner = LocalApplicationRunner("memory-chat", app)
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce(
+                "memory-questions",
+                "what is a tpu?",
+                headers=[("langstream-client-session-id", session)],
+            )
+            out = await runner.consume("memory-answers", n=1, timeout=90)
+            v1 = json.loads(out[0].value)
+            assert v1.get("answer")
+            assert v1.get("history") == []  # first turn: no prior history
+
+            await runner.produce(
+                "memory-questions",
+                "and how fast is it?",
+                headers=[("langstream-client-session-id", session)],
+            )
+            out = await runner.consume("memory-answers", n=2, timeout=90)
+            v2 = json.loads(out[1].value)
+            # second turn sees the first turn in its history
+            assert any("what is a tpu" in str(h) for h in v2.get("history", [])), v2
+        finally:
+            await runner.stop()
+
+    run(scenario())
+
+
+def test_language_router_end_to_end(run):
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pkg = ModelBuilder.build_application_from_path(
+        EXAMPLES / "applications" / "language-router", instance_path=INSTANCE
+    )
+    app = resolve_placeholders(pkg.application)
+
+    async def scenario():
+        runner = LocalApplicationRunner("router", app)
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce(
+                "documents-topic", "the quick brown fox jumps over the lazy dog"
+            )
+            english = await runner.consume("english-topic", n=1, timeout=30)
+            assert "fox" in str(english[0].value)
+        finally:
+            await runner.stop()
+
+    run(scenario())

@@ -1,0 +1,124 @@
+"""Multi-host SPMD serving dispatch (round-2 verdict gap #4).
+
+Two tiers:
+1. LoopbackChannel in one process: a leader engine and a follower engine
+   share the device mesh; after a generation their device-resident state
+   (KV cache, decode chain) must be bit-identical — the lockstep property
+   the real multi-host replica depends on.
+2. A REAL 2-process ``jax.distributed`` run (subprocesses, real
+   coordinator, broadcast_one_to_all over the global mesh): only the
+   leader consumes requests; the follower replays. The leader's greedy
+   tokens must equal the single-process reference.
+"""
+
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+from langstream_tpu.models.transformer import init_params
+from langstream_tpu.parallel.spmd_serving import LoopbackChannel, follower_loop
+from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+
+CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+
+
+def test_loopback_follower_stays_in_lockstep():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    channel = LoopbackChannel(prefill_batch=4, max_width=32, max_batch=2)
+    leader = ServingEngine(
+        CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=4, spmd=channel,
+    )
+    follower = ServingEngine(
+        CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=4,
+    )
+    follower_thread = threading.Thread(
+        target=follower_loop, args=(follower, channel), daemon=True
+    )
+    follower_thread.start()
+    leader.start()
+    try:
+        opts = GenerationOptions(max_new_tokens=5, temperature=0.0)
+        r1 = leader.generate([5, 6, 7], opts, timeout=120)
+        # a long prompt exercises the chunked-prefill ops over the channel
+        long_prompt = [(3 + i) % CFG.vocab_size for i in range(40)]  # 3 segments
+        r2 = leader.generate(long_prompt, opts, timeout=120)
+        assert len(r1.tokens) == 5 and len(r2.tokens) == 5
+    finally:
+        leader.stop()
+    follower_thread.join(timeout=60)
+    assert not follower_thread.is_alive(), "follower never saw STOP"
+
+    # the follower's device state must have evolved identically
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(leader._tokens_dev)),
+        np.asarray(jax.device_get(follower._tokens_dev)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(leader._positions_dev)),
+        np.asarray(jax.device_get(follower._positions_dev)),
+    )
+    lk = jax.device_get(leader._cache)
+    fk = jax.device_get(follower._cache)
+    for a, b in zip(jax.tree.leaves(lk), jax.tree.leaves(fk)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_two_process_jax_distributed_serving():
+    """Real processes, real coordinator: leader serves, follower replays,
+    greedy output equals the single-process reference."""
+    # single-process reference
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    ref_engine = ServingEngine(
+        CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=4,
+    )
+    ref_engine.start()
+    try:
+        ref = ref_engine.generate(
+            [5, 6, 7, 8], GenerationOptions(max_new_tokens=6, temperature=0.0),
+            timeout=120,
+        )
+    finally:
+        ref_engine.stop()
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = Path(__file__).parent / "spmd_worker.py"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # one device per process
+    env["PYTHONPATH"] = str(Path(__file__).parent.parent)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("SPMD processes hung (lockstep broken)")
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    by_role = {o["role"]: o for o in outs}
+    assert by_role["follower"]["done"] is True
+    assert by_role["leader"]["tokens"] == ref.tokens, (
+        "2-process sharded generation diverged from single-process reference"
+    )

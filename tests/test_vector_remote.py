@@ -1,0 +1,262 @@
+"""Remote vector-DB HTTP clients against local stubs (the WireMock-style
+pattern the reference's google/github auth tests set; reference per-DB
+sources: pinecone/PineconeDataSource.java, opensearch/OpenSearchWriter.java,
+solr/SolrDataSource.java)."""
+
+import json
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.agents.vector import build_datasource, build_writer
+from langstream_tpu.api.record import SimpleRecord
+
+
+async def start_stub(routes):
+    app = web.Application()
+    app.add_routes(routes)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+# ---------------------------------------------------------------------------
+# Pinecone
+# ---------------------------------------------------------------------------
+
+
+def make_pinecone_stub(store, queries):
+    async def upsert(request):
+        assert request.headers["Api-Key"] == "pk-test"
+        body = await request.json()
+        for v in body["vectors"]:
+            store[v["id"]] = v
+        return web.json_response({"upsertedCount": len(body["vectors"])})
+
+    async def query(request):
+        assert request.headers["Api-Key"] == "pk-test"
+        body = await request.json()
+        queries.append(body)
+        matches = [
+            {"id": vid, "score": 0.9, "metadata": v.get("metadata", {})}
+            for vid, v in sorted(store.items())
+        ][: body.get("topK", 10)]
+        return web.json_response({"matches": matches})
+
+    return [web.post("/vectors/upsert", upsert), web.post("/query", query)]
+
+
+def test_pinecone_write_and_query(run):
+    async def main():
+        store, queries = {}, []
+        runner, base = await start_stub(make_pinecone_stub(store, queries))
+        ds = build_datasource(
+            {"service": "pinecone", "endpoint": base, "api-key": "pk-test"}
+        )
+        try:
+            writer = build_writer(ds, {
+                "id": "value.doc_id",
+                "vector": "value.embeddings",
+                "fields": [{"name": "text", "expression": "value.text"}],
+            })
+            await writer.upsert(
+                SimpleRecord.of(
+                    {"doc_id": "d1", "embeddings": [0.1, 0.2], "text": "hello"}
+                ),
+                {},
+            )
+            assert store["d1"]["values"] == [0.1, 0.2]
+            assert store["d1"]["metadata"] == {"text": "hello"}
+
+            rows = await ds.fetch_data(
+                json.dumps({"vector": "?", "topK": 5, "includeMetadata": True}),
+                [[0.1, 0.2]],
+            )
+            assert rows == [{"id": "d1", "similarity": 0.9, "text": "hello"}]
+            # the "?" placeholder was substituted with the param vector
+            assert queries[-1]["vector"] == [0.1, 0.2]
+        finally:
+            await ds.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# OpenSearch
+# ---------------------------------------------------------------------------
+
+
+def make_opensearch_stub(docs, searches):
+    async def index_doc(request):
+        assert request.headers["Authorization"].startswith("Basic ")
+        docs[request.match_info["id"]] = await request.json()
+        return web.json_response({"result": "created"})
+
+    async def search(request):
+        searches.append(await request.json())
+        hits = [
+            {"_id": did, "_score": 1.5, "_source": doc}
+            for did, doc in sorted(docs.items())
+        ]
+        return web.json_response({"hits": {"hits": hits}})
+
+    return [
+        web.put("/idx/_doc/{id}", index_doc),
+        web.post("/idx/_search", search),
+    ]
+
+
+def test_opensearch_write_and_query(run):
+    async def main():
+        docs, searches = {}, []
+        runner, base = await start_stub(make_opensearch_stub(docs, searches))
+        ds = build_datasource({
+            "service": "opensearch", "endpoint": base, "index-name": "idx",
+            "username": "admin", "password": "pw",
+        })
+        try:
+            writer = build_writer(ds, {
+                "id": "value.doc_id",
+                "vector": "value.embeddings",
+                "vector-field": "vec",
+                "fields": [{"name": "content", "expression": "value.text"}],
+            })
+            await writer.upsert(
+                SimpleRecord.of(
+                    {"doc_id": "a", "embeddings": [1.0, 0.0], "text": "doc a"}
+                ),
+                {},
+            )
+            assert docs["a"] == {"content": "doc a", "vec": [1.0, 0.0]}
+
+            rows = await ds.fetch_data(
+                json.dumps({"query": {"knn": {"vec": {"vector": "?", "k": 3}}}}),
+                [[1.0, 0.0]],
+            )
+            assert rows == [
+                {"id": "a", "similarity": 1.5, "content": "doc a", "vec": [1.0, 0.0]}
+            ]
+            assert searches[-1]["query"]["knn"]["vec"]["vector"] == [1.0, 0.0]
+        finally:
+            await ds.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Solr
+# ---------------------------------------------------------------------------
+
+
+def make_solr_stub(docs, selects):
+    async def update(request):
+        assert request.query.get("commit") == "true"
+        body = await request.json()
+        for doc in body if isinstance(body, list) else [body]:
+            docs[doc["id"]] = doc
+        return web.json_response({"responseHeader": {"status": 0}})
+
+    async def select(request):
+        selects.append(await request.json())
+        return web.json_response(
+            {"response": {"docs": [doc for _, doc in sorted(docs.items())]}}
+        )
+
+    return [
+        web.post("/solr/col/update/json/docs", update),
+        web.post("/solr/col/select", select),
+    ]
+
+
+def test_solr_write_and_query(run):
+    async def main():
+        docs, selects = {}, []
+        runner, base = await start_stub(make_solr_stub(docs, selects))
+        ds = build_datasource(
+            {"service": "solr", "endpoint": base, "collection-name": "col"}
+        )
+        try:
+            writer = build_writer(ds, {
+                "id": "value.doc_id",
+                "vector": "value.embeddings",
+                "fields": [{"name": "text", "expression": "value.text"}],
+            })
+            await writer.upsert(
+                SimpleRecord.of(
+                    {"doc_id": "s1", "embeddings": [0.5], "text": "solr doc"}
+                ),
+                {},
+            )
+            assert docs["s1"]["text"] == "solr doc"
+            assert docs["s1"]["embeddings"] == [0.5]
+
+            rows = await ds.fetch_data(
+                json.dumps({"query": "{!knn f=embeddings topK=10}?", "limit": 10}),
+                [],
+            )
+            assert rows[0]["id"] == "s1"
+        finally:
+            await ds.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_unbundled_services_still_rejected():
+    with pytest.raises(ValueError, match="not bundled"):
+        build_datasource({"service": "cassandra"})
+    with pytest.raises(ValueError, match="requires 'endpoint'"):
+        build_datasource({"service": "pinecone"})
+
+
+def test_query_vector_db_agent_against_pinecone_stub(run):
+    """The query-vector-db agent drives the pinecone datasource through the
+    platform's registry path (fields → params → substituted JSON query)."""
+    from langstream_tpu.agents.vector import QueryVectorDBAgent
+
+    class FakeRegistry:
+        def __init__(self, ds):
+            self.ds = ds
+
+        def get_datasource(self, name):
+            return self.ds
+
+    class FakeContext:
+        def __init__(self, ds):
+            self._r = FakeRegistry(ds)
+
+        def get_service_provider_registry(self):
+            return self._r
+
+    async def main():
+        store, queries = {}, []
+        runner, base = await start_stub(make_pinecone_stub(store, queries))
+        ds = build_datasource(
+            {"service": "pinecone", "endpoint": base, "api-key": "pk-test"}
+        )
+        try:
+            await ds.upsert("d9", [0.3, 0.4], {"text": "via agent"})
+            agent = QueryVectorDBAgent()
+            await agent.init({
+                "query": json.dumps({"vector": "?", "topK": 1}),
+                "fields": ["value.embeddings"],
+                "output-field": "value.result",
+                "datasource": "pc",
+            })
+            agent.set_context(FakeContext(ds))
+            await agent.start()
+            out = await agent.process_record(
+                SimpleRecord.of({"embeddings": [0.3, 0.4]})
+            )
+            value = json.loads(out[0].value) if isinstance(out[0].value, str) else out[0].value
+            assert value["result"][0]["text"] == "via agent"
+        finally:
+            await ds.close()
+            await runner.cleanup()
+
+    run(main())
