@@ -11,7 +11,10 @@ Expressions see the record parts as variables: ``value``, ``key``,
 ``properties``, ``destinationTopic``, ``origin``, ``timestamp``; dotted
 access works on dicts (``value.chunk_id``). Helper functions are available
 both bare (``lowercase(x)``) and with the reference's ``fn:`` prefix
-(``fn:lowercase(x)``, rewritten before parsing).
+(``fn:lowercase(x)``). ``fn:``/``util:``-prefixed names ALWAYS resolve to
+the function registry, even when a record binding shadows the bare name —
+``fn:timestamp()`` calls the helper, bare ``timestamp`` is the record's
+event time.
 """
 
 from __future__ import annotations
@@ -146,6 +149,12 @@ class _Evaluator(ast.NodeVisitor):
         return node.value
 
     def visit_Name(self, node: ast.Name) -> Any:
+        if node.id.startswith("__fn__"):
+            # explicit fn:/util: namespace — registry only, never record scope
+            name = node.id[len("__fn__"):]
+            if name in FUNCTIONS:
+                return FUNCTIONS[name]
+            raise ExpressionError(f"unknown function fn:{name}")
         if node.id in self.scope:
             return self.scope[node.id]
         if node.id in FUNCTIONS:
@@ -283,9 +292,20 @@ _UTIL_PREFIX = re.compile(r"\butil:([A-Za-z_][A-Za-z0-9_]*)")
 _SPANS = re.compile(r"('(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\")")
 
 
+def _rewrite_prefixes(e: str) -> str:
+    """fn:name / util:name → __fn__name, outside quoted spans. Must run
+    BEFORE the ternary rewrite: otherwise the ':' in ``fn:name`` inside a
+    ternary branch is mistaken for the ternary separator."""
+    parts = _SPANS.split(e)
+    return "".join(
+        part
+        if i % 2
+        else _UTIL_PREFIX.sub(r"__fn__\1", _FN_PREFIX.sub(r"__fn__\1", part))
+        for i, part in enumerate(parts)
+    )
+
+
 def _rewrite_code(e: str) -> str:
-    e = _FN_PREFIX.sub(r"\1", e)
-    e = _UTIL_PREFIX.sub(r"\1", e)
     e = re.sub(r"&&", " and ", e)
     e = re.sub(r"\|\|", " or ", e)
     e = re.sub(r"(?<![=!<>])!(?!=)", " not ", e)
@@ -294,11 +314,80 @@ def _rewrite_code(e: str) -> str:
     return e
 
 
+def _rewrite_ternary(e: str) -> str:
+    """JSTL ``cond ? then : else`` → python conditional expression.
+
+    First recurses into every top-level bracketed group (so parenthesized
+    nested ternaries anywhere get rewritten), then splits this level on its
+    first top-level '?' and the matching ':' — right-associative like JSTL.
+    Quoted text is never touched; subscripts/slices keep their ':'."""
+    # pass 1: rewrite inside (), [] groups
+    out: list[str] = []
+    i, n = 0, len(e)
+    while i < n:
+        ch = e[i]
+        if ch in "'\"":
+            j = i + 1
+            while j < n and (e[j] != ch or e[j - 1] == "\\"):
+                j += 1
+            out.append(e[i : j + 1])
+            i = j + 1
+            continue
+        if ch in "([":
+            close = ")" if ch == "(" else "]"
+            depth = 1
+            j = i + 1
+            while j < n and depth:
+                c = e[j]
+                if c in "'\"":
+                    k = j + 1
+                    while k < n and (e[k] != c or e[k - 1] == "\\"):
+                        k += 1
+                    j = k
+                elif c == ch:
+                    depth += 1
+                elif c == close:
+                    depth -= 1
+                j += 1
+            out.append(ch + _rewrite_ternary(e[i + 1 : j - 1]) + close)
+            i = j
+            continue
+        out.append(ch)
+        i += 1
+    e = "".join(out)
+    # pass 2: split this level's ternary
+    depth = 0
+    quote: Optional[str] = None
+    q_pos = -1
+    for i, ch in enumerate(e):
+        if quote:
+            if ch == quote and e[i - 1] != "\\":
+                quote = None
+            continue
+        if ch in "'\"":
+            quote = ch
+        elif ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "?" and depth == 0 and q_pos < 0:
+            q_pos = i
+        elif ch == ":" and depth == 0 and q_pos >= 0:
+            cond = e[:q_pos]
+            then = _rewrite_ternary(e[q_pos + 1 : i])
+            other = _rewrite_ternary(e[i + 1 :])
+            return f"(({then}) if ({cond}) else ({other}))"
+    return e
+
+
 def _rewrite(expression: str) -> str:
-    # JSTL artifacts: fn:/util: namespaces, && / || / ! operators, ${...} shell
+    # JSTL artifacts: fn:/util: namespaces, && / || / ! operators, ${...}
+    # shell, ternary ?:
     e = expression.strip()
     if e.startswith("${") and e.endswith("}"):
         e = e[2:-1]
+    e = _rewrite_prefixes(e)
+    e = _rewrite_ternary(e)
     parts = _SPANS.split(e)
     return "".join(
         part if i % 2 else _rewrite_code(part) for i, part in enumerate(parts)

@@ -351,3 +351,28 @@ def test_tpu_embeddings(run):
         await provider.close()
 
     run(scenario())
+
+
+def test_el_ternary_operator():
+    """JSTL ternary `cond ? a : b` (right-associative, quote/bracket aware)."""
+    from langstream_tpu.agents.genai import el
+    from langstream_tpu.agents.genai.mutable import MutableRecord
+    from langstream_tpu.api.record import SimpleRecord
+
+    r = MutableRecord.from_record(SimpleRecord.of({"q": "hi", "n": 3}))
+    assert el.evaluate("value.n > 2 ? 'big' : 'small'", r) == "big"
+    assert el.evaluate("value.missing != null ? value.missing : value.q", r) == "hi"
+    # ':' inside quotes and subscripts is not a ternary separator
+    assert el.evaluate("value.n == 3 ? 'a: yes' : 'b ? c : d'", r) == "a: yes"
+    # nested/chained ternary is right-associative
+    assert el.evaluate("1 == 2 ? 'x' : 2 == 2 ? 'y' : 'z'", r) == "y"
+
+
+def test_el_ternary_nested_in_parens():
+    from langstream_tpu.agents.genai import el
+    from langstream_tpu.agents.genai.mutable import MutableRecord
+    from langstream_tpu.api.record import SimpleRecord
+
+    r = MutableRecord.from_record(SimpleRecord.of({"n": 15}))
+    assert el.evaluate("value.n > 2 ? (value.n > 10 ? 'huge' : 'big') : 'small'", r) == "huge"
+    assert el.evaluate("(value.n > 10 ? 1 : 0) == 1 ? 'yes' : 'no'", r) == "yes"
