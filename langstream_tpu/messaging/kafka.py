@@ -8,12 +8,14 @@ topic admin. No client library: the protocol codec is
 protocol-level fake (``kafka_fake.py`` — the `k8s/fake.py` testing pattern).
 
 Design notes:
-- Partition assignment is STATIC: each consumer takes every partition of its
-  topic (or an explicit ``partitions`` list). The platform's unit of
-  parallelism is the pod replica pinned by the planner/operator, so the
-  JoinGroup/SyncGroup rebalance protocol is deliberately not spoken; group
-  state is only used for offset storage (OffsetCommit/OffsetFetch with
-  generation -1 — Kafka's "simple consumer" convention).
+- Partition assignment is DYNAMIC when a ``group.id`` is set: the consumer
+  speaks the JoinGroup/SyncGroup/Heartbeat group protocol
+  (``KafkaGroupMembership`` below) with a client-side RangeAssignor, so
+  replicas of the same agent split a topic's partitions and rebalance on
+  membership change; commits are generation-fenced. With an explicit
+  ``partitions`` list the consumer is static and uses offset storage only
+  (OffsetCommit/OffsetFetch with generation -1 — the "simple consumer"
+  convention).
 - Commit bookkeeping is the same native OffsetTracker the memory broker
   uses: acks may arrive out of order, the committed offset only advances
   over the contiguous prefix.

@@ -1,10 +1,10 @@
 """Streaming-cluster runtime registry (reference TopicConnectionsRuntimeRegistry).
 
 Maps `instance.streamingCluster.type` → TopicConnectionsRuntime. The kafka
-runtime is dependency-free (pure-asyncio wire protocol, kafka.py) and always
-registers; pulsar/pravega register only when their client library is
-importable (the image ships neither; the memory broker is the default local
-transport).
+and pulsar runtimes are dependency-free (pure-asyncio wire-protocol clients,
+kafka.py / pulsar.py) and always register; pravega registers only when its
+client library is importable (the image ships none; the memory broker is the
+default local transport).
 """
 
 from __future__ import annotations
@@ -31,10 +31,9 @@ class TopicConnectionsRuntimeRegistry:
         return factory()
 
     # type → (module, class); these register only when their broker client
-    # library is installed (kafka is NOT here — it is dependency-free and
-    # imports unconditionally above)
+    # library is installed (kafka/pulsar are NOT here — they are
+    # dependency-free and import unconditionally below)
     _GATED_BUILTINS = (
-        ("pulsar", "langstream_tpu.messaging.pulsar", "PulsarTopicConnectionsRuntime"),
         ("pravega", "langstream_tpu.messaging.pravega", "PravegaTopicConnectionsRuntime"),
     )
 
@@ -54,6 +53,11 @@ class TopicConnectionsRuntimeRegistry:
             from langstream_tpu.messaging.kafka import KafkaTopicConnectionsRuntime
 
             cls._factories["kafka"] = KafkaTopicConnectionsRuntime
+        if "pulsar" not in cls._factories:
+            # same: wire-protocol client, no pulsar-client dependency
+            from langstream_tpu.messaging.pulsar import PulsarTopicConnectionsRuntime
+
+            cls._factories["pulsar"] = PulsarTopicConnectionsRuntime
         for type_, module_name, class_name in cls._GATED_BUILTINS:
             if type_ in cls._factories:
                 continue
