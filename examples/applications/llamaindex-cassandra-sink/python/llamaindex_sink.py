@@ -1,0 +1,33 @@
+"""LlamaIndex interop: each record becomes a Document inserted into a
+CassandraVectorStore-backed index. llamaindex + cassio are the agent's own
+dependencies (ship in the code archive)."""
+
+from langstream_tpu.api.agent import AgentSink
+
+
+class LlamaIndexCassandraSink(AgentSink):
+    async def init(self, configuration):
+        self.config = dict(configuration)
+        self._index = None
+
+    def _build_index(self):
+        import cassio
+        from llama_index.core import VectorStoreIndex
+        from llama_index.vector_stores.cassandra import CassandraVectorStore
+
+        cassio.init(
+            contact_points=[self.config["cassandra-contact-points"].split(":")[0]],
+            token=self.config.get("cassandra-token"),
+            keyspace=self.config.get("keyspace", "docs"),
+        )
+        store = CassandraVectorStore(
+            table=self.config.get("table", "llama_index"), embedding_dimension=1536
+        )
+        return VectorStoreIndex.from_vector_store(store)
+
+    async def write(self, record):
+        if self._index is None:
+            self._index = self._build_index()
+        from llama_index.core import Document
+
+        self._index.insert(Document(text=str(record.value)))

@@ -349,9 +349,9 @@ class LocalVectorWriter(VectorDatabaseWriter):
 # datasource resource resolution
 # ---------------------------------------------------------------------------
 
-# drivers that still need a binary/SDK client not in this image (cassandra
-# native protocol, milvus grpc); the HTTP-API DBs are bundled (remote.py)
-_UNBUNDLED = {"cassandra", "astra", "astra-vector-db", "milvus"}
+# every reference datasource service is bundled SDK-free: sqlite/local
+# here, HTTP APIs in remote.py/milvus.py, the CQL native protocol in
+# cassandra.py (cql_protocol.py codec)
 
 
 def build_datasource(config: dict[str, Any]) -> DataSource:
@@ -369,12 +369,14 @@ def build_datasource(config: dict[str, Any]) -> DataSource:
             "solr": remote.SolrDataSource,
         }[service]
         return cls(config)
-    if service in _UNBUNDLED:
-        raise ValueError(
-            f"datasource service {service!r} requires an external client that is "
-            f"not bundled; use 'jdbc' (sqlite), 'local-vector', or an HTTP-API "
-            f"store (pinecone/opensearch/solr)"
-        )
+    if service in ("cassandra", "astra", "astra-vector-db"):
+        from langstream_tpu.agents.vector.cassandra import CassandraDataSource
+
+        return CassandraDataSource(config)
+    if service == "milvus":
+        from langstream_tpu.agents.vector.milvus import MilvusDataSource
+
+        return MilvusDataSource(config)
     raise ValueError(f"unknown datasource service {service!r}")
 
 
@@ -391,6 +393,17 @@ def build_writer(datasource: DataSource, config: dict[str, Any]) -> VectorDataba
         return remote.OpenSearchWriter(datasource, config)
     if isinstance(datasource, remote.SolrDataSource):
         return remote.SolrWriter(datasource, config)
+    from langstream_tpu.agents.vector.cassandra import (
+        CassandraDataSource,
+        CassandraWriter,
+    )
+
+    if isinstance(datasource, CassandraDataSource):
+        return CassandraWriter(datasource, config)
+    from langstream_tpu.agents.vector.milvus import MilvusDataSource, MilvusWriter
+
+    if isinstance(datasource, MilvusDataSource):
+        return MilvusWriter(datasource, config)
     raise ValueError(f"no vector writer for datasource {type(datasource).__name__}")
 
 
@@ -804,6 +817,76 @@ def _register() -> None:
                     ConfigProperty("table-name", "table to manage", required=True),
                     ConfigProperty("create-statements", "DDL to create", type="array"),
                     ConfigProperty("delete-statements", "DDL to drop", type="array"),
+                    ConfigProperty("datasource", "datasource config", type="object"),
+                ),
+                allow_unknown=True,
+            ),
+        )
+    )
+
+    def _cassandra_table_factory():
+        from langstream_tpu.agents.vector.cassandra import CassandraTableAssetManager
+
+        return CassandraTableAssetManager()
+
+    def _cassandra_keyspace_factory():
+        from langstream_tpu.agents.vector.cassandra import (
+            CassandraKeyspaceAssetManager,
+        )
+
+        return CassandraKeyspaceAssetManager()
+
+    for type_ in ("cassandra-table", "astra-table"):
+        REGISTRY.register_asset(
+            AssetTypeInfo(
+                type=type_,
+                factory=_cassandra_table_factory,
+                description="Create/drop a Cassandra/Astra table from CQL DDL.",
+                config_model=ConfigModel(
+                    type=type_,
+                    properties=props(
+                        ConfigProperty("table-name", "table to manage", required=True),
+                        ConfigProperty("keyspace", "keyspace"),
+                        ConfigProperty("create-statements", "CQL DDL", type="array"),
+                        ConfigProperty("delete-statements", "CQL DDL", type="array"),
+                        ConfigProperty("datasource", "datasource config", type="object"),
+                    ),
+                    allow_unknown=True,
+                ),
+            )
+        )
+    for type_ in ("cassandra-keyspace", "astra-keyspace"):
+        REGISTRY.register_asset(
+            AssetTypeInfo(
+                type=type_,
+                factory=_cassandra_keyspace_factory,
+                description="Create/drop a Cassandra/Astra keyspace.",
+                config_model=ConfigModel(
+                    type=type_,
+                    properties=props(
+                        ConfigProperty("keyspace", "keyspace to manage", required=True),
+                        ConfigProperty("datasource", "datasource config", type="object"),
+                    ),
+                    allow_unknown=True,
+                ),
+            )
+        )
+
+    def _milvus_collection_factory():
+        from langstream_tpu.agents.vector.milvus import MilvusCollectionAssetManager
+
+        return MilvusCollectionAssetManager()
+
+    REGISTRY.register_asset(
+        AssetTypeInfo(
+            type="milvus-collection",
+            factory=_milvus_collection_factory,
+            description="Create/drop a Milvus collection (REST v2 API).",
+            config_model=ConfigModel(
+                type="milvus-collection",
+                properties=props(
+                    ConfigProperty("collection-name", "collection", required=True),
+                    ConfigProperty("dimension", "vector dim", type="integer"),
                     ConfigProperty("datasource", "datasource config", type="object"),
                 ),
                 allow_unknown=True,

@@ -1,0 +1,497 @@
+"""Cassandra/Astra CQL data plane: codec units + client vs the protocol-level
+fake (the test_kafka.py ladder for the vector stores), plus the milvus REST
+datasource against an aiohttp stub."""
+
+import json
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.agents.vector import build_datasource, build_writer
+from langstream_tpu.agents.vector import cql_protocol as wire
+from langstream_tpu.agents.vector.cassandra import (
+    CassandraDataSource,
+    CassandraKeyspaceAssetManager,
+    CassandraTableAssetManager,
+)
+from langstream_tpu.agents.vector.cql_fake import FakeCassandra
+from langstream_tpu.api.record import SimpleRecord
+
+# ---------------------------------------------------------------------------
+# codec units
+# ---------------------------------------------------------------------------
+
+
+def test_frame_header_roundtrip():
+    f = wire.frame(wire.OP_QUERY, b"body-bytes", stream=7)
+    version, stream, opcode, length = wire.parse_header(f[: wire.HEADER_SIZE])
+    assert version == wire.VERSION_REQUEST
+    assert (stream, opcode, length) == (7, wire.OP_QUERY, 10)
+
+
+def test_value_codec_roundtrip():
+    cases = [
+        (wire.T_VARCHAR, "héllo"),
+        (wire.T_INT, -42),
+        (wire.T_BIGINT, 1 << 40),
+        (wire.T_DOUBLE, 3.5),
+        (wire.T_BOOLEAN, True),
+        (wire.T_BLOB, b"\x00\x01"),
+        (("list", wire.T_VARCHAR), ["a", "b"]),
+        (("map", wire.T_VARCHAR, wire.T_VARCHAR), {"k": "v"}),
+        (("vector", 3), [1.0, 2.0, 3.0]),
+    ]
+    for type_, value in cases:
+        assert wire.decode_value(type_, wire.encode_value(type_, value)) == value
+
+
+def test_query_body_roundtrip_with_binds():
+    body = wire.query_body("SELECT * FROM t WHERE id = ?", ["x1"])
+    query, raw_values, consistency = wire.parse_query_body(body)
+    assert query == "SELECT * FROM t WHERE id = ?"
+    assert raw_values == [b"x1"]
+    assert consistency == wire.CONSISTENCY_LOCAL_QUORUM
+
+
+def test_rows_body_roundtrip():
+    body = wire.rows_body(
+        "ks",
+        "t",
+        [("id", wire.T_VARCHAR), ("emb", ("vector", 2)), ("n", wire.T_BIGINT)],
+        [["a", [1.0, 2.0], 7], ["b", None, None]],
+    )
+    result = wire.parse_result_body(body)
+    assert result["kind"] == "rows"
+    assert result["rows"] == [
+        {"id": "a", "emb": [1.0, 2.0], "n": 7},
+        {"id": "b", "emb": None, "n": None},
+    ]
+
+
+# ---------------------------------------------------------------------------
+# client ↔ fake integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cass():
+    class Ctx:
+        async def start(self, **kw):
+            self.broker = await FakeCassandra(**kw).start()
+            return self.broker
+
+        async def stop(self):
+            await self.broker.stop()
+
+    return Ctx()
+
+
+def test_ddl_insert_select_ann(cass, run):
+    async def main():
+        broker = await cass.start()
+        ds = CassandraDataSource({"contact-points": broker.contact_point})
+        try:
+            await ds.execute_statement(
+                "CREATE KEYSPACE IF NOT EXISTS vs WITH replication = "
+                "{'class': 'SimpleStrategy', 'replication_factor': 1}",
+                [],
+            )
+            await ds.execute_statement(
+                "CREATE TABLE IF NOT EXISTS vs.docs ("
+                "id text PRIMARY KEY, text text, embeddings vector<float, 3>)",
+                [],
+            )
+            for i, vec in enumerate([[1, 0, 0], [0, 1, 0], [0.9, 0.1, 0]]):
+                await ds.execute_statement(
+                    "INSERT INTO vs.docs (id, text, embeddings) VALUES (?, ?, ?)",
+                    [f"d{i}", f"doc {i}", [float(x) for x in vec]],
+                )
+            # exact-match WHERE
+            rows = await ds.fetch_data(
+                "SELECT id, text FROM vs.docs WHERE id = ?", ["d1"]
+            )
+            assert rows == [{"id": "d1", "text": "doc 1"}]
+            # ANN ordering: closest to [1,0,0] is d0 then d2
+            rows = await ds.fetch_data(
+                "SELECT id FROM vs.docs ORDER BY embeddings ANN OF ? LIMIT 2",
+                [[1.0, 0.0, 0.0]],
+            )
+            assert [r["id"] for r in rows] == ["d0", "d2"]
+            # upsert semantics: same primary key overwrites
+            await ds.execute_statement(
+                "INSERT INTO vs.docs (id, text, embeddings) VALUES (?, ?, ?)",
+                ["d1", "doc 1 v2", [0.0, 1.0, 0.0]],
+            )
+            rows = await ds.fetch_data(
+                "SELECT text FROM vs.docs WHERE id = ?", ["d1"]
+            )
+            assert rows == [{"text": "doc 1 v2"}]
+        finally:
+            await ds.close()
+            await cass.stop()
+
+    run(main())
+
+
+def test_astra_token_auth(cass, run):
+    async def main():
+        broker = await cass.start(require_auth=("token", "AstraCS:test-token"))
+        good = build_datasource(
+            {
+                "service": "astra",
+                "contact-points": broker.contact_point,
+                "token": "AstraCS:test-token",
+            }
+        )
+        try:
+            await good.execute_statement(
+                "CREATE TABLE t (id text PRIMARY KEY)", []
+            )
+        finally:
+            await good.close()
+        bad = CassandraDataSource(
+            {"contact-points": broker.contact_point, "token": "AstraCS:wrong"}
+        )
+        with pytest.raises(wire.CqlError, match="bad credentials"):
+            await bad.fetch_data("SELECT * FROM t", [])
+        await bad.close()
+        await cass.stop()
+
+    run(main())
+
+
+def test_asset_managers(cass, run):
+    from langstream_tpu.api.model import AssetDefinition
+
+    async def main():
+        broker = await cass.start()
+        ds_config = {"contact-points": broker.contact_point}
+        ks = CassandraKeyspaceAssetManager()
+        await ks.initialize(
+            AssetDefinition(
+                id="ks",
+                asset_type="cassandra-keyspace",
+                config={"keyspace": "vs", "datasource": ds_config},
+            )
+        )
+        try:
+            assert not await ks.asset_exists()
+            await ks.deploy_asset()
+            assert await ks.asset_exists()
+
+            table = CassandraTableAssetManager()
+            await table.initialize(
+                AssetDefinition(
+                    id="t",
+                    asset_type="cassandra-table",
+                    config={
+                        "table-name": "docs",
+                        "keyspace": "vs",
+                        "datasource": {**ds_config, "keyspace": "vs"},
+                        "create-statements": [
+                            "CREATE TABLE IF NOT EXISTS vs.docs ("
+                            "id text PRIMARY KEY, embeddings vector<float, 2>)"
+                        ],
+                    },
+                )
+            )
+            try:
+                assert not await table.asset_exists()
+                await table.deploy_asset()
+                assert await table.asset_exists()
+                await table.delete_asset()
+                assert not await table.asset_exists()
+            finally:
+                await table.close()
+        finally:
+            await ks.close()
+            await cass.stop()
+
+    run(main())
+
+
+def test_writer_upserts_records(cass, run):
+    async def main():
+        broker = await cass.start()
+        ds = build_datasource(
+            {"service": "cassandra", "contact-points": broker.contact_point}
+        )
+        try:
+            await ds.execute_statement(
+                "CREATE TABLE docs (id text PRIMARY KEY, text text, "
+                "embeddings vector<float, 2>)",
+                [],
+            )
+            writer = build_writer(
+                ds,
+                {
+                    "table-name": "docs",
+                    "fields": [
+                        {"name": "id", "expression": "value.doc_id"},
+                        {"name": "text", "expression": "value.text"},
+                        {"name": "embeddings", "expression": "value.embeddings"},
+                    ],
+                },
+            )
+            await writer.upsert(
+                SimpleRecord.of(
+                    {"doc_id": "w1", "text": "written", "embeddings": [0.5, 0.5]}
+                ),
+                {},
+            )
+            rows = await ds.fetch_data("SELECT text FROM docs WHERE id = ?", ["w1"])
+            assert rows == [{"text": "written"}]
+        finally:
+            await ds.close()
+            await cass.stop()
+
+    run(main())
+
+
+def test_rag_pipeline_over_cassandra(cass, run):
+    """Full platform: assets deploy the keyspace+table on the fake, the
+    vector-db-sink writes crawl chunks, query-vector-db answers with ANN —
+    `service: cassandra` end to end (reference
+    webcrawler-astra-vector-db/query-astradb shape)."""
+    import tempfile
+    from pathlib import Path
+
+    import yaml
+
+    from langstream_tpu.core.parser import ModelBuilder
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pipeline = """
+module: default
+id: app
+assets:
+  - name: vs-keyspace
+    asset-type: cassandra-keyspace
+    creation-mode: create-if-not-exists
+    config:
+      keyspace: vs
+      datasource: cass
+  - name: docs-table
+    asset-type: cassandra-table
+    creation-mode: create-if-not-exists
+    config:
+      table-name: docs
+      keyspace: vs
+      datasource: cass
+      create-statements:
+        - "CREATE TABLE IF NOT EXISTS vs.docs (id text PRIMARY KEY, text text, embeddings vector<float, 2>)"
+topics:
+  - name: chunks-t
+    creation-mode: create-if-not-exists
+  - name: questions-t
+    creation-mode: create-if-not-exists
+  - name: answers-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: write
+    type: vector-db-sink
+    input: chunks-t
+    configuration:
+      datasource: cass
+      table-name: vs.docs
+      fields:
+        - name: id
+          expression: value.doc_id
+        - name: text
+          expression: value.text
+        - name: embeddings
+          expression: value.embeddings
+  - name: lookup
+    type: query-vector-db
+    input: questions-t
+    output: answers-t
+    configuration:
+      datasource: cass
+      query: "SELECT id, text FROM vs.docs ORDER BY embeddings ANN OF ? LIMIT 1"
+      fields:
+        - value.embeddings
+      output-field: value.matches
+"""
+
+    async def main():
+        broker = await cass.start()
+        ds_config = {"contact-points": broker.contact_point}
+        app_dir = Path(tempfile.mkdtemp(prefix="cass-e2e-"))
+        (app_dir / "pipeline.yaml").write_text(pipeline)
+        (app_dir / "configuration.yaml").write_text(
+            yaml.safe_dump(
+                {
+                    "configuration": {
+                        "resources": [
+                            {
+                                "type": "datasource",
+                                "name": "cass",
+                                "configuration": {
+                                    "service": "cassandra",
+                                    **ds_config,
+                                },
+                            }
+                        ]
+                    }
+                }
+            )
+        )
+        instance = app_dir / "instance.yaml"
+        instance.write_text(
+            yaml.safe_dump(
+                {
+                    "instance": {
+                        "streamingCluster": {"type": "memory"},
+                        "computeCluster": {"type": "local"},
+                        "globals": {"ds": {"service": "cassandra", **ds_config}},
+                    }
+                }
+            )
+        )
+        pkg = ModelBuilder.build_application_from_path(app_dir, instance_path=instance)
+        from langstream_tpu.core.resolver import resolve_placeholders
+
+        app = resolve_placeholders(pkg.application)
+        runner = LocalApplicationRunner("app", app)
+        await runner.deploy()
+        await runner.start()
+        try:
+            await runner.produce(
+                "chunks-t",
+                json.dumps(
+                    {"doc_id": "c1", "text": "tpus multiply", "embeddings": [1.0, 0.0]}
+                ),
+            )
+            await runner.produce(
+                "chunks-t",
+                json.dumps(
+                    {"doc_id": "c2", "text": "bananas are yellow", "embeddings": [0.0, 1.0]}
+                ),
+            )
+            # the sink and query branches are independent agents: wait for
+            # both chunks to land in the store before asking the question
+            import asyncio
+
+            for _ in range(200):
+                table = broker.tables.get(("vs", "docs"))
+                if table is not None and len(table.rows) >= 2:
+                    break
+                await asyncio.sleep(0.05)
+            await runner.produce(
+                "questions-t", json.dumps({"embeddings": [0.9, 0.1]})
+            )
+            out = await runner.consume("answers-t", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert value["matches"][0]["text"] == "tpus multiply"
+        finally:
+            await runner.stop()
+            await cass.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# milvus REST
+# ---------------------------------------------------------------------------
+
+
+def make_milvus_stub(collections, inserts, searches):
+    async def create(request):
+        body = await request.json()
+        collections[body["collectionName"]] = body
+        return web.json_response({"code": 0, "data": {}})
+
+    async def has(request):
+        body = await request.json()
+        return web.json_response(
+            {"code": 0, "data": {"has": body["collectionName"] in collections}}
+        )
+
+    async def drop(request):
+        body = await request.json()
+        collections.pop(body["collectionName"], None)
+        return web.json_response({"code": 0, "data": {}})
+
+    async def insert(request):
+        assert request.headers.get("Authorization") == "Bearer mv-token"
+        body = await request.json()
+        inserts.extend(body["data"])
+        return web.json_response({"code": 0, "data": {"insertCount": len(body["data"])}})
+
+    async def search(request):
+        body = await request.json()
+        searches.append(body)
+        return web.json_response(
+            {"code": 0, "data": [{"id": "m1", "text": "from milvus", "distance": 0.1}]}
+        )
+
+    return [
+        web.post("/v2/vectordb/collections/create", create),
+        web.post("/v2/vectordb/collections/has", has),
+        web.post("/v2/vectordb/collections/drop", drop),
+        web.post("/v2/vectordb/entities/insert", insert),
+        web.post("/v2/vectordb/entities/search", search),
+    ]
+
+
+def test_milvus_write_and_query(run):
+    async def main():
+        collections, inserts, searches = {}, [], []
+        app = web.Application()
+        app.add_routes(make_milvus_stub(collections, inserts, searches))
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        base = f"http://127.0.0.1:{port}"
+
+        ds = build_datasource({"service": "milvus", "url": base, "token": "mv-token"})
+        try:
+            # asset manager lifecycle
+            from langstream_tpu.agents.vector.milvus import MilvusCollectionAssetManager
+            from langstream_tpu.api.model import AssetDefinition
+
+            mgr = MilvusCollectionAssetManager()
+            await mgr.initialize(
+                AssetDefinition(
+                    id="c",
+                    asset_type="milvus-collection",
+                    config={
+                        "collection-name": "docs",
+                        "dimension": 2,
+                        "datasource": {"url": base, "token": "mv-token"},
+                    },
+                )
+            )
+            assert not await mgr.asset_exists()
+            await mgr.deploy_asset()
+            assert await mgr.asset_exists()
+            await mgr.close()
+
+            writer = build_writer(
+                ds,
+                {
+                    "collection-name": "docs",
+                    "fields": [
+                        {"name": "id", "expression": "value.doc_id"},
+                        {"name": "vector", "expression": "value.embeddings"},
+                    ],
+                },
+            )
+            await writer.upsert(
+                SimpleRecord.of({"doc_id": "m1", "embeddings": [0.1, 0.2]}), {}
+            )
+            assert inserts == [{"id": "m1", "vector": [0.1, 0.2]}]
+
+            rows = await ds.fetch_data(
+                json.dumps({"collection": "docs", "vector": "?", "topK": 1}),
+                [[0.1, 0.2]],
+            )
+            assert rows[0]["text"] == "from milvus"
+            assert searches[0]["data"] == [[0.1, 0.2]]
+        finally:
+            await ds.close()
+            await runner.cleanup()
+
+    run(main())

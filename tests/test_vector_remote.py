@@ -207,9 +207,9 @@ def test_solr_write_and_query(run):
     run(main())
 
 
-def test_unbundled_services_still_rejected():
-    with pytest.raises(ValueError, match="not bundled"):
-        build_datasource({"service": "cassandra"})
+def test_unknown_service_rejected():
+    with pytest.raises(ValueError, match="unknown datasource service"):
+        build_datasource({"service": "no-such-db"})
     with pytest.raises(ValueError, match="requires 'endpoint'"):
         build_datasource({"service": "pinecone"})
 
