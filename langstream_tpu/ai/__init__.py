@@ -29,10 +29,12 @@ __all__ = [
 
 def register_providers() -> None:
     """Register built-in AI resource types (called from agents bootstrap)."""
-    from langstream_tpu.ai import mock_provider, tpu_serving
+    from langstream_tpu.ai import mock_provider, openai_compat, remote_cloud, tpu_serving
 
     mock_provider.register()
     tpu_serving.register()
+    openai_compat.register()
+    remote_cloud.register()
 
 
 register_providers()

@@ -1,0 +1,247 @@
+"""OpenAI-compatible remote provider: SSE streaming + embeddings against a
+local stub server (the reference's WireMock pattern for
+OpenAICompletionService), then the full ai-chat-completions pipeline with an
+`open-ai-configuration` resource mixing into the platform."""
+
+import json
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.ai.openai_compat import OpenAICompatProvider
+from langstream_tpu.ai.provider import ChatMessage
+
+
+def make_stub(calls):
+    """Minimal /v1 OpenAI-compatible stub: SSE streaming chat + embeddings."""
+
+    async def chat(request):
+        body = await request.json()
+        calls.append(body)
+        prompt = body["messages"][-1]["content"]
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            words = f"echo: {prompt}".split(" ")
+            for i, word in enumerate(words):
+                text = word if i == 0 else " " + word
+                event = {
+                    "choices": [
+                        {"index": 0, "delta": {"content": text}, "finish_reason": None}
+                    ]
+                }
+                await resp.write(f"data: {json.dumps(event)}\n\n".encode())
+            final = {"choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}]}
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response(
+            {
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": f"echo: {prompt}"},
+                        "finish_reason": "stop",
+                    }
+                ],
+                "usage": {"prompt_tokens": 7, "completion_tokens": 3},
+            }
+        )
+
+    async def embeddings(request):
+        body = await request.json()
+        calls.append(body)
+        texts = body["input"]
+        return web.json_response(
+            {
+                "data": [
+                    {"index": i, "embedding": [float(len(t)), 1.0, 2.0]}
+                    for i, t in enumerate(texts)
+                ]
+            }
+        )
+
+    app = web.Application()
+    app.add_routes(
+        [web.post("/v1/chat/completions", chat), web.post("/v1/embeddings", embeddings)]
+    )
+    return app
+
+
+async def start_stub(calls):
+    runner = web.AppRunner(make_stub(calls))
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/v1"
+
+
+def test_chat_completions_blocking(run):
+    async def main():
+        calls = []
+        runner, base = await start_stub(calls)
+        provider = OpenAICompatProvider(
+            {"url": base, "access-key": "sk-test", "model": "gpt-x"}
+        )
+        try:
+            service = provider.get_completions_service({})
+            result = await service.get_chat_completions(
+                [ChatMessage("user", "hello world")], {"max-tokens": 32}
+            )
+            assert result.content == "echo: hello world"
+            assert result.prompt_tokens == 7
+            assert calls[0]["model"] == "gpt-x"
+            assert calls[0]["max_tokens"] == 32
+        finally:
+            await provider.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_chat_completions_streaming_chunks(run):
+    async def main():
+        calls = []
+        runner, base = await start_stub(calls)
+        provider = OpenAICompatProvider({"url": base, "model": "gpt-x"})
+        try:
+            service = provider.get_completions_service({})
+            chunks = []
+            result = await service.get_chat_completions(
+                [ChatMessage("user", "stream me")],
+                {},
+                chunks_consumer=chunks.append,
+            )
+            assert result.content == "echo: stream me"
+            # chunk stream: at least one content delta + the last marker
+            assert [c.content for c in chunks[:-1]] == ["echo:", " stream", " me"]
+            assert chunks[-1].last and chunks[-1].content == ""
+            assert all(c.answer_id == chunks[0].answer_id for c in chunks)
+            assert calls[0]["stream"] is True
+        finally:
+            await provider.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_embeddings(run):
+    async def main():
+        calls = []
+        runner, base = await start_stub(calls)
+        provider = OpenAICompatProvider(
+            {"url": base, "embeddings-model": "embed-x"}
+        )
+        try:
+            service = provider.get_embeddings_service({})
+            vectors = await service.compute_embeddings(["abc", "defgh"])
+            assert vectors == [[3.0, 1.0, 2.0], [5.0, 1.0, 2.0]]
+            assert calls[0]["model"] == "embed-x"
+        finally:
+            await provider.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_pipeline_streams_remote_model_to_topic(run):
+    """Full platform path: ai-chat-completions with an open-ai-configuration
+    resource streams SSE chunks into a topic — a remote model mixing into
+    the same pipeline surface the TPU provider serves."""
+    import tempfile
+    from pathlib import Path
+
+    import yaml
+
+    from langstream_tpu.core.parser import ModelBuilder
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    pipeline = """
+module: default
+id: app
+topics:
+  - name: in-t
+    creation-mode: create-if-not-exists
+  - name: out-t
+    creation-mode: create-if-not-exists
+  - name: chunks-t
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: convert
+    type: document-to-json
+    input: in-t
+    configuration:
+      text-field: question
+  - name: chat
+    type: ai-chat-completions
+    output: out-t
+    configuration:
+      model: gpt-x
+      stream-to-topic: chunks-t
+      stream-response-completion-field: value
+      min-chunks-per-message: 1
+      completion-field: value.answer
+      messages:
+        - role: user
+          content: "{{ value.question }}"
+"""
+
+    async def main():
+        calls = []
+        stub_runner, base = await start_stub(calls)
+        try:
+            app_dir = Path(tempfile.mkdtemp(prefix="openai-e2e-"))
+            (app_dir / "pipeline.yaml").write_text(pipeline)
+            (app_dir / "configuration.yaml").write_text(
+                yaml.safe_dump(
+                    {
+                        "configuration": {
+                            "resources": [
+                                {
+                                    "type": "open-ai-configuration",
+                                    "name": "openai",
+                                    "configuration": {
+                                        "url": base,
+                                        "access-key": "sk-test",
+                                    },
+                                }
+                            ]
+                        }
+                    }
+                )
+            )
+            instance = app_dir / "instance.yaml"
+            instance.write_text(
+                yaml.safe_dump(
+                    {
+                        "instance": {
+                            "streamingCluster": {"type": "memory"},
+                            "computeCluster": {"type": "local"},
+                        }
+                    }
+                )
+            )
+            pkg = ModelBuilder.build_application_from_path(
+                app_dir, instance_path=instance
+            )
+            runner = LocalApplicationRunner("app", pkg.application)
+            await runner.deploy()
+            await runner.start()
+            try:
+                await runner.produce("in-t", "what is a tpu")
+                out = await runner.consume("out-t", n=1, timeout=30)
+                answer = json.loads(out[0].value)
+                assert answer["answer"] == "echo: what is a tpu"
+                # streamed chunks landed on the stream topic too
+                chunks = await runner.consume("chunks-t", n=1, timeout=30)
+                assert chunks, "no streamed chunks on chunks-t"
+            finally:
+                await runner.stop()
+        finally:
+            await stub_runner.cleanup()
+
+    run(main())

@@ -1,0 +1,143 @@
+"""Bedrock + Vertex remote providers against local stubs (the WireMock
+pattern; reference BedrockService/VertexAI tests)."""
+
+import json
+
+from aiohttp import web
+
+from langstream_tpu.ai.provider import ChatMessage
+from langstream_tpu.ai.remote_cloud import BedrockProvider, VertexProvider
+
+
+async def _serve(routes):
+    app = web.Application()
+    app.add_routes(routes)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_bedrock_chat_and_embeddings(run):
+    async def main():
+        invocations = []
+
+        async def invoke(request):
+            # SigV4 with the bedrock service scope actually applied
+            auth = request.headers.get("authorization", "")
+            assert "AWS4-HMAC-SHA256" in auth
+            assert "/bedrock/aws4_request" in auth
+            body = await request.json()
+            invocations.append((request.match_info["model"], body))
+            if "inputText" in body:
+                return web.json_response({"embedding": [0.1, 0.2]})
+            return web.json_response(
+                {
+                    "content": [{"type": "text", "text": "bedrock says hi"}],
+                    "stop_reason": "end_turn",
+                    "usage": {"input_tokens": 5, "output_tokens": 3},
+                }
+            )
+
+        runner, base = await _serve([web.post("/model/{model}/invoke", invoke)])
+        provider = BedrockProvider(
+            {
+                "endpoint": base,
+                "region": "us-east-1",
+                "access-key": "AK",
+                "secret-key": "SK",
+                "model": "anthropic.claude-3",
+            }
+        )
+        try:
+            chunks = []
+            result = await provider.get_completions_service({}).get_chat_completions(
+                [ChatMessage("system", "be brief"), ChatMessage("user", "hello")],
+                {"max-tokens": 16},
+                chunks_consumer=chunks.append,
+            )
+            assert result.content == "bedrock says hi"
+            assert result.prompt_tokens == 5
+            assert chunks[-1].last
+            model, body = invocations[0]
+            assert model == "anthropic.claude-3"
+            assert body["system"] == "be brief"
+            assert body["max_tokens"] == 16
+
+            vectors = await provider.get_embeddings_service(
+                {"model": "amazon.titan-embed"}
+            ).compute_embeddings(["abc"])
+            assert vectors == [[0.1, 0.2]]
+        finally:
+            await provider.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_vertex_chat_and_embeddings(run):
+    async def main():
+        calls = []
+
+        async def generate(request):
+            assert request.headers["Authorization"] == "Bearer vx-token"
+            body = await request.json()
+            calls.append((request.match_info["verb"], body))
+            verb = request.match_info["verb"]
+            if verb.endswith(":predict"):
+                return web.json_response(
+                    {
+                        "predictions": [
+                            {"embeddings": {"values": [1.0, 2.0]}},
+                            {"embeddings": {"values": [3.0, 4.0]}},
+                        ]
+                    }
+                )
+            return web.json_response(
+                {
+                    "candidates": [
+                        {"content": {"parts": [{"text": "vertex says hi"}]}}
+                    ],
+                    "usageMetadata": {"promptTokenCount": 4, "candidatesTokenCount": 2},
+                }
+            )
+
+        runner, base = await _serve(
+            [
+                web.post(
+                    "/v1/projects/p1/locations/us-central1/publishers/google/models/{verb}",
+                    generate,
+                )
+            ]
+        )
+        provider = VertexProvider(
+            {
+                "url": base,
+                "project": "p1",
+                "region": "us-central1",
+                "token": "vx-token",
+                "model": "gemini-pro",
+                "embeddings-model": "textembedding-gecko",
+            }
+        )
+        try:
+            result = await provider.get_completions_service({}).get_chat_completions(
+                [ChatMessage("user", "hello")], {"max-tokens": 8, "temperature": 0.2}
+            )
+            assert result.content == "vertex says hi"
+            verb, body = calls[0]
+            assert verb == "gemini-pro:generateContent"
+            assert body["generationConfig"] == {"maxOutputTokens": 8, "temperature": 0.2}
+
+            vectors = await provider.get_embeddings_service({}).compute_embeddings(
+                ["a", "b"]
+            )
+            assert vectors == [[1.0, 2.0], [3.0, 4.0]]
+            assert calls[1][0] == "textembedding-gecko:predict"
+        finally:
+            await provider.close()
+            await runner.cleanup()
+
+    run(main())
