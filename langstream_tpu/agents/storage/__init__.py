@@ -135,8 +135,10 @@ def _sigv4_headers(
     access_key: str,
     secret_key: str,
     payload: bytes = b"",
+    service: str = "s3",
 ) -> dict[str, str]:
-    """Minimal AWS Signature V4 signing for S3-style requests."""
+    """Minimal AWS Signature V4 signing (S3 by default; any AWS service —
+    the bedrock provider signs with service="bedrock")."""
     parsed = urlparse(url)
     host = parsed.netloc
     # callers build URLs with already-percent-encoded paths (quote(name)),
@@ -165,7 +167,7 @@ def _sigv4_headers(
     canonical_request = "\n".join(
         [method, canonical_uri, canonical_query, canonical_headers, signed_headers, payload_hash]
     )
-    scope = f"{datestamp}/{region}/s3/aws4_request"
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
     string_to_sign = "\n".join(
         [
             "AWS4-HMAC-SHA256",
@@ -180,7 +182,7 @@ def _sigv4_headers(
 
     k_date = sign(f"AWS4{secret_key}".encode(), datestamp)
     k_region = sign(k_date, region)
-    k_service = sign(k_region, "s3")
+    k_service = sign(k_region, service)
     k_signing = sign(k_service, "aws4_request")
     signature = hmac.new(k_signing, string_to_sign.encode(), hashlib.sha256).hexdigest()
 

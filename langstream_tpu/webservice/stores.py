@@ -445,12 +445,147 @@ class S3CodeStorage(CodeStorage):
         self._request("DELETE", self._key(tenant, code_store_id))
 
 
+class AzureBlobCodeStorage(CodeStorage):
+    """Archive store on Azure Blob (reference ``AzureBlobCodeStorage.java``).
+    Blobs live at ``{container}/{tenant}/{code_store_id}.zip``. Auth is
+    either a SAS token (appended to every URL, the SDK-free path the
+    azure-blob-storage-source agent uses) or an account key via SharedKey
+    signing. ``endpoint`` overrides the account URL for Azurite/local stubs."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        container: str = "langstream-code-storage",
+        sas_token: str = "",
+        account_name: str = "",
+        account_key: str = "",
+    ) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.container = container
+        self.sas_token = sas_token.lstrip("?")
+        self.account_name = account_name
+        self.account_key = account_key
+
+    @staticmethod
+    def from_config(config: dict[str, Any]) -> "AzureBlobCodeStorage":
+        account = config.get("storage-account-name", "")
+        endpoint = config.get("endpoint") or f"https://{account}.blob.core.windows.net"
+        return AzureBlobCodeStorage(
+            endpoint=endpoint,
+            container=config.get("container", "langstream-code-storage"),
+            sas_token=config.get("sas-token", ""),
+            account_name=account,
+            account_key=config.get("storage-account-key", ""),
+        )
+
+    def _shared_key_headers(
+        self, method: str, path: str, payload: bytes, extra: dict[str, str]
+    ) -> dict[str, str]:
+        # Azure SharedKey: string-to-sign over canonicalized headers/resource
+        import base64
+        import email.utils
+        import hmac
+
+        headers = {
+            "x-ms-date": email.utils.formatdate(usegmt=True),
+            "x-ms-version": "2021-08-06",
+            **extra,
+        }
+        ms_headers = "\n".join(
+            f"{k.lower()}:{v}" for k, v in sorted(headers.items())
+            if k.lower().startswith("x-ms-")
+        )
+        content_length = str(len(payload)) if payload else ""
+        # Content-Type must be signed AND sent explicitly — urllib would
+        # otherwise auto-add x-www-form-urlencoded to PUT bodies and break
+        # the signature
+        content_type = headers.get("Content-Type", "")
+        string_to_sign = "\n".join([
+            method, "", "", content_length, "", content_type, "", "", "", "",
+            "", "",
+            ms_headers,
+            f"/{self.account_name}{path}",
+        ])
+        signature = base64.b64encode(
+            hmac.new(
+                base64.b64decode(self.account_key),
+                string_to_sign.encode(),
+                hashlib.sha256,
+            ).digest()
+        ).decode()
+        headers["Authorization"] = f"SharedKey {self.account_name}:{signature}"
+        return headers
+
+    def _request(self, method: str, key: str, payload: bytes = b"") -> tuple[int, bytes]:
+        import urllib.error
+        import urllib.request
+
+        path = f"/{self.container}/{key}"
+        url = f"{self.endpoint}{path}"
+        extra = (
+            {"x-ms-blob-type": "BlockBlob", "Content-Type": "application/zip"}
+            if method == "PUT"
+            else {}
+        )
+        if self.sas_token:
+            sep = "&" if "?" in url else "?"
+            url = f"{url}{sep}{self.sas_token}"
+            headers = extra
+        elif self.account_key:
+            headers = self._shared_key_headers(method, path, payload, extra)
+        else:
+            headers = extra
+        req = urllib.request.Request(
+            url, data=payload if method == "PUT" else None, method=method
+        )
+        for k, v in headers.items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _key(self, tenant: str, code_store_id: str) -> str:
+        return f"{tenant}/{code_store_id}.zip"
+
+    def store(
+        self, tenant: str, application_id: str, archive_bytes: bytes
+    ) -> CodeArchiveMetadata:
+        digest = hashlib.sha256(archive_bytes).hexdigest()
+        code_store_id = f"{application_id}-{digest[:16]}"
+        status, body = self._request(
+            "PUT", self._key(tenant, code_store_id), archive_bytes
+        )
+        if status not in (200, 201, 204):
+            raise RuntimeError(f"Azure code upload failed ({status}): {body[:200]!r}")
+        return CodeArchiveMetadata(
+            tenant=tenant,
+            code_store_id=code_store_id,
+            application_id=application_id,
+            digests={"archive": digest},
+        )
+
+    def download(self, tenant: str, code_store_id: str) -> bytes:
+        status, body = self._request("GET", self._key(tenant, code_store_id))
+        if status == 404:
+            raise FileNotFoundError(f"code archive {tenant}/{code_store_id} not found")
+        if status != 200:
+            raise RuntimeError(f"Azure code download failed ({status}): {body[:200]!r}")
+        return body
+
+    def delete(self, tenant: str, code_store_id: str) -> None:
+        self._request("DELETE", self._key(tenant, code_store_id))
+
+
 def make_code_storage(config: dict[str, Any]) -> CodeStorage:
     """``codeStorage`` config block → implementation (reference
     CodeStorageRegistry: type s3 | azure | local | memory)."""
     kind = (config.get("type") or "memory").lower()
     if kind == "s3":
         return S3CodeStorage.from_config(config.get("configuration", config))
+    if kind in ("azure", "azure-blob-storage"):
+        return AzureBlobCodeStorage.from_config(config.get("configuration", config))
     if kind in ("local", "disk"):
         cfg = config.get("configuration", config)
         return LocalDiskCodeStorage(cfg.get("path", "/var/lib/langstream-tpu/code"))

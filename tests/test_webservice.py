@@ -441,3 +441,83 @@ def test_control_plane_deploy_download_via_s3(run):
             await s3_runner.cleanup()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# Azure Blob code storage (reference AzureBlobCodeStorage.java)
+# ---------------------------------------------------------------------------
+
+
+async def start_azure_stub(store, *, require_sas: str = ""):
+    """Minimal Azure Blob REST stub: PUT/GET/DELETE blobs in one container."""
+    from aiohttp import web
+
+    async def put_blob(request):
+        if require_sas:
+            assert request.query_string.endswith(require_sas)
+        assert request.headers.get("x-ms-blob-type") == "BlockBlob"
+        store[request.match_info["key"]] = await request.read()
+        return web.Response(status=201)
+
+    async def get_blob(request):
+        key = request.match_info["key"]
+        if key not in store:
+            return web.Response(status=404)
+        return web.Response(body=store[key])
+
+    async def delete_blob(request):
+        store.pop(request.match_info["key"], None)
+        return web.Response(status=202)
+
+    app = web.Application()
+    app.add_routes(
+        [
+            web.put("/code-container/{key:.*}", put_blob),
+            web.get("/code-container/{key:.*}", get_blob),
+            web.delete("/code-container/{key:.*}", delete_blob),
+        ]
+    )
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_azure_code_storage_roundtrip(run):
+    import asyncio
+
+    from langstream_tpu.webservice.stores import AzureBlobCodeStorage, make_code_storage
+
+    async def main():
+        blobs = {}
+        runner, base = await start_azure_stub(blobs, require_sas="sig=abc")
+        try:
+            storage = make_code_storage(
+                {
+                    "type": "azure",
+                    "configuration": {
+                        "endpoint": base,
+                        "container": "code-container",
+                        "sas-token": "?sv=2021&sig=abc",
+                    },
+                }
+            )
+            assert isinstance(storage, AzureBlobCodeStorage)
+
+            def drive():
+                meta = storage.store("t1", "app1", b"azure-zip-bytes")
+                assert f"t1/{meta.code_store_id}.zip" in blobs
+                assert storage.download("t1", meta.code_store_id) == b"azure-zip-bytes"
+                storage.delete("t1", meta.code_store_id)
+                import pytest as _p
+
+                with _p.raises(FileNotFoundError):
+                    storage.download("t1", meta.code_store_id)
+
+            await asyncio.to_thread(drive)
+        finally:
+            await runner.cleanup()
+
+    run(main())
