@@ -286,6 +286,7 @@ def run_operator() -> None:
     app_controller = AppController(kube, InProcessJobExecutor(kube))
     agent_controller = AgentController(kube)
     log.info("operator up against %s (namespace=%s)", kube.server, namespace or "*")
+    backoff = poll
     while True:
         try:
             # apps first — their deployer phase writes the Agent CRs the
@@ -306,10 +307,16 @@ def run_operator() -> None:
                         "agent reconcile failed: %s",
                         manifest.get("metadata", {}).get("name"),
                     )
-        except Exception:  # noqa: BLE001 — API server blip: retry next poll
-            log.exception("list from API server failed; retrying")
+            backoff = poll  # healthy pass: reset
+        except Exception:  # noqa: BLE001 — API server blip: back off and retry
+            log.exception(
+                "list from API server failed; retrying in %.1fs", backoff
+            )
             if once:
                 raise
+            _time.sleep(backoff)
+            backoff = min(backoff * 2, 60.0)  # exponential, capped
+            continue
         if once:
             return
         _time.sleep(poll)
@@ -377,10 +384,12 @@ def run_code_download() -> None:
         archive = resp.read()
     target.mkdir(parents=True, exist_ok=True)
     with zipfile.ZipFile(io.BytesIO(archive)) as zf:
+        root = target.resolve()
         for info in zf.infolist():
-            # refuse path traversal from a hostile archive
+            # refuse path traversal from a hostile archive (proper ancestor
+            # check — a raw str prefix passes sibling dirs like /target-evil)
             dest = (target / info.filename).resolve()
-            if not str(dest).startswith(str(target.resolve())):
+            if not dest.is_relative_to(root):
                 raise RuntimeError(f"archive path escapes target: {info.filename}")
         zf.extractall(target)
     log.info("code archive for %s/%s unpacked to %s", tenant, app_id, target)

@@ -26,6 +26,7 @@ import json
 import os
 import ssl
 import tempfile
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Optional
@@ -74,6 +75,8 @@ class KubeApiClient:
         self.server = server.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # bounded retries for optimistic-concurrency conflicts / API blips
+        self.max_conflict_retries = 5
         self._context: Optional[ssl.SSLContext] = None
         if self.server.startswith("https"):
             if insecure_skip_tls_verify:
@@ -139,27 +142,40 @@ class KubeApiClient:
             u["user"] for u in cfg.get("users", []) if u["name"] == ctx["user"]
         )
 
+        owned: list[str] = []
+
         def materialize(source: dict, data_key: str, path_key: str) -> Optional[str]:
             # inline base64 *-data fields win over file paths, per kubectl
             data = source.get(data_key)
             if data:
-                f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
-                f.write(base64.b64decode(data))
-                f.close()
-                return f.name
+                fd, name = tempfile.mkstemp(suffix=".pem")
+                os.fchmod(fd, 0o600)
+                with os.fdopen(fd, "wb") as f:
+                    f.write(base64.b64decode(data))
+                owned.append(name)
+                return name
             return source.get(path_key)
 
         ca = materialize(cluster, "certificate-authority-data", "certificate-authority")
         cert = materialize(user, "client-certificate-data", "client-certificate")
         key = materialize(user, "client-key-data", "client-key")
-        return KubeApiClient(
-            cluster["server"],
-            token=user.get("token"),
-            ca_cert_path=ca,
-            client_cert_path=cert,
-            client_key_path=key,
-            insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
-        )
+        try:
+            return KubeApiClient(
+                cluster["server"],
+                token=user.get("token"),
+                ca_cert_path=ca,
+                client_cert_path=cert,
+                client_key_path=key,
+                insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
+            )
+        finally:
+            # the SSLContext reads the PEMs eagerly in __init__; don't leave
+            # decoded private-key material behind in /tmp
+            for name in owned:
+                try:
+                    os.unlink(name)
+                except OSError:
+                    pass
 
     # -- plumbing ------------------------------------------------------------
 
@@ -224,22 +240,38 @@ class KubeApiClient:
         meta = manifest.get("metadata", {})
         namespace = meta.get("namespace", "default")
         name = meta["name"]
-        existing = self.get(kind, namespace, name)
-        if existing is None:
-            created = self._request(
-                "POST", self._path(kind, namespace, None), manifest
-            )
-            assert created is not None
-            return created
-        # carry the live resourceVersion forward (optimistic concurrency)
-        manifest = dict(manifest)
-        manifest["metadata"] = dict(meta)
-        rv = existing.get("metadata", {}).get("resourceVersion")
-        if rv is not None:
-            manifest["metadata"]["resourceVersion"] = rv
-        updated = self._request("PUT", self._path(kind, namespace, name), manifest)
-        assert updated is not None
-        return updated
+        # conflict-aware upsert: a 409 means a concurrent writer moved the
+        # object (stale resourceVersion on PUT, or create raced an existing
+        # object) — re-read and retry with the fresh rv (reference JOSDK
+        # operators get this from the framework's retry policy)
+        last: Optional[KubeApiError] = None
+        for attempt in range(self.max_conflict_retries):
+            existing = self.get(kind, namespace, name)
+            try:
+                if existing is None:
+                    created = self._request(
+                        "POST", self._path(kind, namespace, None), manifest
+                    )
+                    assert created is not None
+                    return created
+                # carry the live resourceVersion forward (optimistic concurrency)
+                attempt_manifest = dict(manifest)
+                attempt_manifest["metadata"] = dict(meta)
+                rv = existing.get("metadata", {}).get("resourceVersion")
+                if rv is not None:
+                    attempt_manifest["metadata"]["resourceVersion"] = rv
+                updated = self._request(
+                    "PUT", self._path(kind, namespace, name), attempt_manifest
+                )
+                assert updated is not None
+                return updated
+            except KubeApiError as e:
+                if e.status != 409:
+                    raise
+                last = e
+                time.sleep(min(0.05 * 2**attempt, 1.0))
+        assert last is not None
+        raise last
 
     def delete(self, kind: str, namespace: str, name: str) -> bool:
         out = self._request("DELETE", self._path(kind, namespace, name))
@@ -248,9 +280,21 @@ class KubeApiClient:
     def patch_status(
         self, kind: str, namespace: str, name: str, status: dict[str, Any]
     ) -> Optional[dict[str, Any]]:
-        return self._request(
-            "PATCH",
-            self._path(kind, namespace, name) + "/status",
-            {"status": status},
-            content_type="application/merge-patch+json",
-        )
+        # status patches retry on 409/transient-5xx: the patch is a merge
+        # (no rv), so a conflict or blip just means "send it again"
+        last: Optional[KubeApiError] = None
+        for attempt in range(self.max_conflict_retries):
+            try:
+                return self._request(
+                    "PATCH",
+                    self._path(kind, namespace, name) + "/status",
+                    {"status": status},
+                    content_type="application/merge-patch+json",
+                )
+            except KubeApiError as e:
+                if e.status not in (409, 429, 500, 502, 503, 504):
+                    raise
+                last = e
+                time.sleep(min(0.05 * 2**attempt, 1.0))
+        assert last is not None
+        raise last

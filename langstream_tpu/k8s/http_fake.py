@@ -32,6 +32,10 @@ class HttpFakeKubeServer:
         self.token = token  # when set, requests must carry it as Bearer
         self._runner: Optional[web.AppRunner] = None
         self.port = 0
+        # chaos injection: (method-or-None, status) entries consumed one per
+        # matching request — tests use this to exercise 409/5xx retry paths
+        self.error_queue: list[tuple[Optional[str], int]] = []
+        self.requests_served = 0
 
     @property
     def url(self) -> str:
@@ -79,6 +83,10 @@ class HttpFakeKubeServer:
         return None
 
     async def _handle(self, request: web.Request) -> web.Response:
+        self.requests_served += 1
+        if self.error_queue and self.error_queue[0][0] in (None, request.method):
+            _, status = self.error_queue.pop(0)
+            return web.json_response({"message": "injected chaos"}, status=status)
         if self.token is not None:
             auth = request.headers.get("Authorization", "")
             if auth != f"Bearer {self.token}":

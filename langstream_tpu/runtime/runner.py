@@ -365,10 +365,15 @@ class AgentRunner:
         finally:
             if not writer_task.done():
                 writer_task.cancel()
+            cancelled = [writer_task]
             while not pending.empty():
                 item = pending.get_nowait()
                 if item is not None:
                     item[0].cancel()
+                    cancelled.append(item[0])
+            # retrieve cancellations/exceptions so failed in-flight batches
+            # don't surface as "Task exception was never retrieved"
+            await asyncio.gather(*cancelled, return_exceptions=True)
 
     async def _handle_results(
         self, results: list[ProcessorResult], trace_id: Optional[str] = None

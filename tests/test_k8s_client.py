@@ -295,3 +295,136 @@ def test_code_download_role(run, monkeypatch, tmp_path):
             await runtime.close()
 
     run(main())
+
+
+def test_patch_status_retries_injected_conflicts(run):
+    """A 409 then a 500 on PATCH /status are retried until the patch lands
+    (reference JOSDK retry policy on UpdateControl)."""
+
+    async def main():
+        server = await HttpFakeKubeServer().start()
+        try:
+            client = KubeApiClient(server.url)
+
+            def drive():
+                client.apply(
+                    {
+                        "kind": "Application",
+                        "metadata": {"name": "a1", "namespace": "ns"},
+                        "spec": {},
+                    }
+                )
+                server.error_queue.extend([("PATCH", 409), ("PATCH", 500)])
+                out = client.patch_status("Application", "ns", "a1", {"phase": "X"})
+                assert out is not None
+                assert not server.error_queue  # both injections consumed
+                assert client.get("Application", "ns", "a1")["status"]["phase"] == "X"
+
+            await asyncio.to_thread(drive)
+        finally:
+            await server.stop()
+
+    run(main())
+
+
+def test_apply_retries_conflict_with_fresh_resource_version(run):
+    async def main():
+        server = await HttpFakeKubeServer().start()
+        try:
+            client = KubeApiClient(server.url)
+
+            def drive():
+                client.apply(
+                    {
+                        "kind": "ConfigMap",
+                        "metadata": {"name": "cm", "namespace": "ns"},
+                        "data": {"v": "1"},
+                    }
+                )
+                server.error_queue.append(("PUT", 409))
+                out = client.apply(
+                    {
+                        "kind": "ConfigMap",
+                        "metadata": {"name": "cm", "namespace": "ns"},
+                        "data": {"v": "2"},
+                    }
+                )
+                assert out["data"]["v"] == "2"
+
+            await asyncio.to_thread(drive)
+        finally:
+            await server.stop()
+
+    run(main())
+
+
+def test_operator_chaos_converges(run, monkeypatch):
+    """Chaos ladder: the operator is killed after its first (setup) phase
+    and restarted; the CR is edited concurrently (generation bump); the API
+    server injects 409/500 blips. After the dust settles a final pass must
+    converge every CR to DEPLOYED with the dependents in place — the
+    level-based reconcile contract (AppController.java:92-245)."""
+    from langstream_tpu.entrypoint import main as entrypoint_main
+    from langstream_tpu.k8s.controllers import AppController, InProcessJobExecutor
+
+    async def main():
+        server = await HttpFakeKubeServer().start()
+        try:
+            client = KubeApiClient(server.url)
+            app_cr = ApplicationCustomResource(
+                name="chaos-app",
+                namespace="langstream-default",
+                tenant="default",
+                package_files={"pipeline.yaml": PIPELINE},
+                instance_text=INSTANCE,
+            )
+
+            def drive():
+                monkeypatch.setenv("KUBE_API_SERVER", server.url)
+                monkeypatch.setenv("OPERATOR_ONCE", "true")
+                monkeypatch.setenv("OPERATOR_NAMESPACE", "langstream-default")
+                client.apply(app_cr.to_manifest())
+
+                # crash mid-two-phase: run ONLY phase 1 (setup) by calling
+                # the controller with a deployer that dies, then "restart"
+                class DyingExecutor(InProcessJobExecutor):
+                    def run_deployer(self, app):
+                        raise RuntimeError("operator killed mid-deploy")
+
+                controller = AppController(client, DyingExecutor(client))
+                manifest = client.get("Application", "langstream-default", "chaos-app")
+                status = controller.reconcile(manifest)
+                assert status["phase"] == "ERROR_DEPLOY"
+                # setup phase committed, deploy did not
+                live = client.get("Application", "langstream-default", "chaos-app")
+                assert live["status"].get("setupFor") is not None
+                assert live["status"].get("deployedFor") is None
+
+                # concurrent writer edits the CR while the operator is down
+                edited = dict(live)
+                edited["spec"] = dict(live["spec"])
+                edited["metadata"] = {
+                    k: v
+                    for k, v in live["metadata"].items()
+                    if k != "resourceVersion"
+                }
+                client.apply(edited)
+
+                # API blips on the restarted operator's writes
+                server.error_queue.extend([("PUT", 409), ("PATCH", 500)])
+
+                # restarted operator: one full pass must converge
+                assert entrypoint_main(["operator"]) == 0
+                final = client.get("Application", "langstream-default", "chaos-app")
+                assert final["status"]["phase"] == "DEPLOYED", final["status"]
+                agents = client.list("Agent", "langstream-default")
+                assert len(agents) == 1
+                name = agents[0]["metadata"]["name"]
+                assert client.get("StatefulSet", "langstream-default", name)
+                assert not server.error_queue  # injected blips were consumed
+
+            await asyncio.to_thread(drive)
+        finally:
+            await server.stop()
+
+    run(main())
