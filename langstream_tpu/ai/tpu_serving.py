@@ -182,6 +182,8 @@ class _EngineHolder:
             decode_chunk=int(self.config.get("decode-chunk", 8)),
             prefill_batch=prefill_batch,
             spmd=spmd,
+            pipeline_depth=int(self.config.get("pipeline-depth", 1)),
+            ttft_chunk_floor=int(self.config.get("ttft-chunk-floor", 4)),
         )
         if start:
             engine.start()

@@ -92,7 +92,8 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         n_heads=8,
         n_kv_heads=4,
         d_ff=128,
-        max_seq_len=256,
+        # wide enough for the RAG examples' stuffed prompts (context + history)
+        max_seq_len=1024,
     ),
     "tiny-moe-test": _preset(
         name="tiny-moe-test",

@@ -243,6 +243,8 @@ class ServingEngine:
         decode_chunk: int = 8,
         prefill_batch: Optional[int] = None,
         spmd: Optional[Any] = None,
+        pipeline_depth: int = 1,
+        ttft_chunk_floor: int = 4,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -289,7 +291,18 @@ class ServingEngine:
         # decode chunk size (tokens per dispatch per slot); clamped to
         # powers of two to bound recompiles
         self.decode_chunk = max(1, int(decode_chunk))
-        # steps of the currently in-flight (dispatched, unfetched) chunk
+        # dispatch pipeline depth: how many decode chunks may stay in flight
+        # (dispatched, unfetched) at once. Depth 1 — dispatch chunk k+1,
+        # then fetch chunk k — already overlaps the fetch with compute and
+        # measured BEST on the tunneled chip (deeper pipelines delay
+        # completion discovery and first-token fetches by a full chunk:
+        # +700ms p50 TTFT, no throughput gain). The knob stays for
+        # low-dispatch-latency environments where depth 2 can pay.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        # smallest chunk the TTFT shrink may pick when admissible work waits
+        self.ttft_chunk_floor = max(1, int(ttft_chunk_floor))
+        # total steps of the currently in-flight (dispatched, unfetched)
+        # chunks, summed over the pipeline
         self._inflight_steps = 0
         # rows per prefill dispatch: bigger = fewer serial prefill calls
         # under a burst (each call costs a tunnel dispatch), at the price of
@@ -301,6 +314,9 @@ class ServingEngine:
         # keeps flowing in between
         self._long: Optional[dict] = None
         self._long_queue: list[GenerationRequest] = []
+        # bound the chunked-prefill backlog so submit()'s queue-full
+        # backpressure engages for long prompts too (ADVICE r3)
+        self._long_queue_cap = 8
         self._reserved: set[int] = set()
         # long-prefill local cache, kept on self (not the state dict) so
         # SPMD followers evolve the same attr through _dev_long_segment
@@ -331,6 +347,13 @@ class ServingEngine:
             self._thread = None
         # resolve everything still in flight so blocked callers return now
         self._fail_all(RuntimeError("serving engine stopped"))
+
+    def _requeue_front(self, request: GenerationRequest) -> None:
+        """Push a request back to the head of the submit queue (engine thread
+        only) — used when the bounded long-prompt backlog is full, so the
+        request stays in the bounded queue and backpressure holds."""
+        with self._queue.mutex:
+            self._queue.queue.appendleft(request)
 
     def submit(self, request: GenerationRequest) -> GenerationRequest:
         """Thread-safe enqueue; blocks when the queue is full (backpressure
@@ -378,13 +401,19 @@ class ServingEngine:
     # -- engine thread ------------------------------------------------------
 
     def _run(self) -> None:
-        pending: list[tuple] = []
+        from collections import deque
+
+        # batches of deferred fetch entries, one per loop iteration, newest
+        # last; up to pipeline_depth batches stay unfetched so their device
+        # work overlaps host bookkeeping AND the next dispatches
+        pending: deque[list[tuple]] = deque()
         try:
             while not self._stop.is_set():
-                # the chunk dispatched last iteration is still unfetched when
-                # this iteration's dispatch computes its headroom bound
-                self._inflight_steps = next(
-                    (e[3] for e in pending if e[0] == "chunk"), 0
+                # chunks dispatched in previous iterations are still
+                # unfetched when this iteration's dispatch computes its
+                # headroom bound — subtract ALL of them
+                self._inflight_steps = sum(
+                    e[3] for batch in pending for e in batch if e[0] == "chunk"
                 )
                 had_active = any(s.active for s in self._slots)
                 # long prefill FIRST: it claims a freed slot before _admit
@@ -405,12 +434,21 @@ class ServingEngine:
                     new_pending.append(self._dispatch_chunk())
                 elif not new_pending and not pending and self._long is None:
                     time.sleep(0.001)
-                # fetching round k's tokens overlaps with round k+1's compute
-                for entry in pending:
+                pending.append(new_pending)
+                # process the oldest batch when its device arrays are READY
+                # (no host block, completions/first tokens discovered at
+                # chunk granularity), or unconditionally once the pipeline
+                # is full / nothing new was dispatched to overlap with
+                while pending and (
+                    len(pending) > self.pipeline_depth
+                    or not new_pending
+                    or self._batch_ready(pending[0])
+                ):
+                    for entry in pending.popleft():
+                        self._process_entry(entry)
+            while pending:
+                for entry in pending.popleft():
                     self._process_entry(entry)
-                pending = new_pending
-            for entry in pending:
-                self._process_entry(entry)
         except BaseException as e:  # noqa: BLE001 — fail every pending request
             log.exception("serving engine loop crashed")
             self._fail_all(e)
@@ -427,6 +465,23 @@ class ServingEngine:
                     self._spmd.announce(ControlBlock(op=OP_STOP))
                 except Exception:  # noqa: BLE001 — transport may be gone too
                     log.exception("failed to announce STOP to SPMD followers")
+
+    @staticmethod
+    def _batch_ready(batch: list[tuple]) -> bool:
+        """True when every device array in the batch has materialized (the
+        fetch would not block). Backends without is_ready() report ready —
+        degrading to depth-1 behavior, never deadlock."""
+        for entry in batch:
+            arr = entry[1]
+            is_ready = getattr(arr, "is_ready", None)
+            if is_ready is None:
+                continue
+            try:
+                if not is_ready():
+                    return False
+            except Exception:  # noqa: BLE001 — treat probe failure as ready
+                continue
+        return True
 
     def _process_entry(self, entry: tuple) -> None:
         kind = entry[0]
@@ -480,7 +535,13 @@ class ServingEngine:
                 except queue.Empty:
                     break
                 if len(request.prompt_tokens) > short_limit:
-                    self._long_queue.append(request)  # chunked-prefill path
+                    # chunked-prefill path — but keep it bounded so submit()'s
+                    # queue-full backpressure still engages under sustained
+                    # long-prompt traffic (otherwise memory grows unbounded)
+                    if len(self._long_queue) >= self._long_queue_cap:
+                        self._requeue_front(request)
+                        break
+                    self._long_queue.append(request)
                 else:
                     pairs.append((idx, request))
                     got_short = True
@@ -626,7 +687,7 @@ class ServingEngine:
             not s.active and i not in self._reserved
             for i, s in enumerate(self._slots)
         ):
-            want = min(want, 4)
+            want = min(want, self.ttft_chunk_floor)
         # never dispatch (much) past the longest remaining token budget: a
         # full chunk for slots about to finish wastes its tail on device AND
         # sits in front of whatever arrives next (a burst admission right
