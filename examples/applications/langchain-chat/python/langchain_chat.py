@@ -1,0 +1,38 @@
+"""LangChain interop: a python-processor that answers with a LangChain
+chain. The platform only sees the Record SPI; langchain is the agent's own
+dependency (ship it in the agent's code archive / image)."""
+
+from langstream_tpu.api.agent import AgentProcessor, ProcessorResult
+from langstream_tpu.api.record import SimpleRecord
+
+
+class LangChainChat(AgentProcessor):
+    async def init(self, configuration):
+        self.base_url = configuration.get("openai-base-url")
+        self.api_key = configuration.get("openai-key")
+        self._chain = None
+
+    def _build_chain(self):
+        # imported lazily so the pipeline parses/plans without langchain
+        from langchain_core.prompts import ChatPromptTemplate
+        from langchain_openai import ChatOpenAI
+
+        llm = ChatOpenAI(base_url=self.base_url, api_key=self.api_key)
+        prompt = ChatPromptTemplate.from_messages(
+            [("system", "Answer briefly."), ("user", "{question}")]
+        )
+        return prompt | llm
+
+    async def process(self, records):
+        if self._chain is None:
+            self._chain = self._build_chain()
+        out = []
+        for record in records:
+            answer = await self._chain.ainvoke({"question": str(record.value)})
+            out.append(
+                ProcessorResult(
+                    source_record=record,
+                    records=[SimpleRecord.of(answer.content)],
+                )
+            )
+        return out

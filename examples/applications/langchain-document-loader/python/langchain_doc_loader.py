@@ -1,0 +1,21 @@
+"""LangChain interop: load whatever URL each record carries and emit the
+document text."""
+
+from langstream_tpu.api.agent import AgentProcessor, ProcessorResult
+from langstream_tpu.api.record import SimpleRecord
+
+
+class DocumentLoader(AgentProcessor):
+    async def process(self, records):
+        from langchain_community.document_loaders import WebBaseLoader
+
+        out = []
+        for record in records:
+            docs = WebBaseLoader(str(record.value)).load()
+            out.append(
+                ProcessorResult(
+                    source_record=record,
+                    records=[SimpleRecord.of(d.page_content) for d in docs],
+                )
+            )
+        return out

@@ -1,0 +1,23 @@
+"""LangChain interop: a python-source that emits documents produced by a
+LangChain WebBaseLoader, one record per document."""
+
+from langstream_tpu.api.agent import AgentSource
+from langstream_tpu.api.record import SimpleRecord
+
+
+class WebLoaderSource(AgentSource):
+    async def init(self, configuration):
+        self.url = configuration.get("url")
+        self._done = False
+
+    async def read(self):
+        if self._done:
+            return []
+        from langchain_community.document_loaders import WebBaseLoader
+
+        docs = WebBaseLoader(self.url).load()
+        self._done = True
+        return [
+            SimpleRecord.of(d.page_content, headers=[("source", self.url)])
+            for d in docs
+        ]

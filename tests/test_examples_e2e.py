@@ -1,0 +1,473 @@
+"""Every shipped example that needs no gated dependency runs END TO END on
+the memory broker — external services replaced by the same protocol fakes /
+HTTP stubs the unit suites use (reference bar: every agent has a runnable
+IT, AbstractApplicationRunner).
+
+test_examples.py keeps the parse+plan sweep and a handful of bespoke e2e
+scenarios; this file mass-covers the rest through one harness: per example,
+start stubs → point the secrets at them → deploy on LocalApplicationRunner
+→ produce → assert consumed output."""
+
+import asyncio
+import json
+import tempfile
+from pathlib import Path
+
+import pytest
+import yaml
+
+from langstream_tpu.core.parser import ModelBuilder
+from langstream_tpu.core.resolver import resolve_placeholders
+
+EXAMPLES = Path(__file__).parent.parent / "examples"
+INSTANCE = EXAMPLES / "instances" / "local-memory.yaml"
+BASE_SECRETS = EXAMPLES / "secrets" / "secrets.yaml"
+
+
+def write_secrets(overrides: dict[str, dict]) -> Path:
+    """Copy the shipped secrets file with per-id data overrides merged in."""
+    doc = yaml.safe_load(BASE_SECRETS.read_text())
+    for entry in doc["secrets"]:
+        if entry["id"] in overrides:
+            entry["data"] = {**entry["data"], **overrides[entry["id"]]}
+    out = Path(tempfile.mkdtemp(prefix="ex-secrets-")) / "secrets.yaml"
+    out.write_text(yaml.safe_dump(doc))
+    return out
+
+
+async def run_example(app_name: str, scenario, overrides: dict | None = None):
+    from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+    secrets = write_secrets(overrides or {})
+    pkg = ModelBuilder.build_application_from_path(
+        EXAMPLES / "applications" / app_name,
+        instance_path=INSTANCE,
+        secrets_path=secrets,
+    )
+    app = resolve_placeholders(pkg.application)
+    runner = LocalApplicationRunner(app_name, app)
+    await runner.deploy()
+    await runner.start()
+    try:
+        await scenario(runner)
+    finally:
+        await runner.stop()
+
+
+# ---------------------------------------------------------------------------
+# local-only examples (tpu/mock provider, sqlite, local-vector)
+# ---------------------------------------------------------------------------
+
+
+def test_compute_tpu_embeddings(run):
+    async def scenario(runner):
+        await runner.produce("texts-topic", "embed this")
+        out = await runner.consume("vectors-topic", n=1, timeout=90)
+        value = json.loads(out[0].value)
+        assert isinstance(value["embeddings"], list) and value["embeddings"]
+
+    run(run_example("compute-tpu-embeddings", scenario))
+
+
+def test_tpu_rag_query_module(run):
+    """The query half of tpu-rag: vector index asset + lookup + answer."""
+
+    async def scenario(runner):
+        await runner.produce("rag-questions", "what is a tpu?")
+        out = await runner.consume("rag-answers", n=1, timeout=120)
+        value = json.loads(out[0].value)
+        assert value.get("answer")
+
+    run(run_example("tpu-rag", scenario))
+
+
+def test_chatbot_ui_pipeline(run):
+    async def scenario(runner):
+        await runner.produce("bot-questions", "hello bot")
+        out = await runner.consume("bot-answers", n=1, timeout=90)
+        assert out
+
+    run(run_example("chatbot-ui", scenario))
+
+
+def test_query_postgresql_chat_history(run):
+    async def scenario(runner):
+        await runner.produce(
+            "turns-topic",
+            "what did I ask before?",
+            headers=[("langstream-client-session-id", "s-hist")],
+        )
+        out = await runner.consume("enriched-topic", n=1, timeout=90)
+        assert out
+
+    run(run_example("query-postgresql-chat-history", scenario))
+
+
+def test_flare_loop(run):
+    async def scenario(runner):
+        await runner.produce("flare-questions", "tell me about tpus")
+        out = await runner.consume("flare-answers", n=1, timeout=120)
+        assert out
+
+    run(run_example("flare", scenario))
+
+
+# ---------------------------------------------------------------------------
+# stub-backed examples
+# ---------------------------------------------------------------------------
+
+
+async def _start_app(routes):
+    from aiohttp import web
+
+    app = web.Application()
+    app.add_routes(routes)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_http_request_processor(run):
+    from aiohttp import web
+
+    async def main():
+        async def geocode(request):
+            assert request.query["q"]
+            return web.json_response({"lat": 1.5, "lon": 2.5})
+
+        stub, base = await _start_app([web.get("/", geocode)])
+
+        async def scenario(runner):
+            await runner.produce("geo-input", "Lisbon")
+            out = await runner.consume("geo-output", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert value["api-response"]["lat"] == 1.5
+
+        try:
+            await run_example(
+                "http-request-processor",
+                scenario,
+                {"http-service": {"url": base, "api-key": "k"}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_query_astradb_over_fake(run):
+    from langstream_tpu.agents.vector.cql_fake import FakeCassandra
+
+    async def main():
+        broker = await FakeCassandra().start()
+        # seed the table the example queries
+        from langstream_tpu.agents.vector.cassandra import CassandraDataSource
+
+        ds = CassandraDataSource({"contact-points": broker.contact_point})
+        await ds.execute_statement(
+            "CREATE TABLE shop.products (id text PRIMARY KEY, name text, description text)",
+            [],
+        )
+        await ds.execute_statement(
+            "INSERT INTO shop.products (id, name, description) VALUES (?, ?, ?)",
+            ["p1", "widget", "a fine widget"],
+        )
+        await ds.close()
+
+        async def scenario(runner):
+            await runner.produce("product-requests", "p1")
+            out = await runner.consume("product-rows", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert value["product"][0]["name"] == "widget"
+
+        try:
+            await run_example(
+                "query-astradb",
+                scenario,
+                {"astra": {"contact-points": broker.contact_point, "token": ""}},
+            )
+        finally:
+            await broker.stop()
+
+    run(main())
+
+
+def test_astradb_sink_over_fake(run):
+    from langstream_tpu.agents.vector.cql_fake import FakeCassandra
+
+    async def main():
+        broker = await FakeCassandra().start()
+
+        async def scenario(runner):
+            await runner.produce(
+                "products-topic",
+                json.dumps({"id": "p7", "name": "gizmo", "description": "shiny"}),
+            )
+            for _ in range(100):
+                table = broker.tables.get(("shop", "products"))
+                if table and table.rows:
+                    break
+                await asyncio.sleep(0.05)
+            table = broker.tables[("shop", "products")]
+            assert list(table.rows.values())[0]["name"] == "gizmo"
+
+        try:
+            await run_example(
+                "astradb-sink",
+                scenario,
+                {"astra": {"contact-points": broker.contact_point, "token": ""}},
+            )
+        finally:
+            await broker.stop()
+
+    run(main())
+
+
+def test_query_milvus_over_stub(run):
+    from aiohttp import web
+
+    async def main():
+        searches = []
+
+        async def has(request):
+            return web.json_response({"code": 0, "data": {"has": True}})
+
+        async def search(request):
+            searches.append(await request.json())
+            return web.json_response(
+                {"code": 0, "data": [{"id": "m1", "text": "milvus hit"}]}
+            )
+
+        stub, base = await _start_app(
+            [
+                web.post("/v2/vectordb/collections/has", has),
+                web.post("/v2/vectordb/collections/create", has),
+                web.post("/v2/vectordb/entities/search", search),
+            ]
+        )
+
+        async def scenario(runner):
+            await runner.produce("questions-topic", "find me")
+            out = await runner.consume("answers-topic", n=1, timeout=90)
+            value = json.loads(out[0].value)
+            assert value["results"][0]["text"] == "milvus hit"
+            assert searches and searches[0]["limit"] == 5
+
+        try:
+            await run_example(
+                "query-milvus", scenario, {"milvus": {"url": base, "token": "t"}}
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def _openai_stub_routes(calls):
+    from aiohttp import web
+
+    async def chat(request):
+        body = await request.json()
+        calls.append(body)
+        prompt = body["messages"][-1]["content"]
+        return web.json_response(
+            {
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": f"echo: {prompt}"},
+                        "finish_reason": "stop",
+                    }
+                ]
+            }
+        )
+
+    return [web.post("/v1/chat/completions", chat)]
+
+
+def test_ollama_chatbot_over_stub(run):
+    async def main():
+        calls = []
+        stub, base = await _start_app(_openai_stub_routes(calls))
+
+        async def scenario(runner):
+            await runner.produce("ollama-input", "hi ollama")
+            out = await runner.consume("ollama-output", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert value["answer"] == "echo: hi ollama"
+            assert calls[0]["model"] == "llama3"
+
+        try:
+            await run_example(
+                "ollama-chatbot", scenario, {"ollama": {"url": f"{base}/v1"}}
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_bedrock_text_completions_over_stub(run):
+    from aiohttp import web
+
+    async def main():
+        async def invoke(request):
+            assert "AWS4-HMAC-SHA256" in request.headers.get("authorization", "")
+            return web.json_response(
+                {
+                    "content": [{"type": "text", "text": "bedrock completion"}],
+                    "stop_reason": "end_turn",
+                }
+            )
+
+        stub, base = await _start_app([web.post("/model/{model}/invoke", invoke)])
+
+        async def scenario(runner):
+            await runner.produce("bedrock-input", "complete me")
+            out = await runner.consume("bedrock-output", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert value["completion"] == "bedrock completion"
+
+        try:
+            await run_example(
+                "bedrock-text-completions", scenario, {"bedrock": {"endpoint": base}}
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_vertexai_text_completions_over_stub(run):
+    from aiohttp import web
+
+    async def main():
+        async def generate(request):
+            return web.json_response(
+                {
+                    "candidates": [
+                        {"content": {"parts": [{"text": "vertex completion"}]}}
+                    ]
+                }
+            )
+
+        stub, base = await _start_app(
+            [
+                web.post(
+                    "/v1/projects/{p}/locations/{l}/publishers/google/models/{verb}",
+                    generate,
+                )
+            ]
+        )
+
+        async def scenario(runner):
+            await runner.produce("vertex-input", "complete me")
+            out = await runner.consume("vertex-output", n=1, timeout=30)
+            value = json.loads(out[0].value)
+            assert value["completion"] == "vertex completion"
+
+        try:
+            await run_example(
+                "vertexai-text-completions", scenario, {"vertex": {"url": base}}
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_query_pinecone_over_stub(run):
+    from aiohttp import web
+
+    async def main():
+        store = {}
+
+        async def upsert(request):
+            body = await request.json()
+            for v in body["vectors"]:
+                store[v["id"]] = v
+            return web.json_response({"upsertedCount": len(body["vectors"])})
+
+        async def query(request):
+            matches = [
+                {"id": vid, "score": 0.9, "metadata": v.get("metadata", {})}
+                for vid, v in store.items()
+            ]
+            return web.json_response({"matches": matches})
+
+        stub, base = await _start_app(
+            [web.post("/vectors/upsert", upsert), web.post("/query", query)]
+        )
+
+        async def scenario(runner):
+            await runner.produce("docs-topic", "a pinecone document")
+            for _ in range(200):
+                if store:
+                    break
+                await asyncio.sleep(0.05)
+            assert store, "sink never wrote to the stub"
+            await runner.produce("questions-topic", "what do you know?")
+            out = await runner.consume("answers-topic", n=1, timeout=90)
+            assert out
+
+        try:
+            await run_example(
+                "query-pinecone",
+                scenario,
+                {"pinecone": {"endpoint": base, "api-key": "change-me"}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_webcrawler_astra_over_fakes(run):
+    """Crawl a local stub site, embed, and land rows in the CQL fake —
+    the full webcrawler-astra-vector-db path with zero egress."""
+    from aiohttp import web
+
+    from langstream_tpu.agents.vector.cql_fake import FakeCassandra
+
+    async def main():
+        async def page(request):
+            return web.Response(
+                text="<html><body><p>tpus are fast matrix machines</p></body></html>",
+                content_type="text/html",
+            )
+
+        site_stub, site_base = await _start_app([web.get("/", page)])
+        broker = await FakeCassandra().start()
+
+        async def scenario(runner):
+            for _ in range(400):
+                table = broker.tables.get(("docs", "documents"))
+                if table and table.rows:
+                    break
+                await asyncio.sleep(0.05)
+            table = broker.tables.get(("docs", "documents"))
+            assert table and table.rows, "no crawled rows reached the store"
+            row = next(iter(table.rows.values()))
+            assert "tpus" in row["text"]
+            assert isinstance(row["embeddings"], list) and len(row["embeddings"]) == 64
+
+        from urllib.parse import urlparse
+
+        domain = urlparse(site_base).hostname
+        try:
+            await run_example(
+                "webcrawler-astra-vector-db",
+                scenario,
+                {
+                    "astra": {"contact-points": broker.contact_point, "token": ""},
+                    "crawler": {"seed-url": f"{site_base}/", "allowed-domain": domain},
+                },
+            )
+        finally:
+            await broker.stop()
+            await site_stub.cleanup()
+
+    run(main())
