@@ -385,9 +385,13 @@ def _layer(
     causal: bool = True,
     kv_offset: Optional[jax.Array] = None,
     kv_bound: Optional[int] = None,
+    collect_kv: bool = False,
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
     """One transformer block. If cache_kv given, k/v are written at
-    cache_positions and attention runs over the full cache width."""
+    cache_positions and attention runs over the full cache width. With
+    ``collect_kv`` (cache-less paths) the layer's roped K/V come back
+    head-major so a caller can build a cache from a full forward — the
+    ring-prefill serving path (parallel.sp.ring_prefill)."""
     b, s, d = x.shape
     hd = config.resolved_head_dim
 
@@ -425,6 +429,8 @@ def _layer(
         k_all, v_all = ck, cv
     else:
         k_all, v_all = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+        if collect_kv:
+            new_cache = (k_all, v_all)
 
     if config.ring_axis is not None and cache_kv is None:
         # sequence-parallel path: K/V blocks rotate around the ring; the
@@ -479,19 +485,24 @@ def _unembed(params: Params, x: jax.Array, config: ModelConfig) -> jax.Array:
 
 def _scan_layers(
     params, x, sin, cos, mask, config, cache=None, cache_positions=None, causal=True,
-    kv_offset=None, kv_bound=None,
+    kv_offset=None, kv_bound=None, collect_kv=False,
 ):
-    """lax.scan over stacked layer params; carries (x, cache)."""
+    """lax.scan over stacked layer params; carries (x, cache). With
+    ``collect_kv`` (cache-less) the scan stacks each layer's roped K/V into
+    [L, B, Hkv, S, D] arrays — the makings of a serving cache."""
     layers = params["layers"]
 
     if cache is None:
 
         def body(carry, lp):
-            y, _ = _layer(carry, lp, sin, cos, mask, config, causal=causal)
-            return y, None
+            y, kv = _layer(
+                carry, lp, sin, cos, mask, config, causal=causal,
+                collect_kv=collect_kv,
+            )
+            return y, kv
 
-        x, _ = lax.scan(body, x, layers)
-        return x, None
+        x, kvs = lax.scan(body, x, layers)
+        return x, kvs
 
     def body_cached(carry, inputs):
         lp, (ck, cv) = inputs

@@ -203,6 +203,58 @@ def _make_admit_group(mesh):
     return admit_group
 
 
+def _make_ring_admit(mesh):
+    """Factory for the RING long-prompt admission: one dispatch runs the
+    sequence-sharded ring prefill (parallel.sp.ring_prefill — prompt blocks
+    spread over the mesh's "seq" axis, K/V rotating over ICI), quantizes the
+    returned K/V if the cache is int8, splices it into the big cache, and
+    samples the first token. The multi-chip counterpart of the single-chip
+    chunked-prefill segment loop: S/W sequential segment dispatches become
+    ONE compiled call whose attention memory stays O(S·S/n) per device."""
+    @functools.partial(
+        jax.jit,
+        static_argnames=("config",),
+        donate_argnames=(
+            "cache", "tokens_dev", "positions_dev", "temp_dev",
+            "top_k_dev", "top_p_dev",
+        ),
+    )
+    def ring_admit(
+        params, cache, tokens_dev, positions_dev, temp_dev, top_k_dev,
+        top_p_dev, key, tokens, meta, slots, config,
+    ):
+        from langstream_tpu.models.transformer import _quantize_kv
+        from langstream_tpu.parallel.sp import ring_prefill
+
+        lengths = meta[0].astype(jnp.int32)
+        temps = meta[1]
+        top_ks = meta[2].astype(jnp.int32)
+        top_ps = meta[3]
+        logits, kv = ring_prefill(params, tokens, lengths, config, mesh)
+        key, sub = jax.random.split(key)
+        first = sample(logits, sub, temps, top_ks, top_ps)
+        if isinstance(cache["k"], dict):  # int8 big cache
+            kq, ks = _quantize_kv(kv["k"])
+            vq, vs = _quantize_kv(kv["v"])
+            local = {"k": {"q": kq, "s": ks}, "v": {"q": vq, "s": vs}}
+        else:
+            local = kv
+
+        def put(big, small):
+            w = small.shape[3]
+            return big.at[:, slots, :, :w].set(small.astype(big.dtype), mode="drop")
+
+        cache = jax.tree.map(put, cache, local)
+        tokens_dev = tokens_dev.at[slots].set(first, mode="drop")
+        positions_dev = positions_dev.at[slots].set(lengths, mode="drop")
+        temp_dev = temp_dev.at[slots].set(temps, mode="drop")
+        top_k_dev = top_k_dev.at[slots].set(top_ks, mode="drop")
+        top_p_dev = top_p_dev.at[slots].set(top_ps, mode="drop")
+        return first, cache, tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev, key
+
+    return ring_admit
+
+
 def _make_insert_group():
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def insert_group(cache, local_cache, slots):
@@ -269,6 +321,18 @@ class ServingEngine:
             self._cache = shard_serving_cache(self._cache, mesh)
         self._insert_group = _make_insert_group()
         self._admit_group = _make_admit_group(mesh)
+        # ring long-prefill: mesh spans a "seq" axis → long prompts run as
+        # ONE sequence-sharded dispatch instead of the segment loop. The
+        # SPMD leader/follower (multi-host) path keeps the segment loop —
+        # its control-block replay protocol is per-segment.
+        self._ring_admit = (
+            _make_ring_admit(mesh)
+            if mesh is not None
+            and "seq" in getattr(mesh, "shape", {})
+            and mesh.shape["seq"] > 1
+            and spmd is None
+            else None
+        )
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -743,6 +807,10 @@ class ServingEngine:
             if free is None:
                 return []
             request = self._long_queue.pop(0)
+            if self._ring_admit is not None and self._ring_pad(
+                len(request.prompt_tokens)
+            ) is not None:
+                return self._ring_step(free, request)
             self._reserved.add(free)
             self._long = {"idx": free, "request": request, "seg": 0}
         st = self._long
@@ -801,6 +869,72 @@ class ServingEngine:
         # final segment landed on device: activate the slot host-side
         self._long = None
         self._reserved.discard(idx)
+        slot = self._slots[idx]
+        slot.request = request
+        slot.position = len(prompt)
+        slot.generated = []
+        slot.started_at = time.monotonic()
+        slot.first_token_at = 0.0
+        self.total_requests += 1
+        return [("prefill", first, [(idx, request)])]
+
+    def _ring_pad(self, prompt_len: int) -> Optional[int]:
+        """Padded width for the ring path: |seq| pow2-sized blocks (O(log)
+        compiled shapes). None when that padding cannot fit max_seq_len —
+        the caller falls back to the single-dispatch-per-segment loop, which
+        has no divisibility constraint."""
+        n = self.mesh.shape["seq"]
+        block = 128
+        while block * n < prompt_len:
+            block *= 2
+        s_pad = block * n
+        return s_pad if s_pad <= self.max_seq_len else None
+
+    def _ring_step(self, idx: int, request: GenerationRequest) -> list[tuple]:
+        """One-dispatch ring long-prefill: run the fused ring admit and
+        activate the slot. Decode chunks for other slots resume next
+        iteration."""
+        prompt = request.prompt_tokens
+        s_pad = self._ring_pad(len(prompt))
+        assert s_pad is not None  # caller checked
+        tokens = np.zeros((1, s_pad), np.int32)
+        tokens[0, : len(prompt)] = prompt
+        opts = request.options
+        meta = np.asarray(
+            [[len(prompt)], [opts.temperature], [opts.top_k], [opts.top_p]],
+            np.float32,
+        )
+        try:
+            (
+                first,
+                self._cache,
+                self._tokens_dev,
+                self._positions_dev,
+                self._temp_dev,
+                self._top_k_dev,
+                self._top_p_dev,
+                self._key,
+            ) = self._ring_admit(
+                self.params,
+                self._cache,
+                self._tokens_dev,
+                self._positions_dev,
+                self._temp_dev,
+                self._top_k_dev,
+                self._top_p_dev,
+                self._key,
+                jnp.asarray(tokens),
+                jnp.asarray(meta),
+                jnp.asarray(np.full(1, idx, np.int32)),
+                self.config,
+            )
+        except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            log.exception("ring prefill failed")
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=e,
+            ))
+            return []
         slot = self._slots[idx]
         slot.request = request
         slot.position = len(prompt)

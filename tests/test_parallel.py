@@ -101,3 +101,68 @@ def test_tp_engine_greedy_decode_matches_single_device():
 
     assert ref.tokens == out.tokens
     assert out.finish_reason == ref.finish_reason
+
+
+def test_ring_prefill_matches_dense_prefill():
+    """parallel.sp.ring_prefill (sequence-sharded single-dispatch long
+    prefill) returns the same last-token logits and K/V the dense prefill
+    writes into a cache."""
+    from langstream_tpu.models.transformer import make_kv_cache, prefill
+    from langstream_tpu.parallel.sp import ring_prefill
+
+    config = fp32_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    prompt_len, s_pad = 100, 128
+    tokens = np.zeros((1, s_pad), np.int32)
+    tokens[0, :prompt_len] = rng.integers(1, config.vocab_size, size=prompt_len)
+    lengths = jnp.asarray([prompt_len], jnp.int32)
+
+    cache = make_kv_cache(config, 1, s_pad)
+    dense_logits, cache = prefill(params, jnp.asarray(tokens), lengths, cache, config)
+
+    mesh = build_mesh({"seq": 4})
+    ring_logits, kv = ring_prefill(params, jnp.asarray(tokens), lengths, config, mesh)
+    np.testing.assert_allclose(
+        np.asarray(ring_logits), np.asarray(dense_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(kv["k"][:, :, :, :prompt_len]),
+        np.asarray(cache["k"][:, :, :, :prompt_len]),
+        rtol=2e-4,
+        atol=2e-4,
+    )
+
+
+def test_ring_long_prefill_engine_matches_single_device():
+    """A long prompt (wider than every prefill bucket) served on a
+    model×seq mesh takes the one-dispatch ring path and generates the same
+    greedy tokens as the single-device chunked-prefill segment loop."""
+    from langstream_tpu.models.configs import GenerationOptions
+    from langstream_tpu.serving.engine import ServingEngine
+
+    config = fp32_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    prompt = [7 + (i % 23) for i in range(100)]  # > largest bucket (32)
+    options = GenerationOptions(max_new_tokens=10, temperature=0.0)
+    kw = dict(max_batch=2, max_seq_len=512, prefill_buckets=(16, 32), decode_chunk=4)
+
+    single = ServingEngine(config, params, **kw)
+    single.start()
+    try:
+        ref = single.generate(prompt, options, timeout=300)
+    finally:
+        single.stop()
+
+    mesh = build_mesh({"model": 2, "seq": 4})
+    sharded = shard_params(params, mesh, config)
+    ring = ServingEngine(config, sharded, mesh=mesh, **kw)
+    assert ring._ring_admit is not None, "seq mesh axis must enable ring admit"
+    ring.start()
+    try:
+        out = ring.generate(prompt, options, timeout=300)
+    finally:
+        ring.stop()
+
+    assert ref.tokens == out.tokens
+    assert out.finish_reason == ref.finish_reason
