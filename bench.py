@@ -152,10 +152,15 @@ def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
 
 
 def bench_long_prompt(preset: str, quantize: bool, prompt_len: int,
-                      segment: int, max_seq_len: int) -> float:
+                      segment: int, max_seq_len: int, max_batch: int = 4,
+                      kv_int8: bool = False) -> float:
     """Chunked-prefill TTFT: one long prompt on an otherwise idle engine —
     the latency a RAG request with a big stuffed context actually sees.
-    Returns TTFT in seconds."""
+    Returns TTFT in seconds. ``kv_int8``/small ``max_batch``: the
+    long-context shapes (serving/memory.py's plan is the arithmetic —
+    llama-3.1-8b int8+int8kv at B=1 is what makes 32k fit 16G HBM)."""
+    import dataclasses
+
     import jax
     import numpy as np
 
@@ -164,16 +169,19 @@ def bench_long_prompt(preset: str, quantize: bool, prompt_len: int,
     from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
 
     config = MODEL_PRESETS[preset]
-    params = init_params(config, jax.random.PRNGKey(0))
+    if kv_int8:
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
     if quantize:
-        from langstream_tpu.models.quant import quantize_params
+        from langstream_tpu.models.quant import init_random_quantized_params
 
-        params = jax.jit(lambda p: quantize_params(p, config))(params)
+        params = init_random_quantized_params(config, jax.random.PRNGKey(0))
         jax.block_until_ready(params)
+    else:
+        params = init_params(config, jax.random.PRNGKey(0))
     engine = ServingEngine(
         config,
         params,
-        max_batch=4,
+        max_batch=max_batch,
         max_seq_len=min(max_seq_len, config.max_seq_len),
         prefill_buckets=(segment,),
         decode_chunk=8,
@@ -338,6 +346,19 @@ def main() -> None:
             extras["llama_3_8b_int8_tokens_per_sec"] = round(llama_tok_s, 2)
         except Exception as e:  # noqa: BLE001
             print(f"[bench] llama phase failed: {e}", file=sys.stderr, flush=True)
+        # long-context ceiling phase: the largest context the memory plan
+        # says ONE chip truly serves on the 128k NTK preset — llama-3.1-8b,
+        # int8 weights + int8 KV, B=1 → 32k (serving/memory.py). TTFT of a
+        # 32k-token prompt through the chunked-prefill path.
+        try:
+            print("[bench] llama-3.1 32k long-context phase", file=sys.stderr, flush=True)
+            ttft32k = bench_long_prompt(
+                "llama-3.1-8b", True, 32000, 2048, 32768,
+                max_batch=1, kv_int8=True,
+            )
+            extras["long_prompt_32000_ttft_ms"] = round(ttft32k * 1e3, 1)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] 32k phase failed: {e}", file=sys.stderr, flush=True)
     print(f"[bench] extras: {extras}", file=sys.stderr, flush=True)
     baseline = 2000.0  # BASELINE.json aggregate target
     name = f"{preset}-int8" if quantize else preset

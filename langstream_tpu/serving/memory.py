@@ -1,0 +1,133 @@
+"""Serving HBM accounting: what a (model, batch, context) configuration
+actually costs on a chip, BEFORE allocating it.
+
+The reference never has to answer this question — its serving is delegated
+to remote providers (OpenAICompletionService.java etc.), so context length
+is someone else's capacity problem. Here the model lives in local HBM, and
+the honest ceiling for long-context serving is arithmetic, not marketing:
+weights + decode cache + chunked-prefill local cache + XLA workspace must
+fit. ``plan_serving_memory`` computes the terms from the real param/cache
+pytree shapes (``jax.eval_shape`` — nothing is allocated), and
+``max_context_single_chip`` inverts the plan to the largest power-of-two
+context a given HBM budget serves.
+
+Used by bench.py's long-prompt phases and the capacity docs/tests; the
+engine logs the plan at startup so an over-committed config fails loudly
+with numbers instead of an opaque RESOURCE_EXHAUSTED mid-request.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+
+from langstream_tpu.models.configs import ModelConfig
+
+
+def _tree_bytes(shape_tree: Any) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(shape_tree)
+    )
+
+
+@dataclass(frozen=True)
+class ServingMemoryPlan:
+    weights_bytes: int
+    cache_bytes: int  # decode cache: max_batch × max_seq_len
+    long_cache_bytes: int  # chunked-prefill local cache (one prompt wide)
+    workspace_bytes: int  # XLA scratch / activation headroom estimate
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            self.weights_bytes
+            + self.cache_bytes
+            + self.long_cache_bytes
+            + self.workspace_bytes
+        )
+
+    def fits(self, hbm_bytes: int) -> bool:
+        return self.total_bytes <= hbm_bytes
+
+    def summary(self) -> str:
+        gib = 1024**3
+        return (
+            f"weights {self.weights_bytes / gib:.2f}GiB + "
+            f"cache {self.cache_bytes / gib:.2f}GiB + "
+            f"long-prefill {self.long_cache_bytes / gib:.2f}GiB + "
+            f"workspace {self.workspace_bytes / gib:.2f}GiB = "
+            f"{self.total_bytes / gib:.2f}GiB"
+        )
+
+
+def plan_serving_memory(
+    config: ModelConfig,
+    max_batch: int,
+    max_seq_len: int,
+    *,
+    quantized_weights: bool = False,
+    long_prefill: bool = True,
+    workspace_bytes: int = 1 << 30,
+) -> ServingMemoryPlan:
+    """Account a ServingEngine's HBM from the actual pytree shapes.
+
+    ``long_prefill``: include the 1-row local cache the chunked-prefill /
+    ring path holds while a max-length prompt streams in (engine._long_step
+    allocates it at the pow2 width covering the prompt, here bounded by
+    ``max_seq_len``). ``workspace_bytes``: flat allowance for activations,
+    XLA scratch, and the collectives' staging buffers — 1GiB is empirically
+    comfortable for 8B-class decode at B≤96.
+    """
+    from langstream_tpu.models.quant import init_random_quantized_params
+    from langstream_tpu.models.transformer import init_params, make_kv_cache
+
+    key = jax.ShapeDtypeStruct((2,), jax.numpy.uint32)
+    if quantized_weights:
+        params_shape = jax.eval_shape(
+            lambda k: init_random_quantized_params(config, k), key
+        )
+    else:
+        params_shape = jax.eval_shape(lambda k: init_params(config, k), key)
+    cache_shape = jax.eval_shape(
+        lambda: make_kv_cache(config, max_batch, max_seq_len)
+    )
+    long_shape = (
+        jax.eval_shape(lambda: make_kv_cache(config, 1, max_seq_len))
+        if long_prefill
+        else None
+    )
+    return ServingMemoryPlan(
+        weights_bytes=_tree_bytes(params_shape),
+        cache_bytes=_tree_bytes(cache_shape),
+        long_cache_bytes=_tree_bytes(long_shape) if long_shape else 0,
+        workspace_bytes=workspace_bytes,
+    )
+
+
+def max_context_single_chip(
+    config: ModelConfig,
+    max_batch: int,
+    hbm_bytes: int,
+    *,
+    quantized_weights: bool = True,
+    ceiling: int = 1 << 20,
+) -> int:
+    """Largest power-of-two max_seq_len (≥1k) the HBM budget serves, or 0.
+
+    This is the number the llama-3.1 128k preset must be honest about: NTK
+    scaling makes 128k *positions* work, but one chip serves only what the
+    cache arithmetic allows — shard (tp/seq) for the rest.
+    """
+    best = 0
+    width = 1024
+    while width <= min(ceiling, config.max_seq_len):
+        plan = plan_serving_memory(
+            config, max_batch, width, quantized_weights=quantized_weights
+        )
+        if not plan.fits(hbm_bytes):
+            break
+        best = width
+        width *= 2
+    return best

@@ -1,0 +1,53 @@
+"""Serving HBM accounting (serving/memory.py): the plans that decide what
+context length a chip honestly serves — nothing allocates, shapes only."""
+
+import dataclasses
+
+from langstream_tpu.models.configs import MODEL_PRESETS
+from langstream_tpu.serving.memory import (
+    max_context_single_chip,
+    plan_serving_memory,
+)
+
+GIB = 1024**3
+
+
+def test_plan_tracks_real_param_shapes():
+    cfg = MODEL_PRESETS["tiny-test"]
+    plan = plan_serving_memory(cfg, 4, 256, workspace_bytes=0)
+    # bf16 weights: 2 bytes per param; the tiny config is well under 10MB
+    assert 0 < plan.weights_bytes < 10 * 1024**2
+    # cache: 2 (K+V) × L×B×Hkv×T×D × 2 bytes
+    expected_cache = (
+        2 * cfg.n_layers * 4 * cfg.n_kv_heads * 256 * cfg.resolved_head_dim * 2
+    )
+    assert plan.cache_bytes == expected_cache
+    assert plan.long_cache_bytes == expected_cache // 4  # one row vs four
+    assert plan.total_bytes == (
+        plan.weights_bytes + plan.cache_bytes + plan.long_cache_bytes
+    )
+
+
+def test_int8_weights_and_kv_shrink_the_plan():
+    cfg = MODEL_PRESETS["tiny-test"]
+    fp = plan_serving_memory(cfg, 4, 256, workspace_bytes=0)
+    q = plan_serving_memory(cfg, 4, 256, quantized_weights=True, workspace_bytes=0)
+    assert q.weights_bytes < fp.weights_bytes
+    kv8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    q8 = plan_serving_memory(kv8, 4, 256, quantized_weights=True, workspace_bytes=0)
+    assert q8.cache_bytes < q.cache_bytes
+
+
+def test_llama31_single_chip_ceiling_is_32k():
+    """The honest long-context claim for the 128k NTK preset on a 16GiB
+    chip: int8 weights + int8 KV serve 32k at B≤2, 16k at B=4 — the numbers
+    bench.py's 32k phase and the capacity docs are built on."""
+    cfg = dataclasses.replace(MODEL_PRESETS["llama-3.1-8b"], kv_cache_dtype="int8")
+    hbm = 16 * GIB
+    assert max_context_single_chip(cfg, 1, hbm) == 32768
+    assert max_context_single_chip(cfg, 2, hbm) == 32768
+    assert max_context_single_chip(cfg, 4, hbm) == 16384
+    # bf16 KV cannot serve 32k at all on one chip — the plan says so
+    bf = MODEL_PRESETS["llama-3.1-8b"]
+    plan = plan_serving_memory(bf, 1, 32768, quantized_weights=True)
+    assert not plan.fits(hbm)
