@@ -1,10 +1,9 @@
 """Streaming-cluster runtime registry (reference TopicConnectionsRuntimeRegistry).
 
-Maps `instance.streamingCluster.type` → TopicConnectionsRuntime. The kafka
-and pulsar runtimes are dependency-free (pure-asyncio wire-protocol clients,
-kafka.py / pulsar.py) and always register; pravega registers only when its
-client library is importable (the image ships none; the memory broker is the
-default local transport).
+Maps `instance.streamingCluster.type` → TopicConnectionsRuntime. All four
+broker runtimes — kafka, pulsar, pravega, memory — are dependency-free
+(pure-asyncio wire-protocol clients / in-process broker) and always
+register; the memory broker is the default local transport.
 """
 
 from __future__ import annotations
@@ -30,17 +29,8 @@ class TopicConnectionsRuntimeRegistry:
             raise ValueError(f"unknown streaming cluster type {type_!r}; known: {known}")
         return factory()
 
-    # type → (module, class); these register only when their broker client
-    # library is installed (kafka/pulsar are NOT here — they are
-    # dependency-free and import unconditionally below)
-    _GATED_BUILTINS = (
-        ("pravega", "langstream_tpu.messaging.pravega", "PravegaTopicConnectionsRuntime"),
-    )
-
     @classmethod
     def _ensure_builtins(cls) -> None:
-        import importlib
-
         if "memory" not in cls._factories:
             # always required — an import failure here is a real bug and must
             # surface, not be masked as "unknown streaming cluster type"
@@ -58,14 +48,13 @@ class TopicConnectionsRuntimeRegistry:
             from langstream_tpu.messaging.pulsar import PulsarTopicConnectionsRuntime
 
             cls._factories["pulsar"] = PulsarTopicConnectionsRuntime
-        for type_, module_name, class_name in cls._GATED_BUILTINS:
-            if type_ in cls._factories:
-                continue
-            try:
-                module = importlib.import_module(module_name)
-            except ImportError:
-                continue
-            cls._factories[type_] = getattr(module, class_name)
+        if "pravega" not in cls._factories:
+            # same: segment-store wire client + controller REST, stdlib-only
+            from langstream_tpu.messaging.pravega import (
+                PravegaTopicConnectionsRuntime,
+            )
+
+            cls._factories["pravega"] = PravegaTopicConnectionsRuntime
 
 
 def get_topic_connections_runtime(type_: str) -> TopicConnectionsRuntime:

@@ -76,7 +76,35 @@ class PulsarCtx:
         await self.broker.stop()
 
 
-@pytest.fixture(params=[MemoryCtx, KafkaCtx, PulsarCtx], ids=["memory", "kafka", "pulsar"])
+class PravegaCtx:
+    name = "pravega"
+
+    async def start(self):
+        from langstream_tpu.messaging.pravega import PravegaTopicConnectionsRuntime
+        from langstream_tpu.messaging.pravega_fake import FakePravega
+
+        self.broker = await FakePravega().start()
+        self.runtime = PravegaTopicConnectionsRuntime()
+        await self.runtime.init(
+            {
+                "client": {
+                    "controller-rest-uri": self.broker.controller_url,
+                    "segment-store": self.broker.segment_store_url,
+                    "scope": "langstream",
+                }
+            }
+        )
+        return self.runtime
+
+    async def stop(self):
+        await self.runtime.close()
+        await self.broker.stop()
+
+
+@pytest.fixture(
+    params=[MemoryCtx, KafkaCtx, PulsarCtx, PravegaCtx],
+    ids=["memory", "kafka", "pulsar", "pravega"],
+)
 def ctx(request):
     return request.param()
 
