@@ -1,52 +1,287 @@
-"""Connector adapter agent types (gated).
+"""Kafka Connect adapter agents + camel-source (gated).
 
-Parity: reference ``kafkaconnect/KafkaConnectSinkAgent.java`` /
-``KafkaConnectSourceAgent.java`` (types ``sink`` / ``source`` — run stock
-Kafka Connect connectors as agents) and ``CamelSource.java``
-(``camel-source`` — any Apache Camel endpoint as a source).
+Parity: reference ``kafkaconnect/KafkaConnectSinkAgent.java:1`` /
+``KafkaConnectSourceAgent.java:1`` (types ``sink`` / ``source`` — run stock
+Kafka Connect connectors as agents) and ``CamelSource.java:1``
+(``camel-source``).
 
-Both depend on JVM connector runtimes the image does not ship; the planner
-accepts and validates these types (so apps referencing them parse, plan, and
-document — the reference's planner-metadata layer), but starting one raises
-with an explicit gating message, matching the kafka/pulsar broker-runtime
-pattern.
+The reference EMBEDS the connector jar in its JVM runtime (instantiates the
+SinkTask/SourceTask classes in-process). This image has no JVM, so that
+path cannot exist; instead these agents drive an EXTERNAL Kafka Connect
+cluster through its documented REST interface (the same API `curl` and the
+Confluent tooling use), restoring the capability class natively:
+
+- ``sink``: the agent creates/updates the connector
+  (``PUT /connectors/{name}/config``) pointing it at a BRIDGE topic, then
+  bridges every pipeline record into that topic. When the app runs on the
+  kafka streaming cluster the external Connect workers consume the bridge
+  topic directly — the standard Connect data path, zero copies beyond the
+  broker. The agent watches ``GET /connectors/{name}/status`` and restarts
+  FAILED tasks (``POST .../restart``).
+- ``source``: the connector's config is pointed at the bridge topic
+  (``topic``/``kafka.topic``) and the agent consumes it, emitting records
+  into the pipeline with at-least-once commit semantics.
+
+``camel-source`` remains gated: Apache Camel components are JVM classes
+with no remote-API equivalent to drive.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import logging
+import time
+from typing import Any, Optional
 
 from langstream_tpu.api.agent import AgentSink, AgentSource, ComponentType
 from langstream_tpu.api.doc import ConfigModel, ConfigProperty
 from langstream_tpu.api.record import Record
 from langstream_tpu.core.registry import REGISTRY, AgentTypeInfo
 
-_GATE_MESSAGE = (
-    "{kind} adapters embed a JVM connector runtime that this image does not "
-    "ship; run the connector natively against the broker, or use a built-in "
-    "agent type"
+log = logging.getLogger(__name__)
+
+_CAMEL_GATE = (
+    "camel-source embeds JVM Camel components this image does not ship; "
+    "use the http/webcrawler/storage sources, or a Kafka Connect source "
+    "via an external Connect cluster (type: source)"
 )
 
 
-class KafkaConnectSinkAgent(AgentSink):
+class ConnectRestError(RuntimeError):
+    pass
+
+
+class ConnectRestClient:
+    """Minimal client for the Kafka Connect REST interface."""
+
+    def __init__(self, rest_url: str) -> None:
+        self.rest_url = rest_url.rstrip("/")
+        self._http = None
+
+    async def _session(self):
+        import aiohttp
+
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession()
+        return self._http
+
+    async def close(self) -> None:
+        if self._http is not None and not self._http.closed:
+            await self._http.close()
+        self._http = None
+
+    async def request(
+        self, method: str, path: str, body: Optional[Any] = None
+    ) -> tuple[int, Any]:
+        session = await self._session()
+        async with session.request(
+            method, f"{self.rest_url}{path}", json=body
+        ) as resp:
+            try:
+                doc = await resp.json(content_type=None)
+            except Exception:  # noqa: BLE001 — empty body (e.g. 204)
+                doc = None
+            return resp.status, doc
+
+    async def info(self) -> dict:
+        status, doc = await self.request("GET", "/")
+        if status != 200:
+            raise ConnectRestError(f"connect cluster unreachable: HTTP {status}")
+        return doc or {}
+
+    async def put_config(self, name: str, config: dict) -> dict:
+        status, doc = await self.request(
+            "PUT", f"/connectors/{name}/config", config
+        )
+        if status not in (200, 201):
+            raise ConnectRestError(
+                f"connector {name} config rejected: HTTP {status} {doc}"
+            )
+        return doc or {}
+
+    async def status(self, name: str) -> dict:
+        status, doc = await self.request("GET", f"/connectors/{name}/status")
+        if status == 404:
+            return {}
+        return doc or {}
+
+    async def restart(self, name: str, task: Optional[int] = None) -> None:
+        path = f"/connectors/{name}/restart"
+        if task is not None:
+            path = f"/connectors/{name}/tasks/{task}/restart"
+        await self.request("POST", path)
+
+    async def delete(self, name: str) -> None:
+        await self.request("DELETE", f"/connectors/{name}")
+
+
+class _ConnectAgentBase:
+    """Shared lifecycle: config parsing, connector upsert, health watch."""
+
+    def _parse(self, configuration: dict[str, Any]) -> None:
+        connect = configuration.get("connect", {}) or {}
+        self.rest = ConnectRestClient(
+            connect.get("rest-url", "http://localhost:8083")
+        )
+        self.connector_name = connect.get("name") or f"ls-{self.agent_id or 'connector'}"
+        self.delete_on_close = bool(connect.get("delete-on-close", False))
+        self.status_interval = float(connect.get("status-interval", 10.0))
+        # everything else (connector.class, transforms, …) IS the connector
+        # config — the reference passes the agent configuration through the
+        # same way (KafkaConnectSinkAgent.java adapter config pass-through)
+        self.connector_config = {
+            k: v
+            for k, v in configuration.items()
+            if k not in ("connect", "composable", "agent.type")
+        }
+        self._last_status: dict[str, Any] = {}
+        self._last_check = 0.0
+
+    async def _watch_once(self) -> None:
+        """Poll status; restart FAILED connector/tasks (the reference's
+        embedded runtime restarts crashed tasks the same way). Best-effort:
+        it runs on the record hot path, and a blip in the Connect cluster's
+        REST endpoint must not fail records whose bridge write succeeded."""
+        now = time.monotonic()
+        if now - self._last_check < self.status_interval:
+            return
+        self._last_check = now
+        try:
+            await self._watch_unguarded()
+        except Exception:  # noqa: BLE001 — health polling never fails records
+            log.warning(
+                "connector %s status poll failed", self.connector_name, exc_info=True
+            )
+
+    async def _watch_unguarded(self) -> None:
+        doc = await self.rest.status(self.connector_name)
+        self._last_status = doc
+        if not doc:
+            return
+        if doc.get("connector", {}).get("state") == "FAILED":
+            log.warning("connector %s FAILED; restarting", self.connector_name)
+            await self.rest.restart(self.connector_name)
+        for task in doc.get("tasks", []):
+            if task.get("state") == "FAILED":
+                log.warning(
+                    "connector %s task %s FAILED; restarting",
+                    self.connector_name,
+                    task.get("id"),
+                )
+                await self.rest.restart(self.connector_name, int(task.get("id", 0)))
+
+    def _info(self) -> dict[str, Any]:
+        return {
+            "connector": self.connector_name,
+            "rest-url": self.rest.rest_url,
+            "status": self._last_status,
+        }
+
+    async def _teardown(self) -> None:
+        if self.delete_on_close:
+            try:
+                await self.rest.delete(self.connector_name)
+            except ConnectRestError:
+                log.warning("connector %s delete failed", self.connector_name)
+        await self.rest.close()
+
+
+class KafkaConnectSinkAgent(AgentSink, _ConnectAgentBase):
+    """type: sink — bridge pipeline records into the connector's topic on
+    the app's streaming cluster and manage the connector remotely."""
+
+    def component_type(self) -> ComponentType:
+        return ComponentType.SINK
+
     async def init(self, configuration: dict[str, Any]) -> None:
-        raise NotImplementedError(_GATE_MESSAGE.format(kind="Kafka Connect sink"))
+        self._parse(configuration)
+        self.bridge_topic = (
+            configuration.get("topics") or f"ls-connect-{self.agent_id or 'sink'}"
+        )
+        self.connector_config.setdefault("topics", self.bridge_topic)
+        self._producer = None
 
-    async def write(self, record: Record) -> None:  # pragma: no cover
-        raise NotImplementedError
+    async def start(self) -> None:
+        await self.rest.info()  # fail fast when the cluster is unreachable
+        await self.rest.put_config(self.connector_name, self.connector_config)
+        assert self.context is not None
+        admin = self.context.get_topic_admin()
+        if not await admin.topic_exists(self.bridge_topic):
+            await admin.create_topic(self.bridge_topic)
+        self._producer = self.context.get_topic_producer(self.bridge_topic)
+        await self._producer.start()
+        await self._watch_once()
+
+    async def write(self, record: Record) -> None:
+        assert self._producer is not None, "agent not started"
+        await self._producer.write(record)
+        await self._watch_once()
+
+    async def close(self) -> None:
+        if self._producer is not None:
+            await self._producer.close()
+            self._producer = None
+        await self._teardown()
+
+    def agent_info(self) -> dict[str, Any]:
+        return {**super().agent_info(), **self._info(), "bridge-topic": self.bridge_topic}
 
 
-class KafkaConnectSourceAgent(AgentSource):
+class KafkaConnectSourceAgent(AgentSource, _ConnectAgentBase):
+    """type: source — the connector produces into the bridge topic; the
+    agent consumes it into the pipeline (at-least-once via commit)."""
+
+    def component_type(self) -> ComponentType:
+        return ComponentType.SOURCE
+
     async def init(self, configuration: dict[str, Any]) -> None:
-        raise NotImplementedError(_GATE_MESSAGE.format(kind="Kafka Connect source"))
+        self._parse(configuration)
+        self.bridge_topic = (
+            configuration.get("topic")
+            or configuration.get("kafka.topic")
+            or f"ls-connect-{self.agent_id or 'source'}"
+        )
+        # the common config keys source connectors use for their target
+        self.connector_config.setdefault("topic", self.bridge_topic)
+        self.connector_config.setdefault("kafka.topic", self.bridge_topic)
+        self._consumer = None
 
-    async def read(self) -> list[Record]:  # pragma: no cover
-        raise NotImplementedError
+    async def start(self) -> None:
+        await self.rest.info()
+        assert self.context is not None
+        admin = self.context.get_topic_admin()
+        if not await admin.topic_exists(self.bridge_topic):
+            await admin.create_topic(self.bridge_topic)
+        await self.rest.put_config(self.connector_name, self.connector_config)
+        self._consumer = self.context.get_topic_consumer(self.bridge_topic)
+        await self._consumer.start()
+        await self._watch_once()
+
+    async def read(self) -> list[Record]:
+        assert self._consumer is not None, "agent not started"
+        records = await self._consumer.read()
+        await self._watch_once()
+        return records
+
+    async def commit(self, records: list[Record]) -> None:
+        assert self._consumer is not None
+        await self._consumer.commit(records)
+
+    async def close(self) -> None:
+        if self._consumer is not None:
+            await self._consumer.close()
+            self._consumer = None
+        await self._teardown()
+
+    def agent_info(self) -> dict[str, Any]:
+        return {**super().agent_info(), **self._info(), "bridge-topic": self.bridge_topic}
 
 
 class CamelSourceAgent(AgentSource):
+    def component_type(self) -> ComponentType:
+        return ComponentType.SOURCE
+
     async def init(self, configuration: dict[str, Any]) -> None:
-        raise NotImplementedError(_GATE_MESSAGE.format(kind="Apache Camel source"))
+        raise NotImplementedError(_CAMEL_GATE)
 
     async def read(self) -> list[Record]:  # pragma: no cover
         raise NotImplementedError
@@ -58,14 +293,22 @@ def _register() -> None:
             type="sink",
             component_type=ComponentType.SINK,
             factory=KafkaConnectSinkAgent,
-            description="Stock Kafka Connect sink connector (gated: JVM runtime).",
+            description=(
+                "Stock Kafka Connect sink connector, managed on an external "
+                "Connect cluster over its REST API."
+            ),
             config_model=ConfigModel(
                 type="sink",
                 allow_unknown=True,
                 properties={
                     "connector.class": ConfigProperty(
                         "connector.class", "Connect connector class", required=True
-                    )
+                    ),
+                    "connect": ConfigProperty(
+                        "connect",
+                        "External cluster: rest-url, name, delete-on-close",
+                        type="object",
+                    ),
                 },
             ),
         )
@@ -75,14 +318,22 @@ def _register() -> None:
             type="source",
             component_type=ComponentType.SOURCE,
             factory=KafkaConnectSourceAgent,
-            description="Stock Kafka Connect source connector (gated: JVM runtime).",
+            description=(
+                "Stock Kafka Connect source connector, managed on an external "
+                "Connect cluster over its REST API."
+            ),
             config_model=ConfigModel(
                 type="source",
                 allow_unknown=True,
                 properties={
                     "connector.class": ConfigProperty(
                         "connector.class", "Connect connector class", required=True
-                    )
+                    ),
+                    "connect": ConfigProperty(
+                        "connect",
+                        "External cluster: rest-url, name, delete-on-close",
+                        type="object",
+                    ),
                 },
             ),
         )

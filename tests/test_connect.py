@@ -1,0 +1,233 @@
+"""Kafka Connect adapter agents vs a fake Connect REST cluster.
+
+The agents manage connectors on an EXTERNAL Connect cluster (PUT config,
+status watch, restart-on-FAILED) and bridge records through a topic on the
+app's streaming cluster — reference kafkaconnect/KafkaConnectSinkAgent.java
+behavior, minus the in-JVM task embedding this image cannot host."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from langstream_tpu.agents.connect import (
+    KafkaConnectSinkAgent,
+    KafkaConnectSourceAgent,
+)
+from langstream_tpu.api.metrics import MetricsReporter
+from langstream_tpu.api.record import SimpleRecord
+from langstream_tpu.messaging.memory import (
+    MemoryBroker,
+    MemoryTopicConnectionsRuntime,
+)
+from langstream_tpu.runtime.runner import SimpleAgentContext
+
+
+class FakeConnectCluster:
+    """The Kafka Connect REST interface surface the agents drive."""
+
+    def __init__(self) -> None:
+        self.connectors: dict[str, dict] = {}
+        self.states: dict[str, dict] = {}
+        self.restarts: list[tuple[str, object]] = []
+        self.url = ""
+        self._runner = None
+
+    async def start(self) -> "FakeConnectCluster":
+        app = web.Application()
+        app.router.add_get("/", self._root)
+        app.router.add_put("/connectors/{name}/config", self._put_config)
+        app.router.add_get("/connectors/{name}/status", self._status)
+        app.router.add_post("/connectors/{name}/restart", self._restart)
+        app.router.add_post(
+            "/connectors/{name}/tasks/{task}/restart", self._restart_task
+        )
+        app.router.add_delete("/connectors/{name}", self._delete)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}"
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _root(self, request):
+        return web.json_response(
+            {"version": "3.7.0-fake", "kafka_cluster_id": "fake"}
+        )
+
+    async def _put_config(self, request):
+        name = request.match_info["name"]
+        created = name not in self.connectors
+        self.connectors[name] = await request.json()
+        self.states.setdefault(name, {
+            "name": name,
+            "connector": {"state": "RUNNING", "worker_id": "fake:8083"},
+            "tasks": [{"id": 0, "state": "RUNNING", "worker_id": "fake:8083"}],
+        })
+        return web.json_response(
+            {"name": name, "config": self.connectors[name]},
+            status=201 if created else 200,
+        )
+
+    async def _status(self, request):
+        name = request.match_info["name"]
+        if name not in self.states:
+            return web.json_response({"message": "not found"}, status=404)
+        return web.json_response(self.states[name])
+
+    async def _restart(self, request):
+        name = request.match_info["name"]
+        self.restarts.append((name, None))
+        if name in self.states:
+            self.states[name]["connector"]["state"] = "RUNNING"
+        return web.Response(status=204)
+
+    async def _restart_task(self, request):
+        name = request.match_info["name"]
+        task = int(request.match_info["task"])
+        self.restarts.append((name, task))
+        if name in self.states:
+            for t in self.states[name]["tasks"]:
+                if t["id"] == task:
+                    t["state"] = "RUNNING"
+        return web.Response(status=204)
+
+    async def _delete(self, request):
+        name = request.match_info["name"]
+        self.connectors.pop(name, None)
+        self.states.pop(name, None)
+        return web.Response(status=204)
+
+
+async def _context(agent_id="app-connect"):
+    MemoryBroker.reset()
+    rt = MemoryTopicConnectionsRuntime()
+    await rt.init({"broker": "connect-test"})
+    return rt, SimpleAgentContext(agent_id, "t", rt, MetricsReporter())
+
+
+def test_sink_creates_connector_and_bridges_records(run):
+    async def main():
+        cluster = await FakeConnectCluster().start()
+        rt, ctx = await _context()
+        agent = KafkaConnectSinkAgent()
+        agent.agent_id = "snowflake-sink"
+        agent.set_context(ctx)
+        try:
+            await agent.init({
+                "connect": {"rest-url": cluster.url, "delete-on-close": True},
+                "connector.class": "com.snowflake.kafka.connector.SnowflakeSinkConnector",
+                "snowflake.url.name": "acct.snowflakecomputing.com",
+                "agent.type": "kafka-connect",
+            })
+            await agent.start()
+            # connector exists, pointed at the bridge topic, agent.type and
+            # connect block NOT leaked into the connector config
+            cfg = cluster.connectors["ls-snowflake-sink"]
+            assert cfg["connector.class"].endswith("SnowflakeSinkConnector")
+            assert cfg["topics"] == "ls-connect-snowflake-sink"
+            assert "connect" not in cfg and "agent.type" not in cfg
+            # records bridge onto the topic the connector consumes
+            await agent.write(SimpleRecord(key="k", value=json.dumps({"x": 1})))
+            await agent.write(SimpleRecord.of("plain"))
+            consumer = rt.create_consumer("check", "ls-connect-snowflake-sink")
+            await consumer.start()
+            got = []
+            for _ in range(20):
+                got.extend(await consumer.read())
+                if len(got) >= 2:
+                    break
+            assert len(got) == 2
+            info = agent.agent_info()
+            assert info["status"]["connector"]["state"] == "RUNNING"
+            await consumer.close()
+        finally:
+            await agent.close()
+            assert "ls-snowflake-sink" not in cluster.connectors  # delete-on-close
+            await cluster.stop()
+
+    run(main())
+
+
+def test_source_consumes_bridge_topic_and_commits(run):
+    async def main():
+        cluster = await FakeConnectCluster().start()
+        rt, ctx = await _context()
+        agent = KafkaConnectSourceAgent()
+        agent.agent_id = "jdbc-source"
+        agent.set_context(ctx)
+        try:
+            await agent.init({
+                "connect": {"rest-url": cluster.url},
+                "connector.class": "io.confluent.connect.jdbc.JdbcSourceConnector",
+                "connection.url": "jdbc:postgresql://db/x",
+            })
+            await agent.start()
+            assert cluster.connectors["ls-jdbc-source"]["topic"] == "ls-connect-jdbc-source"
+            # "the connector" (simulated) produces into the bridge topic
+            producer = rt.create_producer("fake-connector", "ls-connect-jdbc-source")
+            await producer.start()
+            for i in range(3):
+                await producer.write(SimpleRecord.of(f"row-{i}"))
+            got = []
+            for _ in range(20):
+                got.extend(await agent.read())
+                if len(got) >= 3:
+                    break
+            assert sorted(r.value for r in got) == ["row-0", "row-1", "row-2"]
+            await agent.commit(got)
+            await producer.close()
+        finally:
+            await agent.close()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_failed_connector_and_task_restarted(run):
+    async def main():
+        cluster = await FakeConnectCluster().start()
+        rt, ctx = await _context()
+        agent = KafkaConnectSinkAgent()
+        agent.agent_id = "s"
+        agent.set_context(ctx)
+        try:
+            await agent.init({
+                "connect": {"rest-url": cluster.url, "status-interval": 0.0},
+                "connector.class": "X",
+            })
+            await agent.start()
+            cluster.states["ls-s"]["connector"]["state"] = "FAILED"
+            cluster.states["ls-s"]["tasks"][0]["state"] = "FAILED"
+            await agent.write(SimpleRecord.of("v"))  # watch fires inline
+            assert ("ls-s", None) in cluster.restarts
+            assert ("ls-s", 0) in cluster.restarts
+            assert cluster.states["ls-s"]["connector"]["state"] == "RUNNING"
+        finally:
+            await agent.close()
+            await cluster.stop()
+
+    run(main())
+
+
+def test_unreachable_cluster_fails_fast(run):
+    async def main():
+        rt, ctx = await _context()
+        agent = KafkaConnectSinkAgent()
+        agent.agent_id = "s"
+        agent.set_context(ctx)
+        await agent.init({
+            "connect": {"rest-url": "http://127.0.0.1:9"},  # nothing listens
+            "connector.class": "X",
+        })
+        with pytest.raises(Exception):
+            await agent.start()
+        await agent.close()
+
+    run(main())
