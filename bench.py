@@ -100,7 +100,8 @@ instance:
 
 
 def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
-                 n_requests: int, max_seq_len: int, decode_chunk: int) -> float:
+                 n_requests: int, max_seq_len: int, decode_chunk: int,
+                 prefill_batch: "int | None" = None) -> float:
     import jax
     import numpy as np
 
@@ -126,6 +127,9 @@ def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
         max_seq_len=min(max_seq_len, config.max_seq_len),
         prefill_buckets=(64,),
         decode_chunk=decode_chunk,
+        # whole admission waves in one dispatch (the gateway phase's knob):
+        # serial 8-row groups at wave boundaries were the last device gap
+        prefill_batch=prefill_batch or max_batch,
     )
     engine.start()
 
