@@ -471,3 +471,417 @@ def test_webcrawler_astra_over_fakes(run):
             await site_stub.cleanup()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# round 4: the rest of the ungated examples (object stores, search backends,
+# remote chains/webhooks, chat apps, routing). Each stubs its external
+# service with the same aiohttp fakes the unit suites use.
+# ---------------------------------------------------------------------------
+
+
+def test_s3_source_pipeline(run):
+    async def main():
+        from aiohttp import web
+
+        store = {"doc.txt": b"alpha bravo " * 60}
+
+        async def list_objects(request):
+            keys = "".join(f"<Contents><Key>{k}</Key></Contents>" for k in store)
+            return web.Response(
+                text=f"<ListBucketResult>{keys}</ListBucketResult>",
+                content_type="application/xml",
+            )
+
+        async def get_object(request):
+            return web.Response(body=store[request.match_info["key"]])
+
+        async def delete_object(request):
+            store.pop(request.match_info["key"], None)
+            return web.Response(status=204)
+
+        stub, base = await _start_app([
+            web.get("/langstream-source", list_objects),
+            web.get("/langstream-source/{key:.+}", get_object),
+            web.delete("/langstream-source/{key:.+}", delete_object),
+        ])
+
+        async def scenario(runner):
+            out = await runner.consume("s3-chunks", n=1, timeout=60)
+            assert "alpha bravo" in out[0].value
+
+        try:
+            await run_example(
+                "s3-source", scenario,
+                {"s3": {"endpoint": base, "bucket": "langstream-source"}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_azure_document_ingestion_pipeline(run):
+    async def main():
+        from aiohttp import web
+
+        store = {"d.txt": b"delta echo " * 60}
+
+        async def list_blobs(request):
+            blobs = "".join(f"<Blob><Name>{k}</Name></Blob>" for k in store)
+            return web.Response(
+                text=f"<EnumerationResults><Blobs>{blobs}</Blobs></EnumerationResults>",
+                content_type="application/xml",
+            )
+
+        async def get_blob(request):
+            return web.Response(body=store[request.match_info["key"]])
+
+        async def delete_blob(request):
+            store.pop(request.match_info["key"], None)
+            return web.Response(status=202)
+
+        stub, base = await _start_app([
+            web.get("/documents", list_blobs),
+            web.get("/documents/{key:.+}", get_blob),
+            web.delete("/documents/{key:.+}", delete_blob),
+        ])
+
+        async def scenario(runner):
+            out = await runner.consume("az-chunks", n=1, timeout=60)
+            value = json.loads(out[0].value)
+            assert value["embeddings"]
+
+        try:
+            await run_example(
+                "azure-document-ingestion", scenario,
+                {"azure": {"endpoint": base, "sas-token": "sv=fake"}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_webcrawler_source_pipeline(run):
+    async def main():
+        from urllib.parse import urlparse
+
+        from aiohttp import web
+
+        async def page(request):
+            return web.Response(
+                text="<html><body><p>" + "crawl me " * 80 + "</p></body></html>",
+                content_type="text/html",
+            )
+
+        async def robots(request):
+            return web.Response(text="User-agent: *\nAllow: /\n")
+
+        stub, base = await _start_app([
+            web.get("/robots.txt", robots),
+            web.get("/", page),
+        ])
+
+        async def scenario(runner):
+            out = await runner.consume("crawl-chunks", n=1, timeout=60)
+            assert "crawl me" in out[0].value
+
+        try:
+            await run_example(
+                "webcrawler-source", scenario,
+                {"crawler": {
+                    "seed-url": base + "/",
+                    "allowed-domain": urlparse(base).hostname,
+                }},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_slack_webhook_pipeline(run):
+    async def main():
+        from aiohttp import web
+
+        posted = []
+
+        async def webhook(request):
+            # accept raw text: the example renders JSON via mustache, and a
+            # model summary containing quotes/newlines is still a valid post
+            posted.append(await request.text())
+            return web.Response(text="ok")
+
+        stub, base = await _start_app([web.post("/services/T/B/X", webhook)])
+
+        async def scenario(runner):
+            await runner.produce("pages-topic", json.dumps({"text": "a page about TPUs"}))
+            out = await runner.consume("notified-topic", n=1, timeout=90)
+            assert posted and "text" in posted[0]
+            assert json.loads(out[0].value)["slack-response"]
+
+        try:
+            await run_example(
+                "slack", scenario,
+                {"slack": {"webhook-url": base + "/services/T/B/X"}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_langserve_invoke_pipeline(run):
+    async def main():
+        from aiohttp import web
+
+        async def invoke(request):
+            body = await request.json()
+            return web.json_response({"output": f"chain:{body['input']['topic']}"})
+
+        stub, base = await _start_app([web.post("/chain/invoke", invoke)])
+
+        async def scenario(runner):
+            await runner.produce("ls-in", "quantum chips")
+            out = await runner.consume("ls-out", n=1, timeout=60)
+            assert json.loads(out[0].value)["answer"] == "chain:quantum chips"
+
+        try:
+            await run_example(
+                "langserve-invoke", scenario,
+                {"langserve": {"url": base + "/chain/invoke"}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def _search_backend_scenario():
+    """Query answers come from canned stub hits (never from the racing
+    doc-write), so the scenario has no timing dependence; the WRITE path is
+    asserted separately by polling the stub's store."""
+
+    async def scenario(runner):
+        await runner.produce("docs-topic", json.dumps({"document": "tpus are fast"}))
+        await runner.produce("questions-topic", "what is fast?")
+        out = await runner.consume("answers-topic", n=1, timeout=90)
+        value = json.loads(out[0].value)
+        assert value["results"], value
+
+    return scenario
+
+
+async def _poll_until(check, timeout=15.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not check():
+        assert asyncio.get_running_loop().time() < deadline, "condition never held"
+        await asyncio.sleep(0.05)
+
+
+def test_query_opensearch_pipeline(run):
+    async def main():
+        from aiohttp import web
+
+        docs = {}
+
+        async def index_doc(request):
+            docs[request.match_info["id"]] = await request.json()
+            return web.json_response({"result": "created"})
+
+        async def search(request):
+            hits = [{"_id": "1", "_source": {"text": "tpus are fast"}, "_score": 0.9}]
+            return web.json_response({"hits": {"hits": hits}})
+
+        stub, base = await _start_app([
+            web.put("/docs/_doc/{id}", index_doc),
+            web.post("/docs/_search", search),
+        ])
+
+        try:
+            async def scenario(runner):
+                await _search_backend_scenario()(runner)
+                await _poll_until(lambda: docs)  # the sink's write landed
+
+            await run_example(
+                "query-opensearch", scenario, {"opensearch": {"endpoint": base}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_query_solr_pipeline(run):
+    async def main():
+        from aiohttp import web
+
+        docs = []
+
+        async def update(request):
+            docs.append(await request.json())
+            return web.json_response({"responseHeader": {"status": 0}})
+
+        async def select(request):
+            return web.json_response(
+                {"response": {"docs": [{"id": "1", "text": "tpus are fast"}]}}
+            )
+
+        stub, base = await _start_app([
+            web.post("/solr/docs/update/json/docs", update),
+            web.post("/solr/docs/select", select),
+        ])
+
+        try:
+            async def scenario(runner):
+                await _search_backend_scenario()(runner)
+                await _poll_until(lambda: docs)  # the sink's write landed
+
+            await run_example(
+                "query-solr", scenario, {"solr": {"endpoint": base}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def test_rag_aws_chatbot_pipeline(run):
+    """The chatbot half of rag-aws: embed -> local vector lookup -> Bedrock
+    (stubbed, SigV4-verified by the provider suite) -> answers topic."""
+
+    async def main():
+        from aiohttp import web
+
+        async def invoke(request):
+            body = await request.json()
+            assert "AWS4-HMAC-SHA256" in request.headers.get("authorization", "")
+            if "inputText" in body:
+                return web.json_response({"embedding": [0.1] * 8})
+            return web.json_response({
+                "content": [{"type": "text", "text": "bedrock answer"}],
+                "stop_reason": "end_turn",
+                "usage": {"input_tokens": 5, "output_tokens": 3},
+            })
+
+        async def list_objects(request):  # the ingest half polls an s3 bucket
+            return web.Response(
+                text="<ListBucketResult></ListBucketResult>",
+                content_type="application/xml",
+            )
+
+        stub, base = await _start_app([
+            web.post("/model/{model}/invoke", invoke),
+            web.get("/langstream-source", list_objects),
+        ])
+        vdb = Path(tempfile.mkdtemp(prefix="ragaws-")) / "vectors.db"
+
+        async def scenario(runner):
+            await runner.produce("aws-questions", "what do tpus do?")
+            out = await runner.consume("aws-answers", n=1, timeout=90)
+            assert json.loads(out[0].value)["answer"] == "bedrock answer"
+
+        try:
+            await run_example(
+                "rag-aws", scenario,
+                {
+                    "bedrock": {"endpoint": base},
+                    "s3": {"endpoint": base, "bucket": "langstream-source"},
+                    "vector-database": {"path": str(vdb)},
+                },
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
+
+
+def _chat_app_scenario(in_topic, out_topic):
+    async def scenario(runner):
+        await runner.produce(in_topic, "hello there")
+        # these apps stream chunks into the answers topic (raw text values,
+        # stream-response-completion-field: value)
+        out = await runner.consume(out_topic, n=1, timeout=90)
+        assert isinstance(out[0].value, str) and out[0].value
+
+    return scenario
+
+
+def test_react_chatbot_ui_pipeline(run):
+    run(run_example("react-chatbot-ui", _chat_app_scenario("ui-questions", "ui-answers")))
+
+
+def test_gateway_authentication_pipeline(run):
+    run(run_example(
+        "gateway-authentication", _chat_app_scenario("auth-questions", "auth-answers")
+    ))
+
+
+def test_docker_chatbot_pipeline(run):
+    run(run_example("docker-chatbot", _chat_app_scenario("chat-in", "chat-out")))
+
+
+def test_language_router_pipeline(run):
+    async def scenario(runner):
+        await runner.produce(
+            "documents-topic", "The quick brown fox jumps over the lazy dog again and again."
+        )
+        out = await runner.consume("english-topic", n=1, timeout=60)
+        assert "quick brown fox" in out[0].value
+
+    run(run_example("language-router", scenario))
+
+
+def test_kafka_connect_pipeline(run):
+    """Both halves of the kafka-connect example against a fake Connect REST
+    cluster: the sink bridges pipeline records to the connector's topic; the
+    source emits whatever 'the connector' (simulated) wrote to its bridge."""
+
+    async def main():
+        from aiohttp import web
+
+        connectors = {}
+
+        async def put_config(request):
+            connectors[request.match_info["name"]] = await request.json()
+            return web.json_response({"name": request.match_info["name"]}, status=201)
+
+        async def root(request):
+            return web.json_response({"version": "3.7.0-fake"})
+
+        async def status(request):
+            return web.json_response({
+                "connector": {"state": "RUNNING"}, "tasks": [],
+            })
+
+        stub, base = await _start_app([
+            web.get("/", root),
+            web.put("/connectors/{name}/config", put_config),
+            web.get("/connectors/{name}/status", status),
+        ])
+
+        async def scenario(runner):
+            # sink half: pipeline record lands on the connector's topic
+            await runner.produce("connect-in", "to the warehouse")
+            sunk = await runner.consume("connect-sink-bridge", n=1, timeout=60)
+            assert sunk[0].value == "to the warehouse"
+            # source half: "the connector" writes to its bridge; the agent
+            # emits it into the pipeline
+            await runner.produce("connect-source-bridge", "from the source system")
+            out = await runner.consume("connect-out", n=1, timeout=60)
+            assert out[0].value == "from the source system"
+            # both connectors were created with their topics wired
+            by_topic = {c.get("topic") or c.get("topics"): c for c in connectors.values()}
+            assert "connect-source-bridge" in by_topic, sorted(connectors)
+            assert "connect-sink-bridge" in by_topic, sorted(connectors)
+
+        try:
+            await run_example(
+                "kafka-connect", scenario,
+                {"kafka-connect": {"rest-url": base}},
+            )
+        finally:
+            await stub.cleanup()
+
+    run(main())
