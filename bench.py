@@ -309,14 +309,16 @@ def main() -> None:
         long_len, long_seg, long_max_seq = 150, 32, 256
     else:
         # decode is HBM-bandwidth-bound: int8 weights halve the dominant
-        # read stream; B=96 x chunk=64 measured best on v5e (B=128
-        # regresses on cache reads, chunk=128 on mid-chunk finish waste).
-        # prefill_batch=96: the whole 96-session burst admits in ONE prefill
-        # dispatch (batch 96 x width 64 is still memory-bound-cheap) — serial
-        # prefill groups were the dominant term in burst TTFT
+        # read stream. B=96 x chunk=16 measured best on v5e AFTER the
+        # fetch-free admission landed (r4): the old chunk=64 knee was an
+        # artifact of per-iteration host stalls — with those gone, smaller
+        # chunks cut mid-chunk completion waste AND TTFT
+        # (64/32/16/8 -> 7215/7948/8386/5915 tok/s; gateway p50 TTFT
+        # 866/505/326ms at 64/32/16). prefill_batch=96: the whole
+        # 96-session burst admits in ONE prefill dispatch
         preset, quantize = "gemma-2b", True
         max_batch, new_tokens, n_requests, n_sessions = 96, 256, 192, 96
-        max_seq_len, decode_chunk, prefill_batch = 1024, 64, 96
+        max_seq_len, decode_chunk, prefill_batch = 1024, 16, 96
         long_len, long_seg, long_max_seq = 8000, 2048, 8192
 
     print(f"[bench] engine phase: {preset} quantize={quantize}", file=sys.stderr, flush=True)
@@ -345,7 +347,7 @@ def main() -> None:
             print("[bench] llama-3-8b phase", file=sys.stderr, flush=True)
             llama_tok_s = bench_engine(
                 "llama-3-8b", True, max_batch=32, new_tokens=128,
-                n_requests=64, max_seq_len=1024, decode_chunk=32,
+                n_requests=64, max_seq_len=1024, decode_chunk=16,
             )
             extras["llama_3_8b_int8_tokens_per_sec"] = round(llama_tok_s, 2)
         except Exception as e:  # noqa: BLE001

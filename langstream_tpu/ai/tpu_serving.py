@@ -179,7 +179,7 @@ class _EngineHolder:
             eos_token_id=self.tokenizer().eos_token_id,
             prefill_buckets=buckets,
             mesh=self.mesh(),
-            decode_chunk=int(self.config.get("decode-chunk", 8)),
+            decode_chunk=int(self.config.get("decode-chunk", 16)),
             prefill_batch=prefill_batch,
             spmd=spmd,
             pipeline_depth=int(self.config.get("pipeline-depth", 1)),

@@ -292,7 +292,7 @@ class ServingEngine:
         prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048),
         rng_seed: int = 0,
         mesh: Optional[Any] = None,
-        decode_chunk: int = 8,
+        decode_chunk: int = 16,
         prefill_batch: Optional[int] = None,
         spmd: Optional[Any] = None,
         pipeline_depth: int = 1,
