@@ -53,6 +53,11 @@ KIND_ROUTES: dict[str, tuple[str, str, bool]] = {
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+class KubeWatchExpired(RuntimeError):
+    """The watch's resourceVersion fell behind the server's event horizon
+    (HTTP/in-stream 410 Gone): re-list, then watch from the fresh version."""
+
+
 class KubeApiError(RuntimeError):
     def __init__(self, status: int, message: str) -> None:
         super().__init__(f"kubernetes api error {status}: {message}")
@@ -223,6 +228,50 @@ class KubeApiClient:
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[dict[str, Any]]:
         return self._request("GET", self._path(kind, namespace, name))
+
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        timeout_seconds: int = 30,
+    ):
+        """Yield (type, object) watch events until the server ends the
+        stream (timeoutSeconds). Raises KubeWatchExpired on an in-stream
+        410 (the bounded event horizon passed the requested
+        resourceVersion) — the caller re-lists and restarts the watch, the
+        standard list-then-watch loop."""
+        prefix, plural, namespaced = KIND_ROUTES[kind]
+        if namespaced and namespace is None:
+            path = f"{prefix}/{plural}"
+        else:
+            path = self._path(kind, namespace, None)
+        query = f"?watch=1&timeoutSeconds={int(timeout_seconds)}"
+        if resource_version is not None:
+            query += f"&resourceVersion={resource_version}"
+        req = urllib.request.Request(self.server + path + query, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_seconds + self.timeout, context=self._context
+            ) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    event = json.loads(line)
+                    if (
+                        event.get("type") == "ERROR"
+                        and event.get("object", {}).get("code") == 410
+                    ):
+                        raise KubeWatchExpired(str(resource_version))
+                    yield event.get("type", ""), event.get("object", {})
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise KubeWatchExpired(str(resource_version)) from e
+            raise KubeApiError(e.code, e.read().decode(errors="replace")) from e
 
     def list(self, kind: str, namespace: Optional[str] = None) -> list[dict[str, Any]]:
         prefix, plural, namespaced = KIND_ROUTES[kind]

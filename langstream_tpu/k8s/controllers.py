@@ -219,6 +219,20 @@ class InProcessJobExecutor:
                 delete_agent_and_dependents(self.kube, app.namespace, manifest)
 
 
+
+def _patch_status_if_changed(
+    kube, kind: str, namespace: str, name: str,
+    previous: dict[str, Any], status: dict[str, Any],
+) -> None:
+    """Skip the write when the status is already at the desired level: an
+    unconditional patch bumps resourceVersion and emits a MODIFIED watch
+    event, which would wake the operator's own watcher and busy-loop the
+    reconcile pass against itself (the classic self-triggered storm)."""
+    if previous == status:
+        return
+    kube.patch_status(kind, namespace, name, status)
+
+
 class AppController:
     """Two-phase application reconciler."""
 
@@ -234,6 +248,7 @@ class AppController:
 
     def reconcile(self, app_manifest: dict[str, Any]) -> dict[str, Any]:
         app = ApplicationCustomResource.from_manifest(app_manifest)
+        previous = dict(app.status)
         status = dict(app.status)
         generation = str(app.generation)
 
@@ -245,7 +260,9 @@ class AppController:
                 self.executor.run_setup(app)
             except Exception as e:  # noqa: BLE001
                 status.update({"phase": "ERROR_SETUP", "reason": str(e)})
-                self.kube.patch_status(app.KIND, app.namespace, app.name, status)
+                _patch_status_if_changed(
+                    self.kube, app.KIND, app.namespace, app.name, previous, status
+                )
                 return status
             status["setupFor"] = generation
 
@@ -257,13 +274,17 @@ class AppController:
                 self.executor.run_deployer(app)
             except Exception as e:  # noqa: BLE001
                 status.update({"phase": "ERROR_DEPLOY", "reason": str(e)})
-                self.kube.patch_status(app.KIND, app.namespace, app.name, status)
+                _patch_status_if_changed(
+                    self.kube, app.KIND, app.namespace, app.name, previous, status
+                )
                 return status
             status["deployedFor"] = generation
 
         status["phase"] = "DEPLOYED"
         status.pop("reason", None)
-        self.kube.patch_status(app.KIND, app.namespace, app.name, status)
+        _patch_status_if_changed(
+            self.kube, app.KIND, app.namespace, app.name, previous, status
+        )
         return status
 
     def cleanup(self, app_manifest: dict[str, Any]) -> None:
@@ -305,7 +326,10 @@ class AgentController:
         self._apply_if_changed(statefulset)
 
         status = self._aggregate_status(agent)
-        self.kube.patch_status(agent.KIND, agent.namespace, agent.name, status)
+        _patch_status_if_changed(
+            self.kube, agent.KIND, agent.namespace, agent.name,
+            dict(agent_manifest.get("status") or {}), status,
+        )
         return status
 
     def _apply_if_changed(self, manifest: dict[str, Any]) -> bool:

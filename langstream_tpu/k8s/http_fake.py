@@ -82,6 +82,42 @@ class HttpFakeKubeServer:
                 return kind, None, name, is_status
         return None
 
+    async def _watch(self, request: web.Request, kind: str, ns: Optional[str]):
+        """?watch=1 stream: newline-delimited watch events, the real
+        apiserver's wire shape — {type, object} lines, an ERROR event with
+        code 410 when the requested resourceVersion fell out of the bounded
+        event log, clean end-of-stream at timeoutSeconds."""
+        import asyncio
+
+        rv = int(request.query.get("resourceVersion", self.store.version) or 0)
+        timeout = float(request.query.get("timeoutSeconds", 30))
+        resp = web.StreamResponse()
+        resp.content_type = "application/json"
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        try:
+            while loop.time() < deadline:
+                events = self.store.events_since(rv, kind=kind, namespace=ns)
+                if events is None:  # horizon expired → 410 inside the stream
+                    await resp.write(json.dumps({
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status", "code": 410, "reason": "Expired",
+                        },
+                    }).encode() + b"\n")
+                    break
+                for ev_rv, type_, obj in events:
+                    await resp.write(
+                        json.dumps({"type": type_, "object": obj}).encode() + b"\n"
+                    )
+                    rv = ev_rv
+                await asyncio.sleep(0.03)
+            await resp.write_eof()
+        except ConnectionResetError:
+            pass  # client went away mid-stream; nothing left to write
+        return resp
+
     async def _handle(self, request: web.Request) -> web.Response:
         self.requests_served += 1
         if self.error_queue and self.error_queue[0][0] in (None, request.method):
@@ -105,9 +141,15 @@ class HttpFakeKubeServer:
             if out is None:
                 return web.json_response({"message": "not found"}, status=404)
             return web.json_response(out)
+        if method == "GET" and name is None and request.query.get("watch"):
+            return await self._watch(request, kind, ns)
         if method == "GET" and name is None:
             items = self.store.list(kind, ns)
-            return web.json_response({"kind": f"{kind}List", "items": items})
+            return web.json_response({
+                "kind": f"{kind}List",
+                "metadata": {"resourceVersion": str(self.store.version)},
+                "items": items,
+            })
         if method == "GET":
             obj = self.store.get(kind, ns or "default", name or "")
             if obj is None:

@@ -428,3 +428,123 @@ def test_operator_chaos_converges(run, monkeypatch):
             await server.stop()
 
     run(main())
+
+
+def test_watch_streams_add_modify_delete(run):
+    """client.watch yields the CR lifecycle as it happens (the apiserver
+    ?watch=1 wire shape), and a stale resourceVersion past the bounded
+    event horizon raises KubeWatchExpired for the re-list loop."""
+
+    async def main():
+        from langstream_tpu.k8s.client import KubeWatchExpired
+
+        server = await HttpFakeKubeServer().start()
+        try:
+            client = KubeApiClient(server.url)
+            events: list = []
+
+            def watch_thread():
+                for type_, obj in client.watch(
+                    "Secret", "ns1", resource_version="0", timeout_seconds=3
+                ):
+                    events.append((type_, obj["metadata"]["name"]))
+                    if len(events) >= 3:
+                        return
+
+            def drive():
+                t = threading.Thread(target=watch_thread)
+                t.start()
+                import time
+
+                time.sleep(0.2)  # watcher connected
+                client.apply({
+                    "apiVersion": "v1", "kind": "Secret",
+                    "metadata": {"name": "w1", "namespace": "ns1"},
+                })
+                client.apply({
+                    "apiVersion": "v1", "kind": "Secret",
+                    "metadata": {"name": "w1", "namespace": "ns1"},
+                    "stringData": {"k": "v2"},
+                })
+                client.delete("Secret", "ns1", "w1")
+                t.join(timeout=10)
+                assert not t.is_alive()
+                assert [e[0] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+                assert all(n == "w1" for _, n in events)
+
+                # horizon expiry → KubeWatchExpired
+                server.store.event_window = 2
+                for i in range(6):
+                    client.apply({
+                        "apiVersion": "v1", "kind": "Secret",
+                        "metadata": {"name": f"x{i}", "namespace": "ns1"},
+                    })
+                with pytest.raises(KubeWatchExpired):
+                    for _ in client.watch(
+                        "Secret", "ns1", resource_version="1", timeout_seconds=2
+                    ):
+                        pass
+
+            await asyncio.to_thread(drive)
+        finally:
+            await server.stop()
+
+    run(main())
+
+
+def test_operator_reacts_to_watch_event_without_polling(run, monkeypatch, tmp_path):
+    """A CR created AFTER the operator starts reconciles far sooner than
+    the fallback interval — proof the watch path, not the poll, drove it."""
+
+    async def main():
+        import time
+
+        from langstream_tpu import entrypoint
+
+        server = await HttpFakeKubeServer().start()
+        try:
+
+            def drive():
+                monkeypatch.setenv("KUBE_API_SERVER", server.url)
+                monkeypatch.setenv("OPERATOR_NAMESPACE", "langstream-default")
+                # fallback-only cadence would be 12s; watch must beat it
+                monkeypatch.setenv("OPERATOR_POLL_SECONDS", "12")
+                monkeypatch.delenv("OPERATOR_ONCE", raising=False)
+                stop = threading.Event()
+                t = threading.Thread(
+                    target=entrypoint.run_operator, kwargs={"stop": stop},
+                    daemon=True,
+                )
+                t.start()
+                time.sleep(0.5)  # operator idle, first (empty) pass done
+                client = KubeApiClient(server.url)
+                cr = ApplicationCustomResource(
+                    name="watched-app",
+                    namespace="langstream-default",
+                    tenant="default",
+                    package_files={"pipeline.yaml": PIPELINE},
+                    instance_text=INSTANCE,
+                )
+                client.apply(cr.to_manifest())
+                try:
+                    deadline = time.monotonic() + 8  # << the 12s fallback
+                    while time.monotonic() < deadline:
+                        live = client.get(
+                            "Application", "langstream-default", "watched-app"
+                        )
+                        if (live or {}).get("status", {}).get("phase") == "DEPLOYED":
+                            return
+                        time.sleep(0.1)
+                    raise AssertionError(
+                        "operator never reconciled the watched CR in time"
+                    )
+                finally:
+                    stop.set()
+                    t.join(timeout=15)
+                    assert not t.is_alive(), "operator loop did not stop"
+
+            await asyncio.to_thread(drive)
+        finally:
+            await server.stop()
+
+    run(main())
