@@ -101,7 +101,10 @@ instance:
 
 def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
                  n_requests: int, max_seq_len: int, decode_chunk: int,
-                 prefill_batch: "int | None" = None) -> float:
+                 prefill_batch: "int | None" = None,
+                 kv_int8: bool = False) -> float:
+    import dataclasses
+
     import jax
     import numpy as np
 
@@ -110,6 +113,8 @@ def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
     from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
 
     config = MODEL_PRESETS[preset]
+    if kv_int8:
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
     if quantize:
         # random int8 params built directly on device: shape-identical to
         # quantize_params(init_params(...)) but never stages the fp tree —
@@ -342,12 +347,15 @@ def main() -> None:
     if on_tpu:
         # flagship phase: BASELINE.md's headline model (llama-3-8b, ≥2000
         # tok/s aggregate across chips = ~250 tok/s/chip on its 8-chip ref
-        # config). int8 weights; B=32 fits 16G HBM beside the KV cache.
+        # config). int8 weights + int8 KV (+25% measured, PERF.md #4);
+        # B=48 is the HBM knee (B=64 OOMs: XLA double-buffers the cache
+        # inside the decode scan).
         try:
             print("[bench] llama-3-8b phase", file=sys.stderr, flush=True)
             llama_tok_s = bench_engine(
-                "llama-3-8b", True, max_batch=32, new_tokens=128,
-                n_requests=64, max_seq_len=1024, decode_chunk=16,
+                "llama-3-8b", True, max_batch=48, new_tokens=128,
+                n_requests=96, max_seq_len=1024, decode_chunk=16,
+                kv_int8=True,
             )
             extras["llama_3_8b_int8_tokens_per_sec"] = round(llama_tok_s, 2)
         except Exception as e:  # noqa: BLE001

@@ -38,6 +38,11 @@ class ServingMemoryPlan:
     cache_bytes: int  # decode cache: max_batch × max_seq_len
     long_cache_bytes: int  # chunked-prefill local cache (one prompt wide)
     workspace_bytes: int  # XLA scratch / activation headroom estimate
+    # XLA double-buffers the cache inside the fused decode scan
+    # (_decode_chunk's lax.scan carries it): the compiler allocates a
+    # second cache-sized HLO temp. Observed on v5e: llama-3-8b int8 B=64
+    # OOMs at exactly weights + 2x cache despite weights+cache fitting.
+    scan_buffer_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -46,6 +51,7 @@ class ServingMemoryPlan:
             + self.cache_bytes
             + self.long_cache_bytes
             + self.workspace_bytes
+            + self.scan_buffer_bytes
         )
 
     def fits(self, hbm_bytes: int) -> bool:
@@ -55,7 +61,8 @@ class ServingMemoryPlan:
         gib = 1024**3
         return (
             f"weights {self.weights_bytes / gib:.2f}GiB + "
-            f"cache {self.cache_bytes / gib:.2f}GiB + "
+            f"cache {self.cache_bytes / gib:.2f}GiB "
+            f"(+{self.scan_buffer_bytes / gib:.2f}GiB scan double-buffer) + "
             f"long-prefill {self.long_cache_bytes / gib:.2f}GiB + "
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
@@ -98,11 +105,13 @@ def plan_serving_memory(
         if long_prefill
         else None
     )
+    cache_bytes = _tree_bytes(cache_shape)
     return ServingMemoryPlan(
         weights_bytes=_tree_bytes(params_shape),
-        cache_bytes=_tree_bytes(cache_shape),
+        cache_bytes=cache_bytes,
         long_cache_bytes=_tree_bytes(long_shape) if long_shape else 0,
         workspace_bytes=workspace_bytes,
+        scan_buffer_bytes=cache_bytes,
     )
 
 
