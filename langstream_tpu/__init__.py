@@ -9,7 +9,7 @@ jit prefill/decode, tensor/expert parallelism over an ICI mesh).
 Layer map (mirrors SURVEY.md §1):
   api/            L0 model + SPIs (pure dataclasses/ABCs)
   core/           L1 parser / placeholder resolver / validator / planner
-  messaging/      L2 broker runtimes (in-memory reference impl; kafka gated)
+  messaging/      L2 broker runtimes: memory, kafka, pulsar, pravega (all dependency-free wire clients)
   runtime/        L3 agent runner main loop, ordered commit, local runner
   agents/         L4 built-in agent library
   ai/             provider SPI (completions/embeddings) + TPU provider

@@ -380,7 +380,7 @@ class Gateway:
 
 @dataclass
 class StreamingCluster:
-    type: str = "memory"  # memory | kafka | pulsar (gated)
+    type: str = "memory"  # memory | kafka | pulsar | pravega
     configuration: dict[str, Any] = field(default_factory=dict)
 
 

@@ -163,6 +163,13 @@ class FakePravega:
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         lock = asyncio.Lock()
+        # per-CONNECTION writer routing (a real store's AppendProcessor):
+        # SetupAppend binds a writer to a segment ON THIS SOCKET; appends
+        # from a writer the connection never set up are rejected, which is
+        # what forces clients to re-setup after a reconnect. (The DEDUP
+        # state — last event number per writer — lives on the segment, as
+        # real segment attributes do.)
+        setups: dict = {}  # writer_id → segment name
 
         async def send(frame_bytes: bytes) -> None:
             async with lock:
@@ -182,6 +189,7 @@ class FakePravega:
                         "message": f"unhandled {name}",
                     }))
                     continue
+                f["_conn_setups"] = setups
                 reply = await handler(f)
                 if reply is not None:
                     await send(reply)
@@ -213,6 +221,7 @@ class FakePravega:
             return wire.encode("no_such_segment", {
                 "request_id": f["request_id"], "segment": f["segment"],
             })
+        f["_conn_setups"][f["writer_id"]] = f["segment"]
         last = seg.writers.setdefault(f["writer_id"], 0)
         return wire.encode("append_setup", {
             "request_id": f["request_id"],
@@ -223,17 +232,13 @@ class FakePravega:
 
     async def _on_append_block_end(self, f: dict) -> bytes:
         writer_id = f["writer_id"]
-        # find the segment this writer was set up on
-        target = None
-        for name, seg in self.segments.items():
-            if writer_id in seg.writers:
-                target = (name, seg)
-                break
-        if target is None:
+        # routing comes from THIS connection's setups, not global state
+        name = f["_conn_setups"].get(writer_id)
+        seg = self.segments.get(name) if name is not None else None
+        if seg is None:
             return wire.encode("error_message", {
                 "request_id": f["request_id"], "message": "writer not set up",
             })
-        name, seg = target
         previous = seg.writers[writer_id]
         event_number = f["last_event_number"]
         if event_number > previous:  # idempotent: replays are dropped

@@ -393,6 +393,27 @@ class ServingEngine:
         self.total_generated = 0
         self.total_requests = 0
         self._busy_steps = 0
+        # HBM accounting up front: an over-committed config should announce
+        # its arithmetic here, not die in an opaque RESOURCE_EXHAUSTED
+        # mid-request (serving/memory.py; divide by the mesh's device count
+        # for the per-chip share when sharded)
+        try:
+            from langstream_tpu.serving.memory import plan_serving_memory
+
+            quantized = any(
+                leaf.dtype == jnp.int8 for leaf in jax.tree.leaves(params)
+            )
+            plan = plan_serving_memory(
+                config, max_batch, self.max_seq_len, quantized_weights=quantized
+            )
+            devices = mesh.devices.size if mesh is not None else 1
+            log.info(
+                "serving memory plan (%s, B=%d, T=%d, %d device%s): %s",
+                config.name, max_batch, self.max_seq_len, devices,
+                "s" if devices != 1 else "", plan.summary(),
+            )
+        except Exception:  # noqa: BLE001 — accounting must never block serving
+            log.debug("serving memory plan unavailable", exc_info=True)
 
     # -- public API ---------------------------------------------------------
 

@@ -337,3 +337,45 @@ def test_meta_log_compaction_snapshot_and_truncate(run):
             await broker.stop()
 
     run(main())
+
+
+def test_producer_survives_connection_drop(run):
+    """Transient socket drop: the dead connection is replaced, writers
+    re-setup on the new socket, and the append retries — a store blip is
+    NOT a permanent outage for the runtime (r4 code-review regression)."""
+
+    async def main():
+        broker = await FakePravega().start()
+        rt = await _runtime(broker)
+        try:
+            producer = rt.create_producer("agent", "rs")
+            await producer.start()
+            await producer.write(SimpleRecord.of("before"))
+
+            # sever the client's socket out from under it
+            conn = await rt.client.conn()
+            conn._writer.close()
+            for _ in range(100):  # dispatch loop notices EOF → dead
+                if conn.dead:
+                    break
+                await asyncio.sleep(0.02)
+            assert conn.dead
+
+            await producer.write(SimpleRecord.of("after"))  # reconnect path
+            assert (await rt.client.conn()) is not conn
+
+            consumer = rt.create_consumer("agent", "rs")
+            await consumer.start()
+            got = []
+            for _ in range(50):
+                got.extend(await consumer.read())
+                if len(got) >= 2:
+                    break
+            assert sorted(r.value for r in got) == ["after", "before"]
+            await consumer.close()
+            await producer.close()
+        finally:
+            await rt.close()
+            await broker.stop()
+
+    run(main())
