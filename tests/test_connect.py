@@ -231,3 +231,72 @@ def test_unreachable_cluster_fails_fast(run):
         await agent.close()
 
     run(main())
+
+
+def test_camel_source_timer_file_http(run):
+    """The native camel-source URI subset: timer ticks, directory polling
+    with delete, HTTP polling; JVM-only schemes still gate."""
+    import tempfile
+    from pathlib import Path
+
+    from langstream_tpu.agents.connect import CamelSourceAgent
+
+    async def main():
+        # timer
+        a = CamelSourceAgent()
+        await a.init({"component-uri": "timer:tick?period=10&repeatCount=2"})
+        got = []
+        for _ in range(50):
+            got.extend(await a.read())
+            if len(got) >= 2:
+                break
+        assert len(got) == 2
+        assert json.loads(got[0].value) == {"timer": "tick", "count": 1}
+        assert (await a.read()) == []  # repeatCount reached
+        await a.close()
+
+        # file with delete=true: files survive until COMMIT (at-least-once)
+        d = Path(tempfile.mkdtemp())
+        (d / "a.txt").write_bytes(b"alpha")
+        (d / "b.txt").write_bytes(b"bravo")
+        f = CamelSourceAgent()
+        await f.init({"component-uri": f"file:{d}?delete=true", "key-header": "camel-file"})
+        records = await f.read()
+        assert sorted(r.key for r in records) == ["a.txt", "b.txt"]
+        assert {h.key: h.value for h in records[0].headers} == {"camel-file": "a.txt"}
+        assert len(list(d.iterdir())) == 2  # NOT deleted before commit
+        await f.commit(records)
+        assert not list(d.iterdir())  # deleted after commit
+        await f.close()
+
+        # http poller
+        async def page(request):
+            assert request.query.get("token") == "t1"  # params preserved
+            return web.Response(text="polled-body")
+
+        app = web.Application()
+        app.router.add_get("/feed", page)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        h = CamelSourceAgent()
+        # the token param must survive URI parsing; only delay is stripped
+        await h.init({"component-uri": f"http://127.0.0.1:{port}/feed?delay=10&token=t1"})
+        assert h.url.endswith("/feed?token=t1")
+        got = []
+        for _ in range(50):
+            got.extend(await h.read())
+            if got:
+                break
+        assert got[0].value == "polled-body"
+        await h.close()
+        await runner.cleanup()
+
+        # JVM-only scheme gates
+        g = CamelSourceAgent()
+        with pytest.raises(NotImplementedError):
+            await g.init({"component-uri": "jms:queue:orders"})
+
+    run(main())

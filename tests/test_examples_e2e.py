@@ -885,3 +885,15 @@ def test_kafka_connect_pipeline(run):
             await stub.cleanup()
 
     run(main())
+
+
+def test_camel_source_pipeline(run):
+    """camel-source with a native URI scheme (timer:) runs end-to-end."""
+
+    async def scenario(runner):
+        out = await runner.consume("camel-out", n=2, timeout=60)
+        values = [json.loads(r.value) for r in out]
+        assert values[0]["timer"] == "tick"
+        assert values[0]["count"] < values[1]["count"]
+
+    run(run_example("camel-source", scenario))
