@@ -511,7 +511,11 @@ class ServingEngine:
                     # to overlap the deferred fetch with, and on a tunneled
                     # device the fetch would otherwise queue BEHIND the first
                     # decode chunk dispatched below (~a full chunk of extra
-                    # TTFT, measured: 700ms → ~300ms at 96-session burst)
+                    # TTFT, measured: 700ms → ~300ms at 96-session burst).
+                    # Do NOT widen this to low-but-nonzero occupancy: an
+                    # inline fetch under ANY active decode serializes the
+                    # loop on the in-flight chunk and collapsed the chat
+                    # bench to 740 tok/s / 14.8s p50 TTFT when tried (r4)
                     for entry in new_pending:
                         self._process_entry(entry)
                     new_pending = []
