@@ -33,6 +33,7 @@ OP_PREFILL = 1
 OP_LONG_SEG = 2
 OP_DECODE = 3
 OP_STOP = 4
+OP_RING = 5  # ring long-prefill: padded prompt streamed in token chunks
 
 # head vector layout (int32[12])
 _H_OP = 0
@@ -166,7 +167,7 @@ class SpmdChannel:
         # DECODE/STOP/IDLE carry everything in the head + slots vector; only
         # prefill ops ship the (prefill_batch x max_width) token buffer —
         # two-phase keeps the per-decode-chunk hot path to two small arrays
-        return op in (OP_PREFILL, OP_LONG_SEG)
+        return op in (OP_PREFILL, OP_LONG_SEG, OP_RING)
 
     def announce(self, block: ControlBlock) -> None:
         """Leader: publish the next device dispatch (engine thread only —
@@ -261,5 +262,31 @@ def _replay(engine: Any, block: ControlBlock) -> None:
             idx=block.long_idx,
             prompt_len=block.prompt_len,
         )
+    elif block.op == OP_RING:
+        # the padded prompt streams in (prefill_batch*max_width)-token
+        # chunks; the final chunk triggers the one-dispatch ring admit,
+        # evolving the follower's sharded state in lockstep with the leader
+        if block.long_start:
+            engine._spmd_ring_buf = []
+        engine._spmd_ring_buf.append(
+            np.asarray(block.tokens, np.int32).reshape(-1)[: block.seg_len]
+        )
+        if block.long_final:
+            prompt = np.concatenate(engine._spmd_ring_buf)
+            engine._spmd_ring_buf = []
+            # reconstruct the leader's pow2 padding locally (deterministic
+            # from the shared mesh/max_seq_len config) — only the prompt
+            # itself rides the channel
+            s_pad = engine._ring_pad(block.prompt_len)
+            tokens = np.zeros((1, s_pad), np.int32)
+            tokens[0, : len(prompt)] = prompt
+            engine._dev_ring(
+                tokens,
+                block.prompt_len,
+                float(block.temps[0]),
+                int(block.top_ks[0]),
+                float(block.top_ps[0]),
+                block.long_idx,
+            )
     elif block.op == OP_DECODE:
         engine._dev_decode(block.steps, block.slots)

@@ -322,17 +322,19 @@ class ServingEngine:
         self._insert_group = _make_insert_group()
         self._admit_group = _make_admit_group(mesh)
         # ring long-prefill: mesh spans a "seq" axis → long prompts run as
-        # ONE sequence-sharded dispatch instead of the segment loop. The
-        # SPMD leader/follower (multi-host) path keeps the segment loop —
-        # its control-block replay protocol is per-segment.
+        # ONE sequence-sharded dispatch instead of the segment loop. On a
+        # multi-host replica the leader streams the prompt to followers in
+        # fixed-shape chunks first (OP_RING), then every process makes the
+        # identical dispatch.
         self._ring_admit = (
             _make_ring_admit(mesh)
             if mesh is not None
             and "seq" in getattr(mesh, "shape", {})
             and mesh.shape["seq"] > 1
-            and spmd is None
             else None
         )
+        # follower-side accumulation buffer for OP_RING token chunks
+        self._spmd_ring_buf: list = []
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -919,42 +921,25 @@ class ServingEngine:
     def _ring_step(self, idx: int, request: GenerationRequest) -> list[tuple]:
         """One-dispatch ring long-prefill: run the fused ring admit and
         activate the slot. Decode chunks for other slots resume next
-        iteration."""
+        iteration. On a multi-host replica the leader first streams the
+        padded prompt to the followers in fixed-shape chunks (OP_RING) so
+        every process makes the identical dispatch."""
         prompt = request.prompt_tokens
         s_pad = self._ring_pad(len(prompt))
         assert s_pad is not None  # caller checked
         tokens = np.zeros((1, s_pad), np.int32)
         tokens[0, : len(prompt)] = prompt
         opts = request.options
-        meta = np.asarray(
-            [[len(prompt)], [opts.temperature], [opts.top_k], [opts.top_p]],
-            np.float32,
-        )
+        if self._spmd is not None:
+            self._announce_ring(tokens, len(prompt), opts, idx)
         try:
-            (
-                first,
-                self._cache,
-                self._tokens_dev,
-                self._positions_dev,
-                self._temp_dev,
-                self._top_k_dev,
-                self._top_p_dev,
-                self._key,
-            ) = self._ring_admit(
-                self.params,
-                self._cache,
-                self._tokens_dev,
-                self._positions_dev,
-                self._temp_dev,
-                self._top_k_dev,
-                self._top_p_dev,
-                self._key,
-                jnp.asarray(tokens),
-                jnp.asarray(meta),
-                jnp.asarray(np.full(1, idx, np.int32)),
-                self.config,
+            first = self._dev_ring(
+                tokens, len(prompt),
+                opts.temperature, opts.top_k, opts.top_p, idx,
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            if self._spmd is not None:
+                raise  # multi-host: crash the replica (see _admit rationale)
             log.exception("ring prefill failed")
             request._finish(GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
@@ -969,6 +954,72 @@ class ServingEngine:
         slot.first_token_at = 0.0
         self.total_requests += 1
         return [("prefill", first, [(idx, request)])]
+
+    def _announce_ring(self, tokens: np.ndarray, prompt_len: int, opts, idx: int) -> None:
+        """Stream the PROMPT (not its pow2 padding — the follower derives
+        the identical _ring_pad locally and zero-pads itself) over the
+        fixed-shape SPMD channel in (prefill_batch × max_width)-token
+        chunks; the final chunk carries the sampling params and fires the
+        follower's _dev_ring."""
+        from langstream_tpu.parallel.spmd_serving import OP_RING, ControlBlock
+
+        flat = tokens.reshape(-1)[:prompt_len]
+        chunk_cap = self._spmd.prefill_batch * self._spmd.max_width
+        total = len(flat)
+        for start in range(0, total, chunk_cap):
+            piece = flat[start : start + chunk_cap]
+            rows = -(-len(piece) // self._spmd.max_width)
+            padded = np.zeros(rows * self._spmd.max_width, np.int32)
+            padded[: len(piece)] = piece
+            self._spmd.announce(ControlBlock(
+                op=OP_RING,
+                width=self._spmd.max_width,
+                n_rows=rows,
+                tokens=padded.reshape(rows, self._spmd.max_width),
+                seg_len=len(piece),
+                long_start=start == 0,
+                long_final=start + chunk_cap >= total,
+                long_idx=idx,
+                prompt_len=prompt_len,
+                temps=np.asarray([opts.temperature], np.float32),
+                top_ks=np.asarray([opts.top_k], np.int32),
+                top_ps=np.asarray([opts.top_p], np.float32),
+            ))
+
+    def _dev_ring(
+        self, tokens: np.ndarray, prompt_len: int,
+        temperature: float, top_k: int, top_p: float, idx: int,
+    ):
+        """Device layer of the ring admit (leader + SPMD followers): the
+        fused sequence-sharded prefill + cache splice + decode-chain
+        scatters, identical on every process."""
+        meta = np.asarray(
+            [[prompt_len], [temperature], [top_k], [top_p]], np.float32
+        )
+        (
+            first,
+            self._cache,
+            self._tokens_dev,
+            self._positions_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            self._key,
+        ) = self._ring_admit(
+            self.params,
+            self._cache,
+            self._tokens_dev,
+            self._positions_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            self._key,
+            jnp.asarray(tokens),
+            jnp.asarray(meta),
+            jnp.asarray(np.full(1, idx, np.int32)),
+            self.config,
+        )
+        return first
 
     def _dev_long_segment(
         self, tokens, s0, seg_len, kv_bound, t_long, temperature, top_k, top_p,

@@ -122,3 +122,52 @@ def test_two_process_jax_distributed_serving():
     assert by_role["leader"]["tokens"] == ref.tokens, (
         "2-process sharded generation diverged from single-process reference"
     )
+
+
+def test_loopback_ring_prefill_lockstep():
+    """Ring long-prefill on an SPMD replica: the leader streams the padded
+    prompt over the channel (OP_RING chunks) and both engines make the
+    identical one-dispatch sequence-sharded admit — device state must stay
+    bit-identical afterwards."""
+    from langstream_tpu.parallel.mesh import build_mesh
+    from langstream_tpu.parallel.sharding import shard_params
+
+    mesh = build_mesh({"model": 2, "seq": 4})
+    params = shard_params(init_params(CFG, jax.random.PRNGKey(1)), mesh, CFG)
+    channel = LoopbackChannel(prefill_batch=2, max_width=32, max_batch=2)
+    mk = lambda spmd: ServingEngine(  # noqa: E731
+        CFG, params, max_batch=2, max_seq_len=512, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=2, mesh=mesh, spmd=spmd,
+    )
+    leader, follower = mk(channel), mk(None)
+    assert leader._ring_admit is not None and follower._ring_admit is not None
+    follower_thread = threading.Thread(
+        target=follower_loop, args=(follower, channel), daemon=True
+    )
+    follower_thread.start()
+    leader.start()
+    try:
+        opts = GenerationOptions(max_new_tokens=4, temperature=0.0)
+        # > largest bucket (32) → the ring path; > one OP_RING chunk
+        # (prefill_batch×max_width = 64 tokens) → multi-chunk streaming
+        prompt = [(5 + i) % CFG.vocab_size for i in range(100)]
+        result = leader.generate(prompt, opts, timeout=300)
+        assert len(result.tokens) == 4
+    finally:
+        leader.stop()
+    follower_thread.join(timeout=60)
+    assert not follower_thread.is_alive(), "follower never saw STOP"
+
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(leader._tokens_dev)),
+        np.asarray(jax.device_get(follower._tokens_dev)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(leader._positions_dev)),
+        np.asarray(jax.device_get(follower._positions_dev)),
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(leader._cache)),
+        jax.tree.leaves(jax.device_get(follower._cache)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
