@@ -1,0 +1,87 @@
+"""Regenerate the sample golden transcripts from the protocol fakes.
+
+These are SELF-CAPTURED (fake-broker) conversations — they prove the
+replay harness mechanics and pin the current wire bytes against drift;
+they are NOT real-broker captures. Replace with tcpdump'd conversations
+per docs/COMPAT_RUNBOOK.md when a real broker is reachable.
+
+Run: python tests/golden/generate_sample.py
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+HERE = Path(__file__).parent
+
+
+async def capture_pulsar() -> None:
+    """Record every frame of a produce/consume conversation by wrapping the
+    client's socket pair."""
+    from langstream_tpu.api.record import SimpleRecord
+    from langstream_tpu.messaging import pulsar as p
+    from langstream_tpu.messaging.pulsar_fake import FakePulsarBroker
+
+    frames: list[tuple[str, bytes]] = []
+    orig_send = p.PulsarConnection._send
+    orig_read = p.PulsarConnection._read_frame
+
+    async def send(self, command, metadata=b"", payload=b""):
+        from langstream_tpu.messaging import pulsar_protocol as wire
+
+        data = (
+            wire.payload_frame(command, metadata, payload)
+            if metadata
+            else wire.frame(command)
+        )
+        frames.append((">", data))
+        await orig_send(self, command, metadata, payload)
+
+    async def read_frame(self):
+        from langstream_tpu.messaging import pulsar_protocol as wire
+
+        header = await self._reader.readexactly(4)
+        total = int.from_bytes(header, "big")
+        body = await self._reader.readexactly(total)
+        frames.append(("<", header + body))
+        return wire.split_frame(body)
+
+    p.PulsarConnection._send = send
+    p.PulsarConnection._read_frame = read_frame
+    try:
+        broker = await FakePulsarBroker().start()
+        rt = p.PulsarTopicConnectionsRuntime()
+        await rt.init({
+            "service": {"serviceUrl": broker.service_url},
+            "admin": {"serviceUrl": broker.admin_url},
+        })
+        producer = rt.create_producer("a", "golden-topic")
+        await producer.start()
+        await producer.write(SimpleRecord(key="k1", value="golden-value"))
+        consumer = rt.create_consumer("a", "golden-topic")
+        await consumer.start()
+        got = []
+        for _ in range(50):
+            got.extend(await consumer.read())
+            if got:
+                break
+        await consumer.commit(got)
+        await consumer.close()
+        await producer.close()
+        await rt.close()
+        await broker.stop()
+    finally:
+        p.PulsarConnection._send = orig_send
+        p.PulsarConnection._read_frame = orig_read
+
+    lines = ["# pulsar produce/consume conversation (fake-broker capture)"]
+    for direction, data in frames:
+        lines.append(f"{direction} " + data.hex())
+    (HERE / "pulsar_produce_consume.hex").write_text("\n".join(lines) + "\n")
+    print(f"pulsar: {sum(1 for d, _ in frames if d == '>')} client frames")
+
+
+if __name__ == "__main__":
+    asyncio.run(capture_pulsar())
