@@ -314,16 +314,16 @@ def main() -> None:
         long_len, long_seg, long_max_seq = 150, 32, 256
     else:
         # decode is HBM-bandwidth-bound: int8 weights halve the dominant
-        # read stream. B=96 x chunk=16 measured best on v5e AFTER the
-        # fetch-free admission landed (r4): the old chunk=64 knee was an
-        # artifact of per-iteration host stalls — with those gone, smaller
-        # chunks cut mid-chunk completion waste AND TTFT
-        # (64/32/16/8 -> 7215/7948/8386/5915 tok/s; gateway p50 TTFT
-        # 866/505/326ms at 64/32/16). prefill_batch=96: the whole
-        # 96-session burst admits in ONE prefill dispatch
+        # read stream, and the decode chunk scans a kv_bound-sliced cache
+        # (engine._decode_kv_bound) so cache reads scale with the longest
+        # LIVE row, not max_seq_len. That moved the batch knee from 96 to
+        # 192 (r5 sweep: 96/128/192/224/256 ->
+        # 11212/13942/15686/15295/14765 tok/s at chunk=16; chunk=32
+        # regressed to 14905 at B=192). prefill_batch=max_batch: a whole
+        # admission wave lands in ONE prefill dispatch
         preset, quantize = "gemma-2b", True
-        max_batch, new_tokens, n_requests, n_sessions = 96, 256, 192, 96
-        max_seq_len, decode_chunk, prefill_batch = 1024, 16, 96
+        max_batch, new_tokens, n_requests, n_sessions = 192, 256, 384, 96
+        max_seq_len, decode_chunk, prefill_batch = 1024, 16, 192
         long_len, long_seg, long_max_seq = 8000, 2048, 8192
 
     print(f"[bench] engine phase: {preset} quantize={quantize}", file=sys.stderr, flush=True)
@@ -348,26 +348,47 @@ def main() -> None:
         # flagship phase: BASELINE.md's headline model (llama-3-8b, ≥2000
         # tok/s aggregate across chips = ~250 tok/s/chip on its 8-chip ref
         # config). int8 weights + int8 KV (+25% measured, PERF.md #4);
-        # B=48 is the HBM knee (B=64 OOMs: XLA double-buffers the cache
-        # inside the decode scan).
+        # B=84 is the r5 HBM knee (the in-place layer scan killed the
+        # decode-scan cache double-buffer that OOMed B>48; the kv_bound
+        # chunk slice adds one bound-wide copy pair per chunk, which is
+        # what stops B=88/96 — 15.9G peak vs 15.75G HBM).
         try:
             print("[bench] llama-3-8b phase", file=sys.stderr, flush=True)
             llama_tok_s = bench_engine(
-                "llama-3-8b", True, max_batch=48, new_tokens=128,
-                n_requests=96, max_seq_len=1024, decode_chunk=16,
+                "llama-3-8b", True, max_batch=84, new_tokens=128,
+                n_requests=168, max_seq_len=1024, decode_chunk=16,
                 kv_int8=True,
             )
             extras["llama_3_8b_int8_tokens_per_sec"] = round(llama_tok_s, 2)
         except Exception as e:  # noqa: BLE001
             print(f"[bench] llama phase failed: {e}", file=sys.stderr, flush=True)
+        # MoE phase (BASELINE config #5): mixtral architecture at the scale
+        # ONE chip serves in int8 (mixtral-8x1b preset — 8 experts, top-2,
+        # same ratios as 8x7b; ~8.9GiB weights). Expert routing under the
+        # continuous batcher; the full-size 8x7b dp×ep×tp sharding is
+        # dryrun-validated in __graft_entry__ instead.
+        try:
+            print("[bench] mixtral-8x1b MoE phase", file=sys.stderr, flush=True)
+            moe_tok_s = bench_engine(
+                "mixtral-8x1b", True, max_batch=32, new_tokens=128,
+                n_requests=64, max_seq_len=1024, decode_chunk=16,
+                kv_int8=True,
+            )
+            extras["moe_mixtral_8x1b_int8_tokens_per_sec"] = round(moe_tok_s, 2)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] MoE phase failed: {e}", file=sys.stderr, flush=True)
         # long-context ceiling phase: the largest context the memory plan
         # says ONE chip truly serves on the 128k NTK preset — llama-3.1-8b,
         # int8 weights + int8 KV, B=1 → 32k (serving/memory.py). TTFT of a
-        # 32k-token prompt through the chunked-prefill path.
+        # 32k-token prompt through the chunked-prefill path. 8192-token
+        # segments (r5): model-dtype MXU dots + 512-wide kernel blocks took
+        # the segment kernel from 14 to 35 TFLOPS, and wider segments
+        # amortize the ~360ms/segment dispatch+linear floor
+        # (2048/4096/8192 → 9.0/7.3/6.6s).
         try:
             print("[bench] llama-3.1 32k long-context phase", file=sys.stderr, flush=True)
             ttft32k = bench_long_prompt(
-                "llama-3.1-8b", True, 32000, 2048, 32768,
+                "llama-3.1-8b", True, 32000, 8192, 32768,
                 max_batch=1, kv_int8=True,
             )
             extras["long_prompt_32000_ttft_ms"] = round(ttft32k * 1e3, 1)

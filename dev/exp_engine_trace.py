@@ -1,0 +1,89 @@
+"""Instrument the engine loop: where does wall time go at steady state?
+
+Monkeypatches dispatch/fetch/process points with timestamps and prints a
+phase summary after a llama-3-8b B=96 run.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.argv = ["x"]
+
+import numpy as np
+
+events: list[tuple[str, float, float, int]] = []  # (kind, t0, dt, steps)
+
+
+def main() -> None:
+    import jax
+
+    from langstream_tpu.serving import engine as eng
+
+    orig_dev_decode = eng.ServingEngine._dev_decode
+    orig_dev_prefill = eng.ServingEngine._dev_prefill
+    orig_process = eng.ServingEngine._process_entry
+
+    def dev_decode(self, steps, stale, kv_bound=None):
+        t0 = time.monotonic()
+        out = orig_dev_decode(self, steps, stale, kv_bound)
+        events.append((f"dispatch-b{kv_bound}-st{len(stale)}", t0, time.monotonic() - t0, steps))
+        return out
+
+    def dev_prefill(self, width, *a):
+        t0 = time.monotonic()
+        out = orig_dev_prefill(self, width, *a)
+        events.append(("prefill", t0, time.monotonic() - t0, width))
+        return out
+
+    def process(self, entry):
+        t0 = time.monotonic()
+        out = orig_process(self, entry)
+        events.append((f"proc-{entry[0]}", t0, time.monotonic() - t0, 0))
+        return out
+
+    eng.ServingEngine._dev_decode = dev_decode
+    eng.ServingEngine._dev_prefill = dev_prefill
+    eng.ServingEngine._process_entry = process
+
+    from bench import bench_engine
+
+    t = bench_engine(
+        "llama-3-8b", True, max_batch=96, new_tokens=128, n_requests=192,
+        max_seq_len=1024, decode_chunk=16, kv_int8=True,
+    )
+    print(f"tok/s={t:.0f}", flush=True)
+
+    # summarize from the last prefill onward minus warmup (first 20 events)
+    ev = events[10:]
+    t_start, t_end = ev[0][1], max(e[1] + e[2] for e in ev)
+    span = t_end - t_start
+    by_kind: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    for kind, _, dt, _ in ev:
+        by_kind[kind] = by_kind.get(kind, 0.0) + dt
+        counts[kind] = counts.get(kind, 0) + 1
+    print(f"span={span:.2f}s", flush=True)
+    for k in sorted(by_kind):
+        print(f"  {k}: total={by_kind[k]:.2f}s n={counts[k]} avg={by_kind[k]/counts[k]*1e3:.1f}ms")
+    acc = span - sum(by_kind.values())
+    print(f"  (loop other/idle: {acc:.2f}s)")
+    print("  slowest events:")
+    for kind, t0, dt, steps in sorted(ev, key=lambda e: -e[2])[:8]:
+        print(f"    {kind} at t+{t0-t_start:.2f}s: {dt*1e3:.0f}ms (steps={steps})")
+    # dispatch gap histogram: time between consecutive dispatch STARTS
+    disp = [e for e in ev if e[0].startswith("dispatch")]
+    gaps = [b[1] - (a[1]) for a, b in zip(disp, disp[1:])]
+    if gaps:
+        print(
+            f"  dispatch-start gaps: mean={np.mean(gaps)*1e3:.1f}ms "
+            f"p50={np.percentile(gaps,50)*1e3:.1f} p90={np.percentile(gaps,90)*1e3:.1f} "
+            f"max={max(gaps)*1e3:.1f} n={len(gaps)}"
+        )
+        steps = [d[3] for d in disp]
+        print(f"  chunk steps: {dict((s, steps.count(s)) for s in set(steps))}")
+
+
+if __name__ == "__main__":
+    main()

@@ -85,13 +85,16 @@ def full_topic(name: str, tenant: str = "public", namespace: str = "default") ->
 
 def _pack_mid(ledger_id: int, entry_id: int) -> int:
     """Message id → opaque int for the reader's offset map (gateway resume).
-    20 bits of entry per ledger covers the gateway's short-lived resume
-    windows; the packing is an implementation detail of this runtime."""
-    return (ledger_id << 20) | (entry_id & 0xFFFFF)
+    32 bits of entry per ledger (brokers roll ledgers long before 4G
+    entries; a guard raises rather than silently aliasing a different
+    message the way the old 20-bit packing could)."""
+    if not 0 <= entry_id < 1 << 32:
+        raise ValueError(f"entry_id {entry_id} exceeds the 32-bit packing")
+    return (ledger_id << 32) | entry_id
 
 
 def _unpack_mid(packed: int) -> tuple[int, int]:
-    return packed >> 20, packed & 0xFFFFF
+    return packed >> 32, packed & 0xFFFFFFFF
 
 
 class PulsarProtocolError(RuntimeError):
@@ -113,6 +116,10 @@ class PulsarConnection:
         self._write_lock = asyncio.Lock()
         self._request_ids = itertools.count(1)
         self.max_message_size = 5 * 1024 * 1024
+        # set when the dispatch loop exits: the client discards dead
+        # connections and re-dials instead of reusing a poisoned one
+        # (mirrors pravega's reconnect handling)
+        self.dead = False
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
@@ -205,6 +212,7 @@ class PulsarConnection:
         except (asyncio.CancelledError, asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
+            self.dead = True
             err = PulsarProtocolError("connection closed")
             for fut in list(self._pending.values()) + list(self._receipts.values()):
                 if not fut.done():
@@ -294,6 +302,18 @@ class PulsarClient:
     async def _conn_to(self, host: str, port: int) -> PulsarConnection:
         async with self._lock:
             conn = self._conns.get((host, port))
+            if conn is not None and conn.dead:
+                # dropped broker connection: discard and re-dial — reusing
+                # it would fail every request with "connection closed" until
+                # process restart. Topic→conn cache entries pointing at the
+                # dead object are purged so conn_for_topic re-LOOKUPs.
+                await conn.close()
+                self._conns.pop((host, port), None)
+                for topic in [
+                    t for t, c in self._topic_conns.items() if c is conn
+                ]:
+                    self._topic_conns.pop(topic, None)
+                conn = None
             if conn is None:
                 conn = PulsarConnection(host, port)
                 await conn.connect()
@@ -309,8 +329,10 @@ class PulsarClient:
         following redirects (response 0 = redirect, 1 = connect here).
         ``topic`` must be a fully-qualified data topic name."""
         cached = self._topic_conns.get(topic)
-        if cached is not None:
+        if cached is not None and not cached.dead:
             return cached
+        if cached is not None:
+            self._topic_conns.pop(topic, None)
         conn = await self.conn()
         authoritative = 0
         for _ in range(8):
@@ -472,6 +494,52 @@ def _message_to_consumed(
     )
 
 
+def _explode_frame(
+    metadata: dict, payload: bytes
+) -> list[tuple[dict, bytes, int, int]]:
+    """One wire frame → its logical messages as (metadata, payload,
+    batch_index, batch_emitted) tuples.
+
+    JVM/official producers batch by default (MessageMetadata
+    ``num_messages_in_batch`` > 1, payload = repeated
+    [size][SingleMessageMetadata][bytes]); treating the whole payload as one
+    record would hand agents concatenated garbage. batch_index is -1 for
+    unbatched frames. Unsupported compression raises explicitly instead of
+    decoding noise."""
+    codec = int(metadata.get("compression", 0) or 0)
+    if codec != 0:
+        raise PulsarProtocolError(
+            f"unsupported pulsar compression codec {codec} (this runtime "
+            "implements NONE; configure the producer with compression "
+            "disabled)"
+        )
+    n = int(metadata.get("num_messages_in_batch", 1) or 1)
+    if n <= 1:
+        return [(metadata, payload, -1, 1)]
+    entries: list[tuple[dict, bytes, int, int]] = []
+    raw = wire.split_batch(payload, n)
+    emitted = sum(1 for smm, _ in raw if not smm.get("compacted_out"))
+    for i, (smm, data) in enumerate(raw):
+        if smm.get("compacted_out"):
+            continue
+        merged = dict(metadata)
+        merged.pop("num_messages_in_batch", None)
+        # per-entry metadata is authoritative inside a batch
+        merged["properties"] = smm.get("properties", [])
+        merged.pop("partition_key", None)
+        merged.pop("partition_key_b64_encoded", None)
+        if not smm.get("null_partition_key") and "partition_key" in smm:
+            merged["partition_key"] = smm["partition_key"]
+            if smm.get("partition_key_b64_encoded"):
+                merged["partition_key_b64_encoded"] = 1
+        if smm.get("event_time"):
+            merged["publish_time"] = smm["event_time"]
+        if smm.get("null_value"):
+            data = b""
+        entries.append((merged, data, i, emitted))
+    return entries
+
+
 async def _flow_replenish(sub: dict[str, Any], queue_size: int) -> None:
     """Half-empty permit refill (the standard pulsar client cadence) against
     the subscription's OWNER-broker connection. Shared by the consumer and
@@ -510,6 +578,8 @@ class PulsarTopicConsumer(TopicConsumer):
         self._subs: dict[int, dict[str, Any]] = {}  # partition → sub state
         self._offsets = itertools.count(0)
         self._inflight: dict[tuple[int, int], dict] = {}  # (partition, local) → ack info
+        # (consumer_id, ledger, entry) → emitted batch entries still unacked
+        self._batch_left: dict[tuple[int, int, int], int] = {}
         self._total_out = 0
 
     async def start(self) -> None:
@@ -560,28 +630,70 @@ class PulsarTopicConsumer(TopicConsumer):
     async def _replenish(self, sub: dict[str, Any]) -> None:
         await _flow_replenish(sub, self.receiver_queue_size)
 
+    async def _resubscribe(self, sub: dict[str, Any]) -> None:
+        """Re-establish a subscription whose broker connection dropped: new
+        LOOKUP (ownership may have moved), fresh registration on the new
+        connection, full permit grant. Delivered-but-unacked messages
+        redeliver through the broker cursor, so no client state is lost."""
+        log.warning(
+            "pulsar consumer resubscribing to %s after connection loss",
+            sub["topic"],
+        )
+        conn = await self.client.conn_for_topic(sub["topic"])
+        queue = conn.register_consumer(sub["consumer_id"])
+        await conn.request(
+            "subscribe",
+            {
+                "topic": sub["topic"],
+                "subscription": self.subscription,
+                "sub_type": SUB_SHARED,
+                "consumer_id": sub["consumer_id"],
+                "consumer_name": f"{self.subscription}-{uuid.uuid4().hex[:8]}",
+                "durable": 1,
+                "initial_position": POSITION_EARLIEST,
+            },
+        )
+        await conn.fire(
+            "flow",
+            {
+                "consumer_id": sub["consumer_id"],
+                "message_permits": self.receiver_queue_size,
+            },
+        )
+        sub.update(
+            {"conn": conn, "queue": queue, "permits": self.receiver_queue_size}
+        )
+
     async def read(self) -> list[Record]:
         out: list[Record] = []
         deadline = asyncio.get_running_loop().time() + self.poll_timeout
         while len(out) < self.max_records:
             got_any = False
             for partition, sub in self._subs.items():
+                if sub["conn"].dead:
+                    await self._resubscribe(sub)
                 try:
                     fields, metadata, payload = sub["queue"].get_nowait()
                 except asyncio.QueueEmpty:
                     continue
                 got_any = True
-                local = next(self._offsets)
                 mid = fields.get("message_id", {})
-                self._inflight[(partition, local)] = {
-                    "consumer_id": sub["consumer_id"],
-                    "message_id": mid,
-                }
-                out.append(
-                    _message_to_consumed(
-                        self.topic_name, partition, local, metadata or {}, payload
+                for entry_md, entry_payload, bindex, emitted in _explode_frame(
+                    metadata or {}, payload
+                ):
+                    local = next(self._offsets)
+                    self._inflight[(partition, local)] = {
+                        "consumer_id": sub["consumer_id"],
+                        "message_id": mid,
+                        "batch_index": bindex,
+                        "batch_emitted": emitted,
+                    }
+                    out.append(
+                        _message_to_consumed(
+                            self.topic_name, partition, local, entry_md,
+                            entry_payload,
+                        )
                     )
-                )
                 await self._replenish(sub)
                 if len(out) >= self.max_records:
                     break
@@ -598,7 +710,12 @@ class PulsarTopicConsumer(TopicConsumer):
     async def commit(self, records: list[Record]) -> None:
         """Individual acks per message id — the broker cursor owns redelivery,
         so out-of-order acks need no client-side prefix tracking (unlike the
-        Kafka runtime's contiguous-prefix commit)."""
+        Kafka runtime's contiguous-prefix commit).
+
+        Batched messages (one wire id covering several records) ack the id
+        once EVERY emitted entry of the batch has been committed — the
+        broker redelivers whole batches, so an early per-entry ack would
+        drop its uncommitted siblings."""
         by_consumer: dict[int, list[dict]] = {}
         for r in records:
             if not isinstance(r, ConsumedRecord):
@@ -606,9 +723,19 @@ class PulsarTopicConsumer(TopicConsumer):
             entry = self._inflight.pop((r.partition, r.offset), None)
             if entry is None:
                 continue
-            by_consumer.setdefault(entry["consumer_id"], []).append(
-                entry["message_id"]
-            )
+            mid = entry["message_id"]
+            if entry["batch_index"] >= 0:
+                key = (
+                    entry["consumer_id"],
+                    int(mid.get("ledger_id", 0)),
+                    int(mid.get("entry_id", 0)),
+                )
+                left = self._batch_left.get(key, entry["batch_emitted"]) - 1
+                if left > 0:
+                    self._batch_left[key] = left
+                    continue
+                self._batch_left.pop(key, None)
+            by_consumer.setdefault(entry["consumer_id"], []).append(mid)
         if not by_consumer:
             return
         conns = {s["consumer_id"]: s["conn"] for s in self._subs.values()}
@@ -670,6 +797,21 @@ class PulsarTopicProducer(TopicProducer):
     async def write(self, record: Record) -> None:
         if not self._producers:
             await self.start()
+        try:
+            await self._write_once(record)
+        except (PulsarProtocolError, ConnectionError) as e:
+            if "connection closed" not in str(e):
+                raise
+            # broker connection dropped mid-write: re-LOOKUP the owners
+            # (the client has discarded the dead connection), re-register
+            # the producers, retry ONCE — unlimited retries would mask a
+            # down cluster
+            log.warning("pulsar producer reconnecting after: %s", e)
+            self._producers.clear()
+            await self.start()
+            await self._write_once(record)
+
+    async def _write_once(self, record: Record) -> None:
         payload, partition_key, properties, key_b64 = _record_to_payload(record)
         n = len(self._producers)
         if partition_key is not None and n > 1:
@@ -767,6 +909,7 @@ class PulsarTopicReader(TopicReader):
                 "queue": queue,
                 "permits": self.receiver_queue_size,
                 "conn": conn,
+                "topic": topic,
             }
 
     async def close(self) -> None:
@@ -781,12 +924,57 @@ class PulsarTopicReader(TopicReader):
             conn.drop_consumer(sub["consumer_id"])
         self._subs.clear()
 
+    async def _resubscribe(self, partition: int, sub: dict[str, Any]) -> None:
+        """Reader reconnect: fresh non-durable subscription + SEEK back to
+        the last delivered position, so resume semantics survive a broker
+        connection drop."""
+        log.warning(
+            "pulsar reader resubscribing to %s after connection loss",
+            sub["topic"],
+        )
+        conn = await self.client.conn_for_topic(sub["topic"])
+        queue = conn.register_consumer(sub["consumer_id"])
+        await conn.request(
+            "subscribe",
+            {
+                "topic": sub["topic"],
+                "subscription": f"reader-{uuid.uuid4().hex[:12]}",
+                "sub_type": SUB_EXCLUSIVE,
+                "consumer_id": sub["consumer_id"],
+                "consumer_name": f"reader-{sub['consumer_id']}",
+                "durable": 0,
+                "initial_position": POSITION_EARLIEST,
+            },
+        )
+        packed = self._pos.get(partition)
+        if packed is not None:
+            ledger_id, entry_id = _unpack_mid(packed)
+            await conn.request(
+                "seek",
+                {
+                    "consumer_id": sub["consumer_id"],
+                    "message_id": {"ledger_id": ledger_id, "entry_id": entry_id},
+                },
+            )
+        await conn.fire(
+            "flow",
+            {
+                "consumer_id": sub["consumer_id"],
+                "message_permits": self.receiver_queue_size,
+            },
+        )
+        sub.update(
+            {"conn": conn, "queue": queue, "permits": self.receiver_queue_size}
+        )
+
     async def read(self) -> TopicReadResult:
         out: list[Record] = []
         record_offsets: list[dict[int, int]] = []
         for _ in range(10):
             got_any = False
             for partition, sub in self._subs.items():
+                if sub["conn"].dead:
+                    await self._resubscribe(partition, sub)
                 try:
                     fields, metadata, payload = sub["queue"].get_nowait()
                 except asyncio.QueueEmpty:
@@ -797,12 +985,18 @@ class PulsarTopicReader(TopicReader):
                     int(mid.get("ledger_id", 0)), int(mid.get("entry_id", 0))
                 )
                 self._pos[partition] = packed
-                out.append(
-                    _message_to_consumed(
-                        self.topic_name, partition, packed, metadata or {}, payload
+                # batched frames emit one record per entry; the resume
+                # offset is frame-granular (SEEK re-reads the whole batch)
+                for entry_md, entry_payload, _, _ in _explode_frame(
+                    metadata or {}, payload
+                ):
+                    out.append(
+                        _message_to_consumed(
+                            self.topic_name, partition, packed, entry_md,
+                            entry_payload,
+                        )
                     )
-                )
-                record_offsets.append(dict(self._pos))
+                    record_offsets.append(dict(self._pos))
                 # without the refill the reader stalls permanently after the
                 # initial grant drains
                 await _flow_replenish(sub, self.receiver_queue_size)

@@ -277,10 +277,48 @@ MESSAGE_METADATA: Schema = {
     3: ("publish_time", "varint"),
     4: ("properties*", ("msg", KEY_VALUE)),
     6: ("partition_key", "string"),
+    8: ("compression", "varint"),  # CompressionType enum; 0 = NONE
     9: ("uncompressed_size", "varint"),
     11: ("num_messages_in_batch", "varint"),
     15: ("partition_key_b64_encoded", "varint"),  # key is base64 of raw bytes
 }
+
+# PulsarApi.proto SingleMessageMetadata — one per entry of a batched payload
+# (JVM producers batch by default; each entry is [4-byte size][this][payload])
+SINGLE_MESSAGE_METADATA: Schema = {
+    1: ("properties*", ("msg", KEY_VALUE)),
+    2: ("partition_key", "string"),
+    3: ("payload_size", "varint"),
+    4: ("compacted_out", "varint"),
+    5: ("event_time", "varint"),
+    6: ("partition_key_b64_encoded", "varint"),
+    8: ("sequence_id", "varint"),
+    9: ("null_value", "varint"),
+    10: ("null_partition_key", "varint"),
+}
+
+
+def split_batch(payload: bytes, n: int) -> list[tuple[dict[str, Any], bytes]]:
+    """Split a batched message payload into ``n`` (SingleMessageMetadata,
+    entry payload) pairs — the spec layout is
+    ``[int32 metadata_size][SingleMessageMetadata][payload_size bytes]``
+    repeated, sizes big-endian."""
+    out: list[tuple[dict[str, Any], bytes]] = []
+    off = 0
+    for _ in range(n):
+        if off + 4 > len(payload):
+            raise ValueError(
+                f"truncated batch payload: {len(payload)} bytes, "
+                f"entry header at {off}"
+            )
+        size = int.from_bytes(payload[off : off + 4], "big")
+        off += 4
+        smm = decode_message(SINGLE_MESSAGE_METADATA, payload[off : off + size])
+        off += size
+        psize = int(smm.get("payload_size", 0))
+        out.append((smm, payload[off : off + psize]))
+        off += psize
+    return out
 
 # BaseCommand type enum values + the field that carries each sub-command
 _COMMANDS: dict[str, tuple[int, int, Schema]] = {

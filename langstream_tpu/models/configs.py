@@ -164,6 +164,25 @@ MODEL_PRESETS: dict[str, ModelConfig] = {
         rope_scaling_high_freq_factor=4.0,
         rope_scaling_original_max_seq_len=8192,
     ),
+    "mixtral-8x1b": _preset(
+        # mixtral-8x7b architecture (8 experts, top-2, 3.5x ffn ratio,
+        # GQA kv=8, rope 1e6) scaled to what ONE 16GiB v5e chip serves in
+        # int8 (~8.9B total / ~1.06B per expert): the single-chip bench row
+        # for BASELINE config #5 — the full-size preset above shards over
+        # dp×ep×tp instead (see __graft_entry__._mixtral_sharding_lower_check)
+        name="mixtral-8x1b",
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=24,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=7168,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-5,
+        max_seq_len=32768,
+        n_experts=8,
+        n_experts_per_tok=2,
+    ),
     "mixtral-8x7b": _preset(
         name="mixtral-8x7b",
         vocab_size=32000,

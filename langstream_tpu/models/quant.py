@@ -90,8 +90,24 @@ def init_random_quantized_params(config: ModelConfig, key: jax.Array) -> Params:
     keys = iter(jax.random.split(key, 16))
 
     def qw(*shape, scale_of=None):
+        import numpy as np
+
         fan_in = scale_of if scale_of is not None else shape[-2]
-        q = jax.random.randint(next(keys), shape, -127, 128, jnp.int8)
+        # int8 values are drawn on the HOST and uploaded in one put:
+        # device-side jax.random.randint materializes a uint32 temp of the
+        # full shape (4 bytes/elem — 11.3GiB for the stacked mixtral-8x1b
+        # w_gate), and splitting into per-layer draws still OOMed because
+        # remote/tunnel backends defer intermediate buffer frees. A single
+        # host-generated upload has no device temps at all; init is a
+        # once-per-engine cost.
+        k = next(keys)
+        if isinstance(k, jax.core.Tracer):
+            # abstract evaluation (serving/memory.py plans via eval_shape):
+            # only shapes/dtypes matter, so skip the host draw
+            q = jnp.zeros(shape, jnp.int8)
+        else:
+            rng = np.random.default_rng(np.asarray(k))
+            q = jnp.asarray(rng.integers(-127, 128, shape, np.int8))
         s = jnp.full(shape[:-2] + (1, shape[-1]), fan_in**-0.5 / 127.0, jnp.float32)
         return {"q": q, "s": s}
 

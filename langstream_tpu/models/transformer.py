@@ -242,32 +242,41 @@ def _dispatch_attention(
     quantized = isinstance(k_all, dict)
     t = (k_all["q"] if quantized else k_all).shape[2]
     interpret = jax.default_backend() != "tpu"
-    # decode: the ragged kernel only wins when block DMAs can be skipped;
-    # measured on v5e (gemma-2b, B=96, fast sampler) XLA's fused masked path
-    # still beats it (~10.4 vs 11.3ms/step — kv=1 makes the per-block DMAs
-    # tiny), so "auto" keeps jnp for decode; the kernel stays opt-in
-    # ("pallas", bf16 caches only — it reads raw arrays)
-    use_decode_kernel = config.attention_impl == "pallas" and not quantized
+    if kv_bound is not None and kv_bound < t:
+        # static pow2 cap on readable cache columns (decode chunks bound it
+        # by max position + in-flight steps; chunked-prefill segments by
+        # offset + W): the masked read then streams only the valid prefix.
+        # Measured r5 (llama-3-8b int8 B=96): step time scales with cache
+        # WIDTH (27.9ms at T=256 vs 61.8 at T=1024), so this is decode's
+        # main bandwidth lever. The pallas ragged int8 kernel lost to it
+        # (592 tok/s engine — per-block DMA/grid overhead at decode shapes).
+        k_all = jax.tree.map(lambda x: x[:, :, :kv_bound], k_all)
+        v_all = jax.tree.map(lambda x: x[:, :, :kv_bound], v_all)
+        mask = mask[:, :, :kv_bound]
+        t = kv_bound
+    # decode kernels stay opt-in ("pallas"): XLA's fused masked path over
+    # the kv_bound-sliced cache beat both (bf16: 10.4 vs 11.3ms/step on
+    # gemma B=96; int8: the ragged-int8 kernel regressed 1322 → 592 tok/s)
+    use_decode_kernel = config.attention_impl == "pallas"
     if s == 1 and use_decode_kernel and cache_positions is not None and pallas_ok(config, s, t):
         # decode: single query per row, ragged valid prefix = position + 1
         lengths = cache_positions[:, 0] + 1
-        out = ragged_decode_attention(
-            q[:, 0], k_all, v_all, lengths, config, interpret=interpret
-        )
+        if quantized:
+            from langstream_tpu.ops.attention import ragged_decode_attention_int8
+
+            out = ragged_decode_attention_int8(
+                q[:, 0], k_all, v_all, lengths, config, interpret=interpret
+            )
+        else:
+            out = ragged_decode_attention(
+                q[:, 0], k_all, v_all, lengths, config, interpret=interpret
+            )
         return out[:, None, :]
     if s > 1 and kv_offset is not None:
         # chunked prefill: the segment attends to the whole written cache
         # prefix plus its own lower triangle (global-position causal)
         from langstream_tpu.ops.attention import flash_segment_attention
 
-        if kv_bound is not None and kv_bound < t:
-            # early segments only ever read columns < offset + S; slicing to
-            # the (static, pow2-bucketed) bound keeps int8 dequantization and
-            # kernel grid from streaming the whole mostly-unwritten cache
-            k_all = jax.tree.map(lambda x: x[:, :, :kv_bound], k_all)
-            v_all = jax.tree.map(lambda x: x[:, :, :kv_bound], v_all)
-            mask = mask[:, :, :kv_bound]
-            t = kv_bound
         if pallas_ok(config, s, t):
             return flash_segment_attention(
                 q,
@@ -517,6 +526,51 @@ def _scan_layers(
     return x, {"k": new_kv[0], "v": new_kv[1]}
 
 
+def _scan_layers_inplace(
+    params, x, sin, cos, mask, config, cache, cache_positions, kv_bound=None
+):
+    """Layer loop with the cache updated IN PLACE via a scan carry +
+    dynamic-update-slice at the layer index, instead of consuming the cache
+    as scan ``xs`` and stacking fresh ``ys``.
+
+    The xs/ys form allocates a second cache-sized buffer every call — inside
+    an outer step loop (engine `_decode_chunk`'s lax.scan) that temp is live
+    across the whole chunk, which is exactly the double-buffer that capped
+    llama-3-8b at B=48 on a 16GiB chip (serving/memory.py scan_buffer term).
+    A while-loop carry is aliased in place by XLA, and the per-layer
+    dynamic-update-slice back into the carried buffer is in-place too, so
+    peak cache memory here is 1x cache + one layer slice."""
+    layers = params["layers"]
+
+    def read(full, l):
+        return jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, l, 0, keepdims=False), full
+        )
+
+    def write(full, new, l):
+        return jax.tree.map(
+            lambda a, n: lax.dynamic_update_index_in_dim(a, n, l, 0), full, new
+        )
+
+    def body(carry, inputs):
+        x, cache = carry
+        lp, l = inputs
+        ck = read(cache["k"], l)
+        cv = read(cache["v"], l)
+        y, new_kv = _layer(
+            x, lp, sin, cos, mask, config, cache_kv=(ck, cv),
+            cache_positions=cache_positions, kv_bound=kv_bound,
+        )
+        nck, ncv = new_kv
+        cache = {"k": write(cache["k"], nck, l), "v": write(cache["v"], ncv, l)}
+        return (y, cache), None
+
+    (x, cache), _ = lax.scan(
+        body, (x, cache), (layers, jnp.arange(config.n_layers))
+    )
+    return x, cache
+
+
 # ---------------------------------------------------------------------------
 # Public entry points (all jittable; config is static)
 # ---------------------------------------------------------------------------
@@ -676,6 +730,37 @@ def decode_step(
     x = _embed(params, tokens[:, None], config)
     x, cache = _scan_layers(
         params, x, sin, cos, mask, config, cache=cache, cache_positions=pos2
+    )
+    return _unembed(params, x, config)[:, 0], cache
+
+
+def decode_step_inplace(
+    params: Params,
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    cache: KVCache,
+    config: ModelConfig,
+    kv_bound: Optional[int] = None,  # static cap on readable cache columns
+) -> tuple[jax.Array, KVCache]:
+    """decode_step with the in-place layer scan (_scan_layers_inplace) —
+    NOT separately jitted: intended as the body of a fused multi-step chunk
+    (engine `_decode_chunk`) where the xs/ys cache double-buffer would
+    otherwise persist for the whole chunk.
+
+    ``kv_bound``: static pow2 ≥ every row's position + chunk steps (the
+    engine derives it from host positions). Attention reads only the first
+    kv_bound cache columns — decode is cache-bandwidth-bound, so this is
+    the width≫content lever (see _dispatch_attention)."""
+    b = tokens.shape[0]
+    t = cache_width(cache)
+    pos2 = positions[:, None]  # [B, 1]
+    sin, cos = _rope_freqs(pos2, config)
+    kv_pos = jnp.arange(t)[None, None, :]
+    mask = kv_pos <= pos2[:, :, None]
+    x = _embed(params, tokens[:, None], config)
+    x, cache = _scan_layers_inplace(
+        params, x, sin, cos, mask, config, cache=cache, cache_positions=pos2,
+        kv_bound=kv_bound,
     )
     return _unembed(params, x, config)[:, 0], cache
 

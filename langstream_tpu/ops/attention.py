@@ -69,9 +69,13 @@ def _prefill_kernel(
     # causal: skip key blocks strictly above the diagonal
     @pl.when(k_start <= q_start + block_q - 1)
     def _body():
-        q = q_ref[0, 0, :, :, :].astype(jnp.float32)  # [G, block_q, D]
-        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [block_k, D]
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        # dots stay in the MODEL dtype (bf16 in production) with fp32
+        # accumulation — casting operands to f32 forced multi-pass f32 MXU
+        # matmuls and capped the kernel at ~14 TFLOPS effective (measured
+        # r5; the entire 19s 32k-prefill TTFT was this)
+        q = q_ref[0, 0, :, :, :]  # [G, block_q, D]
+        k = k_ref[0, 0, :, :]  # [block_k, D]
+        v = v_ref[0, 0, :, :]
         s = (
             jax.lax.dot_general(
                 q,
@@ -80,7 +84,7 @@ def _prefill_kernel(
                 preferred_element_type=jnp.float32,
             )
             * scale
-        )  # [G, block_q, block_k]
+        )  # [G, block_q, block_k] f32
         if softcap is not None:
             s = jnp.tanh(s / softcap) * softcap
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 1)
@@ -94,7 +98,7 @@ def _prefill_kernel(
         corr = jnp.exp(m_prev - m_new)
         l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
         pv = jax.lax.dot_general(
-            p,
+            p.astype(v.dtype),
             v,
             dimension_numbers=(((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -113,8 +117,8 @@ def flash_prefill_attention(
     k: jax.Array,  # [B, Hkv, S, D] head-major
     v: jax.Array,  # [B, Hkv, S, D]
     config: ModelConfig,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Causal GQA attention → [B, S, H*D]."""
@@ -201,9 +205,11 @@ def _segment_kernel(
     # plus the lower triangle within the segment
     @pl.when(k_start <= q_start + block_q - 1)
     def _body():
-        q = q_ref[0, 0, :, :, :].astype(jnp.float32)  # [G, block_q, D]
-        k = k_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        # model-dtype dots, fp32 accumulation (see _prefill_kernel note:
+        # f32-cast operands ran the MXU at ~14 TFLOPS — the 32k TTFT)
+        q = q_ref[0, 0, :, :, :]  # [G, block_q, D]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
         s = (
             jax.lax.dot_general(
                 q,
@@ -226,7 +232,7 @@ def _segment_kernel(
         corr = jnp.exp(m_prev - m_new)
         l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
         pv = jax.lax.dot_general(
-            p,
+            p.astype(v.dtype),
             v,
             dimension_numbers=(((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -246,8 +252,8 @@ def flash_segment_attention(
     v: jax.Array,  # [B, Hkv, T, D]
     offset: jax.Array,  # [B] int32 global position of the segment start
     config: ModelConfig,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     """Causal GQA attention of a segment against cache prefix + itself
@@ -429,6 +435,167 @@ def ragged_decode_attention(
         out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), qg, k, v)
+    return out.reshape(b, h * d)
+
+
+# ---------------------------------------------------------------------------
+# Decode over an INT8 cache: same ragged structure, but k/v blocks are read
+# raw int8 (+ per-token f32 scales) straight from HBM — cache bandwidth is
+# the decode bottleneck (measured r5: llama-3-8b B=96 step time 27.9ms at
+# T=256 vs 61.8ms at T=1024 — the dense masked read scales with cache WIDTH,
+# not content), and the block-skip makes it scale with the longest row
+# instead.
+# ---------------------------------------------------------------------------
+
+
+def _decode_int8_kernel(
+    lengths_ref,  # scalar-prefetch [B]
+    q_ref,  # [1, Hkv, G, D]
+    kq_ref,  # [1, Hkv, block_k, D] int8
+    ks_ref,  # [1, Hkv, block_k, 1] f32 per-token scales
+    vq_ref,  # [1, Hkv, block_k, D] int8
+    vs_ref,  # [1, Hkv, block_k, 1] f32
+    o_ref,  # [1, Hkv, G, D]
+    m_scr,  # [Hkv, G, 128] f32
+    l_scr,  # [Hkv, G, 128] f32
+    acc_scr,  # [Hkv, G, D] f32
+    *,
+    block_k: int,
+    scale: float,
+    softcap,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = lengths_ref[b]
+    k_start = j * block_k
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(k_start < length)
+    def _body():
+        # ALL kv heads ride one grid step (batched dots): an [B,Hkv,·]
+        # grid needed 8x the steps, and per-step grid overhead made the
+        # kernel LOSE to the dense masked path (592 vs 1322 tok/s, r5)
+        q = q_ref[0].astype(jnp.float32)  # [Hkv, G, D]
+        # dequantize IN VMEM: the HBM read stays int8 (the bandwidth win)
+        k = kq_ref[0].astype(jnp.float32) * ks_ref[0]  # [Hkv, block_k, D]
+        v = vq_ref[0].astype(jnp.float32) * vs_ref[0]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hkv, G, block_k]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_k), 2
+        )
+        s = jnp.where(k_pos < length, s, _NEG)
+
+        m_prev = m_scr[:, :, 0]  # [Hkv, G]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hkv, G, D]
+        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
+        m_scr[:, :, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def ragged_decode_attention_int8(
+    q: jax.Array,  # [B, H, D] single query per row
+    k: dict,  # int8 cache entry {"q": [B,Hkv,T,D] i8, "s": [B,Hkv,T] f32}
+    v: dict,
+    lengths: jax.Array,  # [B]
+    config: ModelConfig,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA decode attention over an int8 KV cache → [B, H*D].
+
+    Grid is (B, T/block_k) with every kv head inside the block — fewer,
+    fatter grid steps and ~1MB DMAs. Blocks past a row's length clamp to
+    its last valid block (DMA elided), so HBM traffic scales with CONTENT
+    (sum of lengths), not cache width, and stays int8 on the wire.
+
+    Differs from the jnp int8 path in q handling (q stays full precision
+    here; the jnp path re-quantizes q to ride the int8 MXU) — slightly MORE
+    accurate, same K/V math."""
+    b, h, d = q.shape
+    hkv = k["q"].shape[1]
+    t = k["q"].shape[2]
+    group = h // hkv
+    block_k = min(block_k, t)
+    assert t % block_k == 0, "caller gates divisibility"
+    qg = q.reshape(b, hkv, group, d)
+
+    kernel = functools.partial(
+        _decode_int8_kernel,
+        block_k=block_k,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+
+    def kv_index(b, j, lens):
+        # clamp past-length blocks to the row's last valid block: Pallas
+        # re-references the same block and elides the HBM→VMEM DMA
+        last = jnp.maximum(pl.cdiv(lens[b], block_k) - 1, 0)
+        return (b, 0, jnp.minimum(j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, hkv, group, d), lambda b, j, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, block_k, d), kv_index),
+            # trailing singleton: Mosaic needs the block's last two dims
+            # (8,128)-divisible or equal to the array's — [.., block_k, 1]
+            pl.BlockSpec((1, hkv, block_k, 1), kv_index),
+            pl.BlockSpec((1, hkv, block_k, d), kv_index),
+            pl.BlockSpec((1, hkv, block_k, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hkv, group, d), lambda b, j, lens: (b, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, group, 128), jnp.float32),
+            pltpu.VMEM((hkv, group, 128), jnp.float32),
+            pltpu.VMEM((hkv, group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        qg,
+        k["q"],
+        k["s"][..., None],
+        v["q"],
+        v["s"][..., None],
+    )
     return out.reshape(b, h * d)
 
 

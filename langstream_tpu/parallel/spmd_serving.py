@@ -289,4 +289,5 @@ def _replay(engine: Any, block: ControlBlock) -> None:
                 block.long_idx,
             )
     elif block.op == OP_DECODE:
-        engine._dev_decode(block.steps, block.slots)
+        # kv_bound=0 replays pre-bound announcements as unbounded
+        engine._dev_decode(block.steps, block.slots, block.kv_bound or None)

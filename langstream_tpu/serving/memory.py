@@ -38,10 +38,11 @@ class ServingMemoryPlan:
     cache_bytes: int  # decode cache: max_batch × max_seq_len
     long_cache_bytes: int  # chunked-prefill local cache (one prompt wide)
     workspace_bytes: int  # XLA scratch / activation headroom estimate
-    # XLA double-buffers the cache inside the fused decode scan
-    # (_decode_chunk's lax.scan carries it): the compiler allocates a
-    # second cache-sized HLO temp. Observed on v5e: llama-3-8b int8 B=64
-    # OOMs at exactly weights + 2x cache despite weights+cache fitting.
+    # Residual decode-chunk temp: ONE LAYER's cache slice. The layer scan
+    # carries the cache and updates it in place via dynamic-update-slice
+    # (transformer._scan_layers_inplace), so the old cache-sized xs/ys
+    # double-buffer is gone (r4 it OOMed llama-3-8b past B=48); what
+    # remains live is the current layer's read slice + its updated copy.
     scan_buffer_bytes: int = 0
 
     @property
@@ -111,7 +112,8 @@ def plan_serving_memory(
         cache_bytes=cache_bytes,
         long_cache_bytes=_tree_bytes(long_shape) if long_shape else 0,
         workspace_bytes=workspace_bytes,
-        scan_buffer_bytes=cache_bytes,
+        # 2 layer slices (read + updated copy) live inside the chunk scan
+        scan_buffer_bytes=2 * cache_bytes // max(config.n_layers, 1),
     )
 
 

@@ -368,3 +368,98 @@ def test_lookup_redirect_to_owner_broker(run):
             await owner.stop()
 
     run(main())
+
+
+def test_batched_payload_explodes_per_entry():
+    """JVM producers batch by default: num_messages_in_batch>1 with
+    [size][SingleMessageMetadata][payload] framing must yield one record
+    per entry, per-entry keys/properties authoritative (ADVICE r4)."""
+    from langstream_tpu.messaging.pulsar import _explode_frame
+
+    entries = []
+    for i in range(3):
+        smm = {
+            "payload_size": len(f"payload-{i}"),
+            "partition_key": f"key-{i}",
+            "properties": [{"key": "idx", "value": str(i)}],
+        }
+        body = wire.encode_message(wire.SINGLE_MESSAGE_METADATA, smm)
+        entries.append(
+            len(body).to_bytes(4, "big") + body + f"payload-{i}".encode()
+        )
+    metadata = {
+        "producer_name": "p",
+        "sequence_id": 9,
+        "publish_time": 123000,
+        "num_messages_in_batch": 3,
+        "partition_key": "outer-key",  # batch-level; entries override
+    }
+    out = _explode_frame(metadata, b"".join(entries))
+    assert len(out) == 3
+    for i, (md, payload, bindex, emitted) in enumerate(out):
+        assert payload == f"payload-{i}".encode()
+        assert md["partition_key"] == f"key-{i}"
+        assert bindex == i and emitted == 3
+        assert {p["key"]: p["value"] for p in md["properties"]} == {"idx": str(i)}
+    # unbatched passes through untouched
+    solo = _explode_frame({"publish_time": 1}, b"x")
+    assert solo == [({"publish_time": 1}, b"x", -1, 1)]
+
+
+def test_batched_compression_raises_explicitly():
+    from langstream_tpu.messaging.pulsar import (
+        PulsarProtocolError,
+        _explode_frame,
+    )
+
+    with pytest.raises(PulsarProtocolError, match="compression"):
+        _explode_frame({"compression": 2, "num_messages_in_batch": 2}, b"zz")
+
+
+def test_batch_ack_waits_for_all_entries(run):
+    """A batch's wire message id must not ack until EVERY emitted entry
+    committed — the broker redelivers whole batches."""
+    from langstream_tpu.messaging.memory import ConsumedRecord
+    from langstream_tpu.messaging.pulsar import PulsarTopicConsumer
+
+    consumer = PulsarTopicConsumer.__new__(PulsarTopicConsumer)
+    consumer._inflight = {}
+    consumer._batch_left = {}
+
+    acked = []
+
+    class _Conn:
+        async def fire(self, name, fields):
+            acked.append(fields)
+
+    consumer._subs = {0: {"consumer_id": 7, "conn": _Conn()}}
+    mid = {"ledger_id": 3, "entry_id": 44}
+    records = []
+    for i in range(3):
+        consumer._inflight[(0, i)] = {
+            "consumer_id": 7,
+            "message_id": mid,
+            "batch_index": i,
+            "batch_emitted": 3,
+        }
+        records.append(
+            ConsumedRecord(
+                value=b"", key=None, headers=(), origin="t",
+                timestamp=0.0, partition=0, offset=i,
+            )
+        )
+    run(consumer.commit([records[0]]))
+    run(consumer.commit([records[1]]))
+    assert acked == []  # two of three entries committed: no ack yet
+    run(consumer.commit([records[2]]))
+    assert len(acked) == 1 and acked[0]["message_id"] == [mid]
+    assert consumer._batch_left == {}
+
+
+def test_pack_mid_wide_entries_roundtrip():
+    from langstream_tpu.messaging.pulsar import _pack_mid, _unpack_mid
+
+    for ledger, entry in [(0, 0), (7, 5_000_000), (1 << 40, (1 << 32) - 1)]:
+        assert _unpack_mid(_pack_mid(ledger, entry)) == (ledger, entry)
+    with pytest.raises(ValueError):
+        _pack_mid(1, 1 << 32)

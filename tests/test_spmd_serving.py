@@ -171,3 +171,45 @@ def test_loopback_ring_prefill_lockstep():
         jax.tree.leaves(jax.device_get(follower._cache)),
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loopback_moe_lockstep_on_expert_mesh():
+    """MoE decode under SPMD: leader + follower engines on the SAME
+    expert×model mesh (mixtral-style ep×tp sharding), every dispatch
+    announced over the channel — device state bit-identical after serving.
+    This is the multi-host story for BASELINE config #5."""
+    from langstream_tpu.parallel.mesh import build_mesh
+    from langstream_tpu.parallel.sharding import shard_params
+
+    config = dataclasses.replace(MODEL_PRESETS["tiny-moe-test"], dtype="float32")
+    mesh = build_mesh({"expert": 4, "model": 2})
+    params = shard_params(init_params(config, jax.random.PRNGKey(2)), mesh, config)
+    channel = LoopbackChannel(prefill_batch=2, max_width=32, max_batch=2)
+    mk = lambda spmd: ServingEngine(  # noqa: E731
+        config, params, max_batch=2, max_seq_len=64, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=2, mesh=mesh, spmd=spmd,
+    )
+    leader, follower = mk(channel), mk(None)
+    follower_thread = threading.Thread(
+        target=follower_loop, args=(follower, channel), daemon=True
+    )
+    follower_thread.start()
+    leader.start()
+    try:
+        opts = GenerationOptions(max_new_tokens=5, temperature=0.0)
+        r1 = leader.generate([5, 6, 7], opts, timeout=300)
+        r2 = leader.generate([9, 2], opts, timeout=300)
+        assert len(r1.tokens) == 5 and len(r2.tokens) == 5
+    finally:
+        leader.stop()
+    follower_thread.join(timeout=60)
+    assert not follower_thread.is_alive(), "follower never saw STOP"
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(leader._tokens_dev)),
+        np.asarray(jax.device_get(follower._tokens_dev)),
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(leader._cache)),
+        jax.tree.leaves(jax.device_get(follower._cache)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
