@@ -35,6 +35,7 @@ import json
 import logging
 import time
 import uuid
+from collections import deque
 from typing import Any, Optional
 from urllib.parse import urlparse
 
@@ -580,6 +581,8 @@ class PulsarTopicConsumer(TopicConsumer):
         self._inflight: dict[tuple[int, int], dict] = {}  # (partition, local) → ack info
         # (consumer_id, ledger, entry) → emitted batch entries still unacked
         self._batch_left: dict[tuple[int, int, int], int] = {}
+        # exploded batch entries past a read() call's max_records cap
+        self._spill: deque = deque()
         self._total_out = 0
 
     async def start(self) -> None:
@@ -630,24 +633,36 @@ class PulsarTopicConsumer(TopicConsumer):
     async def _replenish(self, sub: dict[str, Any]) -> None:
         await _flow_replenish(sub, self.receiver_queue_size)
 
-    async def _resubscribe(self, sub: dict[str, Any]) -> None:
+    async def _resubscribe(self, partition: int, sub: dict[str, Any]) -> None:
         """Re-establish a subscription whose broker connection dropped: new
         LOOKUP (ownership may have moved), fresh registration on the new
         connection, full permit grant. Delivered-but-unacked messages
-        redeliver through the broker cursor, so no client state is lost."""
+        redeliver through the broker cursor (at-least-once), so the
+        pre-drop delivery state is DISCARDED here: stale _inflight entries
+        become commit no-ops, stale _batch_left counts would otherwise ack
+        a redelivered batch after its FIRST commit (data loss), and spilled
+        not-yet-returned entries would duplicate the redelivery."""
         log.warning(
             "pulsar consumer resubscribing to %s after connection loss",
             sub["topic"],
         )
+        cid = sub["consumer_id"]
+        self._inflight = {
+            k: v for k, v in self._inflight.items() if v["consumer_id"] != cid
+        }
+        self._batch_left = {
+            k: v for k, v in self._batch_left.items() if k[0] != cid
+        }
+        self._spill = deque(e for e in self._spill if e[0] != partition)
         conn = await self.client.conn_for_topic(sub["topic"])
-        queue = conn.register_consumer(sub["consumer_id"])
+        queue = conn.register_consumer(cid)
         await conn.request(
             "subscribe",
             {
                 "topic": sub["topic"],
                 "subscription": self.subscription,
                 "sub_type": SUB_SHARED,
-                "consumer_id": sub["consumer_id"],
+                "consumer_id": cid,
                 "consumer_name": f"{self.subscription}-{uuid.uuid4().hex[:8]}",
                 "durable": 1,
                 "initial_position": POSITION_EARLIEST,
@@ -656,7 +671,7 @@ class PulsarTopicConsumer(TopicConsumer):
         await conn.fire(
             "flow",
             {
-                "consumer_id": sub["consumer_id"],
+                "consumer_id": cid,
                 "message_permits": self.receiver_queue_size,
             },
         )
@@ -664,14 +679,34 @@ class PulsarTopicConsumer(TopicConsumer):
             {"conn": conn, "queue": queue, "permits": self.receiver_queue_size}
         )
 
+    def _emit(self, entry: tuple) -> Record:
+        partition, consumer_id, mid, entry_md, entry_payload, bindex, emitted = entry
+        local = next(self._offsets)
+        self._inflight[(partition, local)] = {
+            "consumer_id": consumer_id,
+            "message_id": mid,
+            "batch_index": bindex,
+            "batch_emitted": emitted,
+        }
+        return _message_to_consumed(
+            self.topic_name, partition, local, entry_md, entry_payload
+        )
+
     async def read(self) -> list[Record]:
         out: list[Record] = []
         deadline = asyncio.get_running_loop().time() + self.poll_timeout
         while len(out) < self.max_records:
+            # batch entries beyond a previous call's max_records cap wait in
+            # the spill and are returned FIRST — a 100-entry JVM batch must
+            # not overrun the caller's cap, nor lose its tail
+            while self._spill and len(out) < self.max_records:
+                out.append(self._emit(self._spill.popleft()))
+            if len(out) >= self.max_records:
+                break
             got_any = False
             for partition, sub in self._subs.items():
                 if sub["conn"].dead:
-                    await self._resubscribe(sub)
+                    await self._resubscribe(partition, sub)
                 try:
                     fields, metadata, payload = sub["queue"].get_nowait()
                 except asyncio.QueueEmpty:
@@ -681,19 +716,14 @@ class PulsarTopicConsumer(TopicConsumer):
                 for entry_md, entry_payload, bindex, emitted in _explode_frame(
                     metadata or {}, payload
                 ):
-                    local = next(self._offsets)
-                    self._inflight[(partition, local)] = {
-                        "consumer_id": sub["consumer_id"],
-                        "message_id": mid,
-                        "batch_index": bindex,
-                        "batch_emitted": emitted,
-                    }
-                    out.append(
-                        _message_to_consumed(
-                            self.topic_name, partition, local, entry_md,
-                            entry_payload,
-                        )
+                    entry = (
+                        partition, sub["consumer_id"], mid, entry_md,
+                        entry_payload, bindex, emitted,
                     )
+                    if len(out) < self.max_records:
+                        out.append(self._emit(entry))
+                    else:
+                        self._spill.append(entry)
                 await self._replenish(sub)
                 if len(out) >= self.max_records:
                     break
@@ -927,11 +957,14 @@ class PulsarTopicReader(TopicReader):
     async def _resubscribe(self, partition: int, sub: dict[str, Any]) -> None:
         """Reader reconnect: fresh non-durable subscription + SEEK back to
         the last delivered position, so resume semantics survive a broker
-        connection drop."""
+        connection drop. With no delivered position yet, the configured
+        initial position is honored — a LATEST tail-follower must not
+        replay the whole retained backlog after a drop."""
         log.warning(
             "pulsar reader resubscribing to %s after connection loss",
             sub["topic"],
         )
+        packed = self._pos.get(partition)
         conn = await self.client.conn_for_topic(sub["topic"])
         queue = conn.register_consumer(sub["consumer_id"])
         await conn.request(
@@ -943,10 +976,15 @@ class PulsarTopicReader(TopicReader):
                 "consumer_id": sub["consumer_id"],
                 "consumer_name": f"reader-{sub['consumer_id']}",
                 "durable": 0,
-                "initial_position": POSITION_EARLIEST,
+                "initial_position": (
+                    POSITION_LATEST
+                    if packed is None
+                    and self.initial_position.position
+                    == TopicOffsetPosition.LATEST
+                    else POSITION_EARLIEST
+                ),
             },
         )
-        packed = self._pos.get(partition)
         if packed is not None:
             ledger_id, entry_id = _unpack_mid(packed)
             await conn.request(

@@ -34,6 +34,17 @@ from langstream_tpu.models.configs import ModelConfig
 _NEG = -1e30
 
 
+def _fit_block(block: int, n: int) -> int:
+    """Largest block ≤ ``block`` that divides ``n``. pallas_ok blesses any
+    128-multiple length, so a 512 default block must step down (512 → 256 →
+    128) for lengths like 640/768 rather than tripping the divisibility
+    assert."""
+    block = min(block, n)
+    while block > 1 and n % block != 0:
+        block //= 2
+    return block
+
+
 # ---------------------------------------------------------------------------
 # Prefill: causal blocked flash attention
 # ---------------------------------------------------------------------------
@@ -125,8 +136,8 @@ def flash_prefill_attention(
     b, s, h, d = q.shape
     hkv = k.shape[1]
     group = h // hkv
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, "caller gates divisibility"
     # head-major queries: [B, Hkv, G, S, D] so the blocked dims are (S, D)
     qg = q.reshape(b, s, hkv, group, d).transpose(0, 2, 3, 1, 4)
@@ -263,8 +274,8 @@ def flash_segment_attention(
     hkv = k.shape[1]
     t = k.shape[2]
     group = h // hkv
-    block_q = min(block_q, s)
-    block_k = min(block_k, t)
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, t)
     assert s % block_q == 0 and t % block_k == 0, "caller gates divisibility"
     qg = q.reshape(b, s, hkv, group, d).transpose(0, 2, 3, 1, 4)
 
@@ -392,7 +403,7 @@ def ragged_decode_attention(
     hkv = k.shape[1]
     t = k.shape[2]
     group = h // hkv
-    block_k = min(block_k, t)
+    block_k = _fit_block(block_k, t)
     assert t % block_k == 0, "caller gates divisibility"
     qg = q.reshape(b, hkv, group, d)
 
@@ -545,7 +556,7 @@ def ragged_decode_attention_int8(
     hkv = k["q"].shape[1]
     t = k["q"].shape[2]
     group = h // hkv
-    block_k = min(block_k, t)
+    block_k = _fit_block(block_k, t)
     assert t % block_k == 0, "caller gates divisibility"
     qg = q.reshape(b, hkv, group, d)
 
