@@ -52,6 +52,9 @@ class CqlConnection:
         self._pending: dict[int, asyncio.Future] = {}
         self._streams = itertools.cycle(range(1, 32768))
         self._write_lock = asyncio.Lock()
+        # statement → (prepared id, server-declared bind types)
+        self._prepared: dict[str, tuple[bytes, list[Any]]] = {}
+        self._prepare_unsupported = False
 
     async def connect(self) -> None:
         ssl_ctx = ssl_mod.create_default_context() if self.tls else None
@@ -121,23 +124,67 @@ class CqlConnection:
                 pass
             self._writer = None
 
-    async def query(
-        self, statement: str, values: Optional[list[Any]] = None
-    ) -> dict[str, Any]:
+    async def _call(self, opcode: int, payload: bytes) -> dict[str, Any]:
         assert self._writer is not None, "not connected"
         stream = next(self._streams)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[stream] = fut
-        data = wire.frame(wire.OP_QUERY, wire.query_body(statement, values), stream)
+        data = wire.frame(opcode, payload, stream)
         async with self._write_lock:
             self._writer.write(data)
             await self._writer.drain()
-        opcode, body = await asyncio.wait_for(fut, timeout=30)
-        if opcode == wire.OP_ERROR:
+        resp_opcode, body = await asyncio.wait_for(fut, timeout=30)
+        if resp_opcode == wire.OP_ERROR:
             raise wire.parse_error_body(body)
-        if opcode != wire.OP_RESULT:
-            raise wire.CqlError(0, f"unexpected opcode 0x{opcode:02x}")
+        if resp_opcode != wire.OP_RESULT:
+            raise wire.CqlError(0, f"unexpected opcode 0x{resp_opcode:02x}")
         return wire.parse_result_body(body)
+
+    async def query(
+        self, statement: str, values: Optional[list[Any]] = None
+    ) -> dict[str, Any]:
+        """Run a statement. With bound values the path is PREPARE + EXECUTE
+        so values are encoded with the SERVER-declared column types —
+        guess_type's widths (python int → 8-byte bigint, numeric list →
+        float32 vector) are rejected or mis-decoded by real Cassandra/Astra
+        for int/smallint/float/list<double> columns. Plain QUERY with
+        guessed types remains only as a fallback for servers without
+        PREPARE (e.g. minimal test stubs)."""
+        if not values:
+            return await self._call(wire.OP_QUERY, wire.query_body(statement))
+        if not self._prepare_unsupported:
+            try:
+                return await self._execute_prepared(statement, values)
+            except wire.CqlError as e:
+                if e.code != 0x000A:  # "unsupported opcode"
+                    raise
+                self._prepare_unsupported = True
+        return await self._call(
+            wire.OP_QUERY, wire.query_body(statement, values)
+        )
+
+    async def _execute_prepared(
+        self, statement: str, values: list[Any]
+    ) -> dict[str, Any]:
+        entry = self._prepared.get(statement)
+        if entry is None:
+            prepared = await self._call(
+                wire.OP_PREPARE, wire.prepare_body(statement)
+            )
+            if prepared.get("kind") != "prepared":
+                raise wire.CqlError(0, f"bad PREPARE result: {prepared}")
+            entry = (prepared["id"], prepared["bind_types"])
+            self._prepared[statement] = entry
+        prepared_id, bind_types = entry
+        try:
+            return await self._call(
+                wire.OP_EXECUTE, wire.execute_body(prepared_id, bind_types, values)
+            )
+        except wire.CqlError as e:
+            if e.code != 0x2500:  # UNPREPARED: id evicted server-side
+                raise
+            self._prepared.pop(statement, None)
+            return await self._execute_prepared(statement, values)
 
 
 class CassandraDataSource(DataSource):

@@ -22,6 +22,7 @@ network egress.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import math
 import re
@@ -119,6 +120,8 @@ class FakeCassandra:
         self._server: Optional[asyncio.base_events.Server] = None
         self._writers: set[asyncio.StreamWriter] = set()
         self.queries: list[str] = []  # observability for tests
+        # prepared id → (query, server-declared bind types)
+        self._prepared: dict[bytes, tuple[str, list[Any]]] = {}
 
     async def start(self) -> "FakeCassandra":
         self._server = await asyncio.start_server(self._serve, self.host, self.port)
@@ -213,6 +216,66 @@ class FakeCassandra:
                                 stream,
                                 wire.VERSION_RESPONSE,
                             )
+                elif opcode in (wire.OP_PREPARE, wire.OP_EXECUTE) and not authenticated:
+                    out = wire.frame(
+                        wire.OP_ERROR,
+                        wire.error_body(0x0100, "not authenticated"),
+                        stream,
+                        wire.VERSION_RESPONSE,
+                    )
+                elif opcode == wire.OP_PREPARE:
+                    query = wire.parse_prepare_body(body)
+                    self.queries.append(f"PREPARE: {query}")
+                    try:
+                        bind_types = self._bind_types(query, keyspace)
+                        prepared_id = hashlib.md5(query.encode()).digest()
+                        self._prepared[prepared_id] = (query, bind_types)
+                        out = wire.frame(
+                            wire.OP_RESULT,
+                            wire.prepared_result_body(prepared_id, bind_types),
+                            stream,
+                            wire.VERSION_RESPONSE,
+                        )
+                    except wire.CqlError as e:
+                        out = wire.frame(
+                            wire.OP_ERROR,
+                            wire.error_body(e.code, e.message),
+                            stream,
+                            wire.VERSION_RESPONSE,
+                        )
+                elif opcode == wire.OP_EXECUTE:
+                    prepared_id, raw_values, _ = wire.parse_execute_body(body)
+                    entry = self._prepared.get(prepared_id)
+                    if entry is None:
+                        out = wire.frame(
+                            wire.OP_ERROR,
+                            wire.error_body(0x2500, "unprepared statement"),
+                            stream,
+                            wire.VERSION_RESPONSE,
+                        )
+                    else:
+                        query, _ = entry
+                        self.queries.append(query)
+                        try:
+                            result = self._execute(query, raw_values, keyspace)
+                            out = wire.frame(
+                                wire.OP_RESULT, result, stream, wire.VERSION_RESPONSE
+                            )
+                        except wire.CqlError as e:
+                            out = wire.frame(
+                                wire.OP_ERROR,
+                                wire.error_body(e.code, e.message),
+                                stream,
+                                wire.VERSION_RESPONSE,
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            log.exception("fake cassandra: execute failed: %s", query)
+                            out = wire.frame(
+                                wire.OP_ERROR,
+                                wire.error_body(0x2000, str(e)),
+                                stream,
+                                wire.VERSION_RESPONSE,
+                            )
                 elif opcode == wire.OP_OPTIONS:
                     out = wire.frame(
                         wire.OP_SUPPORTED,
@@ -236,6 +299,58 @@ class FakeCassandra:
             writer.close()
 
     # -- statement engine ----------------------------------------------------
+
+    def _bind_types(
+        self, query: str, keyspace: list[Optional[str]]
+    ) -> list[Any]:
+        """Server side of PREPARE: the declared CQL type of each ``?``
+        marker, in order — what a real node derives from the schema. The
+        point of the whole prepared path: clients must encode `int` columns
+        as 4 bytes, `float` as 4, `list<double>` as doubles, which
+        guess_type cannot know."""
+        q = query.strip().rstrip(";")
+        table: Optional[_Table] = None
+        m = re.match(
+            r"(?:INSERT\s+INTO|UPDATE|DELETE\s+FROM|SELECT\s+.*?\s+FROM)\s+([\w\".]+)",
+            q, re.I | re.S,
+        )
+        if m:
+            table = self.tables.get(self._resolve(m.group(1), keyspace))
+
+        def col_type(name: str) -> Any:
+            name = name.replace('"', "")
+            if table is not None and name in table.columns:
+                return table.columns[name]
+            return wire.T_VARCHAR
+
+        im = re.match(
+            r"INSERT\s+INTO\s+[\w\".]+\s*\(([^)]*)\)\s*VALUES\s*\((.*)\)",
+            q, re.I | re.S,
+        )
+        if im:
+            cols = [c.strip() for c in im.group(1).split(",")]
+            vals = self._split_args(im.group(2))
+            return [
+                col_type(c) for c, v in zip(cols, vals) if v.strip() == "?"
+            ]
+        types: list[Any] = []
+        for pos in (mm.start() for mm in re.finditer(r"\?", q)):
+            before = q[:pos]
+            cm = re.search(
+                r"([\w\".]+)\s*(?:=|>=|<=|>|<|CONTAINS)\s*$", before, re.I
+            )
+            if cm:
+                types.append(col_type(cm.group(1)))
+                continue
+            am = re.search(r"ORDER\s+BY\s+([\w\".]+)\s+ANN\s+OF\s*$", before, re.I)
+            if am:
+                types.append(col_type(am.group(1)))
+                continue
+            if re.search(r"LIMIT\s*$", before, re.I):
+                types.append(wire.T_INT)
+                continue
+            types.append(wire.T_VARCHAR)
+        return types
 
     def _resolve(self, name: str, keyspace: list[Optional[str]]) -> tuple[str, str]:
         name = name.replace('"', "")

@@ -130,6 +130,11 @@ class Writer:
             self.buf += b
         return self
 
+    def short_bytes(self, b: bytes) -> "Writer":
+        self.u16(len(b))
+        self.buf += b
+        return self
+
     def string_map(self, m: dict[str, str]) -> "Writer":
         self.u16(len(m))
         for k, v in m.items():
@@ -193,6 +198,12 @@ class Reader:
 
     def string_map(self) -> dict[str, str]:
         return {self.string(): self.string() for _ in range(self.u16())}
+
+    def short_bytes(self) -> bytes:
+        n = self.u16()
+        b = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return b
 
     def remaining(self) -> int:
         return len(self.buf) - self.pos
@@ -422,6 +433,73 @@ def query_body(
     return w.build()
 
 
+def prepare_body(query: str) -> bytes:
+    return Writer().long_string(query).build()
+
+
+def execute_body(
+    prepared_id: bytes,
+    bind_types: list[Any],
+    values: list[Any],
+    consistency: int = CONSISTENCY_LOCAL_QUORUM,
+) -> bytes:
+    """EXECUTE of a prepared statement: values encoded with the
+    SERVER-declared bind types (native protocol v4 §4.1.6) — the reason
+    prepared statements exist: an `int`/`smallint`/`float` column rejects
+    the widths guess_type would pick for plain python numbers."""
+    if len(values) != len(bind_types):
+        raise CqlError(
+            0x2200,
+            f"statement has {len(bind_types)} bind markers but "
+            f"{len(values)} values were supplied",
+        )
+    w = Writer().short_bytes(prepared_id)
+    w.u16(consistency)
+    if values:
+        w.u8(QUERY_FLAG_VALUES)
+        w.u16(len(values))
+        for type_, v in zip(bind_types, values):
+            w.bytes_(encode_value(type_, v))
+    else:
+        w.u8(0)
+    return w.build()
+
+
+def prepared_result_body(prepared_id: bytes, bind_types: list[Any]) -> bytes:
+    """RESULT/Prepared (v4 §4.2.5.4): id + bind-variable metadata (types the
+    client must use in EXECUTE) + empty result metadata (NO_METADATA)."""
+    w = Writer().i32(RESULT_PREPARED)
+    w.short_bytes(prepared_id)
+    w.i32(0)  # metadata flags: no global table spec
+    w.i32(len(bind_types))
+    w.i32(0)  # pk_count
+    for i, type_ in enumerate(bind_types):
+        w.string("")  # keyspace
+        w.string("")  # table
+        w.string(f"p{i}")
+        write_type(w, type_)
+    w.i32(0x0004)  # result metadata: NO_METADATA
+    w.i32(0)
+    return w.build()
+
+
+def parse_prepare_body(body: bytes) -> str:
+    return Reader(body).long_string()
+
+
+def parse_execute_body(body: bytes) -> tuple[bytes, list[Optional[bytes]], int]:
+    """Server side: → (prepared id, raw value blobs, consistency)."""
+    r = Reader(body)
+    prepared_id = r.short_bytes()
+    consistency = r.u16()
+    flags = r.u8()
+    raw_values: list[Optional[bytes]] = []
+    if flags & QUERY_FLAG_VALUES:
+        n = r.u16()
+        raw_values = [r.bytes_() for _ in range(n)]
+    return prepared_id, raw_values, consistency
+
+
 def parse_query_body(body: bytes) -> tuple[str, list[Optional[bytes]], int]:
     """Server side: → (query, raw value blobs, consistency)."""
     r = Reader(body)
@@ -489,6 +567,24 @@ def parse_result_body(body: bytes) -> dict[str, Any]:
         return {"kind": "set_keyspace", "keyspace": r.string()}
     if kind == RESULT_SCHEMA_CHANGE:
         return {"kind": "schema_change", "change": r.string(), "target": r.string()}
+    if kind == RESULT_PREPARED:
+        prepared_id = r.short_bytes()
+        flags = r.i32()
+        n_cols = r.i32()
+        pk_count = r.i32()
+        for _ in range(pk_count):
+            r.u16()
+        if flags & ROWS_FLAG_GLOBAL_TABLES_SPEC:
+            r.string()
+            r.string()
+        bind_types: list[Any] = []
+        for _ in range(n_cols):
+            if not flags & ROWS_FLAG_GLOBAL_TABLES_SPEC:
+                r.string()
+                r.string()
+            r.string()  # name
+            bind_types.append(read_type(r))
+        return {"kind": "prepared", "id": prepared_id, "bind_types": bind_types}
     if kind != RESULT_ROWS:
         return {"kind": f"unknown_{kind}"}
     flags = r.i32()

@@ -696,6 +696,12 @@ class PulsarTopicConsumer(TopicConsumer):
         out: list[Record] = []
         deadline = asyncio.get_running_loop().time() + self.poll_timeout
         while len(out) < self.max_records:
+            # dead-connection handling FIRST: _resubscribe discards the
+            # dropped partition's spilled entries (the broker will redeliver
+            # them), so the spill must not be emitted before that check runs
+            for partition, sub in self._subs.items():
+                if sub["conn"].dead:
+                    await self._resubscribe(partition, sub)
             # batch entries beyond a previous call's max_records cap wait in
             # the spill and are returned FIRST — a 100-entry JVM batch must
             # not overrun the caller's cap, nor lose its tail
@@ -705,8 +711,6 @@ class PulsarTopicConsumer(TopicConsumer):
                 break
             got_any = False
             for partition, sub in self._subs.items():
-                if sub["conn"].dead:
-                    await self._resubscribe(partition, sub)
                 try:
                     fields, metadata, payload = sub["queue"].get_nowait()
                 except asyncio.QueueEmpty:

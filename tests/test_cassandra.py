@@ -133,6 +133,54 @@ def test_ddl_insert_select_ann(cass, run):
     run(main())
 
 
+def test_prepared_statements_use_declared_types(cass, run):
+    """Bound values ride PREPARE/EXECUTE with SERVER-declared types: an
+    `int` column binds as 4 bytes and a `float` column as 4 bytes even
+    though python ints/floats guess to bigint/double — the widths real
+    Cassandra rejects from the unprepared path (ADVICE r4)."""
+
+    async def main():
+        broker = await cass.start()
+        ds = CassandraDataSource({"contact-points": broker.contact_point})
+        try:
+            await ds.execute_statement(
+                "CREATE KEYSPACE IF NOT EXISTS tk WITH replication = "
+                "{'class': 'SimpleStrategy', 'replication_factor': 1}",
+                [],
+            )
+            await ds.execute_statement(
+                "CREATE TABLE IF NOT EXISTS tk.t ("
+                "id text PRIMARY KEY, n int, score float, xs list<double>)",
+                [],
+            )
+            await ds.execute_statement(
+                "INSERT INTO tk.t (id, n, score, xs) VALUES (?, ?, ?, ?)",
+                ["a", 7, 1.5, [0.25, 0.5]],
+            )
+            rows = await ds.fetch_data(
+                "SELECT n, score, xs FROM tk.t WHERE id = ?", ["a"]
+            )
+            assert rows == [{"n": 7, "score": 1.5, "xs": [0.25, 0.5]}]
+            # the fake really served PREPARE (not the guess-typed fallback)
+            assert any(q.startswith("PREPARE: INSERT") for q in broker.queries)
+            # and the declared bind types drove the wire widths
+            prepared = {
+                q: types
+                for _, (q, types) in broker._prepared.items()
+            }
+            insert_types = next(
+                t for q, t in prepared.items() if q.startswith("INSERT")
+            )
+            assert insert_types == [
+                wire.T_VARCHAR, wire.T_INT, wire.T_FLOAT,
+                ("list", wire.T_DOUBLE),
+            ]
+        finally:
+            await ds.close()
+
+    run(main())
+
+
 def test_astra_token_auth(cass, run):
     async def main():
         broker = await cass.start(require_auth=("token", "AstraCS:test-token"))
