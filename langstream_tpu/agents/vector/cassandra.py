@@ -164,7 +164,7 @@ class CqlConnection:
         )
 
     async def _execute_prepared(
-        self, statement: str, values: list[Any]
+        self, statement: str, values: list[Any], *, retried: bool = False
     ) -> dict[str, Any]:
         entry = self._prepared.get(statement)
         if entry is None:
@@ -181,10 +181,12 @@ class CqlConnection:
                 wire.OP_EXECUTE, wire.execute_body(prepared_id, bind_types, values)
             )
         except wire.CqlError as e:
-            if e.code != 0x2500:  # UNPREPARED: id evicted server-side
+            # UNPREPARED (id evicted server-side): re-prepare ONCE — a
+            # server that rejects even a fresh id must surface, not recurse
+            if e.code != 0x2500 or retried:
                 raise
             self._prepared.pop(statement, None)
-            return await self._execute_prepared(statement, values)
+            return await self._execute_prepared(statement, values, retried=True)
 
 
 class CassandraDataSource(DataSource):

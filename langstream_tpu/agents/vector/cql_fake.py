@@ -334,7 +334,10 @@ class FakeCassandra:
                 col_type(c) for c, v in zip(cols, vals) if v.strip() == "?"
             ]
         types: list[Any] = []
-        for pos in (mm.start() for mm in re.finditer(r"\?", q)):
+        # blank quoted literals (length-preserving) so a '?' inside a string
+        # is not counted as a bind marker
+        scrubbed = re.sub(r"'[^']*'", lambda m: "'" + " " * (len(m.group()) - 2) + "'", q)
+        for pos in (mm.start() for mm in re.finditer(r"\?", scrubbed)):
             before = q[:pos]
             cm = re.search(
                 r"([\w\".]+)\s*(?:=|>=|<=|>|<|CONTAINS)\s*$", before, re.I

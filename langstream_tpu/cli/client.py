@@ -115,8 +115,41 @@ class AdminClient:
     def delete(self, name: str) -> dict[str, Any]:
         return self._request("DELETE", f"/api/applications/{self.tenant}/{name}").json()
 
-    def logs(self, name: str) -> str:
-        return self._request("GET", f"/api/applications/{self.tenant}/{name}/logs").text
+    def logs(self, name: str, replica: str = "") -> str:
+        params = {"filter": replica} if replica else None
+        return self._request(
+            "GET",
+            f"/api/applications/{self.tenant}/{name}/logs",
+            params=params,
+        ).text
+
+    def follow_logs(self, name: str, replica: str = ""):
+        """Yield live log entries (dicts) from the NDJSON follow stream —
+        the CLI `apps logs -f` tail. Blocks until the server closes or the
+        caller stops iterating; the connection closes either way."""
+        import json as json_mod
+
+        params = {"follow": "1"}
+        if replica:
+            params["filter"] = replica
+        resp = requests.get(
+            f"{self.base_url}/api/applications/{self.tenant}/{name}/logs",
+            headers=self._headers(),
+            params=params,
+            stream=True,
+            timeout=(10, None),
+        )
+        try:
+            if resp.status_code >= 400:
+                raise AdminClientError(
+                    f"logs follow → {resp.status_code}: {resp.text}",
+                    resp.status_code,
+                )
+            for line in resp.iter_lines():
+                if line:
+                    yield json_mod.loads(line)
+        finally:
+            resp.close()
 
     def download(self, name: str) -> bytes:
         return self._request(

@@ -189,10 +189,18 @@ def apps_delete(ctx, name) -> None:
 
 @apps.command("logs")
 @click.argument("name")
+@click.option("-f", "--follow", is_flag=True, help="stream logs live (NDJSON follow)")
+@click.option("--filter", "replica", default="", help="only this agent replica")
 @click.pass_context
-def apps_logs(ctx, name) -> None:
+def apps_logs(ctx, name, follow, replica) -> None:
     try:
-        click.echo(_client(ctx).logs(name))
+        if not follow:
+            click.echo(_client(ctx).logs(name, replica))
+            return
+        for entry in _client(ctx).follow_logs(name, replica):
+            click.echo(f"[{entry.get('replica', '?')}] {entry.get('message', '')}")
+    except KeyboardInterrupt:
+        pass
     except AdminClientError as e:
         raise click.ClickException(str(e)) from e
 

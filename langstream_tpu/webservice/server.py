@@ -239,11 +239,48 @@ class ControlPlaneServer:
         await self.applications.delete(tenant, request.match_info["name"])
         return web.json_response({"deleted": request.match_info["name"]})
 
-    async def _logs(self, request: web.Request) -> web.Response:
+    async def _logs(self, request: web.Request) -> web.StreamResponse:
+        """Application logs. Default: one-shot text snapshot. With
+        ``?follow=1``: an unbounded NDJSON stream of live log lines
+        (history first, then new lines as agents emit them), optionally
+        narrowed with ``?filter=<replica>`` — the reference's pod-log Flux
+        (ApplicationResource.java:312-330) mapped onto the local runtime's
+        per-replica LogHub."""
+        import asyncio
+
         tenant = request.match_info["tenant"]
         self._check_tenant(tenant)
-        lines = self.applications.logs(tenant, request.match_info["name"])
-        return web.Response(text="\n".join(lines), content_type="text/plain")
+        name = request.match_info["name"]
+        follow = request.query.get("follow") in ("1", "true", "yes")
+        replica = request.query.get("filter") or None
+        if not follow:
+            lines = self.applications.logs(tenant, name)
+            if replica:
+                lines = [ln for ln in lines if ln.startswith(f"{replica}:")]
+            return web.Response(text="\n".join(lines), content_type="text/plain")
+        hub = self.applications.log_hub(tenant, name)
+        if hub is None:
+            raise ApplicationServiceError(
+                "log streaming is not available for this runtime", status=501
+            )
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"}
+        )
+        await resp.prepare(request)
+        queue = hub.subscribe()
+        try:
+            for entry in hub.history(replica):
+                await resp.write(json.dumps(entry).encode() + b"\n")
+            while True:
+                entry = await queue.get()
+                if replica and entry["replica"] != replica:
+                    continue
+                await resp.write(json.dumps(entry).encode() + b"\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass  # client went away — the normal end of a follow
+        finally:
+            hub.unsubscribe(queue)
+        return resp
 
     async def _code(self, request: web.Request) -> web.Response:
         import asyncio

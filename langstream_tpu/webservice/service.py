@@ -87,9 +87,19 @@ class LocalRuntimeManager:
         runner = self._runners.get((tenant, application_id))
         if runner is None:
             return []
-        return [
+        lines = [
             f"{info.get('agent-id', '?')}: {info}" for info in runner.agents_info()
         ]
+        lines += [
+            f"{e['replica']}: {e['message']}" for e in runner.log_hub.history()
+        ]
+        return lines
+
+    def application_log_hub(self, tenant: str, application_id: str):
+        """The app's live LogHub, or None (non-local runtimes stream from
+        their own pod-log source instead)."""
+        runner = self._runners.get((tenant, application_id))
+        return None if runner is None else runner.log_hub
 
     async def close(self) -> None:
         for key in list(self._runners):
@@ -361,6 +371,15 @@ class ApplicationService:
         if self.runtime is None:
             return []
         return self.runtime.application_logs(tenant, application_id)
+
+    def log_hub(self, tenant: str, application_id: str):
+        """Live log hub for streaming follow, when the runtime offers one."""
+        if self.store.get(tenant, application_id) is None:
+            raise ApplicationServiceError(
+                f"application {application_id} not found", status=404
+            )
+        getter = getattr(self.runtime, "application_log_hub", None)
+        return None if getter is None else getter(tenant, application_id)
 
     def download_code(self, tenant: str, application_id: str) -> bytes:
         stored = self.store.get(tenant, application_id)
