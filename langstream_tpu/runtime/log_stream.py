@@ -33,9 +33,11 @@ class LogHub:
 
     def __init__(self, application_id: str, maxlen: int = 2000) -> None:
         self.application_id = application_id
+        self.maxlen = maxlen
         self._ring: deque[dict[str, Any]] = deque(maxlen=maxlen)
         self._subscribers: set[asyncio.Queue] = set()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._seq = 0
 
     def attach_loop(self, loop: asyncio.AbstractEventLoop) -> None:
         """Remember the serving loop so emit() can cross threads safely
@@ -43,7 +45,9 @@ class LogHub:
         self._loop = loop
 
     def emit(self, replica: str, level: str, message: str) -> None:
+        self._seq += 1
         entry = {
+            "seq": self._seq,
             "timestamp": time.time(),
             "replica": replica,
             "level": level,
@@ -63,9 +67,26 @@ class LogHub:
             # different running loop (agent library thread) still needs the
             # threadsafe hop, else the subscriber's waiting get() races
             if loop is not None and running is not loop:
-                loop.call_soon_threadsafe(q.put_nowait, entry)
+                loop.call_soon_threadsafe(self._offer, q, entry)
             else:
+                self._offer(q, entry)
+
+    @staticmethod
+    def _offer(q: asyncio.Queue, entry: dict[str, Any]) -> None:
+        """Bounded put: a follower that can't keep up loses its OLDEST
+        pending lines (same contract as the history ring) instead of
+        growing server memory without limit."""
+        try:
+            q.put_nowait(entry)
+        except asyncio.QueueFull:
+            try:
+                q.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            try:
                 q.put_nowait(entry)
+            except asyncio.QueueFull:
+                pass
 
     def history(self, replica: Optional[str] = None) -> list[dict[str, Any]]:
         return [
@@ -73,7 +94,7 @@ class LogHub:
         ]
 
     def subscribe(self) -> asyncio.Queue:
-        q: asyncio.Queue = asyncio.Queue()
+        q: asyncio.Queue = asyncio.Queue(maxsize=self.maxlen)
         self._subscribers.add(q)
         return q
 

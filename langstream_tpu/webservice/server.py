@@ -269,10 +269,17 @@ class ControlPlaneServer:
         await resp.prepare(request)
         queue = hub.subscribe()
         try:
+            # entries emitted between subscribe() and this snapshot land in
+            # BOTH the ring and the queue; their seq lets the live loop skip
+            # what the history replay already wrote
+            last_seq = 0
             for entry in hub.history(replica):
+                last_seq = entry["seq"]
                 await resp.write(json.dumps(entry).encode() + b"\n")
             while True:
                 entry = await queue.get()
+                if entry["seq"] <= last_seq:
+                    continue
                 if replica and entry["replica"] != replica:
                     continue
                 await resp.write(json.dumps(entry).encode() + b"\n")

@@ -83,5 +83,190 @@ async def capture_pulsar() -> None:
     print(f"pulsar: {sum(1 for d, _ in frames if d == '>')} client frames")
 
 
+class _Tap:
+    """Capture every byte crossing client connections opened while active.
+
+    Patches ``asyncio.open_connection``; each connection gets an ordered
+    list of (seq, direction, bytes) chunks. Protocol-specific framers
+    re-split the server-side chunk stream into whole frames afterwards
+    (clients write whole frames, but read them as header+body pairs)."""
+
+    def __init__(self) -> None:
+        self.conns: list[list[tuple[int, str, bytes]]] = []
+        self._seq = 0
+        self._orig = None
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def __enter__(self) -> "_Tap":
+        tap = self
+        self._orig = asyncio.open_connection
+
+        async def tapped(*args, **kwargs):
+            reader, writer = await tap._orig(*args, **kwargs)
+            events: list[tuple[int, str, bytes]] = []
+            tap.conns.append(events)
+
+            class TapReader:
+                async def readexactly(self, n):
+                    data = await reader.readexactly(n)
+                    events.append((tap._next_seq(), "<", data))
+                    return data
+
+                async def read(self, n=-1):
+                    data = await reader.read(n)
+                    events.append((tap._next_seq(), "<", data))
+                    return data
+
+                def __getattr__(self, name):
+                    return getattr(reader, name)
+
+            class TapWriter:
+                def write(self, data):
+                    events.append((tap._next_seq(), ">", data))
+                    writer.write(data)
+
+                def __getattr__(self, name):
+                    return getattr(writer, name)
+
+            return TapReader(), TapWriter()
+
+        asyncio.open_connection = tapped
+        return self
+
+    def __exit__(self, *exc) -> None:
+        asyncio.open_connection = self._orig
+
+    def frames(self, split_response) -> list[tuple[tuple[int, int], str, bytes]]:
+        """Whole frames in global capture order. Client writes are already
+        one frame per chunk; server chunks are concatenated per connection
+        and re-split with ``split_response(buffer) -> (frame, rest)``.
+        Sort key is (seq of first chunk, emission index) so two frames split
+        from the SAME chunk keep arrival order rather than tie-breaking on
+        their raw bytes."""
+        out: list[tuple[tuple[int, int], str, bytes]] = []
+        for events in self.conns:
+            buf = b""
+            buf_seq = 0
+            for seq, direction, data in events:
+                if direction == ">":
+                    out.append(((seq, len(out)), ">", data))
+                    continue
+                if not buf:
+                    buf_seq = seq
+                buf += data
+                while True:
+                    frame, buf = split_response(buf)
+                    if frame is None:
+                        break
+                    out.append(((buf_seq, len(out)), "<", frame))
+                    buf_seq = seq
+        return sorted(out, key=lambda item: item[0])
+
+
+def _split_len32(buf: bytes):
+    """[int32 size][body] framing (kafka request/response)."""
+    if len(buf) < 4:
+        return None, buf
+    size = int.from_bytes(buf[:4], "big")
+    if len(buf) < 4 + size:
+        return None, buf
+    return buf[: 4 + size], buf[4 + size :]
+
+
+def _split_cql(buf: bytes):
+    """9-byte CQL header with the body length at bytes 5..9."""
+    from langstream_tpu.agents.vector import cql_protocol as wire
+
+    if len(buf) < wire.HEADER_SIZE:
+        return None, buf
+    length = int.from_bytes(buf[5:9], "big")
+    total = wire.HEADER_SIZE + length
+    if len(buf) < total:
+        return None, buf
+    return buf[:total], buf[total:]
+
+
+def _write_transcript(name: str, comment: str, frames) -> None:
+    lines = [f"# {comment}"]
+    for _, direction, data in frames:
+        lines.append(f"{direction} " + data.hex())
+    (HERE / name).write_text("\n".join(lines) + "\n")
+    n_client = sum(1 for _, d, _ in frames if d == ">")
+    print(f"{name}: {n_client} client frames / {len(frames)} total")
+
+
+async def capture_kafka() -> None:
+    """Metadata / create-topic / produce / list-offsets / fetch against the
+    fake broker — covers the request header, record-batch and fetch codecs."""
+    from langstream_tpu.messaging import kafka_protocol as wire
+    from langstream_tpu.messaging.kafka import KafkaClient
+    from langstream_tpu.messaging.kafka_fake import FakeKafkaBroker
+
+    broker = await FakeKafkaBroker().start()
+    with _Tap() as tap:
+        client = KafkaClient(broker.bootstrap, client_id="golden-capture")
+        await client.ensure_topic("golden-topic")
+        await client.produce(
+            "golden-topic",
+            0,
+            [wire.WireRecord(key=b"k1", value=b"golden-value", headers=[])],
+        )
+        end = await client.list_offsets("golden-topic", 0, -1)
+        assert end == 1, f"expected end offset 1, got {end}"
+        fetched = await client.fetch({("golden-topic", 0): 0}, max_wait_ms=0)
+        assert fetched[("golden-topic", 0)], "fetch returned nothing"
+        await client.close()
+    await broker.stop()
+    _write_transcript(
+        "kafka_produce_fetch.hex",
+        "kafka metadata/create/produce/list-offsets/fetch (fake-broker capture)",
+        tap.frames(_split_len32),
+    )
+
+
+async def capture_cql() -> None:
+    """STARTUP / QUERY ddl / PREPARE+EXECUTE insert / prepared SELECT
+    against the fake server — covers the frame header, prepared-statement
+    and rows-result codecs."""
+    from langstream_tpu.agents.vector.cassandra import CassandraDataSource
+    from langstream_tpu.agents.vector.cql_fake import FakeCassandra
+
+    server = await FakeCassandra().start()
+    with _Tap() as tap:
+        ds = CassandraDataSource({"contact-points": server.contact_point})
+        try:
+            await ds.execute_statement(
+                "CREATE KEYSPACE IF NOT EXISTS g WITH replication = "
+                "{'class': 'SimpleStrategy', 'replication_factor': 1}",
+                [],
+            )
+            await ds.execute_statement(
+                "CREATE TABLE IF NOT EXISTS g.docs ("
+                "id text PRIMARY KEY, body text, embeddings vector<float, 2>)",
+                [],
+            )
+            await ds.execute_statement(
+                "INSERT INTO g.docs (id, body, embeddings) VALUES (?, ?, ?)",
+                ["d0", "golden doc", [1.0, 0.5]],
+            )
+            rows = await ds.fetch_data(
+                "SELECT id, body FROM g.docs WHERE id = ?", ["d0"]
+            )
+            assert rows == [{"id": "d0", "body": "golden doc"}]
+        finally:
+            await ds.close()
+    await server.stop()
+    _write_transcript(
+        "cql_prepare_execute_select.hex",
+        "cql startup/ddl/prepare/execute/select (fake-server capture)",
+        tap.frames(_split_cql),
+    )
+
+
 if __name__ == "__main__":
     asyncio.run(capture_pulsar())
+    asyncio.run(capture_kafka())
+    asyncio.run(capture_cql())

@@ -1,0 +1,19 @@
+"""Shim of cassio's global-session init — records the connection config the
+llama_index shim's CassandraVectorStore reads back."""
+
+from __future__ import annotations
+
+_CONFIG: dict = {}
+
+
+def init(contact_points=None, token=None, keyspace=None, **kwargs) -> None:
+    _CONFIG.update(
+        {"contact_points": contact_points, "token": token, "keyspace": keyspace}
+    )
+    _CONFIG.update(kwargs)
+
+
+def config() -> dict:
+    if not _CONFIG:
+        raise RuntimeError("cassio.init() has not been called")
+    return dict(_CONFIG)

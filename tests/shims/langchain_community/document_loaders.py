@@ -1,0 +1,48 @@
+"""Shim of langchain-community's WebBaseLoader: really fetches the URL and
+strips markup with the stdlib HTML parser (the real one uses bs4)."""
+
+from __future__ import annotations
+
+import urllib.request
+from html.parser import HTMLParser
+
+
+class Document:
+    def __init__(self, page_content: str, metadata: dict | None = None) -> None:
+        self.page_content = page_content
+        self.metadata = metadata or {}
+
+
+class _TextExtractor(HTMLParser):
+    def __init__(self) -> None:
+        super().__init__()
+        self.chunks: list[str] = []
+        self._skip = 0
+
+    def handle_starttag(self, tag, attrs):
+        if tag in ("script", "style"):
+            self._skip += 1
+
+    def handle_endtag(self, tag):
+        if tag in ("script", "style") and self._skip:
+            self._skip -= 1
+
+    def handle_data(self, data):
+        if not self._skip and data.strip():
+            self.chunks.append(data.strip())
+
+
+class WebBaseLoader:
+    def __init__(self, web_path: str) -> None:
+        self.web_path = web_path
+
+    def load(self) -> list[Document]:
+        with urllib.request.urlopen(self.web_path, timeout=30) as resp:
+            raw = resp.read().decode("utf-8", errors="replace")
+        if "<" in raw:
+            parser = _TextExtractor()
+            parser.feed(raw)
+            text = "\n".join(parser.chunks)
+        else:
+            text = raw
+        return [Document(text, {"source": self.web_path})]

@@ -1,0 +1,39 @@
+"""Shim of the langchain-core prompt surface the examples use
+(`ChatPromptTemplate.from_messages` + LCEL `prompt | llm` piping)."""
+
+from __future__ import annotations
+
+
+class ChatPromptTemplate:
+    def __init__(self, messages: list[tuple[str, str]]) -> None:
+        self.messages = messages
+
+    @classmethod
+    def from_messages(cls, messages: list[tuple[str, str]]) -> "ChatPromptTemplate":
+        return cls(messages)
+
+    def format_messages(self, **inputs) -> list[dict]:
+        role_map = {"user": "user", "human": "user", "system": "system", "ai": "assistant"}
+        return [
+            {"role": role_map.get(role, role), "content": template.format(**inputs)}
+            for role, template in self.messages
+        ]
+
+    def __or__(self, llm) -> "_Chain":
+        return _Chain(self, llm)
+
+
+class _Chain:
+    """`prompt | llm` — the only LCEL composition the examples build."""
+
+    def __init__(self, prompt: ChatPromptTemplate, llm) -> None:
+        self.prompt = prompt
+        self.llm = llm
+
+    async def ainvoke(self, inputs: dict):
+        return await self.llm.ainvoke(self.prompt.format_messages(**inputs))
+
+    def invoke(self, inputs: dict):
+        import asyncio
+
+        return asyncio.run(self.ainvoke(inputs))

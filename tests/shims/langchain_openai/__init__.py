@@ -1,0 +1,41 @@
+"""Shim of langchain-openai's ChatOpenAI: a real POST to the configured
+OpenAI-compatible /chat/completions endpoint (see tests/shims/README.md)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class AIMessage:
+    def __init__(self, content: str) -> None:
+        self.content = content
+
+
+class ChatOpenAI:
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        api_key: Optional[str] = None,
+        model: str = "gpt-3.5-turbo",
+        temperature: float = 0.0,
+    ) -> None:
+        self.base_url = (base_url or "https://api.openai.com/v1").rstrip("/")
+        self.api_key = api_key
+        self.model = model
+        self.temperature = temperature
+
+    async def ainvoke(self, messages: list[dict]) -> AIMessage:
+        import aiohttp
+
+        headers = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"{self.base_url}/chat/completions",
+                json={"model": self.model, "messages": messages},
+                headers=headers,
+            ) as resp:
+                resp.raise_for_status()
+                body = await resp.json()
+        return AIMessage(body["choices"][0]["message"]["content"])

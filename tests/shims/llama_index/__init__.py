@@ -1,0 +1,1 @@
+# test shim — see tests/shims/README.md

@@ -1,0 +1,41 @@
+"""Shim of the llama-index core surface the cassandra-sink example uses:
+``Document`` and ``VectorStoreIndex.from_vector_store(...).insert(...)``.
+
+The real library embeds documents with a configured embedding model; the
+shim derives a deterministic pseudo-embedding from the text so the vector
+column is populated without a model dependency."""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+
+
+class Document:
+    def __init__(self, text: str, metadata: dict | None = None) -> None:
+        self.text = text
+        self.metadata = metadata or {}
+        self.doc_id = str(uuid.uuid4())
+
+
+def _pseudo_embedding(text: str, dim: int) -> list[float]:
+    out: list[float] = []
+    counter = 0
+    while len(out) < dim:
+        digest = hashlib.sha256(f"{counter}:{text}".encode()).digest()
+        out.extend(b / 255.0 for b in digest)
+        counter += 1
+    return out[:dim]
+
+
+class VectorStoreIndex:
+    def __init__(self, vector_store) -> None:
+        self._store = vector_store
+
+    @classmethod
+    def from_vector_store(cls, vector_store) -> "VectorStoreIndex":
+        return cls(vector_store)
+
+    def insert(self, document: Document) -> None:
+        vector = _pseudo_embedding(document.text, self._store.embedding_dimension)
+        self._store.add_row(document.doc_id, document.text, vector)

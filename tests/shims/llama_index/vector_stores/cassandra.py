@@ -1,0 +1,88 @@
+"""Shim of llama-index's CassandraVectorStore: writes rows over the
+platform's own CQL v4 wire client to whatever cluster ``cassio.init``
+configured (in tests: the FakeCassandra server), using the cassio table
+layout (row_id / body_blob / vector)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import cassio
+
+
+class CassandraVectorStore:
+    def __init__(self, table: str, embedding_dimension: int) -> None:
+        self.table = table
+        self.embedding_dimension = embedding_dimension
+        self._ready = False
+        self._lock = threading.Lock()
+
+    def _run(self, coro) -> None:
+        """The real store is sync; the platform CQL client is asyncio — and
+        the caller may itself be inside a running loop (the sink's async
+        write), so each statement batch runs on a throwaway loop in a worker
+        thread (insert volume in the examples is tiny)."""
+        result: dict = {}
+
+        def target() -> None:
+            try:
+                asyncio.run(coro)
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                result["err"] = exc
+
+        worker = threading.Thread(target=target)
+        worker.start()
+        worker.join()
+        if "err" in result:
+            raise result["err"]
+
+    async def _execute(self, statements: list[tuple[str, list]]) -> None:
+        from langstream_tpu.agents.vector.cassandra import CassandraDataSource
+
+        cfg = cassio.config()
+        host = (cfg.get("contact_points") or ["127.0.0.1"])[0]
+        port = cfg.get("port")
+        contact = f"{host}:{port}" if port else host
+        source_config = {"contact-points": contact}
+        if cfg.get("token"):
+            source_config["username"] = "token"
+            source_config["password"] = cfg["token"]
+        ds = CassandraDataSource(source_config)
+        try:
+            for statement, values in statements:
+                await ds.execute_statement(statement, values)
+        finally:
+            await ds.close()
+
+    def _ensure_schema(self) -> list[tuple[str, list]]:
+        keyspace = cassio.config().get("keyspace") or "default_keyspace"
+        return [
+            (
+                f"CREATE KEYSPACE IF NOT EXISTS {keyspace} WITH replication = "
+                "{'class': 'SimpleStrategy', 'replication_factor': 1}",
+                [],
+            ),
+            (
+                f"CREATE TABLE IF NOT EXISTS {keyspace}.{self.table} ("
+                "row_id text PRIMARY KEY, body_blob text, "
+                f"vector vector<float, {self.embedding_dimension}>)",
+                [],
+            ),
+        ]
+
+    def add_row(self, row_id: str, text: str, vector: list[float]) -> None:
+        keyspace = cassio.config().get("keyspace") or "default_keyspace"
+        statements: list[tuple[str, list]] = []
+        with self._lock:
+            if not self._ready:
+                statements.extend(self._ensure_schema())
+                self._ready = True
+        statements.append(
+            (
+                f"INSERT INTO {keyspace}.{self.table} "
+                "(row_id, body_blob, vector) VALUES (?, ?, ?)",
+                [row_id, text, vector],
+            )
+        )
+        self._run(self._execute(statements))

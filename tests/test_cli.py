@@ -203,3 +203,125 @@ def test_run_local_once(app_dir, tmp_path, monkeypatch):
     )
     assert result.exit_code == 0, result.output
     assert "gateway:" in result.output
+
+
+# ---------------------------------------------------------------------------
+# `langstream python` command group (reference BasePythonCmd sandbox)
+# ---------------------------------------------------------------------------
+
+
+def _python_app(tmp_path, agent_body: str, test_body: str):
+    app = tmp_path / "py-app"
+    (app / "python").mkdir(parents=True)
+    (app / "python" / "my_agent.py").write_text(agent_body)
+    (app / "python" / "test_my_agent.py").write_text(test_body)
+    return app
+
+
+AGENT = '''
+from langstream_tpu.api.agent import AgentProcessor, ProcessorResult
+from langstream_tpu.api.record import SimpleRecord
+
+
+class Upper(AgentProcessor):
+    async def process(self, records):
+        return [
+            ProcessorResult(source_record=r, records=[SimpleRecord.of(str(r.value).upper())])
+            for r in records
+        ]
+'''
+
+TEST_OK = '''
+import asyncio
+import unittest
+
+from my_agent import Upper
+from langstream_tpu.api.record import SimpleRecord
+
+
+class UpperTest(unittest.TestCase):
+    def test_upper(self):
+        agent = Upper()
+        out = asyncio.run(agent.process([SimpleRecord.of("hi")]))
+        self.assertEqual(out[0].records[0].value, "HI")
+'''
+
+TEST_FAIL = '''
+import unittest
+
+
+class Broken(unittest.TestCase):
+    def test_broken(self):
+        self.assertTrue(False)
+'''
+
+
+def test_python_run_tests_passes(tmp_path):
+    app = _python_app(tmp_path, AGENT, TEST_OK)
+    runner = CliRunner()
+    result = runner.invoke(cli, ["python", "run-tests", "-app", str(app)])
+    assert result.exit_code == 0, result.output
+    assert "Tests passed" in result.output
+
+
+def test_python_run_tests_fails_on_red(tmp_path):
+    app = _python_app(tmp_path, AGENT, TEST_FAIL)
+    runner = CliRunner()
+    result = runner.invoke(cli, ["python", "run-tests", "-app", str(app)])
+    assert result.exit_code != 0
+
+
+def test_python_run_tests_sees_lib_dir(tmp_path):
+    """Dependencies installed into python/lib are importable — the sandbox
+    path contract load-pip-requirements installs into."""
+    app = _python_app(
+        tmp_path,
+        AGENT,
+        "import unittest\nimport vendored_dep\n\n"
+        "class T(unittest.TestCase):\n"
+        "    def test_dep(self):\n"
+        "        self.assertEqual(vendored_dep.VALUE, 41)\n",
+    )
+    lib = app / "python" / "lib"
+    lib.mkdir()
+    (lib / "vendored_dep.py").write_text("VALUE = 41\n")
+    runner = CliRunner()
+    result = runner.invoke(cli, ["python", "run-tests", "-app", str(app)])
+    assert result.exit_code == 0, result.output
+
+
+def test_python_load_pip_requirements(tmp_path):
+    """The pip plumbing: validates requirements.txt, runs the (stubbed) pip
+    with --target lib, surfaces its exit code. Real installs need network —
+    the stub records the argv contract instead."""
+    app = _python_app(tmp_path, AGENT, TEST_OK)
+    (app / "python" / "requirements.txt").write_text("left-pad==1.0\n")
+    recorder = tmp_path / "pip-args.json"
+    stub = tmp_path / "fake_pip.py"
+    stub.write_text(
+        "import json, sys, pathlib\n"
+        f"pathlib.Path({str(recorder)!r}).write_text(json.dumps(sys.argv[1:]))\n"
+        "pathlib.Path('lib').mkdir(exist_ok=True)\n"
+    )
+    import sys as _sys
+
+    runner = CliRunner()
+    result = runner.invoke(
+        cli,
+        ["python", "load-pip-requirements", "-app", str(app),
+         "--pip-command", f"{_sys.executable} {stub}"],
+    )
+    assert result.exit_code == 0, result.output
+    import json as _json
+
+    args = _json.loads(recorder.read_text())
+    assert args[:3] == ["install", "--target", "lib"]
+    assert "-r" in args and "requirements.txt" in args
+
+
+def test_python_load_pip_requirements_missing_file(tmp_path):
+    app = _python_app(tmp_path, AGENT, TEST_OK)
+    runner = CliRunner()
+    result = runner.invoke(cli, ["python", "load-pip-requirements", "-app", str(app)])
+    assert result.exit_code != 0
+    assert "requirements.txt" in result.output

@@ -63,7 +63,86 @@ def _replay_pulsar(direction: str, data: bytes) -> None:
         assert re_md == md_bytes, f"{name}: metadata re-encode drifted"
 
 
-_REPLAYERS = {"pulsar": _replay_pulsar}
+def _replay_kafka(direction: str, data: bytes) -> None:
+    from langstream_tpu.messaging import kafka_protocol as wire
+
+    # frame: [int32 size][body]
+    size = int.from_bytes(data[:4], "big")
+    assert size == len(data) - 4, "frame length header mismatch"
+    r = wire.Reader(data[4:])
+    if direction == "<":
+        # responses carry only [correlation_id][api-specific body]; the
+        # api-specific parsers live inline in the client, so the replay
+        # asserts framing + correlation header only
+        cid = r.int32()
+        assert cid > 0, f"bad correlation id {cid}"
+        return
+    api_key, api_version, cid, client_id = wire.decode_request_header(r)
+    assert api_key in wire.API_VERSIONS, (
+        f"unknown api key {api_key} — extend kafka_protocol.API_VERSIONS"
+    )
+    assert api_version == wire.API_VERSIONS[api_key], (
+        f"api {api_key}: transcript pins version {api_version}, "
+        f"codec now speaks {wire.API_VERSIONS[api_key]}"
+    )
+    payload = r.data[r.pos :]
+    # wire-drift pin: re-encoding the parsed request must reproduce the bytes
+    assert wire.encode_request(api_key, cid, client_id or "", payload) == data, (
+        f"api {api_key}: re-encoded request differs from transcript"
+    )
+    if api_key == wire.PRODUCE:
+        # decode the record batch payload deeply (the densest codec)
+        pr = wire.Reader(payload)
+        pr.string()  # transactional_id
+        pr.int16()  # acks
+        pr.int32()  # timeout
+        for _ in range(pr.int32()):
+            pr.string()  # topic
+            for _ in range(pr.int32()):
+                pr.int32()  # partition
+                batch = pr.bytes_()
+                records = wire.decode_record_batches(batch)
+                assert records, "produce batch decodes to no records"
+                assert wire.encode_record_batch(
+                    records, base_offset=records[0].offset
+                ) == batch, "record batch re-encode drifted"
+
+
+def _replay_cql(direction: str, data: bytes) -> None:
+    from langstream_tpu.agents.vector import cql_protocol as wire
+
+    version, stream, opcode, length = wire.parse_header(data[: wire.HEADER_SIZE])
+    assert length == len(data) - wire.HEADER_SIZE, "frame length header mismatch"
+    body = data[wire.HEADER_SIZE :]
+    if direction == ">":
+        assert version == wire.VERSION_REQUEST
+        # wire-drift pin: the framer must reproduce the exact bytes
+        assert wire.frame(opcode, body, stream=stream) == data
+        if opcode == wire.OP_PREPARE:
+            assert wire.parse_prepare_body(body)
+        elif opcode == wire.OP_EXECUTE:
+            prepared_id, values, _ = wire.parse_execute_body(body)
+            assert prepared_id
+        elif opcode == wire.OP_QUERY:
+            query, _, _ = wire.parse_query_body(body)
+            assert query
+        return
+    assert version == wire.VERSION_RESPONSE
+    if opcode == wire.OP_RESULT:
+        result = wire.parse_result_body(body)
+        assert result["kind"] in ("rows", "void", "prepared", "schema_change", "set_keyspace")
+    elif opcode == wire.OP_ERROR:
+        wire.parse_error_body(body)
+    else:
+        assert opcode in (
+            wire.OP_READY,
+            wire.OP_AUTHENTICATE,
+            wire.OP_AUTH_SUCCESS,
+            wire.OP_SUPPORTED,
+        ), f"unexpected response opcode 0x{opcode:02x}"
+
+
+_REPLAYERS = {"pulsar": _replay_pulsar, "kafka": _replay_kafka, "cql": _replay_cql}
 
 
 def _files():

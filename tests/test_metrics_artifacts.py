@@ -1,0 +1,101 @@
+"""The shipped Grafana dashboard / Prometheus config must stay in sync with
+the metric names the framework actually registers (reference ships
+docker/metrics/dashboards/apps.json + prometheus.yml; a dashboard whose
+queries match nothing is worse than none)."""
+
+import json
+import re
+from pathlib import Path
+
+import yaml
+
+from langstream_tpu.api.metrics import MetricsReporter
+
+METRICS_DIR = Path(__file__).parent.parent / "docker" / "metrics"
+SRC_DIR = Path(__file__).parent.parent / "langstream_tpu"
+
+
+def registered_metric_suffixes() -> set[str]:
+    """Every name passed to .counter()/.gauge() anywhere in the source."""
+    pat = re.compile(r"\.(?:counter|gauge)\(\s*\"([a-z0-9_]+)\"")
+    names: set[str] = set()
+    for py in SRC_DIR.rglob("*.py"):
+        names.update(pat.findall(py.read_text()))
+    assert names, "no metric registrations found in source"
+    return names
+
+
+def dashboard_exprs() -> list[str]:
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs = [
+        t["expr"]
+        for panel in doc["panels"]
+        for t in panel.get("targets", [])
+        if "expr" in t
+    ]
+    assert exprs, "dashboard has no queries"
+    return exprs
+
+
+def test_prometheus_config_parses_and_scrapes_runtime():
+    doc = yaml.safe_load((METRICS_DIR / "prometheus.yml").read_text())
+    jobs = {j["job_name"]: j for j in doc["scrape_configs"]}
+    assert "langstream-runtime" in jobs
+    targets = jobs["langstream-runtime"]["static_configs"][0]["targets"]
+    # the runtime http server's default port (runtime/http_server.py)
+    assert any(t.endswith(":8080") for t in targets)
+
+
+def test_dashboard_metrics_exist_in_source():
+    registered = registered_metric_suffixes()
+    name_res = re.findall(
+        r"__name__=~\\?\"([^\"\\]+)", "\n".join(dashboard_exprs())
+    ) + re.findall(r'__name__=~"([^"]+)"', "\n".join(dashboard_exprs()))
+    assert name_res, "dashboard queries carry no __name__ matchers"
+    for regex in name_res:
+        suffix = regex.rsplit("_completions_", 1)[-1].rsplit(".+_", 1)[-1]
+        assert suffix in registered, (
+            f"dashboard references metric suffix {suffix!r} that nothing registers"
+        )
+
+
+def test_dashboard_regexes_match_live_exposition():
+    """Register the real serving + runner metric names the way the agents do
+    and verify each dashboard __name__ regex matches at least one line of the
+    rendered Prometheus exposition."""
+    reporter = MetricsReporter()
+    runner_scope = reporter.with_prefix("agent_step1")
+    for n in ("source_out_total", "sink_in_total", "errors_total"):
+        runner_scope.counter(n)
+    serving = reporter.with_prefix("agent_chat_completions")
+    for n in ("num_calls_total", "completion_tokens_total", "prompt_tokens_total"):
+        serving.counter(n)
+    for n in (
+        "last_ttft_ms",
+        "last_tokens_per_sec",
+        "engine_active_slots",
+        "engine_queued_requests",
+    ):
+        serving.gauge(n)
+    exposed = {
+        line.split()[0]
+        for line in reporter.prometheus_text().splitlines()
+        if line and not line.startswith("#")
+    }
+    joined = "\n".join(dashboard_exprs())
+    for regex in re.findall(r'__name__=~\\?"([^"\\]+)"?', joined):
+        matcher = re.compile(regex)
+        assert any(matcher.fullmatch(name) for name in exposed), (
+            f"dashboard regex {regex!r} matches no exported metric"
+        )
+
+
+def test_grafana_provisioning_parses():
+    ds = yaml.safe_load(
+        (METRICS_DIR / "provisioning" / "datasources" / "prometheus.yaml").read_text()
+    )
+    assert ds["datasources"][0]["type"] == "prometheus"
+    dash = yaml.safe_load(
+        (METRICS_DIR / "provisioning" / "dashboards" / "dashboards.yaml").read_text()
+    )
+    assert dash["providers"][0]["type"] == "file"
