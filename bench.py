@@ -147,14 +147,18 @@ def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
             options=GenerationOptions(max_new_tokens=new_tokens, temperature=0.0),
         )
 
-    # warmup: trigger prefill + decode compiles
-    engine.submit(make_request()).result(timeout=600)
+    try:
+        # warmup: trigger prefill + decode compiles
+        engine.submit(make_request()).result(timeout=600)
 
-    start = time.monotonic()
-    requests = [engine.submit(make_request()) for _ in range(n_requests)]
-    results = [r.result(timeout=1200) for r in requests]
-    elapsed = time.monotonic() - start
-    engine.stop()
+        start = time.monotonic()
+        requests = [engine.submit(make_request()) for _ in range(n_requests)]
+        results = [r.result(timeout=1200) for r in requests]
+        elapsed = time.monotonic() - start
+    finally:
+        # ALWAYS stop: a failed phase must not leave the engine thread (and
+        # its HBM-resident weights + cache) alive to OOM every later phase
+        engine.stop()
 
     total_tokens = sum(len(r.tokens) for r in results)
     return total_tokens / elapsed
@@ -203,9 +207,11 @@ def bench_long_prompt(preset: str, quantize: bool, prompt_len: int,
         prompt = rng.integers(1, config.vocab_size, size=prompt_len).tolist()
         return GenerationRequest(prompt_tokens=prompt, options=opts)
 
-    engine.submit(req()).result(timeout=1200)  # warmup: compiles
-    result = engine.submit(req()).result(timeout=1200)
-    engine.stop()
+    try:
+        engine.submit(req()).result(timeout=1200)  # warmup: compiles
+        result = engine.submit(req()).result(timeout=1200)
+    finally:
+        engine.stop()  # leak-free even when a compile fails mid-phase
     return result.ttft_s
 
 
@@ -309,6 +315,18 @@ async def _chat_once(http, server, session_id: str, timeout: float = 300.0):
                 return ttft, nbytes, t_first, time.monotonic()
 
 
+
+def _reclaim() -> None:
+    """Drop phase garbage before the next model stages its weights: an
+    8B-class phase needs nearly all of HBM, and a lingering reference
+    (engine thread, traceback) from an earlier phase is an instant
+    RESOURCE_EXHAUSTED (observed r5: one leaked failed phase OOMed every
+    phase after it)."""
+    import gc
+
+    gc.collect()
+
+
 def main() -> None:
     import os
 
@@ -353,12 +371,14 @@ def main() -> None:
             prefill_batch,
         )
     )
+    _reclaim()
     print(f"[bench] gateway: {extras}; long-prompt phase", file=sys.stderr, flush=True)
     try:
         long_ttft = bench_long_prompt(preset, quantize, long_len, long_seg, long_max_seq)
         extras[f"long_prompt_{long_len}_ttft_ms"] = round(long_ttft * 1e3, 1)
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] long-prompt phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
     if on_tpu:
         # flagship phase: BASELINE.md's headline model (llama-3-8b, ≥2000
         # tok/s aggregate across chips = ~250 tok/s/chip on its 8-chip ref
@@ -369,14 +389,21 @@ def main() -> None:
         # what stops B=88/96 — 15.9G peak vs 15.75G HBM).
         try:
             print("[bench] llama-3-8b phase", file=sys.stderr, flush=True)
+            # max_seq_len sized to the WORKLOAD (32 prompt + 128 new = 160
+            # → 256): the engine now precompiles the full kv_bound ladder,
+            # and a 1024-wide config at B=84 compile-OOMs on the largest
+            # bound — r5's "B=84 knee at 1024" only ever ran bounds ≤256,
+            # i.e. it advertised capacity it couldn't serve. The honest
+            # config also frees ~4G of cache for batch.
             llama_tok_s = bench_engine(
                 "llama-3-8b", True, max_batch=84, new_tokens=128,
-                n_requests=168, max_seq_len=1024, decode_chunk=16,
+                n_requests=168, max_seq_len=256, decode_chunk=16,
                 kv_int8=True,
             )
             extras["llama_3_8b_int8_tokens_per_sec"] = round(llama_tok_s, 2)
         except Exception as e:  # noqa: BLE001
             print(f"[bench] llama phase failed: {e}", file=sys.stderr, flush=True)
+        _reclaim()
         # MoE phase (BASELINE config #5): mixtral architecture at the scale
         # ONE chip serves in int8 (mixtral-8x1b preset — 8 experts, top-2,
         # same ratios as 8x7b; ~8.9GiB weights). Expert routing under the
@@ -386,12 +413,13 @@ def main() -> None:
             print("[bench] mixtral-8x1b MoE phase", file=sys.stderr, flush=True)
             moe_tok_s = bench_engine(
                 "mixtral-8x1b", True, max_batch=32, new_tokens=128,
-                n_requests=64, max_seq_len=1024, decode_chunk=16,
+                n_requests=64, max_seq_len=256, decode_chunk=16,
                 kv_int8=True,
             )
             extras["moe_mixtral_8x1b_int8_tokens_per_sec"] = round(moe_tok_s, 2)
         except Exception as e:  # noqa: BLE001
             print(f"[bench] MoE phase failed: {e}", file=sys.stderr, flush=True)
+        _reclaim()
         # long-context ceiling phase: the largest context the memory plan
         # says ONE chip truly serves on the 128k NTK preset — llama-3.1-8b,
         # int8 weights + int8 KV, B=1 → 32k (serving/memory.py). TTFT of a

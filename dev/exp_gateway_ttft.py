@@ -1,0 +1,97 @@
+"""Diagnose the r5 gateway TTFT stall: run the bench gateway phase with an
+engine-side event timeline (admissions, dispatches, fetches, first-token
+deliveries) and print where the 16s goes.
+
+Usage: python dev/exp_gateway_ttft.py [n_sessions] [prefill_batch]
+"""
+
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+EVENTS: list[tuple[float, str]] = []
+T0 = time.monotonic()
+
+
+def mark(what: str) -> None:
+    EVENTS.append((time.monotonic() - T0, what))
+
+
+def instrument() -> None:
+    from langstream_tpu.serving import engine as e
+
+    orig_admit = e.ServingEngine._admit
+    orig_dev_decode = e.ServingEngine._dev_decode
+    orig_process = e.ServingEngine._process_chunk
+    orig_warm = e.ServingEngine._warmup_decode_ladder
+
+    def admit(self):
+        t = time.monotonic()
+        out = orig_admit(self)
+        if out:
+            mark(f"admit n={len(out)} took={time.monotonic() - t:.3f}s")
+        return out
+
+    def dev_decode(self, steps, stale, kv_bound=None):
+        t = time.monotonic()
+        out = orig_dev_decode(self, steps, stale, kv_bound)
+        dt = time.monotonic() - t
+        if dt > 0.05:
+            mark(f"dev_decode steps={steps} bound={kv_bound} dispatch_took={dt:.3f}s")
+        return out
+
+    def process(self, chunk, snapshot, steps):
+        t = time.monotonic()
+        out = orig_process(self, chunk, snapshot, steps)
+        dt = time.monotonic() - t
+        if dt > 0.05:
+            mark(f"process_chunk steps={steps} rows={len(snapshot)} took={dt:.3f}s")
+        return out
+
+    def warm(self):
+        t = time.monotonic()
+        orig_warm(self)
+        mark(f"warmup_decode_ladder took={time.monotonic() - t:.3f}s")
+
+    e.ServingEngine._admit = admit
+    e.ServingEngine._dev_decode = dev_decode
+    e.ServingEngine._process_chunk = process
+    e.ServingEngine._warmup_decode_ladder = warm
+
+
+def main() -> None:
+    n_sessions = int(sys.argv[1]) if len(sys.argv) > 1 else 96
+    prefill_batch = int(sys.argv[2]) if len(sys.argv) > 2 else 192
+    instrument()
+
+    import bench
+
+    # also mark every websocket first token
+    orig_chat = bench._chat_once
+
+    async def chat(http, server, session_id, timeout=300.0):
+        out = await orig_chat(http, server, session_id, timeout)
+        mark(f"session {session_id} ttft={out[0]:.3f}s")
+        return out
+
+    bench._chat_once = chat
+
+    mark("start")
+    extras = asyncio.run(
+        bench.bench_gateway(
+            "gemma-2b", True, 192, 128, n_sessions, 1024, 16, prefill_batch
+        )
+    )
+    mark("done")
+    print("\n=== timeline (events >50ms or structural) ===")
+    for t, what in EVENTS:
+        print(f"{t:9.3f}  {what}")
+    print("\n=== extras ===")
+    print(extras)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,133 @@ _CAMEL_GATE = (
 )
 
 
+_CRON_MONTHS = {"JAN": 1, "FEB": 2, "MAR": 3, "APR": 4, "MAY": 5, "JUN": 6,
+                "JUL": 7, "AUG": 8, "SEP": 9, "OCT": 10, "NOV": 11, "DEC": 12}
+# Quartz numbering: 1 = Sunday (0 tolerated as Sunday too)
+_CRON_DAYS = {"SUN": 1, "MON": 2, "TUE": 3, "WED": 4, "THU": 5, "FRI": 6, "SAT": 7}
+
+
+def _cron_parse_field(
+    spec: str, lo: int, hi: int, names: dict[str, int], classic_dow: bool = False
+):
+    """One Quartz field → set of matching ints, or None for */?.
+    ``classic_dow``: numeric tokens use crontab numbering (0-7, 0 and
+    7 = Sunday) and are translated to Quartz (1 = Sunday)."""
+    spec = spec.strip().upper()
+    if spec in ("*", "?"):
+        return None
+
+    def conv(token: str) -> int:
+        if token in names:
+            return names[token]
+        v = int(token)
+        if names is _CRON_DAYS:
+            if classic_dow:
+                return (v % 7) + 1  # crontab 0/7=SUN,1=MON → quartz 1=SUN
+            return lo if v == 0 else v  # quartz tolerates 0 as Sunday
+        return v
+
+    out: set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", "?", ""):
+            start, end = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            start, end = conv(a), conv(b)
+        else:
+            start = conv(part)
+            end = hi if step > 1 else start
+        if not (lo <= start <= hi and lo <= end <= hi):
+            raise ValueError(f"cron field {spec!r} out of range [{lo},{hi}]")
+        if start <= end:
+            out.update(range(start, end + 1, step))
+        else:
+            # wrap-around range (FRI-SUN, 22-2): high side then low side
+            span = list(range(start, hi + 1)) + list(range(lo, end + 1))
+            out.update(span[::step])
+    return out
+
+
+def _cron_parse(expr: str) -> list:
+    """Quartz cron: ``sec min hour dom month dow [year]`` (camel-cron's
+    ``schedule=`` syntax, ``+`` already decoded to spaces). A classic
+    5-field crontab is accepted by prepending second 0 — its numeric
+    day-of-week keeps crontab numbering (0/7 = Sunday); a trailing year
+    field is ignored."""
+    fields = expr.split()
+    classic = len(fields) == 5
+    if classic:
+        fields = ["0", *fields]
+    if len(fields) == 7:
+        fields = fields[:6]
+    if len(fields) != 6:
+        raise ValueError(f"cron schedule {expr!r}: expected 5-7 fields")
+    sec, minute, hour, dom, month, dow = fields
+    return [
+        _cron_parse_field(sec, 0, 59, {}),
+        _cron_parse_field(minute, 0, 59, {}),
+        _cron_parse_field(hour, 0, 23, {}),
+        _cron_parse_field(dom, 1, 31, {}),
+        _cron_parse_field(month, 1, 12, _CRON_MONTHS),
+        _cron_parse_field(dow, 1, 7, _CRON_DAYS, classic_dow=classic),
+    ]
+
+
+def _cron_due(fields: list, tm: time.struct_time) -> bool:
+    quartz_dow = ((tm.tm_wday + 1) % 7) + 1  # tm: 0=Mon → quartz: 1=Sun
+    values = (tm.tm_sec, tm.tm_min, tm.tm_hour, tm.tm_mday, tm.tm_mon, quartz_dow)
+    return all(f is None or v in f for f, v in zip(fields, values))
+
+
+def _parse_feed_entries(body: str) -> list[dict]:
+    """RSS 2.0 ``channel/item`` or Atom ``entry`` elements → normalized
+    dicts (id/title/link/published/summary). The id (guid / atom:id /
+    link / title, first present) is the camel-rss dedupe key."""
+    import xml.etree.ElementTree as ET
+
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError as e:
+        log.warning("camel feed parse failed: %s", e)
+        return []
+
+    def text(el, *tags) -> str:
+        for tag in tags:
+            child = el.find(tag)
+            if child is not None and (child.text or "").strip():
+                return child.text.strip()
+        return ""
+
+    out: list[dict] = []
+    # RSS 2.0 (no namespace)
+    for item in root.iter("item"):
+        entry = {
+            "title": text(item, "title"),
+            "link": text(item, "link"),
+            "published": text(item, "pubDate"),
+            "summary": text(item, "description"),
+        }
+        entry["id"] = text(item, "guid") or entry["link"] or entry["title"]
+        out.append(entry)
+    # Atom
+    ns = "{http://www.w3.org/2005/Atom}"
+    for item in root.iter(f"{ns}entry"):
+        link_el = item.find(f"{ns}link")
+        entry = {
+            "title": text(item, f"{ns}title"),
+            "link": link_el.get("href", "") if link_el is not None else "",
+            "published": text(item, f"{ns}published", f"{ns}updated"),
+            "summary": text(item, f"{ns}summary", f"{ns}content"),
+        }
+        entry["id"] = text(item, f"{ns}id") or entry["link"] or entry["title"]
+        out.append(entry)
+    return [e for e in out if e["id"]]
+
+
 class ConnectRestError(RuntimeError):
     pass
 
@@ -284,9 +411,15 @@ class CamelSourceAgent(AgentSource):
     surface: component-uri, max-buffered-records, key-header):
 
     - ``timer:name?period=N[&repeatCount=K]`` — periodic tick records
+    - ``cron:name?schedule=<quartz expr>`` — Quartz-scheduled ticks
+      (camel-cron; ``+`` separators decoded, 5/6/7-field accepted)
     - ``file:/dir[?delete=true]`` — poll a directory, one record per file
     - ``http(s)://url?delay=N`` — poll an HTTP endpoint, one record per
       response body
+    - ``exec:command?args=...&delay=N`` — run a local command per poll,
+      one record per stdout (camel-exec consumer)
+    - ``rss:URL`` / ``atom:URL?delay=N`` — poll a feed, one record per
+      NEW entry (split + dedupe — camel-rss/atom defaults)
 
     Anything else (kafka:, jms:, aws-sqs:, the ~300 JVM components) gates
     with an explicit message — interpreting Camel's component registry
@@ -323,10 +456,42 @@ class CamelSourceAgent(AgentSource):
             keep = [(k, v) for k, v in _up.parse_qsl(query) if k != "delay"]
             self.url = base + ("?" + _up.urlencode(keep) if keep else "")
             self._http = None
+        elif scheme == "cron":
+            self.path = path.lstrip("/")
+            # camel encodes spaces in schedule= as '+'; parse_qsl already
+            # decoded them
+            schedule = self.params.get("schedule", "* * * * * ?")
+            self.cron_fields = _cron_parse(schedule)
+            self._ticks = 0
+            self._checked_sec = int(time.time())  # fire on FUTURE matches
+        elif scheme == "exec":
+            import shlex as _shlex
+
+            self.delay = float(self.params.get("delay", 1000)) / 1000.0
+            self.exec_cmd = [path, *_shlex.split(self.params.get("args", ""))]
+        elif scheme in ("rss", "atom"):
+            import urllib.parse as _up
+
+            self.delay = float(self.params.get("delay", 1000)) / 1000.0
+            # the URI after the scheme IS the feed URL; strip camel-level
+            # params, keep the feed's own query
+            _camel = {"delay", "initialDelay", "splitEntries", "filter",
+                      "sortEntries", "throttleEntries", "feedHeader",
+                      "lastUpdate"}
+            feed = rest
+            base, _, query = feed.partition("?")
+            keep = [(k, v) for k, v in _up.parse_qsl(query) if k not in _camel]
+            self.url = base + ("?" + _up.urlencode(keep) if keep else "")
+            self._http = None
+            # insertion-ordered so the dedupe memory can rotate (see read)
+            from collections import OrderedDict
+
+            self._seen_entries: "OrderedDict[str, None]" = OrderedDict()
         else:
             raise NotImplementedError(
                 f"camel component {scheme!r} needs the JVM Camel runtime; "
-                "native schemes: timer:, file:, http(s):  — " + _CAMEL_GATE
+                "native schemes: timer:, cron:, file:, http(s):, exec:, "
+                "rss:, atom:  — " + _CAMEL_GATE
             )
         self._last = 0.0
         # file scheme: records delivered but not yet committed → their
@@ -365,6 +530,87 @@ class CamelSourceAgent(AgentSource):
                 json.dumps({"timer": self.path, "count": self._ticks}),
                 self.path,
             )]
+        if self.scheme == "cron":
+            await _asyncio.sleep(0.1)
+            sec = int(time.time())
+            if sec == self._checked_sec:
+                return []
+            # catch-up scan: a stall (>1s between reads — slow downstream,
+            # busy loop) must not silently skip a scheduled second (a lost
+            # daily tick). Bounded to the last 5 minutes.
+            start = max(self._checked_sec + 1, sec - 300)
+            self._checked_sec = sec
+            out = []
+            for s in range(start, sec + 1):
+                if not _cron_due(self.cron_fields, time.localtime(s)):
+                    continue
+                self._ticks += 1
+                out.append(self._rec(
+                    json.dumps({"cron": self.path, "count": self._ticks,
+                                "timestamp": s}),
+                    self.path,
+                ))
+                if len(out) >= self.max_buffered:
+                    break
+            return out
+        if self.scheme == "exec":
+            wait = self.delay - (now - self._last)
+            if wait > 0:
+                await _asyncio.sleep(min(wait, 0.5))
+                if self.delay - (time.monotonic() - self._last) > 0:
+                    return []
+            self._last = time.monotonic()
+            proc = await _asyncio.create_subprocess_exec(
+                *self.exec_cmd,
+                stdout=_asyncio.subprocess.PIPE,
+                stderr=_asyncio.subprocess.PIPE,
+            )
+            stdout, stderr = await proc.communicate()
+            if proc.returncode != 0:
+                log.warning(
+                    "camel exec %s exited %d: %s; retrying next poll",
+                    self.exec_cmd[0], proc.returncode,
+                    stderr.decode(errors="replace")[:200],
+                )
+                return []
+            return [self._rec(stdout, None)]
+        if self.scheme in ("rss", "atom"):
+            wait = self.delay - (now - self._last)
+            if wait > 0:
+                await _asyncio.sleep(min(wait, 0.5))
+                if self.delay - (time.monotonic() - self._last) > 0:
+                    return []
+            self._last = time.monotonic()
+            import aiohttp
+
+            if self._http is None or self._http.closed:
+                self._http = aiohttp.ClientSession()
+            try:
+                async with self._http.get(self.url) as resp:
+                    if resp.status >= 300:
+                        log.warning("camel %s poll %s -> HTTP %d; retrying",
+                                    self.scheme, self.url, resp.status)
+                        return []
+                    body = await resp.text()
+            except aiohttp.ClientError as e:
+                log.warning("camel %s poll %s failed (%s); retrying",
+                            self.scheme, self.url, e)
+                return []
+            out = []
+            for entry in _parse_feed_entries(body):
+                if entry["id"] in self._seen_entries:
+                    # refresh recency so rotation evicts truly-gone ids
+                    self._seen_entries.move_to_end(entry["id"])
+                    continue
+                self._seen_entries[entry["id"]] = None
+                out.append(self._rec(json.dumps(entry), entry["id"]))
+                if len(out) >= self.max_buffered:
+                    break
+            # bound the dedupe memory for immortal high-churn feeds: ids
+            # not seen in the last 10k entries may re-emit (at-least-once)
+            while len(self._seen_entries) > 10_000:
+                self._seen_entries.popitem(last=False)
+            return out
         if self.scheme == "file":
             import pathlib
 

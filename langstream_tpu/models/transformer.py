@@ -275,16 +275,21 @@ def _dispatch_attention(
     if s > 1 and kv_offset is not None:
         # chunked prefill: the segment attends to the whole written cache
         # prefix plus its own lower triangle (global-position causal)
-        from langstream_tpu.ops.attention import flash_segment_attention
+        from langstream_tpu.ops.attention import (
+            flash_segment_attention,
+            flash_segment_attention_int8,
+        )
 
         if pallas_ok(config, s, t):
+            if quantized:
+                # int8 cache rides into the kernel unconverted: the r5
+                # dequantize-then-kernel path materialized a cache-sized
+                # bf16 temp and paid its HBM round trip per segment
+                return flash_segment_attention_int8(
+                    q, k_all, v_all, kv_offset, config, interpret=interpret
+                )
             return flash_segment_attention(
-                q,
-                _dequantize_kv(k_all, q.dtype),
-                _dequantize_kv(v_all, q.dtype),
-                kv_offset,
-                config,
-                interpret=interpret,
+                q, k_all, v_all, kv_offset, config, interpret=interpret
             )
         return attention(q, k_all, v_all, mask, config)
     if s > 1 and causal and pallas_ok(config, s):

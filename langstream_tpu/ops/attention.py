@@ -45,6 +45,23 @@ def _fit_block(block: int, n: int) -> int:
     return block
 
 
+def _vmem_block_q(block_q: int, group: int, d: int, itemsize: int) -> int:
+    """Shrink block_q until the kernel's VMEM footprint fits the ~16MB
+    scoped budget. The prefill/segment kernels hold double-buffered q/out
+    blocks [G, block_q, D] plus f32 m/l/acc scratch [G, block_q, 128|D]:
+    at the 512 default that is ~17MB for fat-head models (gemma G=8
+    D=256 — Mosaic refused to compile exactly this in the r5 bench) but
+    ~5MB for llama (G=4 D=128), so the cap must be shape-aware rather
+    than a smaller global default that would slow llama down."""
+    while block_q > 128:
+        io = 2 * 2 * group * block_q * d * itemsize  # q + out, ×2 buffers
+        scratch = group * block_q * (128 + 128 + d) * 4
+        if io + scratch <= 11 * 1024 * 1024:
+            break
+        block_q //= 2
+    return block_q
+
+
 # ---------------------------------------------------------------------------
 # Prefill: causal blocked flash attention
 # ---------------------------------------------------------------------------
@@ -136,7 +153,9 @@ def flash_prefill_attention(
     b, s, h, d = q.shape
     hkv = k.shape[1]
     group = h // hkv
-    block_q = _fit_block(block_q, s)
+    block_q = _fit_block(
+        _vmem_block_q(block_q, group, d, jnp.dtype(q.dtype).itemsize), s
+    )
     block_k = _fit_block(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, "caller gates divisibility"
     # head-major queries: [B, Hkv, G, S, D] so the blocked dims are (S, D)
@@ -274,7 +293,9 @@ def flash_segment_attention(
     hkv = k.shape[1]
     t = k.shape[2]
     group = h // hkv
-    block_q = _fit_block(block_q, s)
+    block_q = _fit_block(
+        _vmem_block_q(block_q, group, d, jnp.dtype(q.dtype).itemsize), s
+    )
     block_k = _fit_block(block_k, t)
     assert s % block_q == 0 and t % block_k == 0, "caller gates divisibility"
     qg = q.reshape(b, s, hkv, group, d).transpose(0, 2, 3, 1, 4)
@@ -319,6 +340,160 @@ def flash_segment_attention(
         out_shape=jax.ShapeDtypeStruct((b, hkv, group, s, d), q.dtype),
         interpret=interpret,
     )(offset.astype(jnp.int32), qg, k, v)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, h * d)
+
+
+def _segment_int8_kernel(
+    off_ref,  # [B] int32 scalar-prefetch: global position of segment start
+    q_ref,  # [1, 1, G, block_q, D]
+    kq_ref,  # [1, 1, block_k, D] int8
+    ks_ref,  # [1, 1, block_k, 1] f32 per-token scales
+    vq_ref,  # [1, 1, block_k, D] int8
+    vs_ref,  # [1, 1, block_k, 1] f32
+    o_ref,  # [1, 1, G, block_q, D]
+    m_scr,  # [G, block_q, 128] f32
+    l_scr,  # [G, block_q, 128] f32
+    acc_scr,  # [G, block_q, D] f32
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+    softcap,
+):
+    """_segment_kernel over an int8 KV cache: the HBM read stays int8
+    (the r5 32k-TTFT residual was the materialized bf16 cache copy the
+    non-quantized kernel forced — ~8.6GB of traffic per late segment);
+    K/V dequantize in VMEM to the model dtype so the dots still ride the
+    MXU at bf16 rate (f32 operands measured 14 vs 34.8 TFLOPS)."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    off = off_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = off + i * block_q
+    k_start = j * block_k
+
+    @pl.when(k_start <= q_start + block_q - 1)
+    def _body():
+        q = q_ref[0, 0, :, :, :]  # [G, block_q, D] model dtype
+        k = (kq_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
+        v = (vq_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 1)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 2)
+        s = jnp.where(k_pos <= q_pos, s, _NEG)
+
+        m_prev = m_scr[:, :, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            dimension_numbers=(((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
+        m_scr[:, :, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
+        o_ref[0, 0, :, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def flash_segment_attention_int8(
+    q: jax.Array,  # [B, S, H, D] — segment queries
+    k: dict,  # int8 cache entry {"q": [B,Hkv,T,D] i8, "s": [B,Hkv,T] f32}
+    v: dict,
+    offset: jax.Array,  # [B] int32 global position of the segment start
+    config: ModelConfig,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """flash_segment_attention directly over the int8 KV cache → no
+    cache-sized bf16 temp, int8 on the HBM wire. Same causal/GQA math."""
+    b, s, h, d = q.shape
+    hkv = k["q"].shape[1]
+    t = k["q"].shape[2]
+    group = h // hkv
+    block_q = _fit_block(
+        _vmem_block_q(block_q, group, d, jnp.dtype(q.dtype).itemsize), s
+    )
+    block_k = _fit_block(block_k, t)
+    assert s % block_q == 0 and t % block_k == 0, "caller gates divisibility"
+    qg = q.reshape(b, s, hkv, group, d).transpose(0, 2, 3, 1, 4)
+
+    kernel = functools.partial(
+        _segment_int8_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+
+    def kv_index(b, h, i, j, off):
+        # clamp past-diagonal blocks to the last block this q block needs
+        # (same DMA-eliding trick as the bf16 segment kernel)
+        last = jnp.maximum(pl.cdiv(off[b] + (i + 1) * block_q, block_k) - 1, 0)
+        return (b, h, jnp.minimum(j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, s // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, group, block_q, d), lambda b, h, i, j, off: (b, h, 0, i, 0)
+            ),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            # trailing singleton: Mosaic needs the block's last two dims
+            # (8,128)-divisible or equal to the array's — [.., block_k, 1]
+            pl.BlockSpec((1, 1, block_k, 1), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, block_q, d), lambda b, h, i, j, off: (b, h, 0, i, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, 128), jnp.float32),
+            pltpu.VMEM((group, block_q, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, s, d), q.dtype),
+        interpret=interpret,
+    )(
+        offset.astype(jnp.int32),
+        qg,
+        k["q"],
+        k["s"][..., None],
+        v["q"],
+        v["s"][..., None],
+    )
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s, h * d)
 
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import itertools
 import logging
 import time
 from collections import deque
@@ -37,7 +38,10 @@ class LogHub:
         self._ring: deque[dict[str, Any]] = deque(maxlen=maxlen)
         self._subscribers: set[asyncio.Queue] = set()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        self._seq = 0
+        # atomic under CPython (single bytecode step): emit() may be called
+        # from agent executor threads concurrently, and a duplicated seq
+        # would make the /logs follow dedupe drop a genuine line
+        self._seq = itertools.count(1)
 
     def attach_loop(self, loop: asyncio.AbstractEventLoop) -> None:
         """Remember the serving loop so emit() can cross threads safely
@@ -45,9 +49,8 @@ class LogHub:
         self._loop = loop
 
     def emit(self, replica: str, level: str, message: str) -> None:
-        self._seq += 1
         entry = {
-            "seq": self._seq,
+            "seq": next(self._seq),
             "timestamp": time.time(),
             "replica": replica,
             "level": level,

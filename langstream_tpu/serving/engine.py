@@ -330,6 +330,7 @@ class ServingEngine:
         spmd: Optional[Any] = None,
         pipeline_depth: int = 1,
         ttft_chunk_floor: int = 4,
+        precompile: Optional[bool] = None,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -429,6 +430,18 @@ class ServingEngine:
         # this channel before making it; followers replay via follower_loop
         # (parallel/spmd_serving.py). None = single-host, zero overhead.
         self._spmd = spmd
+        # compile the decode kv_bound ladder up front (TPU default): a lazy
+        # ladder compile (~20s through the tunnel) otherwise lands MID-
+        # TRAFFIC and stalls every active stream — measured as the r5
+        # gateway bench regression (96 sessions all at 23.1s p50 TTFT
+        # because the first admission wave pushed positions+inflight past
+        # the largest warmed bound). Off by default on CPU: tests build
+        # hundreds of engines.
+        self._precompile = (
+            precompile
+            if precompile is not None
+            else jax.default_backend() == "tpu"
+        )
         # stats
         self.total_generated = 0
         self.total_requests = 0
@@ -519,6 +532,57 @@ class ServingEngine:
 
     # -- engine thread ------------------------------------------------------
 
+    def _warmup_decode_ladder(self) -> None:
+        """Run one throwaway decode chunk per kv_bound ladder step so every
+        decode shape is compiled BEFORE the first request is served. Runs on
+        the engine thread; slots are all free, so the garbage the warmup
+        writes into cache/token buffers is dead state (admission rewrites
+        every row it activates) — positions/tokens are reset anyway. SPMD:
+        announced like any decode so followers warm the same shapes."""
+        bounds = []
+        bound = 64
+        while bound < self.max_seq_len:
+            bounds.append(bound)
+            bound *= 2
+        bounds.append(self.max_seq_len)
+        for bound in dict.fromkeys(bounds):
+            if self._stop.is_set():
+                return
+            if self._spmd is not None:
+                from langstream_tpu.parallel.spmd_serving import (
+                    OP_DECODE,
+                    ControlBlock,
+                )
+
+                self._spmd.announce(ControlBlock(
+                    op=OP_DECODE, steps=self.decode_chunk, n_rows=0,
+                    slots=np.zeros(0, np.int32), kv_bound=bound,
+                ))
+            chunk = self._dev_decode(self.decode_chunk, [], bound)
+            chunk.block_until_ready()
+        floor = min(self.ttft_chunk_floor, self.decode_chunk)
+        if floor != self.decode_chunk:
+            # the TTFT-shrunk chunk is its own (steps, unbounded) program
+            if self._spmd is not None:
+                from langstream_tpu.parallel.spmd_serving import (
+                    OP_DECODE,
+                    ControlBlock,
+                )
+
+                self._spmd.announce(ControlBlock(
+                    op=OP_DECODE, steps=floor, n_rows=0,
+                    slots=np.zeros(0, np.int32), kv_bound=0,
+                ))
+            self._dev_decode(floor, [], None).block_until_ready()
+        # no buffer reset: admission rewrites every row it activates, and
+        # leaving the (deterministic) garbage in place keeps SPMD followers
+        # — which replay these warmups but not a leader-local reset — in
+        # exact lockstep
+        log.info(
+            "decode ladder precompiled: bounds %s, chunk %d",
+            bounds, self.decode_chunk,
+        )
+
     def _run(self) -> None:
         from collections import deque
 
@@ -527,6 +591,8 @@ class ServingEngine:
         # work overlaps host bookkeeping AND the next dispatches
         pending: deque[list[tuple]] = deque()
         try:
+            if self._precompile:
+                self._warmup_decode_ladder()
             while not self._stop.is_set():
                 # chunks dispatched in previous iterations are still
                 # unfetched when this iteration's dispatch computes its
@@ -840,10 +906,17 @@ class ServingEngine:
             for s in self._slots
             if s.active
         )
-        steps = 1
-        while steps * 2 <= min(want, max(1, headroom)):
-            steps *= 2
-        return steps
+        # QUANTIZE to exactly two step counts: every distinct (steps,
+        # kv_bound) pair is a separate XLA program, and on a tunneled chip
+        # a decode compile is ~15-20s — a mid-traffic compile of a novel
+        # shrunk size stalled every active stream (measured r5: the 96-
+        # session gateway wave sat at 23s p50 TTFT behind ONE steps=4
+        # compile). Tail/headroom overshoot is bounded by the floor and
+        # lands on OOB scatters XLA drops.
+        target = min(want, max(1, headroom))
+        if target >= self.decode_chunk:
+            return self.decode_chunk
+        return min(self.ttft_chunk_floor, self.decode_chunk)
 
     # -- chunked prefill (long-context) -------------------------------------
 
@@ -1102,7 +1175,13 @@ class ServingEngine:
         """Dispatch one multi-step decode; returns (device tokens,
         per-slot request snapshot, steps) for deferred host processing."""
         steps = self._chunk_steps()
-        kv_bound = self._decode_kv_bound(steps)
+        # shrunk (non-full) chunks run UNBOUNDED: pairing the occasional
+        # short chunk with the kv_bound ladder would multiply the compiled-
+        # program count (steps × bounds); a few full-width steps cost ~10ms
+        # extra read, a novel program costs a ~15-20s compile stall
+        kv_bound = (
+            self._decode_kv_bound(steps) if steps == self.decode_chunk else None
+        )
         stale: list[int] = []
         if self._freed_slots:
             # skip slots re-admitted since they freed (admit runs before
@@ -1114,7 +1193,10 @@ class ServingEngine:
 
             self._spmd.announce(ControlBlock(
                 op=OP_DECODE, steps=steps, n_rows=len(stale),
-                slots=np.asarray(stale, np.int32), kv_bound=kv_bound,
+                slots=np.asarray(stale, np.int32),
+                # unbounded (shrunk) chunks ride as 0 — the int32 wire
+                # header can't carry None; followers decode 0 back to None
+                kv_bound=kv_bound or 0,
             ))
         chunk = self._dev_decode(steps, stale, kv_bound)
         snapshot = [

@@ -300,3 +300,176 @@ def test_camel_source_timer_file_http(run):
             await g.init({"component-uri": "jms:queue:orders"})
 
     run(main())
+
+
+def test_camel_source_cron_exec_rss(run):
+    """Round-5 native camel widening: Quartz cron ticks, exec polling,
+    RSS/Atom feed polling with per-entry dedupe."""
+    from langstream_tpu.agents.connect import (
+        CamelSourceAgent,
+        _cron_due,
+        _cron_parse,
+        _parse_feed_entries,
+    )
+
+    # -- cron matcher unit coverage (pure) --
+    import time as _time
+
+    every_sec = _cron_parse("* * * * * ?")
+    assert _cron_due(every_sec, _time.localtime())
+    at_30 = _cron_parse("30 * * * * ?")
+    assert _cron_due(at_30, _time.struct_time((2026, 7, 31, 12, 0, 30, 4, 212, 0)))
+    assert not _cron_due(at_30, _time.struct_time((2026, 7, 31, 12, 0, 31, 4, 212, 0)))
+    # steps, ranges, names, 5-field crontab, quartz day numbers (1=SUN)
+    evens = _cron_parse("0/2 * * * * ?")
+    assert _cron_due(evens, _time.struct_time((2026, 7, 31, 0, 0, 4, 4, 212, 0)))
+    assert not _cron_due(evens, _time.struct_time((2026, 7, 31, 0, 0, 5, 4, 212, 0)))
+    jan_mon = _cron_parse("0 0 9 * JAN MON")
+    # 2026-01-05 is a Monday (tm_wday=0 → quartz 2=MON)
+    assert _cron_due(jan_mon, _time.struct_time((2026, 1, 5, 9, 0, 0, 0, 5, 0)))
+    assert not _cron_due(jan_mon, _time.struct_time((2026, 2, 2, 9, 0, 0, 0, 33, 0)))
+    classic = _cron_parse("*/5 * * * *")  # 5-field crontab → second 0
+    assert _cron_due(classic, _time.struct_time((2026, 7, 31, 8, 5, 0, 4, 212, 0)))
+    assert not _cron_due(classic, _time.struct_time((2026, 7, 31, 8, 5, 1, 4, 212, 0)))
+    with pytest.raises(ValueError):
+        _cron_parse("99 * * * * ?")
+
+    # -- feed parsing (pure) --
+    rss_body = """<rss version="2.0"><channel>
+      <item><guid>g1</guid><title>first</title><link>http://x/1</link>
+        <description>d1</description></item>
+      <item><guid>g2</guid><title>second</title><link>http://x/2</link></item>
+    </channel></rss>"""
+    entries = _parse_feed_entries(rss_body)
+    assert [e["id"] for e in entries] == ["g1", "g2"]
+    assert entries[0]["summary"] == "d1"
+    atom_body = """<feed xmlns="http://www.w3.org/2005/Atom">
+      <entry><id>a1</id><title>atom one</title>
+        <link href="http://x/a1"/><updated>2026-01-01</updated></entry>
+    </feed>"""
+    aentries = _parse_feed_entries(atom_body)
+    assert aentries[0]["id"] == "a1" and aentries[0]["link"] == "http://x/a1"
+    assert _parse_feed_entries("not xml") == []
+
+    async def main():
+        # cron: every-second schedule fires within ~1.5s
+        c = CamelSourceAgent()
+        await c.init({"component-uri": "cron:tab?schedule=*+*+*+*+*+?"})
+        got = []
+        for _ in range(40):
+            got.extend(await c.read())
+            if got:
+                break
+        assert got, "cron never fired"
+        payload = json.loads(got[0].value)
+        assert payload["cron"] == "tab" and payload["count"] == 1
+        await c.close()
+
+        # exec: run a command per poll, stdout is the record
+        e = CamelSourceAgent()
+        await e.init({
+            "component-uri": "exec:/bin/echo?args=camel+exec+works&delay=10"
+        })
+        got = []
+        for _ in range(50):
+            got.extend(await e.read())
+            if got:
+                break
+        assert got[0].value.strip() == b"camel exec works"
+        await e.close()
+
+        # rss: one record per NEW entry across polls
+        feed_versions = [
+            """<rss version="2.0"><channel>
+               <item><guid>r1</guid><title>one</title></item>
+               </channel></rss>""",
+            """<rss version="2.0"><channel>
+               <item><guid>r1</guid><title>one</title></item>
+               <item><guid>r2</guid><title>two</title></item>
+               </channel></rss>""",
+        ]
+        polls = []
+
+        async def feed(request):
+            body = feed_versions[min(len(polls), 1)]
+            polls.append(1)
+            return web.Response(text=body, content_type="application/xml")
+
+        app = web.Application()
+        app.router.add_get("/feed.xml", feed)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        r = CamelSourceAgent()
+        await r.init({
+            "component-uri": f"rss:http://127.0.0.1:{port}/feed.xml?delay=10"
+        })
+        first = []
+        for _ in range(50):
+            first.extend(await r.read())
+            if first:
+                break
+        assert [json.loads(rec.value)["id"] for rec in first] == ["r1"]
+        second = []
+        for _ in range(50):
+            second.extend(await r.read())
+            if second:
+                break
+        # only the NEW entry on the second poll — r1 deduped
+        assert [json.loads(rec.value)["id"] for rec in second] == ["r2"]
+        assert second[0].key == "r2"
+        await r.close()
+        await runner.cleanup()
+
+    run(main())
+
+
+def test_cron_classic_dow_wrap_and_catchup(run):
+    """Review follow-ups: classic 5-field crontab keeps crontab day
+    numbering (0/7=SUN), wrap-around ranges work, and a stalled reader
+    catches up missed seconds instead of dropping the fire."""
+    import time as _time
+
+    from langstream_tpu.agents.connect import (
+        CamelSourceAgent,
+        _cron_due,
+        _cron_parse,
+    )
+
+    # classic numeric dow: `0 9 * * 5` = FRIDAY 9am (crontab), not Thursday
+    fri = _cron_parse("0 9 * * 5")
+    # 2026-01-02 is a Friday (tm_wday=4)
+    assert _cron_due(fri, _time.struct_time((2026, 1, 2, 9, 0, 0, 4, 2, 0)))
+    assert not _cron_due(fri, _time.struct_time((2026, 1, 1, 9, 0, 0, 3, 1, 0)))
+    # classic 0 and 7 both mean Sunday (2026-01-04, tm_wday=6)
+    for tok in ("0", "7"):
+        sun = _cron_parse(f"0 9 * * {tok}")
+        assert _cron_due(sun, _time.struct_time((2026, 1, 4, 9, 0, 0, 6, 4, 0)))
+    # quartz (6-field) numeric dow: 1 = Sunday
+    qsun = _cron_parse("0 0 9 ? * 1")
+    assert _cron_due(qsun, _time.struct_time((2026, 1, 4, 9, 0, 0, 6, 4, 0)))
+    # wrap-around range FRI-SUN covers Fri, Sat, Sun
+    wrap = _cron_parse("0 0 22 ? * FRI-SUN")
+    for day, wday in ((2, 4), (3, 5), (4, 6)):  # 2026-01-02..04
+        assert _cron_due(wrap, _time.struct_time((2026, 1, day, 22, 0, 0, wday, day, 0)))
+    assert not _cron_due(wrap, _time.struct_time((2026, 1, 5, 22, 0, 0, 0, 5, 0)))
+    # wrap-around hour range 22-2
+    hours = _cron_parse("0 0 22-2 * * ?")[2]
+    assert hours == {22, 23, 0, 1, 2}
+
+    async def main():
+        # catch-up: simulate a stalled reader by rewinding _checked_sec
+        agent = CamelSourceAgent()
+        await agent.init({"component-uri": "cron:t?schedule=*+*+*+*+*+?"})
+        agent._checked_sec = int(__import__("time").time()) - 4
+        got = await agent.read()
+        # one record per missed second (~4), not just the current one
+        assert len(got) >= 3
+        counts = [json.loads(r.value)["count"] for r in got]
+        assert counts == sorted(counts)
+        await agent.close()
+
+    run(main())

@@ -454,3 +454,53 @@ def test_moe_engine_matches_unbatched_reference():
         assert result.tokens == ref, (result.tokens, ref)
     finally:
         engine.stop()
+
+
+def test_long_prompt_int8_kv_pallas_matches_jnp():
+    """The chunked-prefill (segment) path with an int8 KV cache through the
+    pallas int8 segment kernel (interpret off-TPU) must produce the same
+    greedy tokens as the jnp hoisted-scale path — the kernel is a pure
+    bandwidth optimization, not a math change."""
+    tokens_by_impl = {}
+    for impl in ("jnp", "pallas"):
+        cfg = dataclasses.replace(
+            CFG, kv_cache_dtype="int8", attention_impl=impl
+        )
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        engine = ServingEngine(
+            cfg, params, max_batch=1, max_seq_len=256, decode_chunk=4,
+            prefill_buckets=(64,),
+        )
+        engine.start()
+        try:
+            prompt = [(3 + 5 * i) % cfg.vocab_size for i in range(150)]  # 3 segments
+            result = engine.generate(
+                prompt,
+                GenerationOptions(max_new_tokens=8, temperature=0.0),
+                timeout=600,
+            )
+            assert result.prompt_tokens == 150
+            tokens_by_impl[impl] = result.tokens
+        finally:
+            engine.stop()
+    assert tokens_by_impl["jnp"] == tokens_by_impl["pallas"], tokens_by_impl
+
+
+def test_precompile_ladder_then_serve():
+    """precompile=True warms a decode chunk per kv_bound ladder step before
+    serving; the warmup garbage must not leak into real generations (same
+    greedy tokens as a cold engine)."""
+    cold = make_engine(max_batch=2, max_seq_len=256, decode_chunk=4)
+    try:
+        opts = GenerationOptions(max_new_tokens=12, temperature=0.0)
+        expected = cold.generate([5, 6, 7], opts, timeout=120).tokens
+    finally:
+        cold.stop()
+    warm = make_engine(
+        max_batch=2, max_seq_len=256, decode_chunk=4, precompile=True
+    )
+    try:
+        got = warm.generate([5, 6, 7], opts, timeout=120).tokens
+    finally:
+        warm.stop()
+    assert got == expected

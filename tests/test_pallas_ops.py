@@ -128,3 +128,77 @@ def test_pallas_ok_gating():
     assert pallas_ok(CFG, 128) == (tpu and CFG.resolved_head_dim % 128 == 0)
     wide = dataclasses.replace(CFG, head_dim=128)
     assert pallas_ok(wide, 128) == tpu
+
+
+def _int8_cache(key, b, hkv, t, d):
+    from langstream_tpu.models.transformer import _quantize_kv
+
+    q8, s = _quantize_kv(rand(key, b, hkv, t, d))
+    return {"q": q8, "s": s}
+
+
+def test_flash_segment_matches_reference():
+    """Chunked-prefill segment kernel: global-position causal against the
+    cache prefix + the segment's own lower triangle."""
+    from langstream_tpu.ops.attention import flash_segment_attention
+
+    b, s, t, h, hkv, d = 2, 16, 64, 8, 4, 8
+    q = rand(0, b, s, h, d)
+    k, v = rand(1, b, hkv, t, d), rand(2, b, hkv, t, d)
+    offset = jnp.asarray([0, 32], jnp.int32)
+    q_pos = offset[:, None, None] + jnp.arange(s)[None, :, None]
+    mask = jnp.arange(t)[None, None, :] <= q_pos
+    for config in (CFG, SOFTCAP_CFG):
+        ref = attention(q, k, v, mask, config)
+        out = flash_segment_attention(
+            q, k, v, offset, config, block_q=8, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-5, atol=1e-5)
+
+
+def test_flash_segment_int8_matches_dequantized_reference():
+    """The int8 segment kernel computes dequantize-then-attend with the
+    dequantize in VMEM — so the EXACT reference is attention over the
+    explicitly dequantized cache (the jnp int8 path hoists scales instead,
+    which rounds differently; it is checked loosely below)."""
+    from langstream_tpu.models.transformer import _dequantize_kv
+    from langstream_tpu.ops.attention import flash_segment_attention_int8
+
+    b, s, t, h, hkv, d = 2, 16, 64, 8, 4, 8
+    q = rand(0, b, s, h, d)
+    k8, v8 = _int8_cache(1, b, hkv, t, d), _int8_cache(2, b, hkv, t, d)
+    offset = jnp.asarray([16, 48], jnp.int32)
+    q_pos = offset[:, None, None] + jnp.arange(s)[None, :, None]
+    mask = jnp.arange(t)[None, None, :] <= q_pos
+    kd, vd = _dequantize_kv(k8, q.dtype), _dequantize_kv(v8, q.dtype)
+    for config in (CFG, SOFTCAP_CFG):
+        ref = attention(q, kd, vd, mask, config)
+        out = flash_segment_attention_int8(
+            q, k8, v8, offset, config, block_q=8, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), rtol=1e-5, atol=1e-5
+        )
+        # and the hoisted-scale jnp int8 path agrees to quantization noise
+        loose = attention(q, k8, v8, mask, config)
+        np.testing.assert_allclose(
+            np.asarray(loose), np.asarray(out), rtol=1e-1, atol=3e-2
+        )
+
+
+def test_ragged_decode_int8_matches_int8_reference():
+    from langstream_tpu.ops.attention import ragged_decode_attention_int8
+
+    b, t, h, hkv, d = 4, 64, 8, 4, 8
+    q = rand(0, b, 1, h, d)
+    k8, v8 = _int8_cache(1, b, hkv, t, d), _int8_cache(2, b, hkv, t, d)
+    lengths = jnp.asarray([1, 17, 40, 64], jnp.int32)
+    mask = jnp.arange(t)[None, None, :] < lengths[:, None, None]
+    for config in (CFG, SOFTCAP_CFG):
+        ref = attention(q, k8, v8, mask, config)[:, 0]
+        out = ragged_decode_attention_int8(
+            q[:, 0], k8, v8, lengths, config, block_k=16, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2
+        )

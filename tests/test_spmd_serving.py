@@ -213,3 +213,25 @@ def test_loopback_moe_lockstep_on_expert_mesh():
         jax.tree.leaves(jax.device_get(follower._cache)),
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_announce_unbounded_decode_packs():
+    """Shrunk (TTFT-floor) chunks dispatch with kv_bound=None; the wire
+    header is int32, so the announce layer must carry it as 0 and the
+    follower must decode 0 back to None (regression: None crashed _pack)."""
+    import numpy as np
+
+    from langstream_tpu.parallel.spmd_serving import (
+        OP_DECODE,
+        ControlBlock,
+        LoopbackChannel,
+    )
+
+    channel = LoopbackChannel(prefill_batch=4, max_width=64, max_batch=4)
+    channel.announce(ControlBlock(
+        op=OP_DECODE, steps=4, n_rows=0,
+        slots=np.zeros(0, np.int32), kv_bound=0,
+    ))
+    block = channel.recv()
+    assert block.op == OP_DECODE and block.steps == 4
+    assert (block.kv_bound or None) is None
