@@ -184,6 +184,9 @@ class _EngineHolder:
             spmd=spmd,
             pipeline_depth=int(self.config.get("pipeline-depth", 1)),
             ttft_chunk_floor=int(self.config.get("ttft-chunk-floor", 4)),
+            # default (None): precompile the decode ladder on TPU backends
+            # so no XLA compile ever lands mid-traffic (PERF.md round 5b)
+            precompile=self.config.get("precompile"),
         )
         if start:
             engine.start()

@@ -20,6 +20,25 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _greedy_argmax(logits: jax.Array) -> jax.Array:
+    """Two-stage argmax over the vocab: group maxima first, then one small
+    argmax across groups — a [B, 256k] single-pass argmax keeps a running
+    index vector the full width, while the grouped form does the wide pass
+    as a pure max (cheaper on the VPU) and the index math at 1/128 width.
+    Tie semantics match jnp.argmax exactly (first index wins: the first
+    group holding the global max, the first position within it)."""
+    b, v = logits.shape
+    group = 128
+    if v % group:
+        return jnp.argmax(logits, axis=-1)
+    grouped = logits.reshape(b, v // group, group)
+    within = jnp.argmax(grouped, axis=-1)  # [B, v/group]
+    maxima = jnp.max(grouped, axis=-1)
+    top_group = jnp.argmax(maxima, axis=-1)  # [B]
+    offsets = jnp.take_along_axis(within, top_group[:, None], axis=-1)[:, 0]
+    return top_group * group + offsets
+
+
 @functools.partial(jax.jit, static_argnames=())
 def sample(
     logits: jax.Array,  # [B, V] fp32
@@ -30,7 +49,7 @@ def sample(
 ) -> jax.Array:
     """Returns sampled token ids [B]. temperature 0 → greedy for that slot."""
     b, v = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
+    greedy = _greedy_argmax(logits)
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp

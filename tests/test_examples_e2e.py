@@ -1047,3 +1047,15 @@ def test_llamaindex_cassandra_sink_e2e(run):
             await server.stop()
 
     run(main())
+
+
+def test_moe_chat_e2e(run):
+    """MoE serving end-to-end through the platform path: expert routing
+    (tiny-moe preset) under the continuous batcher, streamed to the topic."""
+
+    async def scenario(runner):
+        await runner.produce("moe-input", "route me through the experts")
+        out = await runner.consume("moe-output", n=1, timeout=240)
+        assert out[0].value  # first streamed chunk arrives non-empty
+
+    run(run_example("moe-chat", scenario))

@@ -1,0 +1,41 @@
+"""Sampler unit coverage: the two-stage greedy argmax must be bit-identical
+to jnp.argmax (including tie-breaking), and the filtered sampling path must
+honor per-slot top-k/top-p."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.serving.sampling import _greedy_argmax, sample
+
+
+def test_two_stage_argmax_matches_plain():
+    key = jax.random.PRNGKey(0)
+    for b, v in ((1, 128), (4, 2048), (3, 128 * 37), (2, 1000)):  # 1000: fallback
+        logits = jax.random.normal(jax.random.fold_in(key, v), (b, v))
+        np.testing.assert_array_equal(
+            np.asarray(_greedy_argmax(logits)), np.asarray(jnp.argmax(logits, axis=-1))
+        )
+
+
+def test_two_stage_argmax_tie_breaks_first_index():
+    # global max duplicated across groups AND within a group: first index wins
+    logits = np.zeros((2, 512), np.float32)
+    logits[0, [5, 130, 300]] = 7.0  # groups 0, 1, 2
+    logits[1, [200, 201]] = 3.0  # same group, adjacent
+    out = np.asarray(_greedy_argmax(jnp.asarray(logits)))
+    assert out.tolist() == [5, 200]
+
+
+def test_sample_greedy_vs_filtered_slots():
+    v = 256
+    logits = jnp.asarray(np.linspace(0.0, 5.0, v, dtype=np.float32))[None, :]
+    logits = jnp.concatenate([logits, logits], axis=0)  # [2, V]
+    temperature = jnp.asarray([0.0, 1.0])  # slot 0 greedy, slot 1 top-k
+    top_k = jnp.asarray([0, 4], jnp.int32)
+    top_p = jnp.asarray([1.0, 1.0], jnp.float32)
+    out = np.asarray(
+        sample(logits, jax.random.PRNGKey(1), temperature, top_k, top_p)
+    )
+    assert out[0] == v - 1  # greedy slot: argmax
+    assert v - 4 <= out[1] <= v - 1  # sampled slot restricted to top-4
