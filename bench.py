@@ -356,7 +356,10 @@ def main() -> None:
         # admission wave lands in ONE prefill dispatch
         preset, quantize = "gemma-2b", True
         max_batch, new_tokens, n_requests, n_sessions = 192, 256, 384, 96
-        max_seq_len, decode_chunk, prefill_batch = 1024, 16, 192
+        # T=512 covers the workload (32 prompt + 256 new + inflight): the
+        # decode kv_bound never exceeded 512 at T=1024 either, and the
+        # smaller width drops one precompiled ladder program per engine
+        max_seq_len, decode_chunk, prefill_batch = 512, 16, 192
         long_len, long_seg, long_max_seq = 8000, 2048, 8192
 
     print(f"[bench] engine phase: {preset} quantize={quantize}", file=sys.stderr, flush=True)
@@ -394,10 +397,12 @@ def main() -> None:
             # and a 1024-wide config at B=84 compile-OOMs on the largest
             # bound — r5's "B=84 knee at 1024" only ever ran bounds ≤256,
             # i.e. it advertised capacity it couldn't serve. The honest
-            # config also frees ~4G of cache for batch.
+            # width freed ~4G of cache, and the batch re-sweep (r5b:
+            # 84/128/160/192/224 → 2666/3719/3842/3883/3812) moved the
+            # knee to B=192.
             llama_tok_s = bench_engine(
-                "llama-3-8b", True, max_batch=84, new_tokens=128,
-                n_requests=168, max_seq_len=256, decode_chunk=16,
+                "llama-3-8b", True, max_batch=192, new_tokens=128,
+                n_requests=384, max_seq_len=256, decode_chunk=16,
                 kv_int8=True,
             )
             extras["llama_3_8b_int8_tokens_per_sec"] = round(llama_tok_s, 2)
@@ -411,9 +416,12 @@ def main() -> None:
         # dryrun-validated in __graft_entry__ instead.
         try:
             print("[bench] mixtral-8x1b MoE phase", file=sys.stderr, flush=True)
+            # r5b batch sweep: 32/64/96/128/160/192/224 →
+            # 1608/2552/3141/4085/4346/4510/4379 tok/s — knee at B=192
+            # (top-2 expert FFNs amortize across the bigger token batch)
             moe_tok_s = bench_engine(
-                "mixtral-8x1b", True, max_batch=32, new_tokens=128,
-                n_requests=64, max_seq_len=256, decode_chunk=16,
+                "mixtral-8x1b", True, max_batch=192, new_tokens=128,
+                n_requests=384, max_seq_len=256, decode_chunk=16,
                 kv_int8=True,
             )
             extras["moe_mixtral_8x1b_int8_tokens_per_sec"] = round(moe_tok_s, 2)

@@ -90,16 +90,21 @@ def init_random_quantized_params(config: ModelConfig, key: jax.Array) -> Params:
     keys = iter(jax.random.split(key, 16))
 
     def qw(*shape, scale_of=None):
+        import math
+
         import numpy as np
 
         fan_in = scale_of if scale_of is not None else shape[-2]
-        # int8 values are drawn on the HOST and uploaded in one put:
-        # device-side jax.random.randint materializes a uint32 temp of the
-        # full shape (4 bytes/elem — 11.3GiB for the stacked mixtral-8x1b
-        # w_gate), and splitting into per-layer draws still OOMed because
-        # remote/tunnel backends defer intermediate buffer frees. A single
-        # host-generated upload has no device temps at all; init is a
-        # once-per-engine cost.
+        # int8 values are drawn on the HOST and uploaded: device-side
+        # jax.random.randint materializes a uint32 temp of the full shape
+        # (4 bytes/elem — 11.3GiB for the stacked mixtral-8x1b w_gate), and
+        # splitting into per-layer draws still OOMed because remote/tunnel
+        # backends defer intermediate buffer frees. Uploading the FULL 8GB
+        # tree through the tunnel cost minutes per bench phase, so only a
+        # ≤64MB block rides the wire and the device tiles it along axis 0
+        # (int8 in, int8 out — no wide temps). Repeating values along the
+        # leading axis is irrelevant to what this exists for: benchmarking
+        # (timing is value-independent; scales keep softmax finite).
         k = next(keys)
         if isinstance(k, jax.core.Tracer):
             # abstract evaluation (serving/memory.py plans via eval_shape):
@@ -107,7 +112,16 @@ def init_random_quantized_params(config: ModelConfig, key: jax.Array) -> Params:
             q = jnp.zeros(shape, jnp.int8)
         else:
             rng = np.random.default_rng(np.asarray(k))
-            q = jnp.asarray(rng.integers(-127, 128, shape, np.int8))
+            row_bytes = math.prod(shape[1:]) if len(shape) > 1 else 1
+            block_rows = min(shape[0], max(1, (64 << 20) // max(row_bytes, 1)))
+            block = jnp.asarray(
+                rng.integers(-127, 128, (block_rows, *shape[1:]), np.int8)
+            )
+            if block_rows == shape[0]:
+                q = block
+            else:
+                reps = -(-shape[0] // block_rows)  # ceil
+                q = jnp.tile(block, (reps,) + (1,) * (len(shape) - 1))[: shape[0]]
         s = jnp.full(shape[:-2] + (1, shape[-1]), fan_in**-0.5 / 127.0, jnp.float32)
         return {"q": q, "s": s}
 

@@ -44,6 +44,14 @@ class ServingMemoryPlan:
     # double-buffer is gone (r4 it OOMed llama-3-8b past B=48); what
     # remains live is the current layer's read slice + its updated copy.
     scan_buffer_bytes: int = 0
+    # kv_bound slice+splice peak: a decode chunk at a SLICED bound copies
+    # the cache's first `bound` columns out and back (engine._decode_chunk),
+    # so up to bound/width of the cache is live ON TOP of the full cache.
+    # The largest sliced bound is width/2 → worst case cache/2. The r5b
+    # full-ladder precompile made this peak unavoidable at startup — the
+    # llama B=84 @ T=1024 config that "fit" without this term compile-OOMed
+    # by exactly this allocation.
+    bound_slice_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -53,6 +61,7 @@ class ServingMemoryPlan:
             + self.long_cache_bytes
             + self.workspace_bytes
             + self.scan_buffer_bytes
+            + self.bound_slice_bytes
         )
 
     def fits(self, hbm_bytes: int) -> bool:
@@ -63,7 +72,8 @@ class ServingMemoryPlan:
         return (
             f"weights {self.weights_bytes / gib:.2f}GiB + "
             f"cache {self.cache_bytes / gib:.2f}GiB "
-            f"(+{self.scan_buffer_bytes / gib:.2f}GiB scan double-buffer) + "
+            f"(+{self.scan_buffer_bytes / gib:.2f}GiB scan double-buffer, "
+            f"+{self.bound_slice_bytes / gib:.2f}GiB kv_bound slice peak) + "
             f"long-prefill {self.long_cache_bytes / gib:.2f}GiB + "
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
@@ -114,6 +124,11 @@ def plan_serving_memory(
         workspace_bytes=workspace_bytes,
         # 2 layer slices (read + updated copy) live inside the chunk scan
         scan_buffer_bytes=2 * cache_bytes // max(config.n_layers, 1),
+        # largest SLICED decode bound is max_seq_len/2 (the full-width
+        # program skips the slice) → worst-case cache/2 live alongside the
+        # cache during that chunk's copy-out/copy-back. Widths ≤64 never
+        # slice (the ladder starts at 64).
+        bound_slice_bytes=cache_bytes // 2 if max_seq_len > 64 else 0,
     )
 
 
