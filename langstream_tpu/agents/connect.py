@@ -498,6 +498,40 @@ class CamelSourceAgent(AgentSource):
         # source paths; deletion happens in commit() (at-least-once)
         self._pending_delete: dict[str, str] = {}
 
+    async def _throttled(self, now: float) -> bool:
+        """Shared poll throttle (http/exec/rss): True = not yet time."""
+        import asyncio as _asyncio
+
+        wait = self.delay - (now - self._last)
+        if wait > 0:
+            await _asyncio.sleep(min(wait, 0.5))
+            if self.delay - (time.monotonic() - self._last) > 0:
+                return True
+        self._last = time.monotonic()
+        return False
+
+    async def _fetch_url(self) -> Optional[str]:
+        """Shared GET for the http/rss/atom pollers: response body, or
+        None on transport/HTTP errors (logged; retried next poll)."""
+        import aiohttp
+
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession()
+        try:
+            async with self._http.get(self.url) as resp:
+                if resp.status >= 300:
+                    log.warning(
+                        "camel %s poll %s -> HTTP %d; retrying next poll",
+                        self.scheme, self.url, resp.status,
+                    )
+                    return None
+                return await resp.text()
+        except aiohttp.ClientError as e:
+            log.warning(
+                "camel %s poll %s failed (%s); retrying", self.scheme, self.url, e
+            )
+            return None
+
     def _rec(self, value, natural_key):
         """Build a record honoring key-header: the reference takes the
         record key from the named exchange header — natively, the natural
@@ -554,12 +588,8 @@ class CamelSourceAgent(AgentSource):
                     break
             return out
         if self.scheme == "exec":
-            wait = self.delay - (now - self._last)
-            if wait > 0:
-                await _asyncio.sleep(min(wait, 0.5))
-                if self.delay - (time.monotonic() - self._last) > 0:
-                    return []
-            self._last = time.monotonic()
+            if await self._throttled(now):
+                return []
             proc = await _asyncio.create_subprocess_exec(
                 *self.exec_cmd,
                 stdout=_asyncio.subprocess.PIPE,
@@ -575,26 +605,10 @@ class CamelSourceAgent(AgentSource):
                 return []
             return [self._rec(stdout, None)]
         if self.scheme in ("rss", "atom"):
-            wait = self.delay - (now - self._last)
-            if wait > 0:
-                await _asyncio.sleep(min(wait, 0.5))
-                if self.delay - (time.monotonic() - self._last) > 0:
-                    return []
-            self._last = time.monotonic()
-            import aiohttp
-
-            if self._http is None or self._http.closed:
-                self._http = aiohttp.ClientSession()
-            try:
-                async with self._http.get(self.url) as resp:
-                    if resp.status >= 300:
-                        log.warning("camel %s poll %s -> HTTP %d; retrying",
-                                    self.scheme, self.url, resp.status)
-                        return []
-                    body = await resp.text()
-            except aiohttp.ClientError as e:
-                log.warning("camel %s poll %s failed (%s); retrying",
-                            self.scheme, self.url, e)
+            if await self._throttled(now):
+                return []
+            body = await self._fetch_url()
+            if body is None:
                 return []
             out = []
             for entry in _parse_feed_entries(body):
@@ -631,29 +645,10 @@ class CamelSourceAgent(AgentSource):
                 await _asyncio.sleep(0.05)
             return out
         # http(s) poller
-        wait = self.delay - (now - self._last)
-        if wait > 0:
-            await _asyncio.sleep(min(wait, 0.5))
-            if self.delay - (time.monotonic() - self._last) > 0:
-                return []
-        self._last = time.monotonic()
-        import aiohttp
-
-        if self._http is None or self._http.closed:
-            self._http = aiohttp.ClientSession()
-        try:
-            async with self._http.get(self.url) as resp:
-                if resp.status >= 300:
-                    log.warning(
-                        "camel http poll %s -> HTTP %d; retrying next poll",
-                        self.url, resp.status,
-                    )
-                    return []
-                body = await resp.text()
-        except aiohttp.ClientError as e:
-            log.warning("camel http poll %s failed (%s); retrying", self.url, e)
+        if await self._throttled(now):
             return []
-        return [self._rec(body, None)]
+        body = await self._fetch_url()
+        return [] if body is None else [self._rec(body, None)]
 
     async def commit(self, records: list[Record]) -> None:
         """file scheme's delete=true happens HERE — after every downstream

@@ -201,11 +201,10 @@ def flash_prefill_attention(
 # ---------------------------------------------------------------------------
 
 
-def _segment_kernel(
+def _segment_body(
     off_ref,  # [B] int32 scalar-prefetch: global position of segment start
     q_ref,  # [1, 1, G, block_q, D]
-    k_ref,  # [1, 1, block_k, D]
-    v_ref,  # [1, 1, block_k, D]
+    load_kv,  # (q_dtype) -> ([block_k, D], [block_k, D]) in model dtype
     o_ref,  # [1, 1, G, block_q, D]
     m_scr,  # [G, block_q, 128] f32
     l_scr,  # [G, block_q, 128] f32
@@ -216,6 +215,8 @@ def _segment_kernel(
     scale: float,
     softcap,
 ):
+    """Shared online-softmax body of the two segment kernels (bf16 cache
+    and int8 cache differ only in how the K/V block materializes)."""
     b = pl.program_id(0)
     i = pl.program_id(2)  # query block (within the segment)
     j = pl.program_id(3)  # key block (over the full cache width)
@@ -238,8 +239,7 @@ def _segment_kernel(
         # model-dtype dots, fp32 accumulation (see _prefill_kernel note:
         # f32-cast operands ran the MXU at ~14 TFLOPS — the 32k TTFT)
         q = q_ref[0, 0, :, :, :]  # [G, block_q, D]
-        k = k_ref[0, 0, :, :]
-        v = v_ref[0, 0, :, :]
+        k, v = load_kv(q.dtype)
         s = (
             jax.lax.dot_general(
                 q,
@@ -274,6 +274,16 @@ def _segment_kernel(
     def _finalize():
         l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
         o_ref[0, 0, :, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _segment_kernel(
+    off_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, **opts
+):
+    _segment_body(
+        off_ref, q_ref,
+        lambda _dt: (k_ref[0, 0, :, :], v_ref[0, 0, :, :]),
+        o_ref, m_scr, l_scr, acc_scr, **opts,
+    )
 
 
 def flash_segment_attention(
@@ -354,71 +364,20 @@ def _segment_int8_kernel(
     m_scr,  # [G, block_q, 128] f32
     l_scr,  # [G, block_q, 128] f32
     acc_scr,  # [G, block_q, D] f32
-    *,
-    block_q: int,
-    block_k: int,
-    scale: float,
-    softcap,
+    **opts,
 ):
-    """_segment_kernel over an int8 KV cache: the HBM read stays int8
+    """_segment_body over an int8 KV cache: the HBM read stays int8
     (the r5 32k-TTFT residual was the materialized bf16 cache copy the
     non-quantized kernel forced — ~8.6GB of traffic per late segment);
     K/V dequantize in VMEM to the model dtype so the dots still ride the
     MXU at bf16 rate (f32 operands measured 14 vs 34.8 TFLOPS)."""
-    b = pl.program_id(0)
-    i = pl.program_id(2)
-    j = pl.program_id(3)
-    nk = pl.num_programs(3)
-    off = off_ref[b]
 
-    @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, _NEG)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
+    def load_kv(dtype):
+        k = (kq_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]).astype(dtype)
+        v = (vq_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]).astype(dtype)
+        return k, v
 
-    q_start = off + i * block_q
-    k_start = j * block_k
-
-    @pl.when(k_start <= q_start + block_q - 1)
-    def _body():
-        q = q_ref[0, 0, :, :, :]  # [G, block_q, D] model dtype
-        k = (kq_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]).astype(q.dtype)
-        v = (vq_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]).astype(q.dtype)
-        s = (
-            jax.lax.dot_general(
-                q,
-                k,
-                dimension_numbers=(((2,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )
-        if softcap is not None:
-            s = jnp.tanh(s / softcap) * softcap
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 1)
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_q, block_k), 2)
-        s = jnp.where(k_pos <= q_pos, s, _NEG)
-
-        m_prev = m_scr[:, :, 0]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, :, None])
-        p = jnp.where(s <= _NEG, 0.0, p)
-        corr = jnp.exp(m_prev - m_new)
-        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype),
-            v,
-            dimension_numbers=(((2,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
-        m_scr[:, :, 0] = m_new
-
-    @pl.when(j == nk - 1)
-    def _finalize():
-        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
-        o_ref[0, 0, :, :, :] = (acc_scr[...] / l).astype(o_ref.dtype)
+    _segment_body(off_ref, q_ref, load_kv, o_ref, m_scr, l_scr, acc_scr, **opts)
 
 
 def flash_segment_attention_int8(

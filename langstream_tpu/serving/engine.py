@@ -539,6 +539,19 @@ class ServingEngine:
         writes into cache/token buffers is dead state (admission rewrites
         every row it activates) — positions/tokens are reset anyway. SPMD:
         announced like any decode so followers warm the same shapes."""
+        def warm(steps: int, bound: Optional[int]) -> None:
+            if self._spmd is not None:
+                from langstream_tpu.parallel.spmd_serving import (
+                    OP_DECODE,
+                    ControlBlock,
+                )
+
+                self._spmd.announce(ControlBlock(
+                    op=OP_DECODE, steps=steps, n_rows=0,
+                    slots=np.zeros(0, np.int32), kv_bound=bound or 0,
+                ))
+            self._dev_decode(steps, [], bound).block_until_ready()
+
         bounds = []
         bound = 64
         while bound < self.max_seq_len:
@@ -548,32 +561,11 @@ class ServingEngine:
         for bound in dict.fromkeys(bounds):
             if self._stop.is_set():
                 return
-            if self._spmd is not None:
-                from langstream_tpu.parallel.spmd_serving import (
-                    OP_DECODE,
-                    ControlBlock,
-                )
-
-                self._spmd.announce(ControlBlock(
-                    op=OP_DECODE, steps=self.decode_chunk, n_rows=0,
-                    slots=np.zeros(0, np.int32), kv_bound=bound,
-                ))
-            chunk = self._dev_decode(self.decode_chunk, [], bound)
-            chunk.block_until_ready()
+            warm(self.decode_chunk, bound)
         floor = min(self.ttft_chunk_floor, self.decode_chunk)
         if floor != self.decode_chunk:
             # the TTFT-shrunk chunk is its own (steps, unbounded) program
-            if self._spmd is not None:
-                from langstream_tpu.parallel.spmd_serving import (
-                    OP_DECODE,
-                    ControlBlock,
-                )
-
-                self._spmd.announce(ControlBlock(
-                    op=OP_DECODE, steps=floor, n_rows=0,
-                    slots=np.zeros(0, np.int32), kv_bound=0,
-                ))
-            self._dev_decode(floor, [], None).block_until_ready()
+            warm(floor, None)
         # no buffer reset: admission rewrites every row it activates, and
         # leaving the (deterministic) garbage in place keeps SPMD followers
         # — which replay these warmups but not a leader-local reset — in
