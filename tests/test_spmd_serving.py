@@ -235,3 +235,44 @@ def test_announce_unbounded_decode_packs():
     block = channel.recv()
     assert block.op == OP_DECODE and block.steps == 4
     assert (block.kv_bound or None) is None
+
+
+def test_loopback_lockstep_with_precompiled_ladder():
+    """precompile=True on the leader announces every warmup decode over the
+    channel; the follower replays them and must STAY bit-identical through
+    real generations afterwards (the warmup intentionally leaves
+    deterministic garbage in the buffers — see _warmup_decode_ladder)."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    channel = LoopbackChannel(prefill_batch=4, max_width=32, max_batch=2)
+    leader = ServingEngine(
+        CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=4, spmd=channel,
+        precompile=True, ttft_chunk_floor=2,
+    )
+    follower = ServingEngine(
+        CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
+        prefill_buckets=(16, 32), prefill_batch=4,
+        ttft_chunk_floor=2,
+    )
+    follower_thread = threading.Thread(
+        target=follower_loop, args=(follower, channel), daemon=True
+    )
+    follower_thread.start()
+    leader.start()
+    try:
+        opts = GenerationOptions(max_new_tokens=6, temperature=0.0)
+        result = leader.generate([9, 8, 7], opts, timeout=120)
+        assert len(result.tokens) == 6
+    finally:
+        leader.stop()
+    follower_thread.join(timeout=60)
+    assert not follower_thread.is_alive(), "follower never saw STOP"
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(leader._tokens_dev)),
+        np.asarray(jax.device_get(follower._tokens_dev)),
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(leader._cache)),
+        jax.tree.leaves(jax.device_get(follower._cache)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
