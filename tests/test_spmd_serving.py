@@ -31,6 +31,22 @@ from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
 CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
 
 
+
+def _assert_lockstep(leader, follower) -> None:
+    """Leader/follower device state must be bit-identical (the property
+    every multi-host replica depends on)."""
+    for attr in ("_tokens_dev", "_positions_dev"):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(getattr(leader, attr))),
+            np.asarray(jax.device_get(getattr(follower, attr))),
+        )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(leader._cache)),
+        jax.tree.leaves(jax.device_get(follower._cache)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_loopback_follower_stays_in_lockstep():
     params = init_params(CFG, jax.random.PRNGKey(0))
     channel = LoopbackChannel(prefill_batch=4, max_width=32, max_batch=2)
@@ -60,18 +76,7 @@ def test_loopback_follower_stays_in_lockstep():
     assert not follower_thread.is_alive(), "follower never saw STOP"
 
     # the follower's device state must have evolved identically
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(leader._tokens_dev)),
-        np.asarray(jax.device_get(follower._tokens_dev)),
-    )
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(leader._positions_dev)),
-        np.asarray(jax.device_get(follower._positions_dev)),
-    )
-    lk = jax.device_get(leader._cache)
-    fk = jax.device_get(follower._cache)
-    for a, b in zip(jax.tree.leaves(lk), jax.tree.leaves(fk)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _assert_lockstep(leader, follower)
 
 
 def test_two_process_jax_distributed_serving():
@@ -204,15 +209,7 @@ def test_loopback_moe_lockstep_on_expert_mesh():
         leader.stop()
     follower_thread.join(timeout=60)
     assert not follower_thread.is_alive(), "follower never saw STOP"
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(leader._tokens_dev)),
-        np.asarray(jax.device_get(follower._tokens_dev)),
-    )
-    for a, b in zip(
-        jax.tree.leaves(jax.device_get(leader._cache)),
-        jax.tree.leaves(jax.device_get(follower._cache)),
-    ):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _assert_lockstep(leader, follower)
 
 
 def test_announce_unbounded_decode_packs():
@@ -267,12 +264,4 @@ def test_loopback_lockstep_with_precompiled_ladder():
         leader.stop()
     follower_thread.join(timeout=60)
     assert not follower_thread.is_alive(), "follower never saw STOP"
-    np.testing.assert_array_equal(
-        np.asarray(jax.device_get(leader._tokens_dev)),
-        np.asarray(jax.device_get(follower._tokens_dev)),
-    )
-    for a, b in zip(
-        jax.tree.leaves(jax.device_get(leader._cache)),
-        jax.tree.leaves(jax.device_get(follower._cache)),
-    ):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _assert_lockstep(leader, follower)
