@@ -198,6 +198,12 @@ def bench_long_prompt(preset: str, quantize: bool, prompt_len: int,
         max_seq_len=min(max_seq_len, config.max_seq_len),
         prefill_buckets=(segment,),
         decode_chunk=8,
+        # a 32k-wide engine's decode ladder is 10 programs (~15-20s compile
+        # each) but this phase decodes 16 tokens after ONE long prefill —
+        # the warmup request compiles the only shapes the measured request
+        # uses, so the mid-traffic-stall hazard precompile exists for
+        # cannot occur here
+        precompile=False,
     )
     engine.start()
     rng = np.random.default_rng(1)
