@@ -266,7 +266,63 @@ async def capture_cql() -> None:
     )
 
 
+def _split_pravega(buf: bytes):
+    """[type:i32][length:i32][payload] WireCommand framing."""
+    if len(buf) < 8:
+        return None, buf
+    length = int.from_bytes(buf[4:8], "big", signed=True)
+    total = 8 + length
+    if len(buf) < total:
+        return None, buf
+    return buf[:total], buf[total:]
+
+
+async def capture_pravega() -> None:
+    """Segment-store WireCommands for a produce/read conversation (the
+    controller half is REST over aiohttp — different transport, not part
+    of the binary-protocol transcript)."""
+    from langstream_tpu.api.record import SimpleRecord
+    from langstream_tpu.messaging.pravega import PravegaTopicConnectionsRuntime
+    from langstream_tpu.messaging.pravega_fake import FakePravega
+
+    broker = await FakePravega().start()
+    with _Tap() as tap:
+        rt = PravegaTopicConnectionsRuntime()
+        await rt.init({
+            "client": {
+                "controller-rest-uri": broker.controller_url,
+                "segment-store": broker.segment_store_url,
+                "scope": "langstream",
+            }
+        })
+        admin = rt.create_topic_admin()
+        await admin.create_topic("golden-topic", partitions=1)
+        producer = rt.create_producer("a", "golden-topic")
+        await producer.start()
+        await producer.write(SimpleRecord(key="k1", value="golden-value"))
+        consumer = rt.create_consumer("a", "golden-topic")
+        await consumer.start()
+        got = []
+        for _ in range(100):
+            got.extend(await consumer.read())
+            if got:
+                break
+        assert got, "consumer read nothing"
+        await consumer.commit(got)
+        await consumer.close()
+        await producer.close()
+        await rt.close()
+    await broker.stop()
+    _write_transcript(
+        "pravega_produce_read.hex",
+        "pravega segment-store produce/read WireCommands (fake capture; "
+        "controller REST not included)",
+        tap.frames(_split_pravega),
+    )
+
+
 if __name__ == "__main__":
     asyncio.run(capture_pulsar())
     asyncio.run(capture_kafka())
     asyncio.run(capture_cql())
+    asyncio.run(capture_pravega())

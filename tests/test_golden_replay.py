@@ -142,7 +142,30 @@ def _replay_cql(direction: str, data: bytes) -> None:
         ), f"unexpected response opcode 0x{opcode:02x}"
 
 
-_REPLAYERS = {"pulsar": _replay_pulsar, "kafka": _replay_kafka, "cql": _replay_cql}
+def _replay_pravega(direction: str, data: bytes) -> None:
+    from langstream_tpu.messaging import pravega_protocol as wire
+
+    # frame: [type:i32][length:i32][payload]
+    type_, length = wire.parse_frame_header(data[:8])
+    assert length == len(data) - 8, "frame length header mismatch"
+    name, fields = wire.decode(type_, data[8:])
+    assert not name.startswith("unknown"), (
+        f"unsupported WireCommand type {type_} — extend pravega_protocol"
+    )
+    if direction == "<":
+        return  # server frames only need to decode cleanly
+    # wire-drift pin: re-encoding the decoded command reproduces the bytes
+    assert wire.encode(name, fields) == data, (
+        f"{name}: re-encoded WireCommand differs from transcript"
+    )
+
+
+_REPLAYERS = {
+    "pulsar": _replay_pulsar,
+    "kafka": _replay_kafka,
+    "cql": _replay_cql,
+    "pravega": _replay_pravega,
+}
 
 
 def _files():
