@@ -74,6 +74,7 @@ configuration:
         decode-chunk: {decode_chunk}
         prefill-batch: {prefill_batch}
         prefill-buckets: [64]
+        overlap: {overlap}
         {quant_line}
 """
 
@@ -223,8 +224,12 @@ def bench_long_prompt(preset: str, quantize: bool, prompt_len: int,
 
 async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens: int,
                         n_sessions: int, max_seq_len: int, decode_chunk: int,
-                        prefill_batch: int) -> dict:
-    """Full-platform path: app (broker + agents) + gateway WS chat."""
+                        prefill_batch: int, overlap: bool = True) -> dict:
+    """Full-platform path: app (broker + agents) + gateway WS chat.
+
+    ``overlap``: fused prefill–decode scheduling on/off — the bench runs
+    BOTH so the TTFT delta of the fused scheduler is a recorded number,
+    not a claim (PERF.md round 6)."""
     import aiohttp
 
     from langstream_tpu.core.parser import ModelBuilder
@@ -239,6 +244,7 @@ async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens:
         CONFIGURATION.format(
             model=preset, max_batch=max_batch, max_seq_len=max_seq_len,
             decode_chunk=decode_chunk, prefill_batch=prefill_batch,
+            overlap="true" if overlap else "false",
             quant_line="quantization: int8" if quantize else "",
         )
     )
@@ -380,6 +386,22 @@ def main() -> None:
             prefill_batch,
         )
     )
+    _reclaim()
+    # same phase with fused scheduling OFF: the overlap TTFT delta must be
+    # a measured pair from one run, not a cross-round comparison
+    print(f"[bench] gateway (overlap on): {extras}; overlap-off phase",
+          file=sys.stderr, flush=True)
+    try:
+        off = asyncio.run(
+            bench_gateway(
+                preset, quantize, max_batch,
+                min(new_tokens, 128), n_sessions, max_seq_len, decode_chunk,
+                prefill_batch, overlap=False,
+            )
+        )
+        extras.update({f"overlap_off_{k}": v for k, v in off.items()})
+    except Exception as e:  # noqa: BLE001 — the headline overlap-on run already landed
+        print(f"[bench] overlap-off phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     print(f"[bench] gateway: {extras}; long-prompt phase", file=sys.stderr, flush=True)
     try:

@@ -28,11 +28,14 @@ def instrument() -> None:
     orig_process = e.ServingEngine._process_chunk
     orig_warm = e.ServingEngine._warmup_decode_ladder
 
-    def admit(self):
+    def admit(self, budget=None):
         t = time.monotonic()
-        out = orig_admit(self)
+        out = orig_admit(self, budget)
         if out:
-            mark(f"admit n={len(out)} took={time.monotonic() - t:.3f}s")
+            mark(
+                f"admit n={len(out)} budget={budget} "
+                f"took={time.monotonic() - t:.3f}s"
+            )
         return out
 
     def dev_decode(self, steps, stale, kv_bound=None):

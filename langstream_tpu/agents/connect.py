@@ -585,6 +585,10 @@ class CamelSourceAgent(AgentSource):
                     self.path,
                 ))
                 if len(out) >= self.max_buffered:
+                    # rewind the cursor to the last second actually SCANNED:
+                    # marking all of (s, sec] checked would silently drop any
+                    # due seconds between the buffer-full break and now
+                    self._checked_sec = s
                     break
             return out
         if self.scheme == "exec":

@@ -64,6 +64,17 @@ class _BaseCompletionsStep(Step):
         self._m_rate = metrics.gauge("last_tokens_per_sec", "last request decode rate")
         self._m_active = metrics.gauge("engine_active_slots", "busy KV-cache slots")
         self._m_queued = metrics.gauge("engine_queued_requests", "requests waiting for a slot")
+        self._m_hbm = metrics.gauge(
+            "engine_hbm_gbps", "achieved HBM read bandwidth per decode step"
+        )
+        self._m_step = metrics.gauge(
+            "engine_decode_step_ms", "measured decode step time (EMA)"
+        )
+        self._m_programs = metrics.gauge(
+            "engine_compiled_programs",
+            "distinct device programs dispatched (growth after warmup = "
+            "a mid-traffic XLA compile stall)",
+        )
 
     def _record_metrics(self, result: Any) -> None:
         self._m_calls.count()
@@ -80,6 +91,9 @@ class _BaseCompletionsStep(Step):
         # always set: stale occupancy must decay to 0, not freeze
         self._m_active.set(stats.get("active-slots", 0))
         self._m_queued.set(stats.get("queued", 0))
+        self._m_hbm.set(stats.get("hbm-gbps-decode", 0))
+        self._m_step.set(stats.get("decode-step-ms", 0))
+        self._m_programs.set(stats.get("compiled_programs", 0))
 
     async def close(self) -> None:
         if self._producer is not None:

@@ -13,6 +13,13 @@ Resource configuration:
   tokenizer: "byte" (default) | "hf:<local path>"
   weights: "random" (default) | path to HF safetensors dir (models.loader)
   max-batch / max-seq-len / prefill-buckets / decode-chunk: engine knobs
+  overlap: true (default) → fused prefill–decode iterations (every device
+    dispatch carries a token-budgeted slice of pending prefill work plus
+    the decode chunk — the gateway-TTFT lever, PERF.md round 6)
+  prefill-token-budget: prefill tokens per fused iteration (default: the
+    chunked-prefill segment width = the largest prefill bucket)
+  max-prefill-streams: concurrent chunked-prefill local caches (default 2
+    with overlap, 1 without; each costs one long-prefill cache of HBM)
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
   quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
     models stage on the host so the bf16 tree never needs device HBM)
@@ -187,6 +194,17 @@ class _EngineHolder:
             # default (None): precompile the decode ladder on TPU backends
             # so no XLA compile ever lands mid-traffic (PERF.md round 5b)
             precompile=self.config.get("precompile"),
+            overlap=bool(self.config.get("overlap", True)),
+            prefill_token_budget=(
+                int(self.config["prefill-token-budget"])
+                if self.config.get("prefill-token-budget") is not None
+                else None
+            ),
+            max_prefill_streams=(
+                int(self.config["max-prefill-streams"])
+                if self.config.get("max-prefill-streams") is not None
+                else None
+            ),
         )
         if start:
             engine.start()

@@ -745,6 +745,82 @@ def ragged_decode_attention_int8(
 
 
 # ---------------------------------------------------------------------------
+# Fused prefill+decode batch: one attention call whose rows mix S-token
+# prompt SEGMENTS (chunked prefill at a global offset) with single-token
+# decode queries against the same big KV cache (arxiv 2604.15464's ragged
+# mixed batch, expressed as a dispatch over the two existing paths rather
+# than a third kernel: prefill rows ride the segment kernel, decode rows
+# the kv_bound-sliced dense read that beat both ragged decode kernels in
+# r5). This is the attention-layer BUILDING BLOCK for a true single-program
+# fused iteration; the shipped engine runs two back-to-back dispatches
+# instead (PERF.md round 6 records the decision), so nothing calls this in
+# production yet — it is exactness-tested and kept for the revisit.
+# ---------------------------------------------------------------------------
+
+
+def fused_segment_decode_attention(
+    q_seg: jax.Array,  # [P, S, H, D] segment queries (prefill rows)
+    seg_offsets: jax.Array,  # [P] int32 global position of each segment start
+    q_dec: jax.Array,  # [Bd, H, D] one query per decode row
+    k,  # [B, Hkv, T, D] shared head-major cache (array or int8 {"q","s"})
+    v,
+    seg_rows: jax.Array,  # [P] int32 cache row of each prefill row
+    dec_rows: jax.Array,  # [Bd] int32 cache row of each decode row
+    dec_lengths: jax.Array,  # [Bd] int32 valid cache prefix per decode row
+    config: ModelConfig,
+    kv_bound: int | None = None,  # static cap on decode rows' readable columns
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Mixed prefill-segment + decode attention over ONE cache
+    → ([P, S, H*D] segment out, [Bd, H*D] decode out).
+
+    The segment rows' own K/V must already be scattered into the cache at
+    [offset, offset+S) (same contract as flash_segment_attention); decode
+    rows attend to their first ``dec_lengths`` columns. Exactness: each half
+    is bit-identical to its standalone path — this function only routes, it
+    never re-derives math — so a fused iteration built on it matches the
+    serialized prefill-then-decode reference token for token."""
+    from langstream_tpu.models.transformer import attention as jnp_attention
+
+    quantized = isinstance(k, dict)
+    t = (k["q"] if quantized else k).shape[2]
+
+    # prefill rows → the segment path (Pallas kernel when shapes fit)
+    k_seg = jax.tree.map(lambda x: x[seg_rows], k)
+    v_seg = jax.tree.map(lambda x: x[seg_rows], v)
+    p, s = q_seg.shape[0], q_seg.shape[1]
+    if pallas_ok(config, s, t):
+        if quantized:
+            seg_out = flash_segment_attention_int8(
+                q_seg, k_seg, v_seg, seg_offsets, config, interpret=interpret
+            )
+        else:
+            seg_out = flash_segment_attention(
+                q_seg, k_seg, v_seg, seg_offsets, config, interpret=interpret
+            )
+    else:
+        positions = seg_offsets[:, None] + jnp.arange(s)[None, :]  # [P, S]
+        kv_pos = jnp.arange(t)[None, None, :]
+        seg_mask = kv_pos <= positions[:, :, None]
+        seg_out = jnp_attention(q_seg, k_seg, v_seg, seg_mask, config)
+
+    # decode rows → the dense masked read over the kv_bound-sliced cache
+    # (r5 measured this beating both ragged kernels at decode shapes)
+    k_dec = jax.tree.map(lambda x: x[dec_rows], k)
+    v_dec = jax.tree.map(lambda x: x[dec_rows], v)
+    t_dec = t
+    if kv_bound is not None and kv_bound < t:
+        k_dec = jax.tree.map(lambda x: x[:, :, :kv_bound], k_dec)
+        v_dec = jax.tree.map(lambda x: x[:, :, :kv_bound], v_dec)
+        t_dec = kv_bound
+    dec_mask = (
+        jnp.arange(t_dec)[None, None, :] < dec_lengths[:, None, None]
+    )  # [Bd, 1, T]
+    dec_out = jnp_attention(q_dec[:, None], k_dec, v_dec, dec_mask, config)
+    return seg_out, dec_out[:, 0]
+
+
+# ---------------------------------------------------------------------------
 # Dispatch gate
 # ---------------------------------------------------------------------------
 

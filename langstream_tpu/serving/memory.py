@@ -47,11 +47,20 @@ class ServingMemoryPlan:
     # kv_bound slice+splice peak: a decode chunk at a SLICED bound copies
     # the cache's first `bound` columns out and back (engine._decode_chunk),
     # so up to bound/width of the cache is live ON TOP of the full cache.
-    # The largest sliced bound is width/2 → worst case cache/2. The r5b
-    # full-ladder precompile made this peak unavoidable at startup — the
-    # llama B=84 @ T=1024 config that "fit" without this term compile-OOMed
-    # by exactly this allocation.
+    # The largest SLICED ladder bound is the largest pow2 strictly below
+    # max_seq_len (the full-width program skips the slice; the ladder floors
+    # at 64) — NOT width/2: for non-pow2 widths (T=1536 → bound 1024 =
+    # 2/3 cache; T=1025 → bound 1024 ≈ the whole cache) the old cache/2
+    # assumption under-reported and the full-ladder precompile OOMed configs
+    # the plan had blessed. The r5b precompile made this peak unavoidable
+    # at startup — the llama B=84 @ T=1024 config that "fit" without this
+    # term compile-OOMed by exactly this allocation.
     bound_slice_bytes: int = 0
+    # fused-iteration peak: with overlapped prefill–decode scheduling the
+    # admission local cache (prefill_batch rows × the largest bucket width)
+    # is live WHILE a decode chunk holds its kv_bound slice — before the
+    # fused scheduler the two alternated, so neither plan term saw the sum.
+    fused_prefill_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -62,6 +71,7 @@ class ServingMemoryPlan:
             + self.workspace_bytes
             + self.scan_buffer_bytes
             + self.bound_slice_bytes
+            + self.fused_prefill_bytes
         )
 
     def fits(self, hbm_bytes: int) -> bool:
@@ -75,9 +85,23 @@ class ServingMemoryPlan:
             f"(+{self.scan_buffer_bytes / gib:.2f}GiB scan double-buffer, "
             f"+{self.bound_slice_bytes / gib:.2f}GiB kv_bound slice peak) + "
             f"long-prefill {self.long_cache_bytes / gib:.2f}GiB + "
+            f"fused-prefill {self.fused_prefill_bytes / gib:.2f}GiB + "
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
         )
+
+
+def largest_sliced_bound(max_seq_len: int) -> int:
+    """The widest kv_bound ladder step that actually SLICES the cache: the
+    largest power of two strictly below ``max_seq_len``, floored at 64 (the
+    ladder's first rung; the full-width program runs unsliced). 0 when the
+    cache is too narrow to ever slice."""
+    if max_seq_len <= 64:
+        return 0
+    bound = 64
+    while bound * 2 < max_seq_len:
+        bound *= 2
+    return bound
 
 
 def plan_serving_memory(
@@ -88,15 +112,23 @@ def plan_serving_memory(
     quantized_weights: bool = False,
     long_prefill: bool = True,
     workspace_bytes: int = 1 << 30,
+    prefill_batch: int = 0,
+    prefill_bucket: int = 0,
+    prefill_streams: int = 1,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
-    ``long_prefill``: include the 1-row local cache the chunked-prefill /
+    ``long_prefill``: include the local cache(s) the chunked-prefill /
     ring path holds while a max-length prompt streams in (engine._long_step
-    allocates it at the pow2 width covering the prompt, here bounded by
-    ``max_seq_len``). ``workspace_bytes``: flat allowance for activations,
-    XLA scratch, and the collectives' staging buffers — 1GiB is empirically
-    comfortable for 8B-class decode at B≤96.
+    allocates one at the pow2 width covering the prompt, here bounded by
+    ``max_seq_len``); ``prefill_streams`` of them may be live at once under
+    the fused scheduler. ``prefill_batch``/``prefill_bucket``: shape of the
+    admission local cache (prefill_batch rows × the largest bucket width)
+    that a fused iteration holds alongside the decode chunk's kv_bound
+    slice — 0 omits the term (pre-overlap accounting).
+    ``workspace_bytes``: flat allowance for activations, XLA scratch, and
+    the collectives' staging buffers — 1GiB is empirically comfortable for
+    8B-class decode at B≤96.
     """
     from langstream_tpu.models.quant import init_random_quantized_params
     from langstream_tpu.models.transformer import init_params, make_kv_cache
@@ -116,19 +148,34 @@ def plan_serving_memory(
         if long_prefill
         else None
     )
+    fused_shape = (
+        jax.eval_shape(
+            lambda: make_kv_cache(
+                config, prefill_batch, min(prefill_bucket, max_seq_len)
+            )
+        )
+        if prefill_batch > 0 and prefill_bucket > 0
+        else None
+    )
     cache_bytes = _tree_bytes(cache_shape)
+    sliced = largest_sliced_bound(max_seq_len)
     return ServingMemoryPlan(
         weights_bytes=_tree_bytes(params_shape),
         cache_bytes=cache_bytes,
-        long_cache_bytes=_tree_bytes(long_shape) if long_shape else 0,
+        long_cache_bytes=(
+            _tree_bytes(long_shape) * max(1, prefill_streams)
+            if long_shape
+            else 0
+        ),
         workspace_bytes=workspace_bytes,
         # 2 layer slices (read + updated copy) live inside the chunk scan
         scan_buffer_bytes=2 * cache_bytes // max(config.n_layers, 1),
-        # largest SLICED decode bound is max_seq_len/2 (the full-width
-        # program skips the slice) → worst-case cache/2 live alongside the
-        # cache during that chunk's copy-out/copy-back. Widths ≤64 never
-        # slice (the ladder starts at 64).
-        bound_slice_bytes=cache_bytes // 2 if max_seq_len > 64 else 0,
+        # the widest chunk that still slices copies `sliced` of the cache's
+        # max_seq_len columns out and back alongside the full cache — for
+        # non-pow2 widths that is MORE than cache/2 (T=1536 → 2/3; T=1025 →
+        # ~all of it), which the old cache//2 shortcut hid
+        bound_slice_bytes=cache_bytes * sliced // max_seq_len if sliced else 0,
+        fused_prefill_bytes=_tree_bytes(fused_shape) if fused_shape else 0,
     )
 
 

@@ -274,7 +274,11 @@ class ControlPlaneServer:
             # what the history replay already wrote
             last_seq = 0
             for entry in hub.history(replica):
-                last_seq = entry["seq"]
+                # max, not last-write: ring entries may replay out of seq
+                # order, and tracking only the final entry's seq would
+                # re-emit (duplicate) every history line above it in the
+                # live loop below
+                last_seq = max(last_seq, entry["seq"])
                 await resp.write(json.dumps(entry).encode() + b"\n")
             while True:
                 entry = await queue.get()

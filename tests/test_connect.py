@@ -473,3 +473,37 @@ def test_cron_classic_dow_wrap_and_catchup(run):
         await agent.close()
 
     run(main())
+
+
+def test_cron_catchup_early_break_keeps_cursor(run):
+    """When the catch-up scan fills max_buffered and breaks early, the
+    cursor must rewind to the last second actually SCANNED — marking the
+    whole window checked would silently drop every due second between the
+    break point and now (a lost daily tick under a deep backlog)."""
+    import time as _time
+
+    from langstream_tpu.agents.connect import CamelSourceAgent
+
+    async def main():
+        agent = CamelSourceAgent()
+        await agent.init({
+            "component-uri": "cron:t?schedule=*+*+*+*+*+?",
+            "max-buffered-records": 2,
+        })
+        agent._checked_sec = int(_time.time()) - 10
+        timestamps = []
+        for _ in range(30):
+            got = await agent.read()
+            timestamps.extend(json.loads(r.value)["timestamp"] for r in got)
+            if len(timestamps) >= 8:
+                break
+        # every-second schedule over a 10s backlog, drained 2 at a time:
+        # the fires must be CONSECUTIVE seconds — any gap means the early
+        # break discarded part of the scan window
+        assert len(timestamps) >= 8
+        assert timestamps == list(
+            range(timestamps[0], timestamps[0] + len(timestamps))
+        ), timestamps
+        await agent.close()
+
+    run(main())

@@ -224,11 +224,15 @@ def test_eos_as_first_token():
 
 
 def test_adaptive_chunk_shrinks_under_queued_work():
-    """With a queued request and a free slot the next chunk is capped small
-    (TTFT lever); with the queue empty it returns to full size."""
+    """Legacy (overlap off) scheduler: with a queued request and a free
+    slot the next chunk is capped small (TTFT lever); with the queue empty
+    it returns to full size. Fused scheduling retires the shrink — full
+    chunks only, prefill rides every iteration (test_engine_fused)."""
     from langstream_tpu.serving.engine import GenerationRequest
 
-    engine = make_engine(max_batch=4, max_seq_len=256, decode_chunk=64)
+    engine = make_engine(
+        max_batch=4, max_seq_len=256, decode_chunk=64, overlap=False
+    )
     engine.stop()  # drive _chunk_steps directly, no device loop
     engine._dead = None
     engine._slots[0].request = GenerationRequest(

@@ -1,0 +1,247 @@
+"""Fused (overlapped) prefill–decode scheduling tests: token-budgeted
+prefill slices riding every engine iteration back-to-back with the decode
+chunk — exactness vs the serialized path, one-iteration admission latency,
+and the no-mid-traffic-compiles guarantee via the compiled_programs stat."""
+
+import dataclasses
+from collections import deque
+
+import jax
+
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+from langstream_tpu.models.transformer import init_params
+from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+
+CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine(start=True, **kw):
+    engine = ServingEngine(CFG, PARAMS, **kw)
+    if start:
+        engine.start()
+    return engine
+
+
+def test_mixed_prefill_decode_matches_serialized_reference():
+    """Greedy tokens from a fused mixed load (active decode + two long
+    prompts chunk-prefilling concurrently + short admissions) are identical
+    to each request served ALONE on a serialized (overlap off) engine —
+    the fused iterations change scheduling, never math."""
+    opts = GenerationOptions(max_new_tokens=16, temperature=0.0)
+    short_prompt = [5, 6, 7]
+    long_a = [(3 + i) % CFG.vocab_size for i in range(70)]  # 5 segments @16
+    long_b = [(11 + 2 * i) % CFG.vocab_size for i in range(55)]  # 4 segments
+
+    ref = {}
+    serial = make_engine(
+        max_batch=1, max_seq_len=128, decode_chunk=4, prefill_buckets=(16,),
+        overlap=False,
+    )
+    try:
+        for name, prompt in (("s", short_prompt), ("a", long_a), ("b", long_b)):
+            ref[name] = serial.generate(prompt, opts, timeout=120).tokens
+    finally:
+        serial.stop()
+
+    fused = make_engine(
+        max_batch=4, max_seq_len=128, decode_chunk=4, prefill_buckets=(16,),
+        overlap=True, max_prefill_streams=2, prefill_token_budget=32,
+    )
+    try:
+        short_req = fused.submit(
+            GenerationRequest(prompt_tokens=short_prompt, options=opts)
+        )
+        ra = fused.submit(GenerationRequest(prompt_tokens=long_a, options=opts))
+        rb = fused.submit(GenerationRequest(prompt_tokens=long_b, options=opts))
+        assert short_req.result(timeout=120).tokens == ref["s"]
+        assert ra.result(timeout=120).tokens == ref["a"]
+        assert rb.result(timeout=120).tokens == ref["b"]
+    finally:
+        fused.stop()
+
+
+def test_admission_rides_the_very_next_iteration_under_load():
+    """With a decode chunk in flight for a saturated-busy engine, a new
+    arrival's prefill must dispatch in the very next fused iteration — not
+    after the running generation drains. White-box: drive _iterate by hand
+    (no engine thread) so 'one iteration' is exact, not a timing guess."""
+    engine = make_engine(
+        start=False, max_batch=2, max_seq_len=128, decode_chunk=8,
+        overlap=True,
+    )
+    pending: deque = deque()
+    opts = GenerationOptions(max_new_tokens=60, temperature=0.0)
+    engine.submit(GenerationRequest(prompt_tokens=[4, 5, 6], options=opts))
+    engine._iterate(pending)  # admits A, dispatches its first chunk
+    assert sum(1 for s in engine._slots if s.active) == 1
+
+    engine.submit(GenerationRequest(prompt_tokens=[7, 8], options=opts))
+    engine._iterate(pending)  # chunk in flight for A — B must still admit
+    assert sum(1 for s in engine._slots if s.active) == 2, (
+        "new arrival did not get its prefill within one fused iteration"
+    )
+    engine._stop.set()
+    while pending:
+        for entry in pending.popleft():
+            engine._process_entry(entry)
+    engine._fail_all(RuntimeError("test torn down"))
+
+
+def test_prefill_token_budget_bounds_per_iteration_admission():
+    """A backlog wider than the budget admits exactly one budget's worth of
+    prefill per iteration (first group always rides), the rest staying
+    queued — so decode chunks interleave instead of stalling behind the
+    whole wave."""
+    engine = make_engine(
+        start=False, max_batch=8, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16,), prefill_batch=2, overlap=True,
+        prefill_token_budget=32,
+    )
+    # long enough that nothing finishes within the iterations driven below
+    opts = GenerationOptions(max_new_tokens=60, temperature=0.0)
+    for _ in range(6):
+        engine.submit(GenerationRequest(prompt_tokens=[9, 9, 9], options=opts))
+    pending: deque = deque()
+    engine._iterate(pending)
+    # budget 32 at bucket width 16 → 2 requests this iteration, 4 queued
+    assert sum(1 for s in engine._slots if s.active) == 2
+    assert engine._queue.qsize() == 4
+    engine._iterate(pending)
+    assert sum(1 for s in engine._slots if s.active) == 4
+    engine._stop.set()
+    while pending:
+        for entry in pending.popleft():
+            engine._process_entry(entry)
+    engine._fail_all(RuntimeError("test torn down"))
+
+
+def test_compiled_programs_flat_after_warmup_mixed_load():
+    """precompile=True warms the decode ladder AND every prefill bucket (the
+    fused-iteration shapes); a mixed load afterwards — bursts, sampling,
+    queued work, near-tail generations — must dispatch ZERO novel device
+    programs (each one would be a 15-23s mid-traffic compile stall on the
+    tunneled chip). Overlap retires the shrunk-chunk program entirely, so
+    the surface is exactly {ladder} ∪ {prefill buckets}."""
+    engine = make_engine(
+        max_batch=4, max_seq_len=256, decode_chunk=8, ttft_chunk_floor=4,
+        prefill_buckets=(16, 32), precompile=True, overlap=True,
+    )
+    try:
+        # first request completes ⇒ warmup finished (the loop warms before
+        # serving); its programs are part of the warmed set by construction
+        engine.generate(
+            [1, 2, 3], GenerationOptions(max_new_tokens=4, temperature=0.0),
+            timeout=120,
+        )
+        warmed = engine.stats()["compiled_programs"]
+        assert warmed >= 5  # ladder (64,128,256) + 2 prefill buckets
+
+        opts_greedy = GenerationOptions(max_new_tokens=12, temperature=0.0)
+        opts_sampled = GenerationOptions(
+            max_new_tokens=12, temperature=0.8, top_k=8, seed=3
+        )
+        requests = [
+            engine.submit(GenerationRequest(
+                prompt_tokens=[(7 * i + j) % CFG.vocab_size
+                               for j in range(4 + 9 * (i % 3))],
+                options=opts_sampled if i % 3 == 0 else opts_greedy,
+            ))
+            for i in range(10)
+        ]
+        for r in requests:
+            r.result(timeout=120)
+        assert engine.stats()["compiled_programs"] == warmed, (
+            "mixed load dispatched a device program the warmup missed"
+        )
+    finally:
+        engine.stop()
+
+
+def test_overlap_off_preserves_single_stream_behavior():
+    """overlap=False keeps the pre-fusion scheduler: unbounded admission,
+    one chunked-prefill stream."""
+    engine = make_engine(
+        start=False, max_batch=4, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16,), overlap=False,
+    )
+    assert engine.max_prefill_streams == 1
+    opts = GenerationOptions(max_new_tokens=60, temperature=0.0)
+    for _ in range(4):
+        engine.submit(GenerationRequest(prompt_tokens=[3, 4], options=opts))
+    pending: deque = deque()
+    engine._iterate(pending)
+    # no budget: the whole backlog admits in one iteration
+    assert sum(1 for s in engine._slots if s.active) == 4
+    engine._stop.set()
+    while pending:
+        for entry in pending.popleft():
+            engine._process_entry(entry)
+    engine._fail_all(RuntimeError("test torn down"))
+
+
+def test_concurrent_long_prefill_streams_share_iterations():
+    """Two long prompts prefill CONCURRENTLY (two streams, round-robin
+    segments) and both finish with correct token counts while a short
+    generation keeps streaming — nobody is serialized behind a whole
+    prompt."""
+    engine = make_engine(
+        max_batch=3, max_seq_len=256, decode_chunk=4, prefill_buckets=(16,),
+        overlap=True, max_prefill_streams=2, prefill_token_budget=64,
+    )
+    try:
+        opts = GenerationOptions(max_new_tokens=20, temperature=0.0)
+        short = engine.submit(
+            GenerationRequest(prompt_tokens=[5, 6, 7], options=opts)
+        )
+        la = [(3 + i) % CFG.vocab_size for i in range(120)]
+        lb = [(5 + 3 * i) % CFG.vocab_size for i in range(100)]
+        ra = engine.submit(GenerationRequest(prompt_tokens=la, options=opts))
+        rb = engine.submit(GenerationRequest(prompt_tokens=lb, options=opts))
+        rs = short.result(timeout=120)
+        res_a = ra.result(timeout=120)
+        res_b = rb.result(timeout=120)
+        assert len(rs.tokens) == 20
+        assert res_a.prompt_tokens == 120 and len(res_a.tokens) == 20
+        assert res_b.prompt_tokens == 100 and len(res_b.tokens) == 20
+    finally:
+        engine.stop()
+
+
+def test_bandwidth_gauge_reports_after_decode():
+    """The achieved-HBM-bandwidth gauge is live after decode chunks ran:
+    step-time EMA > 0 and the bytes-model yields a finite GB/s (the
+    ~25%-of-roofline gap becomes a shipped metric, not a PERF.md note)."""
+    engine = make_engine(max_batch=2, max_seq_len=64, decode_chunk=4)
+    try:
+        engine.generate(
+            [1, 2, 3], GenerationOptions(max_new_tokens=8, temperature=0.0),
+            timeout=120,
+        )
+        stats = engine.stats()
+        assert stats["decode-step-ms"] > 0
+        assert stats["hbm-gbps-decode"] > 0
+        assert stats["compiled_programs"] >= 2  # ≥ one prefill + one decode
+    finally:
+        engine.stop()
+
+
+def test_overlap_runs_full_chunks_only():
+    """Fused scheduling retires the TTFT chunk shrink: queued work no
+    longer shrinks the chunk (prefill rides every iteration instead), so
+    the decode compile surface is exactly the kv_bound ladder — the shrunk
+    size was a whole extra program whose first dispatch landed on the first
+    real burst (the r5b mid-traffic stall class)."""
+    engine = make_engine(
+        start=False, max_batch=4, max_seq_len=256, decode_chunk=64,
+        overlap=True,
+    )
+    engine._slots[0].request = GenerationRequest(
+        prompt_tokens=[1], options=GenerationOptions(max_new_tokens=200)
+    )
+    engine._slots[0].position = 10
+    assert engine._chunk_steps() == 64
+    engine._queue.put(object())
+    assert engine._chunk_steps() == 64  # no shrink under overlap
+    engine._queue.get_nowait()
+    engine._slots[0].request = None

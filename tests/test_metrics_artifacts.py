@@ -75,6 +75,9 @@ def test_dashboard_regexes_match_live_exposition():
         "last_tokens_per_sec",
         "engine_active_slots",
         "engine_queued_requests",
+        "engine_hbm_gbps",
+        "engine_decode_step_ms",
+        "engine_compiled_programs",
     ):
         serving.gauge(n)
     exposed = {

@@ -202,3 +202,51 @@ def test_ragged_decode_int8_matches_int8_reference():
         np.testing.assert_allclose(
             np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2
         )
+
+
+def test_fused_segment_decode_batch_matches_both_references():
+    """The fused mixed-batch dispatch (prefill segments + single-token
+    decode rows against ONE cache) is bit-identical to each half's
+    standalone path — it routes, it never re-derives math. This is the
+    attention layer of a fused engine iteration (segment kernel for the
+    prefill rows, kv_bound-sliced dense read for the decode rows)."""
+    from langstream_tpu.ops.attention import fused_segment_decode_attention
+
+    b, s, t, h, hkv, d = 4, 16, 64, 8, 4, 8
+    k, v = rand(1, b, hkv, t, d), rand(2, b, hkv, t, d)
+    # rows 1 and 3 are mid-prefill segments at different offsets; rows 0
+    # and 2 are decoding at different lengths
+    seg_rows = jnp.asarray([1, 3], jnp.int32)
+    seg_offsets = jnp.asarray([0, 32], jnp.int32)
+    q_seg = rand(3, 2, s, h, d)
+    dec_rows = jnp.asarray([0, 2], jnp.int32)
+    dec_lengths = jnp.asarray([7, 29], jnp.int32)
+    q_dec = rand(4, 2, h, d)
+
+    for config, kv_bound in ((CFG, None), (CFG, 32), (SOFTCAP_CFG, None)):
+        seg_out, dec_out = fused_segment_decode_attention(
+            q_seg, seg_offsets, q_dec, k, v, seg_rows, dec_rows,
+            dec_lengths, config, kv_bound=kv_bound, interpret=True,
+        )
+        # prefill half ≡ the standalone segment path on the gathered rows
+        q_pos = seg_offsets[:, None, None] + jnp.arange(s)[None, :, None]
+        seg_mask = jnp.arange(t)[None, None, :] <= q_pos
+        seg_ref = attention(q_seg, k[seg_rows], v[seg_rows], seg_mask, config)
+        np.testing.assert_allclose(
+            np.asarray(seg_ref), np.asarray(seg_out), rtol=1e-5, atol=1e-5
+        )
+        # decode half ≡ the dense masked read over the (sliced) cache
+        tb = kv_bound or t
+        dec_mask = (
+            jnp.arange(tb)[None, None, :] < dec_lengths[:, None, None]
+        )
+        dec_ref = attention(
+            q_dec[:, None],
+            k[dec_rows][:, :, :tb],
+            v[dec_rows][:, :, :tb],
+            dec_mask,
+            config,
+        )[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(dec_ref), np.asarray(dec_out), rtol=1e-5, atol=1e-5
+        )

@@ -72,3 +72,48 @@ def test_llama31_single_chip_ceiling_is_32k():
     assert plan_serving_memory(
         l3, 84, 256, quantized_weights=True, long_prefill=False
     ).fits(hbm)
+
+
+def test_bound_slice_tracks_largest_sliced_ladder_bound():
+    """The kv_bound slice peak must charge the largest bound that actually
+    SLICES — the largest pow2 strictly below max_seq_len — not a flat
+    cache/2: non-pow2 widths slice MORE than half (T=1536 → 2/3 of the
+    cache; T=1025 → nearly all of it), and the old shortcut let the plan
+    bless configs the full-ladder precompile then OOMed."""
+    from langstream_tpu.serving.memory import largest_sliced_bound
+
+    cfg = MODEL_PRESETS["tiny-test"]
+    # pow2 width: same arithmetic as before (T/2)
+    p1024 = plan_serving_memory(cfg, 4, 1024, workspace_bytes=0)
+    assert p1024.bound_slice_bytes == p1024.cache_bytes // 2
+    # non-pow2 widths under-reported before the fix
+    p1536 = plan_serving_memory(cfg, 4, 1536, workspace_bytes=0)
+    assert p1536.bound_slice_bytes == p1536.cache_bytes * 1024 // 1536
+    assert p1536.bound_slice_bytes > p1536.cache_bytes // 2
+    p1025 = plan_serving_memory(cfg, 4, 1025, workspace_bytes=0)
+    assert p1025.bound_slice_bytes == p1025.cache_bytes * 1024 // 1025
+    # ≤64 never slices (the ladder's first rung runs unsliced)
+    assert plan_serving_memory(cfg, 4, 64, workspace_bytes=0).bound_slice_bytes == 0
+    assert largest_sliced_bound(64) == 0
+    assert largest_sliced_bound(100) == 64
+    assert largest_sliced_bound(1024) == 512
+    assert largest_sliced_bound(1536) == 1024
+
+
+def test_fused_prefill_and_stream_terms():
+    """The fused-iteration peak charges the admission local cache
+    (prefill_batch rows × bucket width) alongside the decode terms, and the
+    long-prefill term scales with concurrent chunked-prefill streams."""
+    cfg = MODEL_PRESETS["tiny-test"]
+    base = plan_serving_memory(cfg, 4, 256, workspace_bytes=0)
+    assert base.fused_prefill_bytes == 0  # pre-overlap accounting unchanged
+    fused = plan_serving_memory(
+        cfg, 4, 256, workspace_bytes=0,
+        prefill_batch=8, prefill_bucket=64, prefill_streams=2,
+    )
+    # admit cache: 8 rows × 64 cols vs decode cache 4 × 256 → exactly half
+    assert fused.fused_prefill_bytes == base.cache_bytes // 2
+    assert fused.long_cache_bytes == 2 * base.long_cache_bytes
+    assert fused.total_bytes == (
+        base.total_bytes + fused.fused_prefill_bytes + base.long_cache_bytes
+    )

@@ -612,3 +612,66 @@ def test_logs_follow_sees_agent_runtime_records(run):
             await server.stop()
 
     run(scenario())
+
+
+def test_logs_follow_dedupes_out_of_order_history(run):
+    """Entries emitted between subscribe() and the history snapshot land in
+    BOTH the ring and the live queue; the live loop skips them by seq. The
+    ring may hold entries out of seq order (concurrent emitter threads), so
+    the replay must track max(seq), not the LAST entry's seq — tracking the
+    last would re-emit (duplicate) every history line above it."""
+    import asyncio
+
+    async def scenario():
+        server, runtime = await start_control_plane()
+        try:
+            async with aiohttp.ClientSession() as session:
+                status, _ = await deploy_app(session, server)
+                assert status == 200
+                hub = runtime.get_runner("default", "app1").log_hub
+
+                def entry(seq, msg):
+                    return {
+                        "seq": seq, "timestamp": 0.0, "replica": "echo-0",
+                        "level": "INFO", "message": msg,
+                    }
+
+                # history replays seq 1002 then 1001 (out of order); the
+                # live queue holds the same two entries (the subscribe/
+                # snapshot race) plus one genuinely new line. High seqs keep
+                # the app's own startup lines (low seqs) out of the way.
+                e2, e1, e3 = (
+                    entry(1002, "two"), entry(1001, "one"), entry(1003, "new")
+                )
+                hub._ring.extend([e2, e1])
+                real_subscribe = hub.subscribe
+
+                def racy_subscribe():
+                    q = real_subscribe()
+                    for e in (e2, e1, e3):
+                        q.put_nowait(e)
+                    return q
+
+                hub.subscribe = racy_subscribe
+                seen = []
+                async with session.get(
+                    f"{server.url}/api/applications/default/app1/logs?follow=1",
+                    timeout=aiohttp.ClientTimeout(total=20),
+                ) as resp:
+                    assert resp.status == 200
+                    async for raw in resp.content:
+                        if raw.strip():
+                            e = json.loads(raw)
+                            if e["seq"] >= 1000:
+                                seen.append(e["seq"])
+                        if 1003 in seen:
+                            break
+                # exactly history (1002, 1001) then the new line (1003) — a
+                # dup of 1002 here means the replay tracked the LAST seq
+                # instead of the max
+                assert seen == [1002, 1001, 1003], seen
+        finally:
+            await runtime.close()
+            await server.stop()
+
+    run(scenario())
