@@ -75,6 +75,25 @@ class _BaseCompletionsStep(Step):
             "distinct device programs dispatched (growth after warmup = "
             "a mid-traffic XLA compile stall)",
         )
+        # prefix KV reuse (serving/prefix_cache.py) — all sourced from the
+        # engine's cumulative stats, so gauges (not counters) carry them
+        self._m_prefix_hit = metrics.gauge(
+            "engine_prefix_cache_hit_rate",
+            "fraction of admissions that reused a cached prompt prefix",
+        )
+        self._m_prefix_saved = metrics.gauge(
+            "engine_prefill_tokens_saved_total",
+            "prompt tokens NOT re-prefilled thanks to prefix KV reuse "
+            "(cumulative)",
+        )
+        self._m_prefix_bytes = metrics.gauge(
+            "engine_prefix_pool_bytes_in_use",
+            "device HBM held by live prefix-cache entries",
+        )
+        self._m_prefix_evict = metrics.gauge(
+            "engine_prefix_cache_evictions_total",
+            "prefix-cache LRU evictions (cumulative)",
+        )
 
     def _record_metrics(self, result: Any) -> None:
         self._m_calls.count()
@@ -94,6 +113,10 @@ class _BaseCompletionsStep(Step):
         self._m_hbm.set(stats.get("hbm-gbps-decode", 0))
         self._m_step.set(stats.get("decode-step-ms", 0))
         self._m_programs.set(stats.get("compiled_programs", 0))
+        self._m_prefix_hit.set(stats.get("prefix-cache-hit-rate", 0))
+        self._m_prefix_saved.set(stats.get("prefill-tokens-saved-total", 0))
+        self._m_prefix_bytes.set(stats.get("prefix-pool-bytes-in-use", 0))
+        self._m_prefix_evict.set(stats.get("prefix-cache-evictions-total", 0))
 
     async def close(self) -> None:
         if self._producer is not None:

@@ -20,6 +20,13 @@ Resource configuration:
     chunked-prefill segment width = the largest prefill bucket)
   max-prefill-streams: concurrent chunked-prefill local caches (default 2
     with overlap, 1 without; each costs one long-prefill cache of HBM)
+  prefix-cache: auto | off (default off) → automatic cross-request prefix
+    KV reuse (serving/prefix_cache.py): shared prompt preambles prefill
+    once, later admissions gather the cached KV and prefill only the
+    suffix. `prefix-cache-fraction` (default 0.25) sizes the device pool
+    relative to the decode cache; `prefix-cache-entries` overrides the
+    row count directly (0 disables the pool entirely). The memory plan
+    accounts the pool before warmup.
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
   quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
     models stage on the host so the bf16 tree never needs device HBM)
@@ -161,6 +168,11 @@ class _EngineHolder:
         from langstream_tpu.serving.engine import ServingEngine
 
         mc = self.model_config()
+        px = self.config.get("prefix-cache", "off")
+        if not isinstance(px, bool) and str(px).lower() not in ("auto", "off"):
+            raise ValueError(
+                f"unknown prefix-cache {px!r}; supported: auto, off"
+            )
         buckets = tuple(
             self.config.get("prefill-buckets", (32, 64, 128, 256, 512, 1024, 2048))
         )
@@ -203,6 +215,15 @@ class _EngineHolder:
             max_prefill_streams=(
                 int(self.config["max-prefill-streams"])
                 if self.config.get("max-prefill-streams") is not None
+                else None
+            ),
+            prefix_cache=px,  # validated at the top of this method
+            prefix_cache_fraction=float(
+                self.config.get("prefix-cache-fraction", 0.25)
+            ),
+            prefix_cache_entries=(
+                int(self.config["prefix-cache-entries"])
+                if self.config.get("prefix-cache-entries") is not None
                 else None
             ),
         )

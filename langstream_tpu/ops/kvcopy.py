@@ -1,0 +1,61 @@
+"""Device-side KV row copies for the prefix cache pool.
+
+Both helpers move whole cache rows between the serving big cache
+([L, B, Hkv, T, D] — bf16 or the int8 {"q","s"} dict, see
+models.transformer.make_kv_cache) and the prefix pool, which uses the SAME
+layout with B = pool entries and T = the largest prefill bucket. Row indices
+are traced scalars, so each helper is ONE compiled program regardless of
+which slot/entry moves (a per-index compile would multiply the program count
+by max_batch × pool entries — the exact mid-traffic-compile hazard the
+engine's compiled_programs guarantee exists to prevent).
+
+Width handling: the pool is (usually) narrower than the decode cache and
+(sometimes) narrower than a long-prefill local cache, so both directions
+copy ``min(src_T, dst_T)`` columns — a STATIC slice. Columns past a cached
+prefix's true length carry garbage by design: the serving mask invariant
+("columns beyond the written frontier are masked until overwritten") makes
+masking the copy pure waste.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+from langstream_tpu.models.transformer import make_kv_cache
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def publish_prefix_rows(pool, cache, slot, entry_row):
+    """Copy big-cache row ``slot`` (its first pool-width columns) into pool
+    row ``entry_row``. One gather + one scatter per leaf; ``entry_row``
+    values out of bounds drop the write (warmup dispatches one such call so
+    the first real publish is never a compile)."""
+
+    def put(p, c):
+        w = min(p.shape[3], c.shape[3])
+        # axis 1 is the row axis, axis 3 is T for both the rank-5 value
+        # arrays and the int8 cache's rank-4 scale arrays; after the row
+        # gather T shifts to axis 2
+        row = lax.dynamic_index_in_dim(c, slot, 1, keepdims=False)[:, :, :w]
+        return p.at[:, entry_row, :, :w].set(row.astype(p.dtype), mode="drop")
+
+    return jax.tree.map(put, pool, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "width"))
+def gather_prefix_local(pool, entry_row, config, width):
+    """Materialize a batch-1 local cache of ``width`` columns whose first
+    ``min(width, pool_T)`` columns are pool row ``entry_row`` — the seed a
+    warm admission's suffix prefill segment then extends in place. The
+    zeros base is traced (free); the gather is the only data movement."""
+    local = make_kv_cache(config, 1, width)
+
+    def put(loc, p):
+        w = min(p.shape[3], loc.shape[3])
+        row = lax.dynamic_index_in_dim(p, entry_row, 1, keepdims=False)[:, :, :w]
+        return loc.at[:, 0, :, :w].set(row.astype(loc.dtype))
+
+    return jax.tree.map(put, local, pool)

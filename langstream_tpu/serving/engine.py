@@ -155,6 +155,30 @@ def _decode_chunk(
 
 
 @functools.partial(
+    jax.jit,
+    donate_argnames=(
+        "tokens_dev", "positions_dev", "temp_dev", "top_k_dev", "top_p_dev"
+    ),
+)
+def _chain_scatter(
+    tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev,
+    idx, first, position, temperature, top_k, top_p,
+):
+    """All five decode-chain scatters for ONE slot in a single dispatch.
+    ``idx`` is traced, so this is one compiled program for every slot (the
+    previous five eager per-slot `.at[idx].set` ops each cost a tunnel
+    round trip AND compiled per slot index); out-of-bounds ``idx`` drops
+    every write, which is what the warmup dispatches."""
+    return (
+        tokens_dev.at[idx].set(first[0], mode="drop"),
+        positions_dev.at[idx].set(position, mode="drop"),
+        temp_dev.at[idx].set(temperature, mode="drop"),
+        top_k_dev.at[idx].set(top_k, mode="drop"),
+        top_p_dev.at[idx].set(top_p, mode="drop"),
+    )
+
+
+@functools.partial(
     jax.jit, static_argnames=("config", "kv_bound"), donate_argnames=("local_cache",)
 )
 def _prefill_segment_and_sample(
@@ -288,6 +312,84 @@ def _make_ring_admit(mesh):
     return ring_admit
 
 
+class _Fetch:
+    """Handle for one deferred device→host token fetch. Created at dispatch
+    time; the fetch thread fills ``_value`` in submission order. ``result``
+    falls back to an inline ``device_get`` when no fetch thread is running
+    (tests drive the loop by hand; engine drain after stop)."""
+
+    __slots__ = ("array", "_fetcher", "_event", "_value")
+
+    def __init__(self, array, fetcher: "_TokenFetcher") -> None:
+        self.array = array
+        self._fetcher = fetcher
+        self._event = threading.Event()
+        self._value = None
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self):
+        if not self._event.is_set() and not self._fetcher.alive():
+            return np.asarray(jax.device_get(self.array))
+        while not self._event.wait(0.5):
+            if not self._fetcher.alive():
+                # fetch thread went away before reaching this handle
+                return np.asarray(jax.device_get(self.array))
+        if isinstance(self._value, BaseException):
+            raise self._value
+        return self._value
+
+
+class _TokenFetcher:
+    """Dedicated device→host fetch thread (PERF.md "levers known but not
+    taken"): the ~100ms per-chunk token fetch through a device tunnel was
+    only hidden behind compute at chunk ≥ 32 — a fetch thread hides it at
+    EVERY chunk size, because the engine thread dispatches the next chunk
+    while this thread blocks on the previous one's bytes. One FIFO queue +
+    one worker keeps results strictly in submission (= chunk) order."""
+
+    def __init__(self) -> None:
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="serving-fetch", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def submit(self, array) -> _Fetch:
+        handle = _Fetch(array, self)
+        if self.alive():
+            self._queue.put(handle)
+        return handle
+
+    def _run(self) -> None:
+        while True:
+            handle = self._queue.get()
+            if handle is None:
+                return
+            try:
+                handle._value = np.asarray(jax.device_get(handle.array))
+            except BaseException as e:  # noqa: BLE001 — surface at result()
+                handle._value = e
+            handle._event.set()
+
+
 def _make_insert_group():
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def insert_group(cache, local_cache, slots):
@@ -334,6 +436,9 @@ class ServingEngine:
         overlap: bool = True,
         prefill_token_budget: Optional[int] = None,
         max_prefill_streams: Optional[int] = None,
+        prefix_cache: Any = False,
+        prefix_cache_fraction: float = 0.25,
+        prefix_cache_entries: Optional[int] = None,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -460,6 +565,47 @@ class ServingEngine:
         # this channel before making it; followers replay via follower_loop
         # (parallel/spmd_serving.py). None = single-host, zero overhead.
         self._spmd = spmd
+        # automatic prefix KV reuse (serving/prefix_cache.py): radix index
+        # over bucket-aligned token prefixes + a device pool in the slot-
+        # cache layout. Warm admissions gather the cached prefix and prefill
+        # ONLY the suffix (one segment at the reuse offset); every completed
+        # prefill publishes its bucket-aligned prefix back (copy-on-publish,
+        # refcounted, LRU-evicted). Off under SPMD: the gather/publish
+        # dispatches are not on the follower wire protocol yet.
+        enabled = (
+            prefix_cache is True
+            or str(prefix_cache).lower() in ("auto", "on", "true", "1")
+        )
+        if enabled and spmd is not None:
+            log.warning(
+                "prefix-cache disabled: not supported on multi-host SPMD "
+                "replicas yet (gather/publish ops are not announced)"
+            )
+            enabled = False
+        self._prefix_pool = None
+        pool_entries, pool_width = 0, 0
+        if enabled:
+            from langstream_tpu.serving.prefix_cache import (
+                pool_entries_for_fraction,
+            )
+
+            pool_width = self.prefill_buckets[-1]
+            # an EXPLICIT entry count wins outright — including 0, which
+            # disables the pool (`or` would silently re-enable it)
+            pool_entries = (
+                int(prefix_cache_entries)
+                if prefix_cache_entries is not None
+                else pool_entries_for_fraction(
+                    max_batch, self.max_seq_len, pool_width,
+                    prefix_cache_fraction,
+                )
+            )
+            # the device pool itself is allocated AFTER the memory plan
+            # below has logged its arithmetic — an over-committed pool
+            # then OOMs with the plan's numbers already on record instead
+            # of an unexplained RESOURCE_EXHAUSTED
+        # dedicated device→host token fetch thread (started with the loop)
+        self._fetcher = _TokenFetcher()
         # compile the decode kv_bound ladder up front (TPU default): a lazy
         # ladder compile (~20s through the tunnel) otherwise lands MID-
         # TRAFFIC and stalls every active stream — measured as the r5
@@ -520,6 +666,8 @@ class ServingEngine:
                 prefill_batch=self.prefill_batch,
                 prefill_bucket=self.prefill_buckets[-1],
                 prefill_streams=self.max_prefill_streams,
+                prefix_pool_entries=pool_entries,
+                prefix_pool_width=pool_width,
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -530,6 +678,13 @@ class ServingEngine:
             )
         except Exception:  # noqa: BLE001 — accounting must never block serving
             log.debug("serving memory plan unavailable", exc_info=True)
+        if pool_entries > 0:
+            from langstream_tpu.serving.prefix_cache import PrefixCachePool
+
+            self._prefix_pool = PrefixCachePool(
+                config, pool_entries, pool_width,
+                boundaries=self.prefill_buckets, mesh=mesh,
+            )
 
     # -- public API ---------------------------------------------------------
 
@@ -538,6 +693,7 @@ class ServingEngine:
             return
         self._dead = None
         self._stop.clear()
+        self._fetcher.start()
         self._thread = threading.Thread(target=self._run, name="serving-engine", daemon=True)
         self._thread.start()
 
@@ -546,6 +702,7 @@ class ServingEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        self._fetcher.stop()
         # resolve everything still in flight so blocked callers return now
         self._fail_all(RuntimeError("serving engine stopped"))
 
@@ -602,6 +759,24 @@ class ServingEngine:
             "compiled_programs": len(self._programs),
             "decode-step-ms": round(self._step_time_ema_s * 1e3, 3),
             "hbm-gbps-decode": self._achieved_hbm_gbps(),
+            # prefix KV reuse (zeros with the cache off, so the metrics
+            # exporter can set its gauges unconditionally)
+            "prefix-cache": self._prefix_pool is not None,
+            "prefix-cache-hit-rate": (
+                self._prefix_pool.hit_rate() if self._prefix_pool else 0.0
+            ),
+            "prefill-tokens-saved-total": (
+                self._prefix_pool.tokens_saved if self._prefix_pool else 0
+            ),
+            "prefix-pool-bytes-in-use": (
+                self._prefix_pool.bytes_in_use() if self._prefix_pool else 0
+            ),
+            "prefix-cache-evictions-total": (
+                self._prefix_pool.evictions if self._prefix_pool else 0
+            ),
+            "prefix-cache-entries": (
+                self._prefix_pool.live_entries if self._prefix_pool else 0
+            ),
         }
 
     def _achieved_hbm_gbps(self) -> float:
@@ -733,9 +908,118 @@ class ServingEngine:
             self._dev_prefill(
                 width, tokens, lengths, temps, top_ks, top_ps, slots
             ).block_until_ready()
+        # the decode-chain scatter (warm prefix admissions AND the final
+        # chunked-prefill segment dispatch it): one traced-index program,
+        # warmed with an all-dropped slot so its first real use — the first
+        # completed long prompt, prefix cache or not — is never a compile
+        self._record_program("chain-scatter")
+        (
+            self._tokens_dev, self._positions_dev, self._temp_dev,
+            self._top_k_dev, self._top_p_dev,
+        ) = _chain_scatter(
+            self._tokens_dev, self._positions_dev, self._temp_dev,
+            self._top_k_dev, self._top_p_dev,
+            jnp.asarray(self.max_batch, jnp.int32),
+            jnp.zeros(1, jnp.int32), 0, 0.0, 0, 1.0,
+        )
+        jax.block_until_ready(self._tokens_dev)
         log.info(
             "prefill buckets precompiled: widths %s, rows %d",
             list(self.prefill_buckets), n_pad,
+        )
+
+    def _warmup_prefix_programs(self) -> None:
+        """Warm every program a warm admission can dispatch — publish, the
+        gather at every local-cache width (pool width for short prompts
+        plus the pow2 long-prompt ladder), the pool-width insert, and all
+        reachable suffix-segment shapes — with all-dropped / throwaway
+        dispatches, so NO prefix-cache code path ever compiles
+        mid-traffic (the compiled_programs-flat guarantee; the
+        chain-scatter is warmed unconditionally in
+        _warmup_prefill_buckets)."""
+        from langstream_tpu.ops.kvcopy import gather_prefix_local, publish_prefix_rows
+
+        pool = self._prefix_pool
+        assert pool is not None
+        # publish with an out-of-bounds entry row: every write drops
+        self._record_program("prefix-publish")
+        pool.dev = publish_prefix_rows(
+            pool.dev, self._cache,
+            jnp.asarray(0, jnp.int32), jnp.asarray(pool.entries, jnp.int32),
+        )
+        # gather ladder: pool width (short warm admissions) + every
+        # _long_width value (warm long-prompt starts) — O(log) programs,
+        # the decode-ladder policy. Each throwaway local frees before the
+        # next, so peak transient = one long-prefill cache (plan term).
+        widths = [pool.width]
+        w = pool.width
+        while w < self.max_seq_len:
+            w *= 2
+            widths.append(min(w, self.max_seq_len))
+        local = None
+        for width in dict.fromkeys(widths):
+            if self._stop.is_set():
+                return
+            self._record_program("prefix-gather", width)
+            got = gather_prefix_local(
+                pool.dev, jnp.asarray(0, jnp.int32), self.config, width
+            )
+            if width == pool.width:
+                local = got
+            else:
+                jax.block_until_ready(got)
+        # the warm-admission insert at pool width; slot out of bounds → drop
+        self._record_program("insert", pool.width)
+        self._cache = self._insert_group(
+            self._cache, local, jnp.asarray(np.full(1, self.max_batch, np.int32))
+        )
+        jax.block_until_ready(self._cache)
+        # suffix-segment shapes: a warm SHORT admission prefills one
+        # (ws ∈ buckets) segment into a pool-width local cache at a
+        # kv_bound from ws's doubling ladder — shapes nothing else
+        # compiles (cold admissions use admit_group; long prompts use
+        # t_long ≥ 2× pool width). Warm every reachable pair so the first
+        # prefix HIT per shape is never the 15-23s stall that would make
+        # the cache slower than no cache until amortized. O(|buckets| ×
+        # log) programs, the same front-load-the-compiles policy as the
+        # decode ladder; offset/lengths are traced so one throwaway
+        # dispatch per shape covers all reuse offsets. The PRNG key
+        # advances per dispatch — before any request is served, like the
+        # bucket warmup.
+        segment_shapes = []
+        for ws in self.prefill_buckets:
+            bound = ws
+            while True:
+                segment_shapes.append((ws, min(bound, pool.width)))
+                if bound >= pool.width:
+                    break
+                bound *= 2
+        for ws, bound in dict.fromkeys(segment_shapes):
+            if self._stop.is_set():
+                return
+            throwaway = gather_prefix_local(
+                pool.dev, jnp.asarray(0, jnp.int32), self.config, pool.width
+            )
+            self._record_program("segment", ws, bound, pool.width)
+            first, throwaway, self._key = _prefill_segment_and_sample(
+                self.params,
+                jnp.zeros((1, ws), jnp.int32),
+                jnp.zeros(1, jnp.int32),
+                jnp.ones(1, jnp.int32),
+                throwaway,
+                self._key,
+                jnp.zeros(1, jnp.float32),
+                jnp.zeros(1, jnp.int32),
+                jnp.ones(1, jnp.float32),
+                self.config,
+                bound,
+            )
+            jax.block_until_ready(first)
+        log.info(
+            "prefix-cache programs precompiled: pool %d×%d, gather widths %s, "
+            "%d suffix-segment shapes",
+            pool.entries, pool.width, list(dict.fromkeys(widths)),
+            len(dict.fromkeys(segment_shapes)),
         )
 
     def _run(self) -> None:
@@ -749,6 +1033,8 @@ class ServingEngine:
             if self._precompile:
                 self._warmup_decode_ladder()
                 self._warmup_prefill_buckets()
+                if self._prefix_pool is not None:
+                    self._warmup_prefix_programs()
             while not self._stop.is_set():
                 self._iterate(pending)
             while pending:
@@ -843,7 +1129,12 @@ class ServingEngine:
         fetch would not block). Backends without is_ready() report ready —
         degrading to depth-1 behavior, never deadlock."""
         for entry in batch:
-            arr = entry[1]
+            handle = entry[1]
+            if isinstance(handle, _Fetch):
+                if handle.done:
+                    continue  # fetch thread already landed the bytes
+                handle = handle.array
+            arr = handle
             is_ready = getattr(arr, "is_ready", None)
             if is_ready is None:
                 continue
@@ -858,9 +1149,14 @@ class ServingEngine:
         kind = entry[0]
         if kind == "prefill":
             # ONE fetch for the whole prefill group — per-request 1-token
-            # fetches cost a full tunnel round trip each (~100ms)
+            # fetches cost a full tunnel round trip each (~100ms); the
+            # fetch thread has usually landed the bytes already
             _, first_dev, group = entry
-            first = np.asarray(jax.device_get(first_dev))
+            first = (
+                first_dev.result()
+                if isinstance(first_dev, _Fetch)
+                else np.asarray(jax.device_get(first_dev))
+            )
             now = time.monotonic()
             for j, (idx, request) in enumerate(group):
                 slot = self._slots[idx]
@@ -974,11 +1270,23 @@ class ServingEngine:
                 break
         if not pairs:
             return []
+        entries: list[tuple] = []
+        # prefix reuse: peel off requests whose longest cached prefix can be
+        # extended in place (gather + suffix-only segment prefill); the rest
+        # take the batched cold admission below
+        if self._prefix_pool is not None:
+            cold: list[tuple[int, GenerationRequest]] = []
+            for idx, request in pairs:
+                hit = self._prefix_lookup(request.prompt_tokens)
+                if hit is not None:
+                    entries.extend(self._prefill_prefix(idx, request, *hit))
+                else:
+                    cold.append((idx, request))
+            pairs = cold
         groups: dict[int, list[tuple[int, GenerationRequest]]] = {}
         for idx, request in pairs:
             width = self._bucket(len(request.prompt_tokens))
             groups.setdefault(width, []).append((idx, request))
-        entries: list[tuple] = []
         for width, group in sorted(groups.items()):
             # fixed sub-batch size: each distinct (batch, width) shape is a
             # separate XLA compile (expensive through a TPU tunnel), so every
@@ -1056,7 +1364,8 @@ class ServingEngine:
             slot.started_at = started
             slot.first_token_at = 0.0  # stamped when the deferred fetch lands
             self.total_requests += 1
-        return [("prefill", first, list(group))]
+            self._maybe_publish(idx, request.prompt_tokens)
+        return [("prefill", self._fetcher.submit(first), list(group))]
 
     def _dev_prefill(self, width, tokens, lengths, temps, top_ks, top_ps, slots):
         """Device layer of a batched prefill — runs IDENTICALLY on the
@@ -1091,6 +1400,158 @@ class ServingEngine:
             self.config,
         )
         return first
+
+    # -- prefix KV reuse -----------------------------------------------------
+
+    def _prefix_lookup(
+        self, prompt: list[int], full_width_only: bool = False
+    ) -> Optional[tuple]:
+        """Longest usable cached prefix for this prompt as ``(p, entry)``,
+        recording the lookup in the pool's hit-rate stats. ``p`` may be
+        SHORTER than the entry (reusing the first p columns of a deeper
+        prefix). Short path: reject lengths where the suffix segment's
+        bucket padding would overhang the pool-width local cache (the
+        clamp-scatter would corrupt the last real column). Long path
+        (``full_width_only``): only a full-segment-width prefix keeps the
+        chunked-prefill segment grid aligned with the local cache."""
+        pool = self._prefix_pool
+        assert pool is not None
+        best = None
+        for p, entry in pool.candidates(prompt):  # ascending by p
+            if full_width_only:
+                if p == pool.width:
+                    best = (p, entry)
+            elif p + self._bucket(len(prompt) - p) <= pool.width:
+                best = (p, entry)
+        pool.record_lookup(best[1] if best else None)
+        return best
+
+    def _prefill_prefix(
+        self, idx: int, request: GenerationRequest, p: int, entry
+    ) -> list[tuple]:
+        """Warm admission: gather the cached prefix (pool row → pool-width
+        local cache), prefill ONLY the suffix as one segment at offset
+        ``p``, insert, and scatter the decode chain — the cold path minus
+        the prefix's prefill FLOPs and cache writes. The entry is pinned
+        for the span of the dispatch so eviction can never hand its row to
+        a concurrent publish mid-read."""
+        pool = self._prefix_pool
+        prompt = request.prompt_tokens
+        suffix = prompt[p:]
+        ws = self._bucket(len(suffix))
+        t_pool = pool.width
+        # static pow2-multiple cap on readable columns, same ladder as the
+        # chunked-prefill segments: the suffix never attends past p + ws
+        kv_bound = ws
+        while kv_bound < min(p + ws, t_pool):
+            kv_bound *= 2
+        kv_bound = min(kv_bound, t_pool)
+        tokens = np.zeros((1, ws), np.int32)
+        tokens[0, : len(suffix)] = suffix
+        opts = request.options
+        started = time.monotonic()
+        pool.acquire(entry)
+        try:
+            first = self._dev_prefix_admit(
+                tokens, p, len(suffix), kv_bound, entry.row,
+                opts.temperature, opts.top_k, opts.top_p, idx,
+            )
+        except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            log.exception("prefix-reuse prefill failed (p=%d)", p)
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=e,
+            ))
+            return []
+        finally:
+            pool.release(entry)
+        pool.tokens_saved += p
+        slot = self._slots[idx]
+        slot.request = request
+        slot.position = len(prompt)
+        slot.generated = []
+        slot.started_at = started
+        slot.first_token_at = 0.0
+        self.total_requests += 1
+        # the prompt may extend past the reused prefix's bucket boundary:
+        # publish the deeper prefix so the next lookup reuses more
+        self._maybe_publish(idx, prompt)
+        return [("prefill", self._fetcher.submit(first), [(idx, request)])]
+
+    def _dev_prefix_admit(
+        self, tokens, offset, seg_len, kv_bound, entry_row,
+        temperature, top_k, top_p, idx,
+    ):
+        """Device layer of a warm admission: prefix gather + suffix segment
+        + big-cache insert + decode-chain scatters. The segment and insert
+        programs are the SAME shapes the chunked-prefill path compiles
+        (local width = pool width = the largest bucket), so reuse adds only
+        the gather/publish pair to the program surface."""
+        from langstream_tpu.ops.kvcopy import gather_prefix_local
+
+        pool = self._prefix_pool
+        t_pool = pool.width
+        self._record_program("prefix-gather", t_pool)
+        local = gather_prefix_local(
+            pool.dev, jnp.asarray(entry_row, jnp.int32), self.config, t_pool
+        )
+        if self.mesh is not None:
+            from langstream_tpu.parallel.sharding import shard_serving_cache
+
+            local = shard_serving_cache(local, self.mesh)
+        self._record_program("segment", tokens.shape[1], kv_bound, t_pool)
+        first, local, self._key = _prefill_segment_and_sample(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray([offset], jnp.int32),
+            jnp.asarray([seg_len], jnp.int32),
+            local,
+            self._key,
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+            self.config,
+            kv_bound,
+        )
+        self._record_program("insert", t_pool)
+        self._cache = self._insert_group(
+            self._cache, local, jnp.asarray(np.full(1, idx, np.int32))
+        )
+        self._record_program("chain-scatter")
+        (
+            self._tokens_dev, self._positions_dev, self._temp_dev,
+            self._top_k_dev, self._top_p_dev,
+        ) = _chain_scatter(
+            self._tokens_dev, self._positions_dev, self._temp_dev,
+            self._top_k_dev, self._top_p_dev,
+            jnp.asarray(idx, jnp.int32), first, offset + seg_len,
+            temperature, top_k, top_p,
+        )
+        return first
+
+    def _maybe_publish(self, idx: int, prompt: list[int]) -> None:
+        """Copy-on-publish after a completed prefill: the slot's bucket-
+        aligned prefix KV rows go into a pool row (one jitted gather-
+        scatter), unless that prefix is already cached or every row is
+        pinned by an in-flight admission (publish never blocks, never
+        evicts a row being read)."""
+        pool = self._prefix_pool
+        if pool is None:
+            return
+        p = pool.publish_length(len(prompt))
+        if p <= 0 or pool.has(prompt, p):
+            return
+        row = pool.allocate()
+        if row is None:
+            return  # every row pinned — skip, don't stall admission
+        from langstream_tpu.ops.kvcopy import publish_prefix_rows
+
+        self._record_program("prefix-publish")
+        pool.dev = publish_prefix_rows(
+            pool.dev, self._cache,
+            jnp.asarray(idx, jnp.int32), jnp.asarray(row, jnp.int32),
+        )
+        pool.insert(prompt, p, row)
 
     def _chunk_steps(self) -> int:
         """Power-of-two chunk bounded by every active slot's cache headroom.
@@ -1204,7 +1665,17 @@ class ServingEngine:
             if free is None:
                 break
             request = self._long_queue.pop(0)
-            if self._ring_admit is not None and self._ring_pad(
+            # prefix reuse for long prompts: a cached FULL-segment-width
+            # prefix lets chunked prefill start at the reuse point (the
+            # segment grid stays aligned). A hit prefers the segment loop
+            # over the ring path — skipping a whole segment of prefill
+            # saves more than the ring's single-dispatch latency win.
+            prefix = None
+            if self._prefix_pool is not None:
+                prefix = self._prefix_lookup(
+                    request.prompt_tokens, full_width_only=True
+                )
+            if prefix is None and self._ring_admit is not None and self._ring_pad(
                 len(request.prompt_tokens)
             ) is not None:
                 # ring path: the whole prompt in ONE sequence-sharded
@@ -1218,7 +1689,13 @@ class ServingEngine:
                     return entries, spent
                 continue
             self._reserved.add(free)
-            self._longs[free] = {"idx": free, "request": request, "seg": 0}
+            st: dict = {"idx": free, "request": request, "seg": 0, "base": 0}
+            if prefix is not None:
+                p, entry = prefix
+                self._prefix_pool.acquire(entry)  # pinned until the gather
+                st["base"] = p
+                st["prefix"] = entry
+            self._longs[free] = st
         if not self._longs:
             return entries, spent
         # round-robin so two concurrent streams alternate segments fairly
@@ -1241,7 +1718,9 @@ class ServingEngine:
         request: GenerationRequest = st["request"]
         prompt = request.prompt_tokens
         width = self.prefill_buckets[-1]
-        s0 = st["seg"] * width
+        # ``base``: prefix-reuse offset (a full segment width when warm) —
+        # chunked prefill starts at the reuse point, segments stay aligned
+        s0 = st.get("base", 0) + st["seg"] * width
         seg = prompt[s0 : s0 + width]
         tokens = np.zeros((1, width), np.int32)
         tokens[0, : len(seg)] = seg
@@ -1268,11 +1747,15 @@ class ServingEngine:
                 top_ks=np.asarray([opts.top_k], np.int32),
                 top_ps=np.asarray([opts.top_p], np.float32),
             ))
+        prefix_entry = st.pop("prefix", None)  # only present on start
         try:
             first = self._dev_long_segment(
                 tokens, s0, len(seg), kv_bound, t_long,
                 opts.temperature, opts.top_k, opts.top_p,
                 start=start, final=final, idx=idx, prompt_len=len(prompt),
+                prefix_row=(
+                    prefix_entry.row if prefix_entry is not None else None
+                ),
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
             if self._spmd is not None:
@@ -1286,6 +1769,11 @@ class ServingEngine:
                 ttft_s=0, total_s=0, error=e,
             ))
             return []
+        finally:
+            if prefix_entry is not None:
+                self._prefix_pool.release(prefix_entry)
+        if prefix_entry is not None:
+            self._prefix_pool.tokens_saved += st.get("base", 0)
         st["seg"] += 1
         if not final:
             return []  # more segments to go
@@ -1300,7 +1788,8 @@ class ServingEngine:
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
         self.total_requests += 1
-        return [("prefill", first, [(idx, request)])]
+        self._maybe_publish(idx, prompt)
+        return [("prefill", self._fetcher.submit(first), [(idx, request)])]
 
     def _ring_pad(self, prompt_len: int) -> Optional[int]:
         """Padded width for the ring path: |seq| pow2-sized blocks (O(log)
@@ -1349,7 +1838,8 @@ class ServingEngine:
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
         self.total_requests += 1
-        return [("prefill", first, [(idx, request)])]
+        self._maybe_publish(idx, prompt)
+        return [("prefill", self._fetcher.submit(first), [(idx, request)])]
 
     def _announce_ring(self, tokens: np.ndarray, prompt_len: int, opts, idx: int) -> None:
         """Stream the PROMPT (not its pow2 padding — the follower derives
@@ -1421,12 +1911,26 @@ class ServingEngine:
     def _dev_long_segment(
         self, tokens, s0, seg_len, kv_bound, t_long, temperature, top_k, top_p,
         *, start: bool, final: bool, idx: int, prompt_len: int,
+        prefix_row: Optional[int] = None,
     ):
         """Device layer of one chunked-prefill segment (leader + SPMD
-        followers): fresh local cache on ``start``, segment forward, and on
-        ``final`` the splice into the big cache + decode-chain scatters."""
+        followers): fresh local cache on ``start`` (seeded from pool row
+        ``prefix_row`` on a warm start — the stream's first segment then
+        begins at the reuse offset), segment forward, and on ``final`` the
+        splice into the big cache + decode-chain scatters."""
         if start:
-            local_cache = make_kv_cache(self.config, 1, t_long)
+            if prefix_row is not None:
+                from langstream_tpu.ops.kvcopy import gather_prefix_local
+
+                self._record_program("prefix-gather", t_long)
+                local_cache = gather_prefix_local(
+                    self._prefix_pool.dev,
+                    jnp.asarray(prefix_row, jnp.int32),
+                    self.config,
+                    t_long,
+                )
+            else:
+                local_cache = make_kv_cache(self.config, 1, t_long)
             if self.mesh is not None:
                 from langstream_tpu.parallel.sharding import shard_serving_cache
 
@@ -1452,11 +1956,16 @@ class ServingEngine:
             self._cache = self._insert_group(
                 self._cache, self._long_caches.pop(idx), slots_dev
             )
-            self._tokens_dev = self._tokens_dev.at[idx].set(first[0])
-            self._positions_dev = self._positions_dev.at[idx].set(prompt_len)
-            self._temp_dev = self._temp_dev.at[idx].set(temperature)
-            self._top_k_dev = self._top_k_dev.at[idx].set(top_k)
-            self._top_p_dev = self._top_p_dev.at[idx].set(top_p)
+            self._record_program("chain-scatter")
+            (
+                self._tokens_dev, self._positions_dev, self._temp_dev,
+                self._top_k_dev, self._top_p_dev,
+            ) = _chain_scatter(
+                self._tokens_dev, self._positions_dev, self._temp_dev,
+                self._top_k_dev, self._top_p_dev,
+                jnp.asarray(idx, jnp.int32), first, prompt_len,
+                temperature, top_k, top_p,
+            )
         return first
 
     def _dispatch_chunk(self, clean: bool = True, pipelined: bool = False) -> tuple:
@@ -1501,7 +2010,13 @@ class ServingEngine:
         ]
         self._busy_steps += steps
         self._last_kv_bound = kv_bound or self.max_seq_len
-        return ("chunk", chunk, snapshot, steps, time.monotonic(), clean, pipelined)
+        # hand the chunk to the fetch thread NOW: it blocks on the bytes
+        # while this thread keeps dispatching — the ~100ms tunnel fetch is
+        # hidden at every chunk size, not only when chunk compute covers it
+        return (
+            "chunk", self._fetcher.submit(chunk), snapshot, steps,
+            time.monotonic(), clean, pipelined,
+        )
 
     def _decode_kv_bound(self, steps: int) -> int:
         """Static pow2 cap on readable cache columns for this chunk: decode
@@ -1552,7 +2067,10 @@ class ServingEngine:
         return chunk
 
     def _process_chunk(self, chunk, snapshot, steps: int) -> None:
-        host = np.asarray(jax.device_get(chunk))  # [steps, B]
+        if isinstance(chunk, _Fetch):
+            host = chunk.result()  # [steps, B], fetched by the fetch thread
+        else:
+            host = np.asarray(jax.device_get(chunk))  # [steps, B]
         for idx, request in snapshot:
             slot = self._slots[idx]
             if slot.request is not request:  # freed/reassigned meanwhile
@@ -1612,6 +2130,9 @@ class ServingEngine:
             ))
             self._held_back = None
         for st in self._longs.values():
+            entry = st.pop("prefix", None)
+            if entry is not None and self._prefix_pool is not None:
+                self._prefix_pool.release(entry)
             st["request"]._finish(GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=error,

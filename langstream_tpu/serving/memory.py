@@ -61,6 +61,10 @@ class ServingMemoryPlan:
     # is live WHILE a decode chunk holds its kv_bound slice — before the
     # fused scheduler the two alternated, so neither plan term saw the sum.
     fused_prefill_bytes: int = 0
+    # prefix KV pool (serving/prefix_cache.py): pool-entry rows × the
+    # largest bucket width, resident for the engine's whole lifetime. Sized
+    # by the `prefix-cache-fraction` knob; 0 when the cache is off.
+    prefix_pool_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -72,6 +76,7 @@ class ServingMemoryPlan:
             + self.scan_buffer_bytes
             + self.bound_slice_bytes
             + self.fused_prefill_bytes
+            + self.prefix_pool_bytes
         )
 
     def fits(self, hbm_bytes: int) -> bool:
@@ -86,6 +91,7 @@ class ServingMemoryPlan:
             f"+{self.bound_slice_bytes / gib:.2f}GiB kv_bound slice peak) + "
             f"long-prefill {self.long_cache_bytes / gib:.2f}GiB + "
             f"fused-prefill {self.fused_prefill_bytes / gib:.2f}GiB + "
+            f"prefix-pool {self.prefix_pool_bytes / gib:.2f}GiB + "
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
         )
@@ -115,6 +121,8 @@ def plan_serving_memory(
     prefill_batch: int = 0,
     prefill_bucket: int = 0,
     prefill_streams: int = 1,
+    prefix_pool_entries: int = 0,
+    prefix_pool_width: int = 0,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -126,6 +134,8 @@ def plan_serving_memory(
     admission local cache (prefill_batch rows × the largest bucket width)
     that a fused iteration holds alongside the decode chunk's kv_bound
     slice — 0 omits the term (pre-overlap accounting).
+    ``prefix_pool_entries``/``prefix_pool_width``: shape of the prefix
+    KV pool (serving/prefix_cache.py) — 0 omits the term (cache off).
     ``workspace_bytes``: flat allowance for activations, XLA scratch, and
     the collectives' staging buffers — 1GiB is empirically comfortable for
     8B-class decode at B≤96.
@@ -157,6 +167,15 @@ def plan_serving_memory(
         if prefill_batch > 0 and prefill_bucket > 0
         else None
     )
+    prefix_shape = (
+        jax.eval_shape(
+            lambda: make_kv_cache(
+                config, prefix_pool_entries, min(prefix_pool_width, max_seq_len)
+            )
+        )
+        if prefix_pool_entries > 0 and prefix_pool_width > 0
+        else None
+    )
     cache_bytes = _tree_bytes(cache_shape)
     sliced = largest_sliced_bound(max_seq_len)
     return ServingMemoryPlan(
@@ -176,6 +195,7 @@ def plan_serving_memory(
         # ~all of it), which the old cache//2 shortcut hid
         bound_slice_bytes=cache_bytes * sliced // max_seq_len if sliced else 0,
         fused_prefill_bytes=_tree_bytes(fused_shape) if fused_shape else 0,
+        prefix_pool_bytes=_tree_bytes(prefix_shape) if prefix_shape else 0,
     )
 
 

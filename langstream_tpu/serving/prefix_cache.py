@@ -1,0 +1,267 @@
+"""Automatic prefix KV-cache reuse: radix index + device-resident pool.
+
+Nearly every chat request opens with the same system prompt / few-shot
+preamble, yet a plain admission re-prefills it from token zero every time.
+This module gives the serving engine a cross-request prefix cache:
+
+- **Host side** (`PrefixCachePool` + its radix trie): an index over token
+  sequences keyed at *prefill-bucket-aligned* boundaries. Edges are the
+  token runs between consecutive bucket widths (32, 64, 128, … — exactly
+  the widths the admission programs already compile for), so a cached
+  prefix is always a shape the engine can extend with existing programs:
+  the suffix prefills as one `prefill_segment` starting at the reuse point.
+- **Device side**: a KV pool in the SAME `[L, B_pool, Hkv, T_pool, D]`
+  layout as the slot caches (bf16 and int8+scales variants both work —
+  `make_kv_cache` builds it), `T_pool` = the largest prefill bucket. One
+  pool row holds one cached prefix. Copies in/out are the two jitted
+  helpers in `ops/kvcopy.py` (traced row indices: one program each).
+
+Semantics that keep reuse EXACT (tested token-for-token vs cold runs):
+prefix KV is a pure function of the prefix tokens (causal attention), so a
+published row equals what a fresh prefill would write — including the int8
+cache, where publish copies the already-quantized values untouched. Columns
+past a prefix's true length carry garbage by design; the engine's masking
+invariant (columns beyond the written frontier never enter an attention
+mask until overwritten) makes that safe, the same way bucket padding is.
+
+Eviction is LRU over unreferenced entries only: `acquire`/`release`
+refcounts pin entries for the span of the admission dispatch that reads
+them, and `allocate` never evicts a pinned row. All methods run on the
+engine thread — no locking.
+
+Cross-request reuse papers this follows: DeepServe (arxiv 2501.14417) and
+STREAM (arxiv 2606.13968) both lean on prefix KV reuse to hold TTFT under
+shared-preamble load; the bucket-aligned twist here is what keeps the
+compile surface identical to the engine's existing ladder.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+
+
+def pool_entries_for_fraction(
+    max_batch: int, max_seq_len: int, pool_width: int, fraction: float,
+    *, cap: int = 512,
+) -> int:
+    """Pool rows whose total token capacity ≈ ``fraction`` of the decode
+    cache's (max_batch × max_seq_len tokens) — cache bytes scale linearly
+    with token capacity, so this is the `prefix-cache-fraction` knob's
+    arithmetic. Floored at 2 (a 1-row pool thrashes on its first eviction),
+    capped so tiny-bucket configs don't index thousands of rows."""
+    if fraction <= 0 or pool_width <= 0:
+        return 0
+    want = int(fraction * max_batch * max_seq_len) // pool_width
+    return max(2, min(want, cap))
+
+
+class _Node:
+    """Radix-trie node; one level per bucket boundary. ``edge`` is the
+    token run from the parent's boundary to this node's (kept for pruning)."""
+
+    __slots__ = ("parent", "edge", "children", "entry")
+
+    def __init__(self, parent: Optional["_Node"] = None, edge: tuple = ()):
+        self.parent = parent
+        self.edge = edge
+        self.children: dict[tuple, _Node] = {}
+        self.entry: Optional[PrefixEntry] = None
+
+
+@dataclass
+class PrefixEntry:
+    row: int  # pool row holding the KV
+    length: int  # bucket-aligned token count (a boundary width)
+    refs: int = 0  # admissions currently reading this row
+    last_used: int = 0  # LRU tick
+    node: Any = field(default=None, repr=False)
+
+
+class PrefixCachePool:
+    """Radix-indexed, refcounted, LRU-evicted device KV pool."""
+
+    def __init__(
+        self,
+        config: Any,
+        entries: int,
+        width: int,
+        boundaries: tuple[int, ...],
+        mesh: Optional[Any] = None,
+    ) -> None:
+        from langstream_tpu.models.transformer import make_kv_cache
+
+        self.config = config
+        self.entries = int(entries)
+        self.width = int(width)
+        # bucket-aligned publish/lookup lengths, ascending, bounded by the
+        # pool width (a prefix wider than a pool row can't be cached)
+        self.boundaries = tuple(
+            sorted({int(b) for b in boundaries if 0 < b <= self.width})
+        )
+        if self.entries < 1 or not self.boundaries:
+            raise ValueError("prefix pool needs ≥1 entry and ≥1 boundary")
+        self.dev = make_kv_cache(config, self.entries, self.width)
+        if mesh is not None:
+            from langstream_tpu.parallel.sharding import shard_serving_cache
+
+            self.dev = shard_serving_cache(self.dev, mesh)
+        self.bytes_total = sum(
+            leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(self.dev)
+        )
+        self._bytes_per_row = self.bytes_total // self.entries
+        self._root = _Node()
+        self._live: dict[int, PrefixEntry] = {}  # row → entry
+        self._free = list(range(self.entries - 1, -1, -1))
+        self._tick = 0
+        # stats (cumulative since engine start)
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+
+    # -- index ---------------------------------------------------------------
+
+    def _walk(self, tokens, limit: int, create: bool = False) -> list[_Node]:
+        """Nodes along the bucket-aligned path of ``tokens``, root excluded,
+        stopping at the first missing edge (or creating edges down to the
+        deepest boundary ≤ limit when ``create``)."""
+        path: list[_Node] = []
+        node, prev = self._root, 0
+        for b in self.boundaries:
+            if b > limit:
+                break
+            seg = tuple(tokens[prev:b])
+            child = node.children.get(seg)
+            if child is None:
+                if not create:
+                    break
+                child = _Node(parent=node, edge=seg)
+                node.children[seg] = child
+            path.append(child)
+            node, prev = child, b
+        return path
+
+    def candidates(self, tokens) -> list[tuple[int, PrefixEntry]]:
+        """Usable ``(reuse_length, entry)`` pairs for this prompt, ascending
+        by length. The limit is ``len(tokens) - 1``: at least one suffix
+        token must prefill, since the first sampled token needs last-token
+        logits. A pair may reuse only the FIRST ``reuse_length`` columns of
+        a DEEPER entry (a preamble cached as part of a longer prompt still
+        serves shorter prompts sharing it — the row's leading columns ARE
+        that prefix's KV). No stats side effects; callers report the final
+        decision through ``record_lookup``."""
+        out: list[tuple[int, PrefixEntry]] = []
+        path = self._walk(tokens, limit=len(tokens) - 1)
+        depth = 0
+        for node, b in zip(path, self.boundaries):
+            if node.entry is not None:
+                out.append((b, node.entry))
+            depth = b
+        if path and (not out or out[-1][0] < depth):
+            # the deepest matched node has no entry of its own, but any
+            # descendant's row carries this prefix in its leading columns
+            sub = self._subtree_entry(path[-1])
+            if sub is not None:
+                out.append((depth, sub))
+        return out
+
+    @staticmethod
+    def _subtree_entry(node: _Node) -> Optional[PrefixEntry]:
+        stack = list(node.children.values())
+        while stack:
+            n = stack.pop()
+            if n.entry is not None:
+                return n.entry
+            stack.extend(n.children.values())
+        return None
+
+    def record_lookup(self, used: Optional[PrefixEntry]) -> None:
+        """Count one admission lookup; ``used`` is the entry the engine
+        actually reused (None = miss / no usable candidate)."""
+        self.lookups += 1
+        if used is not None:
+            self.hits += 1
+            self._tick += 1
+            used.last_used = self._tick
+
+    def has(self, tokens, length: int) -> bool:
+        path = self._walk(tokens, limit=length)
+        return bool(path) and path[-1].entry is not None and (
+            path[-1].entry.length == length
+        )
+
+    def publish_length(self, prompt_len: int) -> int:
+        """Largest bucket-aligned prefix length coverable by a pool row for
+        a prompt of ``prompt_len`` tokens, or 0 when none fits."""
+        best = 0
+        for b in self.boundaries:
+            if b <= prompt_len:
+                best = b
+        return best
+
+    # -- refcounts / eviction ------------------------------------------------
+
+    def acquire(self, entry: PrefixEntry) -> None:
+        entry.refs += 1
+
+    def release(self, entry: PrefixEntry) -> None:
+        assert entry.refs > 0
+        entry.refs -= 1
+
+    def allocate(self) -> Optional[int]:
+        """A free pool row, evicting the least-recently-used UNREFERENCED
+        entry when full. None when every row is pinned by an in-flight
+        admission — the caller skips the publish (never blocks, never
+        evicts a row a dispatch is reading)."""
+        if self._free:
+            return self._free.pop()
+        victims = [e for e in self._live.values() if e.refs == 0]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda e: e.last_used)
+        self._evict(victim)
+        return self._free.pop()
+
+    def _evict(self, entry: PrefixEntry) -> None:
+        node = entry.node
+        node.entry = None
+        # prune entry-less leaf chains so the trie stays bounded by the pool
+        while (
+            node is not None
+            and node.parent is not None
+            and node.entry is None
+            and not node.children
+        ):
+            parent = node.parent
+            del parent.children[node.edge]
+            node = parent
+        del self._live[entry.row]
+        self._free.append(entry.row)
+        self.evictions += 1
+
+    def insert(self, tokens, length: int, row: int) -> PrefixEntry:
+        """Index pool row ``row`` as the prefix ``tokens[:length]`` (the
+        device copy has already been dispatched; in-order streams make the
+        row readable by any later gather)."""
+        assert length in self.boundaries, (length, self.boundaries)
+        node = self._walk(tokens, limit=length, create=True)[-1]
+        self._tick += 1
+        entry = PrefixEntry(row=row, length=length, last_used=self._tick, node=node)
+        node.entry = entry
+        self._live[row] = entry
+        return entry
+
+    # -- stats ---------------------------------------------------------------
+
+    @property
+    def live_entries(self) -> int:
+        return len(self._live)
+
+    def bytes_in_use(self) -> int:
+        return len(self._live) * self._bytes_per_row
+
+    def hit_rate(self) -> float:
+        return round(self.hits / self.lookups, 4) if self.lookups else 0.0

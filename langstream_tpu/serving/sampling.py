@@ -26,11 +26,21 @@ def _greedy_argmax(logits: jax.Array) -> jax.Array:
     index vector the full width, while the grouped form does the wide pass
     as a pure max (cheaper on the VPU) and the index math at 1/128 width.
     Tie semantics match jnp.argmax exactly (first index wins: the first
-    group holding the global max, the first position within it)."""
+    group holding the global max, the first position within it).
+
+    Ragged vocabs (GPT-2-family 50257 etc.) pad with -inf columns to the
+    next multiple of 128 so the grouped path ALWAYS runs — the old silent
+    fallback to the slow single-pass argmax cost exactly the models it was
+    meant to serve. -inf pads sit past every real column, so first-index
+    tie-breaking never selects one: a pad wins its group only when the
+    group is all -inf, and an all--inf row resolves to index 0 the same
+    way jnp.argmax does."""
     b, v = logits.shape
     group = 128
     if v % group:
-        return jnp.argmax(logits, axis=-1)
+        pad = group - v % group
+        logits = jnp.pad(logits, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+        v += pad
     grouped = logits.reshape(b, v // group, group)
     within = jnp.argmax(grouped, axis=-1)  # [B, v/group]
     maxima = jnp.max(grouped, axis=-1)

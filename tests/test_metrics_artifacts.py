@@ -78,6 +78,10 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_hbm_gbps",
         "engine_decode_step_ms",
         "engine_compiled_programs",
+        "engine_prefix_cache_hit_rate",
+        "engine_prefill_tokens_saved_total",
+        "engine_prefix_pool_bytes_in_use",
+        "engine_prefix_cache_evictions_total",
     ):
         serving.gauge(n)
     exposed = {

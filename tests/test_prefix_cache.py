@@ -1,0 +1,293 @@
+"""Prefix KV-cache reuse tests: warm-prefix generations must be
+token-for-token identical to cold runs (the cache is a scheduling/bandwidth
+optimization, never a math change) for both the short admit-group path and
+the chunked-prefill long-prompt path, on float (bf16-on-TPU) and int8
+caches; plus radix-index semantics, refcounted LRU eviction, and the memory
+plan's pool term."""
+
+import dataclasses
+
+import jax
+import pytest
+
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+from langstream_tpu.models.transformer import init_params
+from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+from langstream_tpu.serving.prefix_cache import (
+    PrefixCachePool,
+    pool_entries_for_fraction,
+)
+
+CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+CFG_INT8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine(config=CFG, prefix=False, **kw):
+    engine = ServingEngine(
+        config,
+        PARAMS,
+        prefix_cache="auto" if prefix else "off",
+        prefix_cache_entries=4 if prefix else None,
+        **kw,
+    )
+    engine.start()
+    return engine
+
+
+GREEDY = GenerationOptions(max_new_tokens=10, temperature=0.0)
+
+
+@pytest.mark.parametrize("config", [CFG, CFG_INT8], ids=["float", "int8kv"])
+def test_warm_prefix_exact_short_path(config):
+    """Admit-group path: a generation admitted against a warm prefix is
+    bit-identical to a cold run (greedy, fixed seed). The second request
+    reuses the 32-token bucket-aligned prefix the first one published."""
+    prompt = [(7 + 3 * i) % CFG.vocab_size for i in range(45)]
+    # a shared preamble with a DIFFERENT tail must also reuse the prefix
+    other = prompt[:40] + [(3 * i + 1) % CFG.vocab_size for i in range(5)]
+    cold_engine = make_engine(
+        config, max_batch=2, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16, 32, 64),
+    )
+    try:
+        cold = cold_engine.generate(prompt, GREEDY, timeout=120).tokens
+        cold2 = cold_engine.generate(other, GREEDY, timeout=120).tokens
+    finally:
+        cold_engine.stop()
+
+    engine = make_engine(
+        config, prefix=True, max_batch=2, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16, 32, 64),
+    )
+    try:
+        first = engine.generate(prompt, GREEDY, timeout=120).tokens
+        warm = engine.generate(prompt, GREEDY, timeout=120).tokens
+        stats = engine.stats()
+        assert first == cold, "publishing run diverged from a cold engine"
+        assert warm == cold, "warm-prefix run diverged from the cold run"
+        assert stats["prefill-tokens-saved-total"] == 32  # bucket-aligned
+        assert stats["prefix-cache-hit-rate"] == 0.5  # miss then hit
+        warm2 = engine.generate(other, GREEDY, timeout=120).tokens
+        assert warm2 == cold2
+        assert engine.stats()["prefill-tokens-saved-total"] == 64
+    finally:
+        engine.stop()
+
+
+@pytest.mark.parametrize("config", [CFG, CFG_INT8], ids=["float", "int8kv"])
+def test_warm_prefix_exact_long_path(config):
+    """Chunked-prefill path: a long prompt (wider than the largest bucket)
+    admitted against a warm full-segment-width prefix — chunked prefill
+    starts at the reuse point — matches the cold run token for token."""
+    prompt = [(3 + 5 * i) % CFG.vocab_size for i in range(70)]  # 3 segments @32
+    cold_engine = make_engine(
+        config, max_batch=2, max_seq_len=256, decode_chunk=4,
+        prefill_buckets=(16, 32),
+    )
+    try:
+        cold = cold_engine.generate(prompt, GREEDY, timeout=120).tokens
+    finally:
+        cold_engine.stop()
+
+    engine = make_engine(
+        config, prefix=True, max_batch=2, max_seq_len=256, decode_chunk=4,
+        prefill_buckets=(16, 32),
+    )
+    try:
+        first = engine.generate(prompt, GREEDY, timeout=120).tokens
+        warm = engine.generate(prompt, GREEDY, timeout=120).tokens
+        assert first == cold
+        assert warm == cold
+        stats = engine.stats()
+        # long-path reuse is full-segment-width only (pool width = 32)
+        assert stats["prefill-tokens-saved-total"] == 32
+        assert stats["prefix-cache-entries"] >= 1
+    finally:
+        engine.stop()
+
+
+def test_deeper_entry_serves_shorter_prompt():
+    """A preamble published as part of a LONGER prompt serves shorter
+    prompts sharing it: the pool row's leading columns ARE that prefix's
+    KV, and the radix walk reuses them at the matched depth."""
+    preamble = [(9 + i) % CFG.vocab_size for i in range(32)]
+    long_prompt = preamble + [(5 * i) % CFG.vocab_size for i in range(20)]
+    short_prompt = preamble + [7, 8, 9]
+    cold_engine = make_engine(
+        max_batch=2, max_seq_len=128, decode_chunk=4, prefill_buckets=(16, 32, 64),
+    )
+    try:
+        cold = cold_engine.generate(short_prompt, GREEDY, timeout=120).tokens
+    finally:
+        cold_engine.stop()
+    engine = make_engine(
+        prefix=True, max_batch=2, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16, 32, 64),
+    )
+    try:
+        engine.generate(long_prompt, GREEDY, timeout=120)  # publishes at 32
+        warm = engine.generate(short_prompt, GREEDY, timeout=120).tokens
+        assert warm == cold
+        assert engine.stats()["prefill-tokens-saved-total"] == 32
+    finally:
+        engine.stop()
+
+
+def test_concurrent_shared_preamble_burst_hits():
+    """The workload the cache exists for: after one warmup chat, a burst of
+    chats sharing the preamble all reuse it (hit rate counts the warmup
+    miss) and every completion matches the cold engine's output."""
+    preamble = [(11 + 2 * i) % CFG.vocab_size for i in range(32)]
+    tails = [[(i + 1) % CFG.vocab_size, (2 * i + 3) % CFG.vocab_size] for i in range(4)]
+    opts = GenerationOptions(max_new_tokens=8, temperature=0.0)
+
+    cold_engine = make_engine(
+        max_batch=4, max_seq_len=128, decode_chunk=4, prefill_buckets=(16, 32, 64),
+    )
+    try:
+        cold = [
+            cold_engine.generate(preamble + t, opts, timeout=120).tokens
+            for t in tails
+        ]
+    finally:
+        cold_engine.stop()
+
+    engine = make_engine(
+        prefix=True, max_batch=4, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16, 32, 64),
+    )
+    try:
+        engine.generate(preamble + tails[0], opts, timeout=120)  # warmup/publish
+        requests = [
+            engine.submit(GenerationRequest(prompt_tokens=preamble + t, options=opts))
+            for t in tails
+        ]
+        results = [r.result(timeout=120).tokens for r in requests]
+        assert results == cold
+        stats = engine.stats()
+        # 1 warmup miss + 4 hits
+        assert stats["prefix-cache-hit-rate"] == pytest.approx(4 / 5)
+        assert stats["prefill-tokens-saved-total"] == 4 * 32
+    finally:
+        engine.stop()
+
+
+def test_lru_eviction_under_pressure_skips_referenced():
+    """Refcounted blocks in use are never evicted: with the pool full, the
+    LRU *unreferenced* entry is evicted; with every entry pinned, allocate
+    refuses (publish skips) instead of corrupting an in-flight read."""
+    pool = PrefixCachePool(CFG, entries=2, width=32, boundaries=(16, 32))
+    a = list(range(100, 132))
+    b = list(range(200, 232))
+    c = list(range(300, 332))
+    ea = pool.insert(a, 32, pool.allocate())
+    eb = pool.insert(b, 32, pool.allocate())
+    # touch A so B is the LRU entry
+    pool.record_lookup(ea)
+    pool.acquire(eb)  # ...but B is pinned by an in-flight admission
+    row = pool.allocate()  # must evict A (LRU among unreferenced), not B
+    assert row == ea.row
+    assert pool.evictions == 1
+    assert pool._live[eb.row] is eb  # B untouched
+    ec = pool.insert(c, 32, row)
+    pool.acquire(ec)
+    assert pool.allocate() is None  # everything pinned → refuse, don't evict
+    pool.release(eb)
+    assert pool.allocate() == eb.row  # released entry becomes evictable
+    assert pool.evictions == 2
+
+
+def test_engine_eviction_pressure_stays_exact():
+    """Cycling more distinct preambles than the pool holds forces LRU
+    evictions mid-traffic; generations stay bit-exact throughout."""
+    cold_engine = make_engine(
+        max_batch=2, max_seq_len=128, decode_chunk=4, prefill_buckets=(16, 32),
+    )
+    engine = ServingEngine(
+        CFG, PARAMS, max_batch=2, max_seq_len=128, decode_chunk=4,
+        prefill_buckets=(16, 32), prefix_cache="auto", prefix_cache_entries=2,
+    )
+    engine.start()
+    try:
+        prompts = [
+            [(seed + 7 * i) % CFG.vocab_size for i in range(40)]
+            for seed in (1, 2, 3)
+        ]
+        for rnd in range(2):
+            for prompt in prompts:
+                cold = cold_engine.generate(prompt, GREEDY, timeout=120).tokens
+                warm = engine.generate(prompt, GREEDY, timeout=120).tokens
+                assert warm == cold, f"diverged on round {rnd}"
+        assert engine.stats()["prefix-cache-evictions-total"] > 0
+    finally:
+        engine.stop()
+        cold_engine.stop()
+
+
+def test_radix_candidates_and_publish_dedupe():
+    pool = PrefixCachePool(CFG, entries=4, width=32, boundaries=(8, 16, 32))
+    tokens = list(range(40))
+    assert pool.candidates(tokens) == []
+    assert pool.publish_length(40) == 32
+    assert pool.publish_length(20) == 16
+    assert pool.publish_length(4) == 0
+    e = pool.insert(tokens, 32, pool.allocate())
+    assert pool.has(tokens, 32)
+    # full-depth candidate for a longer prompt...
+    assert pool.candidates(tokens + [99]) == [(32, e)]
+    # ...partial reuse at the matched depth for a prompt diverging at 20
+    divergent = tokens[:16] + [500] * 16
+    assert pool.candidates(divergent) == [(16, e)]
+    # the lookup cap: at least one suffix token must remain to prefill
+    assert pool.candidates(tokens[:32]) == [(16, e)]
+    assert not pool.candidates(tokens[:8])
+
+
+def test_memory_plan_accounts_prefix_pool():
+    from langstream_tpu.serving.memory import plan_serving_memory
+
+    base = plan_serving_memory(CFG, 4, 256)
+    with_pool = plan_serving_memory(
+        CFG, 4, 256, prefix_pool_entries=4, prefix_pool_width=64
+    )
+    assert with_pool.prefix_pool_bytes > 0
+    assert with_pool.total_bytes == base.total_bytes + with_pool.prefix_pool_bytes
+    assert "prefix-pool" in with_pool.summary()
+    # engine surfaces the pool in its own plan
+    engine = ServingEngine(
+        CFG, PARAMS, max_batch=2, max_seq_len=128, prefill_buckets=(16, 32),
+        prefix_cache="auto", prefix_cache_entries=3,
+    )
+    assert engine._plan is not None
+    assert engine._plan.prefix_pool_bytes > 0
+    engine._fail_all(RuntimeError("never started"))
+
+
+def test_pool_sizing_fraction():
+    assert pool_entries_for_fraction(8, 2048, 2048, 0.0) == 0
+    assert pool_entries_for_fraction(8, 2048, 2048, 0.25) == 2
+    assert pool_entries_for_fraction(192, 512, 64, 0.25) == 384
+    assert pool_entries_for_fraction(192, 512, 1, 1.0) == 512  # capped
+
+
+def test_token_fetcher_preserves_order():
+    """The dedicated fetch thread returns results in submission (= chunk)
+    order, and handles resolve inline when no thread is running."""
+    import numpy as np
+
+    from langstream_tpu.serving.engine import _TokenFetcher
+
+    fetcher = _TokenFetcher()
+    # no thread: inline fallback
+    h = fetcher.submit(jax.numpy.arange(4))
+    assert h.result().tolist() == [0, 1, 2, 3]
+    fetcher.start()
+    try:
+        handles = [fetcher.submit(jax.numpy.full((2,), i)) for i in range(16)]
+        for i, h in enumerate(handles):
+            np.testing.assert_array_equal(h.result(), np.full((2,), i))
+    finally:
+        fetcher.stop()
+    # after stop: inline fallback again
+    assert fetcher.submit(jax.numpy.arange(2)).result().tolist() == [0, 1]

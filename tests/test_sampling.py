@@ -11,11 +11,25 @@ from langstream_tpu.serving.sampling import _greedy_argmax, sample
 
 def test_two_stage_argmax_matches_plain():
     key = jax.random.PRNGKey(0)
-    for b, v in ((1, 128), (4, 2048), (3, 128 * 37), (2, 1000)):  # 1000: fallback
+    # ragged vocabs (1000, GPT-2's 50257) pad with -inf to the next multiple
+    # of 128 — the grouped two-stage path always runs, no slow fallback
+    for b, v in ((1, 128), (4, 2048), (3, 128 * 37), (2, 1000), (2, 50257)):
         logits = jax.random.normal(jax.random.fold_in(key, v), (b, v))
         np.testing.assert_array_equal(
             np.asarray(_greedy_argmax(logits)), np.asarray(jnp.argmax(logits, axis=-1))
         )
+
+
+def test_two_stage_argmax_padded_vocab_edges():
+    # max at the LAST real column of a ragged vocab: the -inf pads share its
+    # group and must lose; an all--inf row resolves to 0 like jnp.argmax
+    v = 50257
+    logits = np.full((2, v), -np.inf, np.float32)
+    logits[0, v - 1] = 1.0
+    out = np.asarray(_greedy_argmax(jnp.asarray(logits)))
+    ref = np.asarray(jnp.argmax(jnp.asarray(logits), axis=-1))
+    np.testing.assert_array_equal(out, ref)
+    assert out.tolist() == [v - 1, 0]
 
 
 def test_two_stage_argmax_tie_breaks_first_index():
