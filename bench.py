@@ -314,6 +314,108 @@ def bench_prefix_burst(preset: str, quantize: bool, *, preamble_len: int,
     return out
 
 
+def bench_degradation(preset: str, quantize: bool, max_batch: int,
+                      new_tokens: int, n_requests: int, max_seq_len: int,
+                      decode_chunk: int) -> dict:
+    """Degradation phase (docs/SERVING.md §9): p50/p99 TTFT, shed rate, and
+    recovery counters while the deterministic injector fires periodic
+    decode crashes and a NaN-logits fault into a reject-policy engine with
+    a tight queue. Graceful degradation as measured numbers: the engine
+    must keep completing requests (restarting under backoff, shedding the
+    overflow) rather than dying — a crash of THIS phase is a recovery bug."""
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import (
+        GenerationRequest,
+        ServingEngine,
+        ShedError,
+    )
+    from langstream_tpu.serving.faultinject import FaultInjector
+
+    config = MODEL_PRESETS[preset]
+    if quantize:
+        from langstream_tpu.models.quant import init_random_quantized_params
+
+        params = init_random_quantized_params(config, jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+    else:
+        params = init_params(config, jax.random.PRNGKey(0))
+    # one decode crash every ~50 dispatches from #20, one NaN quarantine:
+    # frequent enough that even the CPU smoke's ~40 dispatches exercise a
+    # restart, rare enough that most requests complete (the seed is pinned
+    # so the schedule is identical across runs — PERF.md comparable)
+    injector = FaultInjector("decode@20:50,nan@12", seed=0)
+    engine = ServingEngine(
+        config,
+        params,
+        max_batch=max_batch,
+        max_seq_len=min(max_seq_len, config.max_seq_len),
+        prefill_buckets=(64,),
+        decode_chunk=decode_chunk,
+        prefill_batch=max_batch,
+        shed_policy="reject",
+        queue_depth=max_batch,
+        restart_backoff_s=0.05,
+        fault_injector=injector,
+    )
+    engine.start()
+    rng = np.random.default_rng(0)
+    ttfts: list = []
+    shed = failed = done = 0
+    try:
+        warm = GenerationRequest(
+            prompt_tokens=rng.integers(1, config.vocab_size, size=24).tolist(),
+            options=GenerationOptions(max_new_tokens=4, temperature=0.0),
+        )
+        engine.submit(warm)
+        warm.result(timeout=600)
+        inflight = []
+        for _ in range(n_requests):
+            first: dict = {}
+            t_submit = time.monotonic()
+            req = GenerationRequest(
+                prompt_tokens=rng.integers(1, config.vocab_size, size=24).tolist(),
+                options=GenerationOptions(
+                    max_new_tokens=new_tokens, temperature=0.0
+                ),
+                on_token=lambda _t, first=first, t0=t_submit: first.setdefault(
+                    "ttft", time.monotonic() - t0
+                ),
+            )
+            try:
+                engine.submit(req)
+                inflight.append((req, first))
+            except ShedError:
+                shed += 1
+            time.sleep(0.005)  # paced arrivals: shedding reflects sustained
+            # load against a crashing engine, not a one-burst artifact
+        for req, first in inflight:
+            try:
+                req.result(timeout=1200)
+                done += 1
+                if "ttft" in first:
+                    ttfts.append(first["ttft"])
+            except Exception:  # noqa: BLE001 — quarantined by an injected fault
+                failed += 1
+    finally:
+        engine.stop()
+    stats = engine.stats()
+    ttfts.sort()
+    return {
+        "degraded_p50_ttft_ms": round(_pct(ttfts, 0.5) * 1e3, 1) if ttfts else None,
+        "degraded_p99_ttft_ms": round(_pct(ttfts, 0.99) * 1e3, 1) if ttfts else None,
+        "degraded_shed_rate": round(shed / max(1, n_requests), 3),
+        "degraded_completed": done,
+        "degraded_failed": failed,
+        "degraded_engine_restarts": stats["engine-restarts-total"],
+        "degraded_quarantined_slots": stats["quarantined-slots-total"],
+        "degraded_faults_fired": stats["fault-injection"],
+    }
+
+
 async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens: int,
                         n_sessions: int, max_seq_len: int, decode_chunk: int,
                         prefill_batch: int, overlap: bool = True) -> dict:
@@ -523,6 +625,17 @@ def main() -> None:
         extras.update(bench_prefix_burst(preset, quantize, **prefix_args))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] prefix burst phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # degradation under injected faults: p99 TTFT + shed rate while the
+    # engine takes periodic decode crashes and a NaN quarantine (§9)
+    print("[bench] degradation (fault-injection) phase", file=sys.stderr, flush=True)
+    try:
+        extras.update(bench_degradation(
+            preset, quantize, max_batch, min(new_tokens, 64),
+            max(n_requests, 32), max_seq_len, decode_chunk,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] degradation phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     if on_tpu:
         # flagship phase: BASELINE.md's headline model (llama-3-8b, ≥2000

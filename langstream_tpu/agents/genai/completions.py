@@ -94,6 +94,30 @@ class _BaseCompletionsStep(Step):
             "engine_prefix_cache_evictions_total",
             "prefix-cache LRU evictions (cumulative)",
         )
+        # request lifecycle / fault recovery (serving/engine.py): sourced
+        # from the engine's cumulative stats, gauges like the prefix set
+        self._m_shed = metrics.gauge(
+            "engine_shed_total",
+            "requests shed at admission (full queue / hopeless deadline / "
+            "draining), cumulative",
+        )
+        self._m_deadline = metrics.gauge(
+            "engine_deadline_exceeded_total",
+            "requests past their deadline (in queue or mid-decode), cumulative",
+        )
+        self._m_cancelled = metrics.gauge(
+            "engine_cancelled_total",
+            "requests cancelled (client disconnect / timeout), cumulative",
+        )
+        self._m_quarantined = metrics.gauge(
+            "engine_quarantined_slots_total",
+            "slots failed by device faults or the NaN-logits guard, cumulative",
+        )
+        self._m_restarts = metrics.gauge(
+            "engine_restarts_total",
+            "engine-loop restarts after a crash (bounded-backoff recovery), "
+            "cumulative",
+        )
 
     def _record_metrics(self, result: Any) -> None:
         self._m_calls.count()
@@ -117,6 +141,11 @@ class _BaseCompletionsStep(Step):
         self._m_prefix_saved.set(stats.get("prefill-tokens-saved-total", 0))
         self._m_prefix_bytes.set(stats.get("prefix-pool-bytes-in-use", 0))
         self._m_prefix_evict.set(stats.get("prefix-cache-evictions-total", 0))
+        self._m_shed.set(stats.get("shed-total", 0))
+        self._m_deadline.set(stats.get("deadline-exceeded-total", 0))
+        self._m_cancelled.set(stats.get("cancelled-total", 0))
+        self._m_quarantined.set(stats.get("quarantined-slots-total", 0))
+        self._m_restarts.set(stats.get("engine-restarts-total", 0))
 
     async def close(self) -> None:
         if self._producer is not None:
@@ -129,7 +158,7 @@ class _BaseCompletionsStep(Step):
             for k in (
                 "max-tokens", "temperature", "top-p", "top-k", "stop",
                 "logit-bias", "user", "presence-penalty", "frequency-penalty",
-                "options",
+                "options", "deadline", "max-queue-wait",
             )
             if self.config.get(k) is not None
         }
@@ -173,6 +202,15 @@ class _BaseCompletionsStep(Step):
 
         assert self._service is not None, "step not started"
         options = self._options()
+        # client-disconnect cancellation: hand the record's chat session id
+        # to the service so the gateway's ClientDisconnected handler can
+        # cancel the in-flight generation (serving/lifecycle.py; only the
+        # tpu-serving provider acts on it, remote providers ignore it)
+        from langstream_tpu.serving.lifecycle import SESSION_HEADER
+
+        session_id = record.properties.get(SESSION_HEADER)
+        if session_id:
+            options["cancel-key"] = str(session_id)
         chunks_consumer = None
         chunk_futures: list = []
         if self.stream_to_topic:

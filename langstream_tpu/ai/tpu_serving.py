@@ -27,6 +27,16 @@ Resource configuration:
     relative to the decode cache; `prefix-cache-entries` overrides the
     row count directly (0 disables the pool entirely). The memory plan
     accounts the pool before warmup.
+  queue-depth / shed-policy: bounded admission queue; "block" (default)
+    backpressures the broker poll loop, "reject" sheds with a retry-after
+    (ShedError) so front doors degrade to fast 429s under overload
+  engine-restart-backoff / engine-max-restarts: loop-crash recovery —
+    quarantine in-flight slots, rebuild device state, restart under
+    bounded exponential backoff (single-host only; SPMD stays crash-only)
+  drain-grace-s: close() drains (finish in-flight, reject new) this many
+    seconds before the hard stop
+  fault-injection / fault-seed / fault-stall-s: deterministic fault drills
+    (serving/faultinject.py; also via LSTPU_FAULTS env)
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
   quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
     models stage on the host so the bf16 tree never needs device HBM)
@@ -226,10 +236,38 @@ class _EngineHolder:
                 if self.config.get("prefix-cache-entries") is not None
                 else None
             ),
+            # request lifecycle / fault recovery (docs/SERVING.md §9)
+            queue_depth=(
+                int(self.config["queue-depth"])
+                if self.config.get("queue-depth") is not None
+                else None
+            ),
+            shed_policy=str(self.config.get("shed-policy", "block")),
+            restart_backoff_s=float(
+                self.config.get("engine-restart-backoff", 0.1)
+            ),
+            max_restarts=int(self.config.get("engine-max-restarts", 5)),
+            fault_injector=self._fault_injector(),
         )
         if start:
             engine.start()
         return engine
+
+    def _fault_injector(self):
+        """Config-driven fault injection (staging drills): `fault-injection`
+        is the spec string (serving/faultinject.py grammar), `fault-seed`
+        pins the schedule. None (the default) still leaves the LSTPU_FAULTS
+        env activation to the engine."""
+        spec = str(self.config.get("fault-injection", "") or "").strip()
+        if not spec:
+            return None
+        from langstream_tpu.serving.faultinject import FaultInjector
+
+        return FaultInjector(
+            spec,
+            seed=int(self.config.get("fault-seed", 0)),
+            stall_s=float(self.config.get("fault-stall-s", 0.05)),
+        )
 
     def engine(self):
         with self._lock:
@@ -267,7 +305,15 @@ class _EngineHolder:
     def close(self) -> None:
         with self._lock:
             if self._engine is not None:
-                self._engine.stop()
+                # graceful teardown: drain (finish in-flight, reject new)
+                # for a bounded grace period, THEN stop — stop() alone
+                # _fail_alls work that only needed a few more chunks
+                try:
+                    self._engine.drain(
+                        float(self.config.get("drain-grace-s", 10.0))
+                    )
+                finally:
+                    self._engine.stop()
                 self._engine = None
 
 
@@ -392,14 +438,51 @@ class TpuCompletionsService(CompletionsService):
             on_token=on_token,
             on_done=_on_done,
         )
-        # submit may block on a full queue (backpressure) → executor; the
-        # WAIT is a loop future resolved by on_done, so an in-flight
-        # generation holds no thread and agent fan-out isn't capped by the
-        # executor pool size
-        await loop.run_in_executor(None, engine.submit, request)
-        result = await asyncio.wait_for(done, 600.0)
+        # client-disconnect wiring: the gateway cancels every request
+        # registered under the record's session header when the websocket
+        # drops (serving/lifecycle.py), so an abandoned stream stops
+        # consuming decode steps within one chunk
+        from langstream_tpu.serving import lifecycle
+
+        cancel_key = str(options.get("cancel-key") or "")
+        if cancel_key:
+            lifecycle.register(cancel_key, request)
+        try:
+            # submit may block on a full queue (backpressure) → executor; the
+            # WAIT is a loop future resolved by on_done, so an in-flight
+            # generation holds no thread and agent fan-out isn't capped by
+            # the executor pool size. Under shed-policy=reject the engine
+            # raises ShedError with a retry-after estimate — honor it here
+            # with a few PACED retries, so pipeline-level error handling
+            # doesn't hammer the overloaded engine with immediate
+            # resubmits (the 429/Retry-After contract, in-process)
+            from langstream_tpu.serving.engine import ShedError
+
+            for attempt in range(3):
+                try:
+                    await loop.run_in_executor(None, engine.submit, request)
+                    break
+                except ShedError as shed:
+                    if attempt == 2:
+                        raise
+                    await asyncio.sleep(min(max(shed.retry_after_s, 0.05), 5.0))
+            try:
+                result = await asyncio.wait_for(done, 600.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                # the awaiting task died (agent timeout / task cancellation):
+                # without this the engine decodes the orphan to
+                # max_new_tokens while its slot serves nobody
+                request.cancel()
+                raise
+        finally:
+            if cancel_key:
+                lifecycle.unregister(cancel_key, request)
         if result.error is not None:
             raise result.error
+        # finish_reason may be "cancelled"/"deadline": partial output flows
+        # through normally (the record commits, the dead client's answer
+        # goes unread) — raising here would only trigger pipeline retries
+        # for work the client already abandoned
         if stream_state is not None:
             stream_state.finish()
 
@@ -464,7 +547,11 @@ class TpuServingProvider(ServiceProvider):
         return TpuEmbeddingsService(self.holder, config)
 
     async def close(self) -> None:
-        self.holder.close()
+        # holder.close() drains synchronously for up to drain-grace-s —
+        # run it off-loop so in-flight chunk-write coroutines (what the
+        # draining generations are producing) keep running
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.holder.close)
 
 
 def register() -> None:

@@ -44,6 +44,27 @@ log = logging.getLogger(__name__)
 SERVICE_REQUEST_ID_HEADER = "langstream-service-request-id"
 
 
+def _cancel_session_requests(headers: list[Header]) -> None:
+    """Client gone → cancel the session's in-flight generations so the
+    serving engine frees their slots at the next chunk boundary instead of
+    decoding to max_new_tokens for nobody (serving/lifecycle.py; effective
+    when the engine shares this process — local runner / embedded gateway).
+
+    CHAT sockets only: a chat disconnect ends the conversation, so its
+    pending answers are dead work. Produce/consume sockets must NOT do
+    this — the split produce/consume flow closes the produce socket while
+    still reading answers elsewhere, and the consume gateway's offset
+    tokens exist precisely so a dropped reader can reconnect and resume."""
+    from langstream_tpu.serving.lifecycle import SESSION_HEADER, cancel
+
+    for h in headers or []:
+        if h.key == SESSION_HEADER:
+            try:
+                cancel(h.value_as_string())
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log.exception("session cancellation failed")
+
+
 @dataclass
 class GatewayApplication:
     application: Application
@@ -293,6 +314,9 @@ class GatewayServer:
                 pass
         finally:
             await consume.close()
+            # NO cancellation here: consume sockets reconnect with offset
+            # tokens (test_consume_offset_resume) — a transient drop must
+            # resume into a complete answer, not a truncated one
             await self._publish_event("ClientDisconnected", context, gw_app)
         return ws
 
@@ -329,6 +353,7 @@ class GatewayServer:
         finally:
             await consume.close()
             await produce.close()
+            _cancel_session_requests(headers)
             await self._publish_event("ClientDisconnected", context, gw_app)
         return ws
 

@@ -22,7 +22,13 @@ from jax import lax
 
 from langstream_tpu.models.configs import ModelConfig
 
-_NEG = jnp.float32(-1e30)
+# plain Python float, NOT jnp.float32(...): this module is lazily imported
+# from inside traced functions (the engine's ring admit, _scan_layers), and
+# a module-level jnp constant created during a trace is a TRACER that
+# outlives its trace — every later ring dispatch then dies with
+# UnexpectedTracerError. A Python scalar weaves into jnp ops just as well
+# and can never leak.
+_NEG = -1e30
 
 
 def ring_attention(
@@ -52,7 +58,9 @@ def ring_attention(
     def _varying(x):
         if hasattr(lax, "pcast"):
             return lax.pcast(x, (axis,), to="varying")
-        return lax.pvary(x, (axis,))  # older jax
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, (axis,))
+        return x  # jax 0.4.x: no varying-type system, arrays are plain
 
     # fp32 online-softmax state (cast device-varying on the ring axis: the
     # carry becomes varying the moment block data folds in)

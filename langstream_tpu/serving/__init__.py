@@ -8,6 +8,24 @@ contract (ChatCompletionsStep.java:137) and its ordered-commit semantics.
 """
 
 from langstream_tpu.serving.sampling import sample
-from langstream_tpu.serving.engine import GenerationRequest, GenerationResult, ServingEngine
+from langstream_tpu.serving.engine import (
+    DeadlineExceededError,
+    GenerationRequest,
+    GenerationResult,
+    LogitsNaNError,
+    ServingEngine,
+    ShedError,
+)
+from langstream_tpu.serving.faultinject import FaultInjector, InjectedFault
 
-__all__ = ["GenerationRequest", "GenerationResult", "ServingEngine", "sample"]
+__all__ = [
+    "DeadlineExceededError",
+    "FaultInjector",
+    "GenerationRequest",
+    "GenerationResult",
+    "InjectedFault",
+    "LogitsNaNError",
+    "ServingEngine",
+    "ShedError",
+    "sample",
+]

@@ -36,9 +36,31 @@ from langstream_tpu.models.transformer import (
     prefill,
     prefill_segment,
 )
+from langstream_tpu.serving.faultinject import FaultInjector
 from langstream_tpu.serving.sampling import sample
 
 log = logging.getLogger(__name__)
+
+
+class ShedError(RuntimeError):
+    """Admission rejected by load shedding (full queue, hopeless deadline,
+    or a draining engine). ``retry_after_s`` is the engine's estimate of
+    when capacity frees — callers surface it as HTTP 429 Retry-After."""
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(reason)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline / max-queue-wait expired while it was still
+    queued — nothing was generated, the caller should NOT retry blindly."""
+
+
+class LogitsNaNError(RuntimeError):
+    """The sampling NaN guard tripped for this request's slot: its logits
+    went non-finite (poisoned KV row or device fault). The slot was
+    quarantined and its KV rows zeroed; other slots were untouched."""
 
 
 @dataclass
@@ -55,6 +77,25 @@ class GenerationRequest:
     submitted_at: float = field(default_factory=time.monotonic)
     _done: threading.Event = field(default_factory=threading.Event)
     _result: Optional["GenerationResult"] = None
+    _cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def cancel(self) -> None:
+        """Request cancellation from ANY thread. The engine honors it at
+        the next chunk boundary: an active slot frees (partial tokens are
+        returned with finish_reason="cancelled"), a queued request resolves
+        when the admission sweep reaches it. Idempotent; a no-op once the
+        request already finished."""
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def deadline_at(self) -> Optional[float]:
+        """Absolute monotonic deadline, or None when the request has none."""
+        if self.options.deadline_s is None:
+            return None
+        return self.submitted_at + self.options.deadline_s
 
     def result(self, timeout: Optional[float] = None) -> "GenerationResult":
         if not self._done.wait(timeout):
@@ -65,6 +106,8 @@ class GenerationRequest:
         return self._result
 
     def _finish(self, result: "GenerationResult") -> None:
+        if self._done.is_set():
+            return  # first resolution wins (sweep vs admission pop races)
         self._result = result
         self._done.set()
         if self.on_done is not None:
@@ -77,7 +120,10 @@ class GenerationRequest:
 @dataclass
 class GenerationResult:
     tokens: list[int]
-    finish_reason: str  # stop | length
+    # stop | length | cancelled | deadline | error — cancelled/deadline
+    # carry the tokens generated so far (error is None: partial output is
+    # valid for a stream the client walked away from or timed out)
+    finish_reason: str
     prompt_tokens: int
     ttft_s: float
     total_s: float
@@ -176,6 +222,22 @@ def _chain_scatter(
         top_k_dev.at[idx].set(top_k, mode="drop"),
         top_p_dev.at[idx].set(top_p, mode="drop"),
     )
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def _reset_rows(cache, slots):
+    """Zero the KV cache rows of quarantined slots — ONE fixed-shape
+    traced-index dispatch for any number of slots (``slots`` is a
+    max_batch-wide buffer, out-of-bounds padding rows drop). A NaN-poisoned
+    row must not survive slot reuse: admission only rewrites the prompt's
+    columns, and a NaN in a later column would flow back through attention
+    the moment a longer request decodes into it (NaN + the -inf mask is
+    still NaN through softmax)."""
+
+    def zero(a):
+        return a.at[:, slots].set(jnp.zeros((), a.dtype), mode="drop")
+
+    return jax.tree.map(zero, cache)
 
 
 @functools.partial(
@@ -350,9 +412,10 @@ class _TokenFetcher:
     while this thread blocks on the previous one's bytes. One FIFO queue +
     one worker keeps results strictly in submission (= chunk) order."""
 
-    def __init__(self) -> None:
+    def __init__(self, injector: Optional[FaultInjector] = None) -> None:
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._thread: Optional[threading.Thread] = None
+        self._injector = injector
 
     def alive(self) -> bool:
         t = self._thread
@@ -384,6 +447,8 @@ class _TokenFetcher:
             if handle is None:
                 return
             try:
+                if self._injector is not None:
+                    self._injector.stall("fetch")
                 handle._value = np.asarray(jax.device_get(handle.array))
             except BaseException as e:  # noqa: BLE001 — surface at result()
                 handle._value = e
@@ -439,6 +504,11 @@ class ServingEngine:
         prefix_cache: Any = False,
         prefix_cache_fraction: float = 0.25,
         prefix_cache_entries: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        shed_policy: str = "block",
+        restart_backoff_s: float = 0.1,
+        max_restarts: int = 5,
+        fault_injector: Optional[FaultInjector] = None,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -454,7 +524,24 @@ class ServingEngine:
         self.prefill_buckets = tuple(
             b for b in prefill_buckets if b <= self.max_seq_len
         ) or (self.max_seq_len,)
-        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(maxsize=max_batch * 4)
+        # bounded admission queue. ``shed_policy`` decides what a FULL queue
+        # does to submit(): "block" (default) is the broker-poll-loop
+        # backpressure contract; "reject" sheds with ShedError(retry-after)
+        # so a front door (gateway/HTTP) degrades to fast 429s instead of
+        # stacking blocked threads while clients time out anyway.
+        if queue_depth is not None and int(queue_depth) <= 0:
+            # the loop pops admissions from this queue, so depth 0 cannot
+            # mean "no queueing" — reject loudly instead of silently
+            # substituting the default
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(
+            maxsize=int(queue_depth) if queue_depth is not None else max_batch * 4
+        )
+        if shed_policy not in ("block", "reject"):
+            raise ValueError(
+                f"unknown shed_policy {shed_policy!r}; supported: block, reject"
+            )
+        self.shed_policy = shed_policy
         self._slots = [_Slot() for _ in range(max_batch)]
         self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
         if mesh is not None:
@@ -604,8 +691,6 @@ class ServingEngine:
             # below has logged its arithmetic — an over-committed pool
             # then OOMs with the plan's numbers already on record instead
             # of an unexplained RESOURCE_EXHAUSTED
-        # dedicated device→host token fetch thread (started with the loop)
-        self._fetcher = _TokenFetcher()
         # compile the decode kv_bound ladder up front (TPU default): a lazy
         # ladder compile (~20s through the tunnel) otherwise lands MID-
         # TRAFFIC and stalls every active stream — measured as the r5
@@ -618,6 +703,57 @@ class ServingEngine:
             if precompile is not None
             else jax.default_backend() == "tpu"
         )
+        # request-lifecycle / fault-recovery state ---------------------------
+        # drain: finish everything already accepted (active slots + queue),
+        # reject new submissions — the graceful half of shutdown; stop()
+        # stays the hard half (fail whatever is left)
+        self._draining = False
+        # True while the engine thread is inside an iteration's admission
+        # phase — the only window where a request can be popped from the
+        # queue but not yet assigned to a slot; _quiesced() (drain, caller
+        # thread) reads it
+        self._mid_iteration = False
+        # loop-restart supervisor (single-host only; SPMD keeps crash-only
+        # semantics): a crashed iteration quarantines the in-flight slots,
+        # rebuilds device state, and restarts under bounded exponential
+        # backoff instead of killing the process's serving capacity
+        self.restart_backoff_s = max(0.01, float(restart_backoff_s))
+        self.max_restarts = max(0, int(max_restarts))
+        self._last_crash_t = 0.0
+        # slots whose KV rows must be zeroed on the next iteration (NaN
+        # quarantine); coalesced into ONE row-reset dispatch
+        self._pending_row_resets: list[int] = []
+        # fault injection (serving/faultinject.py): explicit injector wins,
+        # else env activation (LSTPU_FAULTS) for staging drills
+        self._injector = (
+            fault_injector if fault_injector is not None else FaultInjector.from_env()
+        )
+        # dedicated device→host token fetch thread (started with the loop);
+        # carries the injector for the fetch-stall site
+        self._fetcher = _TokenFetcher(self._injector)
+        # EMA of observed queue wait (submit → admission), feeding the
+        # hopeless-deadline shed decision and ShedError.retry_after_s
+        self._queue_wait_ema_s = 0.0
+        # shadow set of queued-but-unadmitted requests: queue.Queue cannot
+        # be inspected without popping, so the per-iteration expiry sweep
+        # walks this instead — a queued request whose deadline/cancellation
+        # lands while every slot is busy resolves within one iteration, not
+        # when a slot finally frees; its (already-resolved) queue entry is
+        # skipped at pop time
+        self._waiting: dict[int, GenerationRequest] = {}  # id() → request
+        self._waiting_lock = threading.Lock()
+        # lifecycle counters (stats() → genai gauges → Grafana). shed_total
+        # is the one counter written from arbitrary submitter threads
+        # (concurrent submit() calls), so its += goes through this lock;
+        # the rest are engine-thread single-writer
+        self._shed_lock = threading.Lock()
+        self.shed_total = 0
+        self.cancelled_total = 0
+        self.deadline_queue_total = 0
+        self.deadline_decode_total = 0
+        self.quarantined_slots_total = 0
+        self.nan_guard_total = 0
+        self.engine_restarts_total = 0
         # stats
         self.total_generated = 0
         self.total_requests = 0
@@ -706,19 +842,90 @@ class ServingEngine:
         # resolve everything still in flight so blocked callers return now
         self._fail_all(RuntimeError("serving engine stopped"))
 
+    def drain(self, grace_s: float = 30.0) -> bool:
+        """Graceful quiescence, DISTINCT from stop(): reject new submissions
+        (ShedError) but let everything already accepted — active slots,
+        queued admissions, long-prefill streams — run to completion. Returns
+        True when the engine went quiet within ``grace_s``, False when the
+        grace period expired with work still in flight (the caller then
+        decides between waiting longer and a hard stop()). Does NOT stop the
+        engine thread; call stop() after. Re-entrant; ``_draining`` stays set
+        so a drain→stop sequence never readmits."""
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, grace_s)
+        while time.monotonic() < deadline:
+            if self._quiesced():
+                return True
+            if self._thread is None or not self._thread.is_alive():
+                return self._quiesced()  # loop is gone; nothing will drain
+            time.sleep(0.01)
+        return self._quiesced()
+
+    def _quiesced(self) -> bool:
+        return (
+            not self._mid_iteration
+            and not any(s.active for s in self._slots)
+            and self._queue.qsize() == 0
+            and not self._longs
+            and not self._long_queue
+            and self._held_back is None
+        )
 
     def submit(self, request: GenerationRequest) -> GenerationRequest:
-        """Thread-safe enqueue; blocks when the queue is full (backpressure
-        toward the broker poll loop — SURVEY §7 hard parts)."""
+        """Thread-safe enqueue. A full queue blocks (shed_policy="block",
+        backpressure toward the broker poll loop — SURVEY §7 hard parts) or
+        sheds with ShedError carrying a retry-after estimate
+        (shed_policy="reject"). Requests whose deadline cannot survive the
+        CURRENT observed queue wait are shed immediately either way —
+        admitting them would burn queue slots and prefill FLOPs on work
+        that is already dead on arrival."""
         if self._dead is not None:
             raise RuntimeError("serving engine is stopped") from self._dead
+        # (re)stamp on every submit attempt: a ShedError retry reuses the
+        # SAME request object, and a construction-time stamp would count
+        # the retry sleep as queue wait — expiring max_queue_wait_s
+        # immediately and feeding the inflated wait into the shed EMA
+        request.submitted_at = time.monotonic()
+        if self._draining:
+            with self._shed_lock:
+                self.shed_total += 1
+            raise ShedError("serving engine is draining", retry_after_s=5.0)
         limit = self.max_seq_len - 1
         if len(request.prompt_tokens) > limit:
             raise ValueError(
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
                 f"engine limit of {limit} (max_seq_len - 1)"
             )
-        self._queue.put(request)
+        deadline_s = request.options.deadline_s
+        if deadline_s is not None:
+            est_wait = self._queue_wait_ema_s
+            if deadline_s <= 0 or (self._queue.qsize() > 0 and est_wait >= deadline_s):
+                with self._shed_lock:
+                    self.shed_total += 1
+                raise ShedError(
+                    f"deadline of {deadline_s:.2f}s cannot survive the "
+                    f"current ~{est_wait:.2f}s queue wait",
+                    retry_after_s=max(est_wait, 0.1),
+                )
+        with self._waiting_lock:
+            self._waiting[id(request)] = request
+        try:
+            if self.shed_policy == "reject":
+                try:
+                    self._queue.put_nowait(request)
+                except queue.Full:
+                    with self._shed_lock:
+                        self.shed_total += 1
+                    raise ShedError(
+                        f"admission queue full ({self._queue.maxsize} deep)",
+                        retry_after_s=max(self._queue_wait_ema_s, 0.1),
+                    ) from None
+            else:
+                self._queue.put(request)
+        except BaseException:
+            with self._waiting_lock:
+                self._waiting.pop(id(request), None)
+            raise
         return request
 
     def generate(
@@ -728,14 +935,21 @@ class ServingEngine:
         on_token: Optional[Callable[[int], None]] = None,
         timeout: float = 300.0,
     ) -> GenerationResult:
-        """Blocking convenience wrapper (submit + wait)."""
+        """Blocking convenience wrapper (submit + wait). A wait timeout
+        CANCELS the request — before cancellation existed, the caller got
+        its TimeoutError while the engine kept decoding the orphan to
+        max_new_tokens, burning a slot nobody would ever read."""
         req = GenerationRequest(
             prompt_tokens=list(prompt_tokens),
             options=options or GenerationOptions(),
             on_token=on_token,
         )
         self.submit(req)
-        return req.result(timeout)
+        try:
+            return req.result(timeout)
+        except TimeoutError:
+            req.cancel()
+            raise
 
     def stats(self) -> dict[str, Any]:
         active = sum(1 for s in self._slots if s.active)
@@ -776,6 +990,23 @@ class ServingEngine:
             ),
             "prefix-cache-entries": (
                 self._prefix_pool.live_entries if self._prefix_pool else 0
+            ),
+            # request lifecycle / fault recovery (this PR's acceptance
+            # surface: every degradation path is countable in production)
+            "draining": self._draining,
+            "shed-total": self.shed_total,
+            "cancelled-total": self.cancelled_total,
+            "deadline-exceeded-total": (
+                self.deadline_queue_total + self.deadline_decode_total
+            ),
+            "deadline-queue-total": self.deadline_queue_total,
+            "deadline-decode-total": self.deadline_decode_total,
+            "quarantined-slots-total": self.quarantined_slots_total,
+            "nan-guard-total": self.nan_guard_total,
+            "engine-restarts-total": self.engine_restarts_total,
+            "queue-wait-ema-s": round(self._queue_wait_ema_s, 4),
+            "fault-injection": (
+                self._injector.stats() if self._injector is not None else None
             ),
         }
 
@@ -868,6 +1099,15 @@ class ServingEngine:
         # leaving the (deterministic) garbage in place keeps SPMD followers
         # — which replay these warmups but not a leader-local reset — in
         # exact lockstep
+        if self._spmd is None:
+            # quarantine row-reset, warmed all-out-of-bounds (every write
+            # drops, state untouched) so the first NaN-guard trip under
+            # traffic is never a compile. Not warmed under SPMD: the guard
+            # crashes the replica there instead of quarantining.
+            self._record_program("row-reset")
+            idxs = np.full(self.max_batch, self.max_batch, np.int32)
+            self._cache = _reset_rows(self._cache, jnp.asarray(idxs))
+            jax.block_until_ready(jax.tree.leaves(self._cache)[0])
         log.info(
             "decode ladder precompiled: bounds %s, chunk %d",
             bounds, self.decode_chunk,
@@ -1023,26 +1263,59 @@ class ServingEngine:
         )
 
     def _run(self) -> None:
-        from collections import deque
-
-        # batches of deferred fetch entries, one per loop iteration, newest
-        # last; up to pipeline_depth batches stay unfetched so their device
-        # work overlaps host bookkeeping AND the next dispatches
-        pending: deque[list[tuple]] = deque()
+        """Engine-thread supervisor: run the serving loop; on a crash,
+        quarantine the in-flight slots, rebuild device state, and restart
+        under bounded exponential backoff instead of leaving the process
+        alive but unable to serve until a pod restart. Unrecoverable paths
+        (SPMD replicas — a diverged follower must crash with the leader —
+        non-Exception BaseExceptions, or the restart budget exhausted) keep
+        the crash-only contract: fail everything, announce STOP."""
+        backoff = self.restart_backoff_s
+        restarts = 0
         try:
-            if self._precompile:
-                self._warmup_decode_ladder()
-                self._warmup_prefill_buckets()
-                if self._prefix_pool is not None:
-                    self._warmup_prefix_programs()
-            while not self._stop.is_set():
-                self._iterate(pending)
-            while pending:
-                for entry in pending.popleft():
-                    self._process_entry(entry)
-        except BaseException as e:  # noqa: BLE001 — fail every pending request
-            log.exception("serving engine loop crashed")
-            self._fail_all(e)
+            while True:
+                try:
+                    self._run_once(warm=restarts == 0)
+                    return  # clean stop
+                except BaseException as e:  # noqa: BLE001 — classify below
+                    now = time.monotonic()
+                    if self._last_crash_t and now - self._last_crash_t > 60.0:
+                        # a crash long after the previous one is a fresh
+                        # incident, not an escalation — reset the budget
+                        restarts = 0
+                        backoff = self.restart_backoff_s
+                    self._last_crash_t = now
+                    recoverable = (
+                        isinstance(e, Exception)
+                        and self._spmd is None
+                        and restarts < self.max_restarts
+                        and not self._stop.is_set()
+                    )
+                    if not recoverable:
+                        log.exception("serving engine loop crashed (unrecoverable)")
+                        self._fail_all(e)
+                        return
+                    restarts += 1
+                    self.engine_restarts_total += 1
+                    log.exception(
+                        "serving engine loop crashed; quarantining %d in-flight "
+                        "slot(s), restarting in %.2fs (restart %d/%d)",
+                        sum(1 for s in self._slots if s.active) + len(self._longs),
+                        backoff, restarts, self.max_restarts,
+                    )
+                    try:
+                        self._recover(e)
+                    except BaseException as e2:  # noqa: BLE001 — recovery itself failed
+                        # e.g. the cache rebuild OOMed: the crash-only
+                        # contract must hold — without this, the thread
+                        # dies with _dead unset and submit() keeps feeding
+                        # a queue nobody serves
+                        log.exception("crash recovery failed; engine is dead")
+                        self._fail_all(e2)
+                        return
+                    if self._stop.wait(backoff):
+                        return  # stop() raced the backoff; it fails the rest
+                    backoff = min(backoff * 2, 30.0)
         finally:
             if self._spmd is not None:
                 # release follower processes parked in recv() — best-effort
@@ -1057,6 +1330,88 @@ class ServingEngine:
                 except Exception:  # noqa: BLE001 — transport may be gone too
                     log.exception("failed to announce STOP to SPMD followers")
 
+    def _run_once(self, warm: bool) -> None:
+        from collections import deque
+
+        # batches of deferred fetch entries, one per loop iteration, newest
+        # last; up to pipeline_depth batches stay unfetched so their device
+        # work overlaps host bookkeeping AND the next dispatches
+        pending: deque[list[tuple]] = deque()
+        if self._precompile and warm:
+            # restarts skip the warmups: every program is already in the jit
+            # cache (shapes are unchanged), and recovery latency is the point
+            self._warmup_decode_ladder()
+            self._warmup_prefill_buckets()
+            if self._prefix_pool is not None:
+                self._warmup_prefix_programs()
+        while not self._stop.is_set():
+            self._iterate(pending)
+        while pending:
+            for entry in pending.popleft():
+                self._process_entry(entry)
+
+    def _recover(self, error: BaseException) -> None:
+        """Quarantine-and-rebuild after a loop crash, WITHOUT failing
+        untouched work: in-flight slots and long-prefill streams (their
+        device state is suspect — the crashed dispatch may have consumed
+        its donated buffers) fail with the error and count as quarantined;
+        QUEUED admissions were never dispatched, so they stay queued and
+        are served after the restart. Every device-resident array is
+        rebuilt from scratch — with buffer donation there is no safe way
+        to keep using arrays a failed dispatch may have invalidated."""
+        quarantined = 0
+        for slot in self._slots:
+            request = slot.request
+            if request is not None:
+                quarantined += 1
+                request._finish(GenerationResult(
+                    tokens=list(slot.generated), finish_reason="error",
+                    prompt_tokens=len(request.prompt_tokens),
+                    ttft_s=0, total_s=0, error=error,
+                ))
+                slot.request = None
+                slot.generated = []
+                slot.position = 0
+        for st in self._longs.values():
+            entry = st.pop("prefix", None)
+            if entry is not None and self._prefix_pool is not None:
+                try:
+                    self._prefix_pool.release(entry)
+                except Exception:  # noqa: BLE001 — pool resets below anyway
+                    pass
+            quarantined += 1
+            st["request"]._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=error,
+            ))
+        self.quarantined_slots_total += quarantined
+        self._longs.clear()
+        self._long_caches.clear()
+        self._reserved.clear()
+        self._spmd_ring_buf.clear()
+        self._freed_slots.clear()
+        self._pending_row_resets.clear()
+        self._inflight_steps = 0
+        self._step_time_ema_s = 0.0
+        self._last_chunk_ready_t = 0.0
+        # fresh device state (same shapes → no recompiles on restart)
+        self._cache = make_kv_cache(self.config, self.max_batch, self.max_seq_len)
+        if self.mesh is not None:
+            from langstream_tpu.parallel.sharding import shard_serving_cache
+
+            self._cache = shard_serving_cache(self._cache, self.mesh)
+        self._tokens_dev = jnp.zeros(self.max_batch, jnp.int32)
+        self._positions_dev = jnp.zeros(self.max_batch, jnp.int32)
+        self._temp_dev = jnp.zeros(self.max_batch, jnp.float32)
+        self._top_k_dev = jnp.zeros(self.max_batch, jnp.int32)
+        self._top_p_dev = jnp.ones(self.max_batch, jnp.float32)
+        if self._prefix_pool is not None:
+            # pool rows may hold rows published from the poisoned cache (or
+            # the pool buffer itself may be donation-invalidated mid-publish)
+            self._prefix_pool.reset()
+        if not self._fetcher.alive():
+            self._fetcher.start()
+
     def _iterate(self, pending) -> None:
         """ONE fused engine iteration: a token-budgeted slice of pending
         prefill work (chunked-prefill segments first, then admission groups)
@@ -1065,6 +1420,9 @@ class ServingEngine:
         interleave at iteration granularity and neither backlog starves the
         other. Extracted from _run so tests can drive exactly one iteration
         (the engine thread just loops this)."""
+        if self._pending_row_resets:
+            self._flush_row_resets()
+        self._sweep_waiting()
         # chunks dispatched in previous iterations are still unfetched when
         # this iteration's dispatch computes its headroom bound — subtract
         # ALL of them
@@ -1078,10 +1436,19 @@ class ServingEngine:
         # requests, so a long prompt can't be starved forever under
         # sustained short traffic.
         budget = self.prefill_token_budget if self.overlap else None
-        new_pending, spent = self._long_step(budget)
-        if budget is not None:
-            budget = max(0, budget - spent)
-        new_pending.extend(self._admit(budget))  # deferred first-token fetches
+        # _mid_iteration marks drain()'s pop-to-slot blind spot: a request
+        # get_nowait()'d here but not yet visible as an active slot exists
+        # only inside this admission phase, so _quiesced() (sampling from
+        # the drain caller's thread) must not report quiet during it —
+        # while staying False on idle iterations, which never pop anything
+        self._mid_iteration = True
+        try:
+            new_pending, spent = self._long_step(budget)
+            if budget is not None:
+                budget = max(0, budget - spent)
+            new_pending.extend(self._admit(budget))  # deferred first-token fetches
+        finally:
+            self._mid_iteration = False
         # prefill dispatched this iteration rides the in-order stream AHEAD
         # of the chunk below — its chunk must not feed the step-time gauge
         prefill_ahead = bool(new_pending) or spent > 0
@@ -1122,6 +1489,43 @@ class ServingEngine:
         ):
             for entry in pending.popleft():
                 self._process_entry(entry)
+
+    def _sweep_waiting(self) -> None:
+        """Resolve queued-but-unadmitted requests that died while waiting
+        (cancelled, expired deadline/max-queue-wait) WITHOUT waiting for a
+        slot to free: queue.Queue is opaque, so the sweep walks the shadow
+        _waiting dict, the long-prompt backlog, and the held-back slot; a
+        swept request's queue entry is skipped at pop time (_done already
+        set). Bounded by the queue depth (≤ max_batch×4 by default), so
+        this is noise next to a device dispatch."""
+        now = time.monotonic()
+        with self._waiting_lock:
+            waiting = list(self._waiting.values())
+        for request in waiting:
+            if request._done.is_set() or self._resolve_if_dead(request, now):
+                with self._waiting_lock:
+                    self._waiting.pop(id(request), None)
+        # the long-prompt backlog + held-back slot are engine-thread-only
+        self._long_queue = [
+            r for r in self._long_queue
+            if not (r._done.is_set() or self._resolve_if_dead(r, now))
+        ]
+        if self._held_back is not None and (
+            self._held_back._done.is_set()
+            or self._resolve_if_dead(self._held_back, now)
+        ):
+            self._held_back = None
+
+    def _flush_row_resets(self) -> None:
+        """Zero the KV rows of NaN-quarantined slots, coalesced into one
+        row-reset dispatch per iteration (never called under SPMD — the
+        guard raises there instead, preserving crash-only lockstep)."""
+        stale = sorted(set(self._pending_row_resets))
+        self._pending_row_resets.clear()
+        idxs = np.full(self.max_batch, self.max_batch, np.int32)
+        idxs[: len(stale)] = stale
+        self._record_program("row-reset")
+        self._cache = _reset_rows(self._cache, jnp.asarray(idxs))
 
     @staticmethod
     def _batch_ready(batch: list[tuple]) -> bool:
@@ -1197,6 +1601,65 @@ class ServingEngine:
                 return b
         return self.prefill_buckets[-1]
 
+    @staticmethod
+    def _expired(request: GenerationRequest, now: float) -> bool:
+        opts = request.options
+        wait = now - request.submitted_at
+        return (
+            opts.deadline_s is not None and wait >= opts.deadline_s
+        ) or (
+            opts.max_queue_wait_s is not None and wait > opts.max_queue_wait_s
+        )
+
+    def _resolve_if_dead(self, request: GenerationRequest, now: float) -> bool:
+        """Resolve a queued-but-unadmitted request that died while waiting
+        (client cancel, expired deadline / max-queue-wait) WITHOUT spending
+        a slot or prefill FLOPs on it. True = resolved (or already done);
+        the single place the cancelled/deadline-in-queue outcome is built,
+        shared by the pop gate (_prequalify) and the expiry sweep across
+        every backlog (short queue, long backlog, held-back slot)."""
+        if request._done.is_set():
+            return True  # already resolved elsewhere — don't double-count
+        wait = now - request.submitted_at
+        if request.cancelled:
+            self.cancelled_total += 1
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="cancelled",
+                prompt_tokens=len(request.prompt_tokens),
+                ttft_s=0, total_s=wait,
+            ))
+            return True
+        if self._expired(request, now):
+            opts = request.options
+            self.deadline_queue_total += 1
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="deadline",
+                prompt_tokens=len(request.prompt_tokens),
+                ttft_s=0, total_s=wait,
+                error=DeadlineExceededError(
+                    f"request waited {wait:.2f}s in queue against "
+                    f"deadline={opts.deadline_s} "
+                    f"max-queue-wait={opts.max_queue_wait_s}"
+                ),
+            ))
+            return True
+        return False
+
+    def _prequalify(self, request: GenerationRequest) -> bool:
+        """Queue-exit gate (engine thread): True = still worth admitting;
+        live requests feed the queue-wait EMA that submit()'s
+        hopeless-deadline shed reads."""
+        now = time.monotonic()
+        if self._resolve_if_dead(request, now):
+            return False
+        wait = now - request.submitted_at
+        self._queue_wait_ema_s = (
+            wait
+            if self._queue_wait_ema_s == 0
+            else 0.8 * self._queue_wait_ema_s + 0.2 * wait
+        )
+        return True
+
     def _admit(self, budget: Optional[int] = None) -> list[tuple]:
         """Move queued requests into free slots (prefill path); returns ALL
         the deferred first-token fetch entries. Nothing is fetched here —
@@ -1254,6 +1717,12 @@ class ServingEngine:
                     request = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                with self._waiting_lock:
+                    self._waiting.pop(id(request), None)
+                if request._done.is_set():
+                    continue  # already resolved by the expiry sweep
+                if not self._prequalify(request):
+                    continue  # resolved in queue (cancelled / deadline)
                 if len(request.prompt_tokens) > short_limit:
                     # chunked-prefill path — but keep it bounded so submit()'s
                     # queue-full backpressure still engages under sustained
@@ -1371,6 +1840,8 @@ class ServingEngine:
         """Device layer of a batched prefill — runs IDENTICALLY on the
         leader and (via follower_loop) every SPMD follower, so the sharded
         cache and decode chain evolve in lockstep from pure host inputs."""
+        if self._injector is not None:
+            self._injector.fire("prefill")  # before any state mutates
         n = len(tokens)
         assert all(len(a) == n for a in (lengths, temps, top_ks, top_ps, slots))
         self._record_program("prefill", tokens.shape[1], n)
@@ -1665,6 +2136,8 @@ class ServingEngine:
             if free is None:
                 break
             request = self._long_queue.pop(0)
+            if not self._prequalify(request):
+                continue  # resolved in the long backlog
             # prefix reuse for long prompts: a cached FULL-segment-width
             # prefix lets chunked prefill start at the reuse point (the
             # segment grid stays aligned). A hit prefers the segment loop
@@ -1714,8 +2187,36 @@ class ServingEngine:
 
     def _segment_step(self, st: dict) -> list[tuple]:
         """Dispatch one chunked-prefill segment for one stream; on the
-        final segment, activate the slot host-side."""
+        final segment, activate the slot host-side. A stream whose request
+        was cancelled (or blew its deadline) mid-prefill aborts here, before
+        spending another segment of prefill on it — host-side only, so SPMD
+        followers simply stop receiving its segments."""
         request: GenerationRequest = st["request"]
+        now = time.monotonic()
+        deadline = request.deadline_at()
+        if request.cancelled or (deadline is not None and now >= deadline):
+            idx = st["idx"]
+            entry = st.pop("prefix", None)
+            if entry is not None and self._prefix_pool is not None:
+                self._prefix_pool.release(entry)
+            self._reserved.discard(idx)
+            self._longs.pop(idx, None)
+            self._long_caches.pop(idx, None)
+            if request.cancelled:
+                self.cancelled_total += 1
+                reason = "cancelled"
+            else:
+                # mid-PREFILL expiry: zero tokens generated, so this is
+                # the waiting bucket (prefill backlog), not mid-decode —
+                # the queue/decode split is what operators alert on
+                self.deadline_queue_total += 1
+                reason = "deadline"
+            request._finish(GenerationResult(
+                tokens=[], finish_reason=reason,
+                prompt_tokens=len(request.prompt_tokens),
+                ttft_s=0, total_s=now - request.submitted_at,
+            ))
+            return []
         prompt = request.prompt_tokens
         width = self.prefill_buckets[-1]
         # ``base``: prefix-reuse offset (a full segment width when warm) —
@@ -1918,6 +2419,8 @@ class ServingEngine:
         ``prefix_row`` on a warm start — the stream's first segment then
         begins at the reuse offset), segment forward, and on ``final`` the
         splice into the big cache + decode-chain scatters."""
+        if self._injector is not None:
+            self._injector.fire("segment")
         if start:
             if prefix_row is not None:
                 from langstream_tpu.ops.kvcopy import gather_prefix_local
@@ -2037,6 +2540,8 @@ class ServingEngine:
 
     def _dev_decode(self, steps: int, stale, kv_bound: Optional[int] = None) -> Any:
         """Device layer of one decode chunk (leader + SPMD followers)."""
+        if self._injector is not None:
+            self._injector.fire("decode")  # crashes the loop → restart path
         self._record_program("decode", steps, kv_bound or 0)
         if len(stale):
             # fixed-size index buffer (padding rows out of bounds → dropped)
@@ -2071,6 +2576,8 @@ class ServingEngine:
             host = chunk.result()  # [steps, B], fetched by the fetch thread
         else:
             host = np.asarray(jax.device_get(chunk))  # [steps, B]
+        if self._injector is not None:
+            host, _ = self._injector.corrupt_tokens(host, snapshot)
         for idx, request in snapshot:
             slot = self._slots[idx]
             if slot.request is not request:  # freed/reassigned meanwhile
@@ -2086,8 +2593,45 @@ class ServingEngine:
         request = slot.request
         assert request is not None
         opts = request.options
-        finished_reason = None
 
+        if token < 0:
+            # sampling's NaN guard sentinel: this slot's logits went
+            # non-finite. Quarantine ONLY this slot — fail its request,
+            # zero its KV rows (next iteration, one coalesced dispatch) —
+            # while every other slot keeps decoding untouched. SPMD keeps
+            # crash-only semantics (the row-reset dispatch is not on the
+            # follower wire, and a leader-only reset would diverge).
+            self.nan_guard_total += 1
+            if self._spmd is not None:
+                raise LogitsNaNError(
+                    f"non-finite logits for slot {idx} on an SPMD replica"
+                )
+            self.quarantined_slots_total += 1
+            self._pending_row_resets.append(idx)
+            self._finish_slot(
+                idx, "error",
+                error=LogitsNaNError(
+                    f"non-finite logits for slot {idx}; slot quarantined and "
+                    "its KV rows reset"
+                ),
+            )
+            return
+        if request.cancelled:
+            # chunk-boundary cancellation: the slot frees NOW; tokens from
+            # the rest of this (and any in-flight) chunk are dropped by the
+            # snapshot identity check
+            self.cancelled_total += 1
+            self._finish_slot(idx, "cancelled")
+            return
+        deadline = request.deadline_at()
+        if deadline is not None and time.monotonic() >= deadline:
+            self.deadline_decode_total += 1
+            self._finish_slot(idx, "deadline")
+            return
+        if self._injector is not None:
+            self._injector.stall("client")  # slow-client backpressure drill
+
+        finished_reason = None
         is_stop = (self.eos_token_id is not None and token == self.eos_token_id) or (
             token in opts.stop_tokens
         )
@@ -2108,18 +2652,33 @@ class ServingEngine:
                 finished_reason = "length"
 
         if finished_reason is not None:
-            now = time.monotonic()
-            request._finish(GenerationResult(
-                tokens=list(slot.generated),
-                finish_reason=finished_reason,
-                prompt_tokens=len(request.prompt_tokens),
-                ttft_s=slot.first_token_at - request.submitted_at,
-                total_s=now - request.submitted_at,
-            ))
-            slot.request = None
-            slot.generated = []
-            slot.position = 0
-            self._freed_slots.append(idx)
+            self._finish_slot(idx, finished_reason)
+
+    def _finish_slot(
+        self, idx: int, reason: str, error: Optional[BaseException] = None
+    ) -> None:
+        """Resolve the slot's request and free the slot (temp reset rides
+        the next dispatch via _freed_slots, as for natural completions)."""
+        slot = self._slots[idx]
+        request = slot.request
+        assert request is not None
+        now = time.monotonic()
+        request._finish(GenerationResult(
+            tokens=list(slot.generated),
+            finish_reason=reason,
+            prompt_tokens=len(request.prompt_tokens),
+            ttft_s=(
+                slot.first_token_at - request.submitted_at
+                if slot.first_token_at
+                else 0.0
+            ),
+            total_s=now - request.submitted_at,
+            error=error,
+        ))
+        slot.request = None
+        slot.generated = []
+        slot.position = 0
+        self._freed_slots.append(idx)
 
     def _fail_all(self, error: BaseException) -> None:
         self._dead = error
@@ -2162,3 +2721,5 @@ class ServingEngine:
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=error,
             ))
+        with self._waiting_lock:
+            self._waiting.clear()

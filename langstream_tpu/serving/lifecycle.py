@@ -1,0 +1,85 @@
+"""Process-wide request-cancellation registry, keyed by client session.
+
+The gateway and the serving engine meet only through topics (questions in,
+answers out), so when a websocket client disconnects the reference simply
+lets the pipeline finish into the void — and the engine keeps decoding the
+orphan to max_new_tokens, burning a KV slot. This registry is the short
+circuit for the deployments where both ends live in one process (the local
+runner's embedded gateway, the standalone runner + agent pod):
+
+  - the completions step registers every in-flight GenerationRequest under
+    the record's ``langstream-client-session-id`` header (the same header
+    the chat-gateway examples route answers by),
+  - the gateway's ClientDisconnected paths call ``cancel(session_id)``,
+  - the engine frees the cancelled slots at the next chunk boundary.
+
+Cross-process topologies (standalone gateway pod, broker-separated agents)
+get no cancellation from this — the disconnect event and the engine are in
+different processes. That is a documented gap (docs/SERVING.md §9), not a
+silent one: the deadline knobs bound orphan decode time there.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Protocol
+
+log = logging.getLogger(__name__)
+
+# the chat-gateway convention header (examples, bench.py GATEWAYS) — the
+# gateway resolves it from the client's ?param.sessionId, the completions
+# agent sees it as a record property
+SESSION_HEADER = "langstream-client-session-id"
+
+
+class Cancellable(Protocol):
+    def cancel(self) -> None: ...
+
+
+_lock = threading.Lock()
+_by_key: dict[str, dict[int, Any]] = {}
+
+
+def register(key: str, request: Cancellable) -> None:
+    """Track ``request`` under session ``key`` until unregister()."""
+    if not key:
+        return
+    with _lock:
+        _by_key.setdefault(key, {})[id(request)] = request
+
+
+def unregister(key: str, request: Cancellable) -> None:
+    if not key:
+        return
+    with _lock:
+        bucket = _by_key.get(key)
+        if bucket is not None:
+            bucket.pop(id(request), None)
+            if not bucket:
+                _by_key.pop(key, None)
+
+
+def cancel(key: str) -> int:
+    """Cancel every in-flight request registered under ``key``; returns the
+    number cancelled. Requests stay registered until their owner
+    unregisters (cancellation resolves them through the engine, which is
+    what triggers the owner's unregister)."""
+    if not key:
+        return 0
+    with _lock:
+        requests = list(_by_key.get(key, {}).values())
+    for request in requests:
+        try:
+            request.cancel()
+        except Exception:  # noqa: BLE001 — one bad entry must not shield the rest
+            log.exception("cancel() failed for a request under key %r", key)
+    if requests:
+        log.info("cancelled %d in-flight request(s) for session %r", len(requests), key)
+    return len(requests)
+
+
+def active_keys() -> list[str]:
+    """Snapshot of sessions with in-flight requests (tests/debugging)."""
+    with _lock:
+        return [k for k, v in _by_key.items() if v]

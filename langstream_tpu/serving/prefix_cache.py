@@ -96,6 +96,7 @@ class PrefixCachePool:
         self.config = config
         self.entries = int(entries)
         self.width = int(width)
+        self._mesh = mesh
         # bucket-aligned publish/lookup lengths, ascending, bounded by the
         # pool width (a prefix wider than a pool row can't be cached)
         self.boundaries = tuple(
@@ -121,6 +122,26 @@ class PrefixCachePool:
         self.hits = 0
         self.tokens_saved = 0
         self.evictions = 0
+
+    def reset(self) -> None:
+        """Drop every cached prefix and rebuild the device pool — the
+        engine's crash-recovery path (serving/engine.py _recover): pool rows
+        may hold KV published from a poisoned cache, and the pool buffer
+        itself may be donation-invalidated by a publish that crashed
+        mid-dispatch. Hit/eviction counters survive (they are cumulative
+        since engine start); pins do not — every pinned admission was
+        already failed by the recovery that called this."""
+        from langstream_tpu.models.transformer import make_kv_cache
+
+        self.dev = make_kv_cache(self.config, self.entries, self.width)
+        if self._mesh is not None:
+            from langstream_tpu.parallel.sharding import shard_serving_cache
+
+            self.dev = shard_serving_cache(self.dev, self._mesh)
+        self._root = _Node()
+        self._live = {}
+        self._free = list(range(self.entries - 1, -1, -1))
+        self._tick = 0
 
     # -- index ---------------------------------------------------------------
 

@@ -57,8 +57,20 @@ def sample(
     top_k: jax.Array,  # [B] int32, 0 = disabled
     top_p: jax.Array,  # [B] fp32, 1.0 = disabled
 ) -> jax.Array:
-    """Returns sampled token ids [B]. temperature 0 → greedy for that slot."""
+    """Returns sampled token ids [B]. temperature 0 → greedy for that slot.
+
+    NaN guard: a row whose logits contain any non-finite value (NaN/±inf
+    overflow — a numerically-poisoned KV row or a device fault) returns the
+    sentinel ``-1`` instead of a token. Sampling from such a row is
+    undefined (categorical over NaN probabilities), and silently emitting
+    garbage poisons the slot's cache for every later step; the engine
+    quarantines the slot on sight of the sentinel (fails that request,
+    zeroes its KV rows) while every other slot keeps decoding. +inf alone
+    also trips it: softmax over +inf is NaN anyway. The check is one
+    vocab-wide AND-reduction — VPU-cheap next to the transformer step,
+    unlike the sort this module already gates behind any_filter."""
     b, v = logits.shape
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)  # [B]
     greedy = _greedy_argmax(logits)
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
@@ -88,4 +100,5 @@ def sample(
         return jax.random.categorical(key, filtered, axis=-1)
 
     sampled = lax.cond(any_sample, sampled_branch, lambda _: greedy, scaled)
-    return jnp.where(temperature <= 0.0, greedy, sampled)
+    out = jnp.where(temperature <= 0.0, greedy, sampled)
+    return jnp.where(finite, out, -1)

@@ -319,3 +319,185 @@ def test_consume_offset_resume(run):
             await runner.stop()
 
     run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# client disconnect → in-flight generation cancelled (serving/lifecycle.py)
+# ---------------------------------------------------------------------------
+
+GATEWAYS_LS = """
+gateways:
+  - id: chat-ls
+    type: chat
+    parameters: [sessionId]
+    chat-options:
+      questions-topic: input-topic
+      answers-topic: output-topic
+      headers:
+        - key: langstream-client-session-id
+          value-from-parameters: sessionId
+"""
+
+
+def test_chat_disconnect_cancels_registered_session_requests(run):
+    """Closing a chat websocket must cancel every in-flight request
+    registered under the session id the gateway's headers resolve — the
+    gateway half of disconnect-frees-the-slot (the engine half, cancel →
+    slot freed within a chunk, is tests/test_engine_faults.py)."""
+    from langstream_tpu.serving import lifecycle
+
+    app = ModelBuilder.build_application_from_files(
+        {"pipeline.yaml": PIPELINE, "gateways.yaml": GATEWAYS_LS}, INSTANCE, None
+    ).application
+
+    class FakeRequest:
+        cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+    async def scenario():
+        from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+        runner = LocalApplicationRunner("gw-cancel", app)
+        await runner.deploy()
+        await runner.start()
+        server = await runner.serve_gateway()
+        req = FakeRequest()
+        lifecycle.register("sess-disc", req)
+        try:
+            async with aiohttp.ClientSession() as session:
+                url = (
+                    f"{server.ws_url}/v1/chat/default/gw-cancel/chat-ls"
+                    "?param:sessionId=sess-disc"
+                )
+                async with session.ws_connect(url) as ws:
+                    await ws.send_str(json.dumps({"value": "question"}))
+                    await asyncio.wait_for(ws.receive(), 10)
+                    assert not req.cancelled, "cancel must wait for disconnect"
+                # ws context exit closed the socket → ClientDisconnected path
+            for _ in range(200):
+                if req.cancelled:
+                    break
+                await asyncio.sleep(0.05)
+            assert req.cancelled, "disconnect never cancelled the session"
+        finally:
+            lifecycle.unregister("sess-disc", req)
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())
+
+
+CANCEL_CONFIG = """
+configuration:
+  resources:
+    - type: tpu-serving
+      name: tpu
+      configuration:
+        model: tiny-test
+        tokenizer: byte
+        max-seq-len: 2048
+        max-batch: 1
+"""
+
+CANCEL_PIPELINE = """
+module: default
+id: p
+name: chat
+topics:
+  - name: input-topic
+    creation-mode: create-if-not-exists
+  - name: output-topic
+    creation-mode: create-if-not-exists
+pipeline:
+  - name: convert
+    type: document-to-json
+    input: input-topic
+    configuration:
+      text-field: question
+  - name: chat
+    type: ai-chat-completions
+    configuration:
+      model: tiny-test
+      stream-to-topic: output-topic
+      stream-response-completion-field: value
+      min-chunks-per-message: 5
+      completion-field: value.answer
+      max-tokens: 100000
+      messages:
+        - role: user
+          content: "{{ value.question }}"
+"""
+
+
+def test_chat_disconnect_frees_engine_slot_end_to_end(run):
+    """Full stack: gateway chat → ai-chat-completions on the tiny TPU
+    engine with a 100k-token budget. Disconnecting mid-stream must cancel
+    the generation (the in-flight request resolves and unregisters within
+    seconds — decoding 100k tokens would take minutes), freeing the
+    engine's only slot."""
+    from langstream_tpu.serving import lifecycle
+
+    app = ModelBuilder.build_application_from_files(
+        {
+            "pipeline.yaml": CANCEL_PIPELINE,
+            "gateways.yaml": GATEWAYS_LS,
+            "configuration.yaml": CANCEL_CONFIG,
+        },
+        INSTANCE,
+        None,
+    ).application
+
+    async def scenario():
+        from langstream_tpu.runtime.local_runner import LocalApplicationRunner
+
+        runner = LocalApplicationRunner("gw-e2e", app)
+        await runner.deploy()
+        await runner.start()
+        server = await runner.serve_gateway()
+        try:
+            async with aiohttp.ClientSession() as session:
+                url = (
+                    f"{server.ws_url}/v1/chat/default/gw-e2e/chat-ls"
+                    "?param:sessionId=sess-e2e"
+                )
+                async with session.ws_connect(url) as ws:
+                    await ws.send_str(json.dumps({"value": "hi"}))
+                    # wait for the first streamed chunk: the generation is
+                    # then definitely holding the engine's only slot
+                    msg = await asyncio.wait_for(ws.receive(), 120)
+                    assert msg.type == aiohttp.WSMsgType.TEXT
+                    assert "sess-e2e" in lifecycle.active_keys()
+                # socket closed → ClientDisconnected → lifecycle.cancel →
+                # the engine resolves the request at the next chunk
+                # boundary and the service unregisters it
+            for _ in range(600):
+                if "sess-e2e" not in lifecycle.active_keys():
+                    break
+                await asyncio.sleep(0.05)
+            assert "sess-e2e" not in lifecycle.active_keys(), (
+                "generation kept running after client disconnect"
+            )
+            # decisive: the LIVE engine actually took a cancellation — a
+            # generation that merely finished naturally (length cap) would
+            # unregister too, and this assertion is what catches a broken
+            # disconnect→cancel wiring in that case
+            import gc
+
+            from langstream_tpu.serving.engine import ServingEngine
+
+            live = [
+                e for e in gc.get_objects()
+                if isinstance(e, ServingEngine)
+                and e._thread is not None and e._thread.is_alive()
+            ]
+            assert live and any(e.cancelled_total >= 1 for e in live), (
+                "the engine never saw a cancellation — the request "
+                "completed naturally instead of being cancelled"
+            )
+        finally:
+            await server.stop()
+            await runner.stop()
+
+    run(scenario())

@@ -82,6 +82,11 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_prefill_tokens_saved_total",
         "engine_prefix_pool_bytes_in_use",
         "engine_prefix_cache_evictions_total",
+        "engine_shed_total",
+        "engine_deadline_exceeded_total",
+        "engine_cancelled_total",
+        "engine_quarantined_slots_total",
+        "engine_restarts_total",
     ):
         serving.gauge(n)
     exposed = {

