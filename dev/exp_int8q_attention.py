@@ -1,0 +1,112 @@
+"""Experiment: int8×int8 decode attention for the BF16 cache path.
+
+The last untaken device lever on PERF.md's list: with an int8 KV cache the
+attention dots already run int8×int8→int32 on the MXU (quantized q, scales
+hoisted onto the scores). With a BF16 cache the dots run in bf16 — this
+experiment measures whether quantizing q per-vector (cheap) and k per-token
+ON THE FLY (the cache READ stays bf16 — no bandwidth saving, this is purely
+an MXU-rate play) beats the shipped bf16 einsum at decode shapes, and what
+it costs in logit error.
+
+Run on the serving chip before shipping any knob; the CPU numbers only
+establish the overhead floor (CPU has no int8 matmul advantage, so the
+quantize work is pure loss there — recorded in PERF.md round 9 either way).
+
+    JAX_PLATFORMS=cpu python dev/exp_int8q_attention.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.configs import MODEL_PRESETS
+from langstream_tpu.models.transformer import _quantize_kv, attention
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def bf16_decode_attention(q, k, v, mask, config):
+    """The shipped path: bf16 q @ bf16 cache, fp32 softmax."""
+    return attention(q, k, v, mask, config)
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def int8q_decode_attention(q, k, v, mask, config):
+    """Variant: quantize q per-vector and k per-token in-register, dot in
+    int8×int8→int32, scales applied on the [.., T]-shaped scores (the same
+    hoisting the int8-cache path uses); probs·V re-quantized per-row the
+    same way. HBM traffic unchanged (the cache is read bf16 first)."""
+    h, hkv = config.n_heads, config.n_kv_heads
+    group = h // hkv
+    b, s, _, d = q.shape
+    qg = q.reshape(b, s, hkv, group, d)
+    qq, qs = _quantize_kv(qg)
+    kq, ks = _quantize_kv(k)  # per-token, on the fly
+    scores = jnp.einsum(
+        "bshgd,bhtd->bhgst", qq, kq, preferred_element_type=jnp.int32
+    ).astype(jnp.float32)
+    scores = scores * qs.transpose(0, 2, 3, 1)[:, :, :, :, None]
+    scores = scores * ks[:, :, None, None, :]
+    scores = scores / jnp.sqrt(jnp.float32(d))
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    vq, vs = _quantize_kv(v)
+    pv = probs * vs[:, :, None, None, :]
+    pq, ps = _quantize_kv(pv)
+    out = jnp.einsum(
+        "bhgst,bhtd->bshgd", pq, vq, preferred_element_type=jnp.int32
+    ).astype(jnp.float32)
+    out = (out * ps.transpose(0, 3, 1, 2)[..., None]).astype(q.dtype)
+    return out.reshape(b, s, h * d)
+
+
+def bench(fn, *args, iters=30):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main() -> None:
+    config = MODEL_PRESETS["llama-3-8b-shallow"]  # GQA kv=8, the case that matters
+    on_tpu = jax.default_backend() == "tpu"
+    b, t = (96, 1024) if on_tpu else (16, 512)
+    d = config.resolved_head_dim
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, 1, config.n_heads, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(b, config.n_kv_heads, t, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(b, config.n_kv_heads, t, d)), dtype)
+    lengths = rng.integers(32, t, size=b)
+    mask = jnp.asarray(np.arange(t)[None, None, :] < lengths[:, None, None])
+
+    t_bf16, out_bf16 = bench(bf16_decode_attention, q, k, v, mask, config)
+    t_int8, out_int8 = bench(int8q_decode_attention, q, k, v, mask, config)
+    err = float(
+        jnp.max(jnp.abs(out_bf16.astype(jnp.float32) - out_int8.astype(jnp.float32)))
+    )
+    scale = float(jnp.max(jnp.abs(out_bf16.astype(jnp.float32))))
+    print(
+        f"backend={jax.default_backend()} B={b} T={t} kv={config.n_kv_heads} "
+        f"D={d} dtype={dtype.__name__}"
+    )
+    print(f"bf16 path:      {t_bf16 * 1e3:8.3f} ms")
+    print(f"int8q path:     {t_int8 * 1e3:8.3f} ms  ({t_bf16 / t_int8:.2f}x)")
+    print(f"max |Δout| {err:.4g} (max |out| {scale:.4g})")
+    verdict = "WINS — consider an opt-in knob" if t_int8 < t_bf16 else "LOSES — no knob"
+    print(f"verdict on this backend: int8q {verdict}")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,22 @@ class _BaseCompletionsStep(Step):
             "engine_prefix_cache_evictions_total",
             "prefix-cache LRU evictions (cumulative)",
         )
+        # self-speculative decoding (serving/engine.py _verify_chunk):
+        # engine-cumulative ratios, so gauges carry them like the prefix set
+        self._m_spec_accept = metrics.gauge(
+            "engine_spec_acceptance_rate",
+            "fraction of proposed draft tokens the model accepted "
+            "(speculative decoding; 0 when off)",
+        )
+        self._m_spec_per_step = metrics.gauge(
+            "engine_spec_accepted_tokens_per_step",
+            "tokens emitted per verify dispatch (each dispatch = ONE weight "
+            "read; 1.0 means speculation is buying nothing)",
+        )
+        self._m_spec_hit = metrics.gauge(
+            "engine_spec_draft_hit_rate",
+            "fraction of draft lookups where the n-gram index had a proposal",
+        )
         # request lifecycle / fault recovery (serving/engine.py): sourced
         # from the engine's cumulative stats, gauges like the prefix set
         self._m_shed = metrics.gauge(
@@ -141,6 +157,9 @@ class _BaseCompletionsStep(Step):
         self._m_prefix_saved.set(stats.get("prefill-tokens-saved-total", 0))
         self._m_prefix_bytes.set(stats.get("prefix-pool-bytes-in-use", 0))
         self._m_prefix_evict.set(stats.get("prefix-cache-evictions-total", 0))
+        self._m_spec_accept.set(stats.get("spec-acceptance-rate", 0))
+        self._m_spec_per_step.set(stats.get("spec-accepted-tokens-per-step", 0))
+        self._m_spec_hit.set(stats.get("spec-draft-hit-rate", 0))
         self._m_shed.set(stats.get("shed-total", 0))
         self._m_deadline.set(stats.get("deadline-exceeded-total", 0))
         self._m_cancelled.set(stats.get("cancelled-total", 0))

@@ -27,6 +27,13 @@ Resource configuration:
     relative to the decode cache; `prefix-cache-entries` overrides the
     row count directly (0 disables the pool entirely). The memory plan
     accounts the pool before warmup.
+  speculation: auto | off (default off) → self-speculative decoding
+    (serving/speculation.py + engine._verify_chunk): host-side n-gram
+    prompt-lookup drafts verified k+1-at-a-time in one device dispatch —
+    one weight read emits up to k+1 tokens per slot on repetitive text.
+    `speculation-tokens` (default 4) is k, fixed engine-wide (one compiled
+    verify ladder). Disabled automatically under SPMD; composes with
+    overlap, prefix-cache, and both KV dtypes (docs/SERVING.md §10).
   queue-depth / shed-policy: bounded admission queue; "block" (default)
     backpressures the broker poll loop, "reject" sheds with a retry-after
     (ShedError) so front doors degrade to fast 429s under overload
@@ -183,6 +190,16 @@ class _EngineHolder:
             raise ValueError(
                 f"unknown prefix-cache {px!r}; supported: auto, off"
             )
+        spec = self.config.get("speculation", "off")
+        if not isinstance(spec, bool) and str(spec).lower() not in ("auto", "off"):
+            raise ValueError(
+                f"unknown speculation {spec!r}; supported: auto, off"
+            )
+        spec_tokens = int(self.config.get("speculation-tokens", 4))
+        if spec_tokens < 1:
+            raise ValueError(
+                f"speculation-tokens must be >= 1, got {spec_tokens}"
+            )
         buckets = tuple(
             self.config.get("prefill-buckets", (32, 64, 128, 256, 512, 1024, 2048))
         )
@@ -236,6 +253,8 @@ class _EngineHolder:
                 if self.config.get("prefix-cache-entries") is not None
                 else None
             ),
+            speculation=spec,  # validated at the top of this method
+            speculation_tokens=spec_tokens,
             # request lifecycle / fault recovery (docs/SERVING.md §9)
             queue_depth=(
                 int(self.config["queue-depth"])

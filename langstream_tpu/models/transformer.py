@@ -229,6 +229,7 @@ def _dispatch_attention(
     causal: bool,
     kv_offset: Optional[jax.Array] = None,  # [B] — segment prefill at offset
     kv_bound: Optional[int] = None,  # static cap on readable cache columns
+    verify: bool = False,  # speculative multi-token verify (decode-shaped S>1)
 ) -> jax.Array:
     """Route to the Pallas kernels when shapes fit TPU tiling, else the jnp
     reference path. Semantics identical; ops/attention has the kernels."""
@@ -272,6 +273,16 @@ def _dispatch_attention(
                 q[:, 0], k_all, v_all, lengths, config, interpret=interpret
             )
         return out[:, None, :]
+    if s > 1 and kv_offset is not None and verify:
+        # speculative verify chunk: S = k+1 draft tokens per row, decode-
+        # shaped (tiny, never 128-aligned) — the dense masked read over the
+        # (already kv_bound-sliced) cache is both the r5-measured winner at
+        # these shapes AND the same jnp math as single-token decode, the
+        # greedy token-exactness invariant. ``mask`` is the per-slot causal
+        # frontier verify_step_inplace built (already bound-sliced above).
+        from langstream_tpu.ops.attention import multitoken_verify_attention
+
+        return multitoken_verify_attention(q, k_all, v_all, mask, config)
     if s > 1 and kv_offset is not None:
         # chunked prefill: the segment attends to the whole written cache
         # prefix plus its own lower triangle (global-position causal)
@@ -400,6 +411,7 @@ def _layer(
     kv_offset: Optional[jax.Array] = None,
     kv_bound: Optional[int] = None,
     collect_kv: bool = False,
+    verify: bool = False,
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
     """One transformer block. If cache_kv given, k/v are written at
     cache_positions and attention runs over the full cache width. With
@@ -457,7 +469,7 @@ def _layer(
         attn_out = quantized_matmul(
             _dispatch_attention(
                 q, k_all, v_all, mask, config, cache_positions, causal,
-                kv_offset, kv_bound,
+                kv_offset, kv_bound, verify,
             ),
             lp["wo"],
         )
@@ -532,7 +544,8 @@ def _scan_layers(
 
 
 def _scan_layers_inplace(
-    params, x, sin, cos, mask, config, cache, cache_positions, kv_bound=None
+    params, x, sin, cos, mask, config, cache, cache_positions, kv_bound=None,
+    kv_offset=None, verify=False,
 ):
     """Layer loop with the cache updated IN PLACE via a scan carry +
     dynamic-update-slice at the layer index, instead of consuming the cache
@@ -564,7 +577,8 @@ def _scan_layers_inplace(
         cv = read(cache["v"], l)
         y, new_kv = _layer(
             x, lp, sin, cos, mask, config, cache_kv=(ck, cv),
-            cache_positions=cache_positions, kv_bound=kv_bound,
+            cache_positions=cache_positions, kv_offset=kv_offset,
+            kv_bound=kv_bound, verify=verify,
         )
         nck, ncv = new_kv
         cache = {"k": write(cache["k"], nck, l), "v": write(cache["v"], ncv, l)}
@@ -768,6 +782,45 @@ def decode_step_inplace(
         kv_bound=kv_bound,
     )
     return _unembed(params, x, config)[:, 0], cache
+
+
+def verify_step_inplace(
+    params: Params,
+    tokens: jax.Array,  # [B, K+1] — current token + K drafts per slot
+    positions: jax.Array,  # [B] position of each row's FIRST token
+    cache: KVCache,
+    config: ModelConfig,
+) -> tuple[jax.Array, KVCache]:
+    """Multi-token speculative verify: score K drafts per slot in ONE
+    forward — logits at EVERY position come back ([B, K+1, V], unlike
+    prefill_segment's last-token-only), so the engine's rejection sampler
+    can accept the longest valid prefix. Writes K/V for all K+1 tokens at
+    [positions, positions+K+1); rows past the accepted length hold stale
+    draft K/V, which is safe because positions only advance past ACCEPTED
+    tokens and the next dispatch overwrites the stale rows before any
+    query's causal mask can reach them (the same invariant stale freed-slot
+    rows already rely on).
+
+    Bandwidth bounding is the CALLER's job: engine._verify_chunk slices the
+    cache to its kv_bound before calling (and splices after), the same
+    shape _decode_chunk uses — no kv_bound parameter here, so there is
+    exactly ONE bounding mechanism on the verify path.
+
+    Like decode_step_inplace, NOT separately jitted — it is the body of
+    engine._verify_chunk, and the in-place layer scan keeps the chunk from
+    materializing a second cache-sized buffer."""
+    b, s = tokens.shape
+    t = cache_width(cache)
+    pos = positions[:, None] + jnp.arange(s)[None, :]  # [B, K+1] global
+    sin, cos = _rope_freqs(pos, config)
+    kv_pos = jnp.arange(t)[None, None, :]
+    mask = kv_pos <= pos[:, :, None]  # per-slot causal over global positions
+    x = _embed(params, tokens, config)
+    x, cache = _scan_layers_inplace(
+        params, x, sin, cos, mask, config, cache=cache, cache_positions=pos,
+        kv_offset=positions, verify=True,
+    )
+    return _unembed(params, x, config), cache
 
 
 # ---------------------------------------------------------------------------

@@ -821,6 +821,43 @@ def fused_segment_decode_attention(
 
 
 # ---------------------------------------------------------------------------
+# Multi-token verify: K+1 speculative-draft queries per row against the big
+# cache (self-speculative decoding, engine._verify_chunk). Decode-shaped
+# work, not prefill-shaped: S is tiny (k+1 ≤ ~9) and never 128-aligned, so
+# the segment kernels' tiling can't apply — and r5 measured the dense masked
+# read over the kv_bound-sliced cache beating the ragged kernels at exactly
+# these shapes. One routing function for both cache dtypes keeps the verify
+# path on the SAME jnp attention math as single-token decode, which is what
+# makes greedy speculation token-exact with non-speculative greedy.
+# ---------------------------------------------------------------------------
+
+
+def multitoken_verify_attention(
+    q: jax.Array,  # [B, S, H, D] — current token + S-1 draft queries per row
+    k,  # [B, Hkv, T, D] cache (head-major array, or int8 {"q","s"} entry)
+    v,
+    mask: jax.Array,  # [B, S, T] bool — per-slot causal, built by the caller
+    config: ModelConfig,
+) -> jax.Array:
+    """Per-slot causal attention of a draft chunk against the cache
+    → [B, S, H*D]. Query j of row b attends columns ≤ position[b] + j (the
+    prefix written by earlier steps plus the drafts' own lower triangle —
+    their K/V must already be scattered at the query positions, the
+    prefill_segment contract). The mask comes from verify_step_inplace,
+    which owns the ONLY definition of the verify causal frontier — columns
+    past a row's frontier may hold stale rejected-draft K/V from a
+    previous verify, and the mask is what makes that harmless.
+
+    Deliberately a named entry point here rather than an inlined call in
+    transformer._dispatch_attention: this is the seam a Pallas multi-token
+    verify kernel would replace if a chip measurement ever justified one
+    (r5's data says it won't at small S — the dense path won)."""
+    from langstream_tpu.models.transformer import attention as jnp_attention
+
+    return jnp_attention(q, k, v, mask, config)
+
+
+# ---------------------------------------------------------------------------
 # Dispatch gate
 # ---------------------------------------------------------------------------
 

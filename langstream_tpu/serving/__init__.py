@@ -7,7 +7,8 @@ RecordSink.emit point — preserving the reference's StreamingChunksConsumer
 contract (ChatCompletionsStep.java:137) and its ordered-commit semantics.
 """
 
-from langstream_tpu.serving.sampling import sample
+from langstream_tpu.serving.sampling import sample, speculative_verify
+from langstream_tpu.serving.speculation import NGramIndex
 from langstream_tpu.serving.engine import (
     DeadlineExceededError,
     GenerationRequest,
@@ -25,7 +26,9 @@ __all__ = [
     "GenerationResult",
     "InjectedFault",
     "LogitsNaNError",
+    "NGramIndex",
     "ServingEngine",
     "ShedError",
     "sample",
+    "speculative_verify",
 ]

@@ -35,9 +35,11 @@ from langstream_tpu.models.transformer import (
     make_kv_cache,
     prefill,
     prefill_segment,
+    verify_step_inplace,
 )
 from langstream_tpu.serving.faultinject import FaultInjector
-from langstream_tpu.serving.sampling import sample
+from langstream_tpu.serving.sampling import sample, speculative_verify
+from langstream_tpu.serving.speculation import NGramIndex
 
 log = logging.getLogger(__name__)
 
@@ -198,6 +200,55 @@ def _decode_chunk(
             cache,
         )
     return chunk, tokens, positions, cache, key
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "kv_bound"), donate_argnames=("cache",)
+)
+def _verify_chunk(
+    params, tokens, positions, cache, key, temp, top_k, top_p, drafts, config,
+    kv_bound=None,
+):
+    """ONE self-speculative iteration in ONE dispatch: run the multi-token
+    verify forward over [current token ++ drafts] (k+1 positions per slot),
+    accept the longest valid draft prefix (greedy: argmax match; sampled:
+    rejection sampling — serving/sampling.py speculative_verify), and
+    advance the device decode chain by accepted+1. Decode is HBM-bound —
+    every step reads the full weights to emit one token per slot — so
+    scoring k+1 positions per weight read is the amortization lever after
+    int8, overlap and prefix reuse (PERF.md round 9). Rejected tokens need
+    no KV rewind: positions simply don't advance past the accepted length,
+    and the next dispatch overwrites the stale rows before any causal mask
+    can reach them.
+
+    ``kv_bound``: the same static pow2 slice/splice the decode chunk uses —
+    the verify read must not stream cold cache columns either. The fetched
+    result is ONE packed [B, k+2] array (emitted tokens ++ accepted count),
+    one tunnel round trip per iteration. Compile surface: one program per
+    (k, kv_bound) with k fixed engine-wide, so the ladder stays O(log2 T)."""
+    full = None
+    if kv_bound is not None and kv_bound < cache_width(cache):
+        full = cache
+        cache = jax.tree.map(lambda a: a[:, :, :, :kv_bound], cache)
+    inputs = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, k+1]
+    logits, cache = verify_step_inplace(
+        params, inputs, positions, cache, config
+    )
+    key, sub = jax.random.split(key)
+    out, accept = speculative_verify(logits, drafts, sub, temp, top_k, top_p)
+    # the last emitted token (correction or bonus) is the next chunk's input
+    tokens = jnp.take_along_axis(out, accept[:, None], axis=1)[:, 0]
+    positions = positions + accept + 1
+    if full is not None:
+        cache = jax.tree.map(
+            lambda big, small: lax.dynamic_update_slice(
+                big, small.astype(big.dtype), (0,) * big.ndim
+            ),
+            full,
+            cache,
+        )
+    packed = jnp.concatenate([out, accept[:, None]], axis=1)  # [B, k+2]
+    return packed, tokens, positions, cache, key
 
 
 @functools.partial(
@@ -374,6 +425,22 @@ def _make_ring_admit(mesh):
     return ring_admit
 
 
+def _kv_bound_ladder(max_seq_len: int) -> list[int]:
+    """The pow2 kv_bound ladder: 64 doubling up to (and always including)
+    ``max_seq_len``. The ONE definition of the ladder rule — the decode and
+    verify warmups compile exactly these rungs and _decode_kv_bound picks
+    from them at dispatch time, so any drift between the three sites would
+    resurface the 15-23s mid-traffic compile stall the warmups exist to
+    prevent."""
+    bounds = []
+    bound = 64
+    while bound < max_seq_len:
+        bounds.append(bound)
+        bound *= 2
+    bounds.append(max_seq_len)
+    return list(dict.fromkeys(bounds))
+
+
 class _Fetch:
     """Handle for one deferred device→host token fetch. Created at dispatch
     time; the fetch thread fills ``_value`` in submission order. ``result``
@@ -504,6 +571,8 @@ class ServingEngine:
         prefix_cache: Any = False,
         prefix_cache_fraction: float = 0.25,
         prefix_cache_entries: Optional[int] = None,
+        speculation: Any = False,
+        speculation_tokens: int = 4,
         queue_depth: Optional[int] = None,
         shed_policy: str = "block",
         restart_backoff_s: float = 0.1,
@@ -669,6 +738,38 @@ class ServingEngine:
                 "replicas yet (gather/publish ops are not announced)"
             )
             enabled = False
+        # self-speculative decoding (prompt-lookup drafts + one-dispatch
+        # multi-token verification): host-side per-slot n-gram indexes
+        # propose up to ``speculation_tokens`` drafts per iteration; the
+        # _verify_chunk program scores them all in ONE weight read and
+        # advances each slot by accepted+1 tokens. Off under SPMD like the
+        # prefix cache: the verify dispatch is not on the follower wire.
+        spec_on = (
+            speculation is True
+            or str(speculation).lower() in ("auto", "on", "true", "1")
+        )
+        if spec_on and spmd is not None:
+            log.warning(
+                "speculation disabled: not supported on multi-host SPMD "
+                "replicas yet (the verify dispatch is not announced)"
+            )
+            spec_on = False
+        self._spec_enabled = spec_on
+        # ONE static k engine-wide: every distinct k is a separate compiled
+        # verify ladder (k × the pow2 bounds), and a 15-23s mid-traffic
+        # compile costs more than any per-request k tuning could win
+        self.spec_tokens = max(1, int(speculation_tokens)) if spec_on else 0
+        self._spec_index: dict[int, NGramIndex] = {}
+        self.spec_dispatches_total = 0
+        self.spec_draft_tokens_total = 0
+        self.spec_accepted_tokens_total = 0
+        self.spec_emitted_tokens_total = 0
+        # slot-steps: one per (active slot, verify dispatch) pair — the
+        # denominator that makes accepted-tokens-per-step a PER-SLOT number
+        # in [1, k+1], comparable to plain decode's fixed 1.0
+        self.spec_slot_steps_total = 0
+        self.spec_draft_lookups_total = 0
+        self.spec_draft_hits_total = 0
         self._prefix_pool = None
         pool_entries, pool_width = 0, 0
         if enabled:
@@ -804,6 +905,7 @@ class ServingEngine:
                 prefill_streams=self.max_prefill_streams,
                 prefix_pool_entries=pool_entries,
                 prefix_pool_width=pool_width,
+                speculation_tokens=self.spec_tokens,
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -991,6 +1093,38 @@ class ServingEngine:
             "prefix-cache-entries": (
                 self._prefix_pool.live_entries if self._prefix_pool else 0
             ),
+            # self-speculative decoding (zeros with speculation off, so the
+            # metrics exporter sets its gauges unconditionally)
+            "speculation": self._spec_enabled,
+            "speculation-tokens": self.spec_tokens,
+            "spec-acceptance-rate": (
+                round(
+                    self.spec_accepted_tokens_total
+                    / self.spec_draft_tokens_total,
+                    4,
+                )
+                if self.spec_draft_tokens_total
+                else 0.0
+            ),
+            "spec-accepted-tokens-per-step": (
+                round(
+                    self.spec_emitted_tokens_total / self.spec_slot_steps_total,
+                    4,
+                )
+                if self.spec_slot_steps_total
+                else 0.0
+            ),
+            "spec-draft-hit-rate": (
+                round(
+                    self.spec_draft_hits_total / self.spec_draft_lookups_total,
+                    4,
+                )
+                if self.spec_draft_lookups_total
+                else 0.0
+            ),
+            "spec-draft-tokens-total": self.spec_draft_tokens_total,
+            "spec-accepted-tokens-total": self.spec_accepted_tokens_total,
+            "spec-verify-dispatches-total": self.spec_dispatches_total,
             # request lifecycle / fault recovery (this PR's acceptance
             # surface: every degradation path is countable in production)
             "draining": self._draining,
@@ -1074,13 +1208,8 @@ class ServingEngine:
                 ))
             self._dev_decode(steps, stale, bound).block_until_ready()
 
-        bounds = []
-        bound = 64
-        while bound < self.max_seq_len:
-            bounds.append(bound)
-            bound *= 2
-        bounds.append(self.max_seq_len)
-        for i, bound in enumerate(dict.fromkeys(bounds)):
+        bounds = _kv_bound_ladder(self.max_seq_len)
+        for i, bound in enumerate(bounds):
             if self._stop.is_set():
                 return
             # the first rung also warms the stale-slot temp-reset scatter
@@ -1100,17 +1229,43 @@ class ServingEngine:
         # — which replay these warmups but not a leader-local reset — in
         # exact lockstep
         if self._spmd is None:
-            # quarantine row-reset, warmed all-out-of-bounds (every write
-            # drops, state untouched) so the first NaN-guard trip under
-            # traffic is never a compile. Not warmed under SPMD: the guard
-            # crashes the replica there instead of quarantining.
-            self._record_program("row-reset")
-            idxs = np.full(self.max_batch, self.max_batch, np.int32)
-            self._cache = _reset_rows(self._cache, jnp.asarray(idxs))
-            jax.block_until_ready(jax.tree.leaves(self._cache)[0])
+            self._warmup_row_reset()
         log.info(
             "decode ladder precompiled: bounds %s, chunk %d",
             bounds, self.decode_chunk,
+        )
+
+    def _warmup_row_reset(self) -> None:
+        """Quarantine row-reset, warmed all-out-of-bounds (every write
+        drops, state untouched) so the first NaN-guard trip under traffic
+        is never a compile. Not warmed under SPMD: the guard crashes the
+        replica there instead of quarantining."""
+        self._record_program("row-reset")
+        idxs = np.full(self.max_batch, self.max_batch, np.int32)
+        self._cache = _reset_rows(self._cache, jnp.asarray(idxs))
+        jax.block_until_ready(jax.tree.leaves(self._cache)[0])
+
+    def _warmup_verify_ladder(self) -> None:
+        """Speculative twin of _warmup_decode_ladder: one throwaway verify
+        dispatch per kv_bound rung (all-zero drafts; slots are free so the
+        garbage KV the warmup writes is dead state, exactly like the decode
+        warmup), so the (k, bound) verify surface — the ONLY decode-phase
+        programs a speculative engine dispatches — is compiled before the
+        first request. The first rung also warms the stale-slot temp-reset
+        scatter and the tail warms the quarantine row-reset, both with
+        all-out-of-bounds indexes (every write drops). Never runs under
+        SPMD: speculation is disabled there at construction."""
+        drafts = np.zeros((self.max_batch, self.spec_tokens), np.int32)
+        bounds = _kv_bound_ladder(self.max_seq_len)
+        for i, bound in enumerate(bounds):
+            if self._stop.is_set():
+                return
+            stale = [self.max_batch] if i == 0 else []
+            self._dev_verify(drafts, stale, bound).block_until_ready()
+        self._warmup_row_reset()
+        log.info(
+            "verify ladder precompiled: bounds %s, k %d",
+            bounds, self.spec_tokens,
         )
 
     def _warmup_prefill_buckets(self) -> None:
@@ -1340,7 +1495,13 @@ class ServingEngine:
         if self._precompile and warm:
             # restarts skip the warmups: every program is already in the jit
             # cache (shapes are unchanged), and recovery latency is the point
-            self._warmup_decode_ladder()
+            if self._spec_enabled:
+                # a speculative engine dispatches the verify ladder instead
+                # of decode chunks — warming both would double startup time
+                # for programs it can never run
+                self._warmup_verify_ladder()
+            else:
+                self._warmup_decode_ladder()
             self._warmup_prefill_buckets()
             if self._prefix_pool is not None:
                 self._warmup_prefix_programs()
@@ -1390,6 +1551,7 @@ class ServingEngine:
         self._reserved.clear()
         self._spmd_ring_buf.clear()
         self._freed_slots.clear()
+        self._spec_index.clear()
         self._pending_row_resets.clear()
         self._inflight_steps = 0
         self._step_time_ema_s = 0.0
@@ -1465,7 +1627,27 @@ class ServingEngine:
             for entry in new_pending:
                 self._process_entry(entry)
             new_pending = []
-        if any(s.active for s in self._slots):
+        if self._spec_enabled and (
+            new_pending or pending or any(s.active for s in self._slots)
+        ):
+            # self-speculation serializes the host loop on fetched results:
+            # the next iteration's drafts must CONTINUE from the last
+            # accepted token, which only the previous verify's (and this
+            # iteration's prefill entries') fetch knows. Drain everything
+            # before proposing — the conscious pipelining trade the verify
+            # dispatch's k+1-tokens-per-weight-read amortization buys back
+            # (docs/SERVING.md §10 has the tuning story).
+            while pending:
+                for entry in pending.popleft():
+                    self._process_entry(entry)
+            for entry in new_pending:
+                self._process_entry(entry)
+            new_pending = []
+            if any(s.active for s in self._slots):
+                new_pending.append(self._dispatch_verify(
+                    clean=not prefill_ahead
+                ))
+        elif any(s.active for s in self._slots):
             new_pending.append(self._dispatch_chunk(
                 clean=not prefill_ahead,
                 # a chunk dispatched while earlier chunks are still in
@@ -1568,6 +1750,8 @@ class ServingEngine:
                     continue
                 slot.first_token_at = now
                 self._deliver_token(idx, int(first[j]))
+        elif kind == "verify":
+            self._process_verify(entry)
         else:
             _, chunk, snapshot, steps, t_dispatch, clean, pipelined = entry
             self._process_chunk(chunk, snapshot, steps)
@@ -1833,6 +2017,7 @@ class ServingEngine:
             slot.started_at = started
             slot.first_token_at = 0.0  # stamped when the deferred fetch lands
             self.total_requests += 1
+            self._spec_admit(idx, request.prompt_tokens)
             self._maybe_publish(idx, request.prompt_tokens)
         return [("prefill", self._fetcher.submit(first), list(group))]
 
@@ -1944,6 +2129,7 @@ class ServingEngine:
         slot.started_at = started
         slot.first_token_at = 0.0
         self.total_requests += 1
+        self._spec_admit(idx, prompt)
         # the prompt may extend past the reused prefix's bucket boundary:
         # publish the deeper prefix so the next lookup reuses more
         self._maybe_publish(idx, prompt)
@@ -2000,12 +2186,30 @@ class ServingEngine:
         )
         return first
 
+    def _spec_admit(self, idx: int, prompt: list[int]) -> None:
+        """Create the slot's draft index at admission, seeded with the
+        prompt (prompt-lookup: the prompt is where repeated spans live).
+        Generated tokens join via _deliver_token as they are ACCEPTED —
+        never from the verify chunk's written-but-rejected columns, so the
+        index can only propose continuations of tokens that were actually
+        emitted."""
+        if self._spec_enabled:
+            index = NGramIndex()
+            index.extend(prompt)
+            self._spec_index[idx] = index
+
     def _maybe_publish(self, idx: int, prompt: list[int]) -> None:
         """Copy-on-publish after a completed prefill: the slot's bucket-
         aligned prefix KV rows go into a pool row (one jitted gather-
         scatter), unless that prefix is already cached or every row is
         pinned by an in-flight admission (publish never blocks, never
-        evicts a row being read)."""
+        evicts a row being read).
+
+        Speculation invariant: publish boundaries are PROMPT-prefix rows
+        (p ≤ len(prompt)) written by prefill — never generated-region rows,
+        where a verify chunk may have written past the ACCEPTED length and
+        left stale rejected-draft K/V. Accepted-length, not written-length,
+        is the only boundary the pool may ever see."""
         pool = self._prefix_pool
         if pool is None:
             return
@@ -2289,6 +2493,7 @@ class ServingEngine:
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
         self.total_requests += 1
+        self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
 
@@ -2339,6 +2544,7 @@ class ServingEngine:
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
         self.total_requests += 1
+        self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
 
@@ -2491,12 +2697,7 @@ class ServingEngine:
         kv_bound = (
             self._decode_kv_bound(steps) if steps == self.decode_chunk else None
         )
-        stale: list[int] = []
-        if self._freed_slots:
-            # skip slots re-admitted since they freed (admit runs before
-            # dispatch and already wrote their fresh params)
-            stale = [i for i in set(self._freed_slots) if not self._slots[i].active]
-            self._freed_slots.clear()
+        stale = self._collect_stale()
         if self._spmd is not None:
             from langstream_tpu.parallel.spmd_serving import OP_DECODE, ControlBlock
 
@@ -2521,22 +2722,47 @@ class ServingEngine:
             time.monotonic(), clean, pipelined,
         )
 
+    def _collect_stale(self) -> list[int]:
+        """Slots freed since the last dispatch whose device temperature
+        must be reset — skipping slots re-admitted meanwhile (admit runs
+        before dispatch and already wrote their fresh params). ONE
+        definition shared by the decode and verify dispatch paths so the
+        re-admitted-slot rule cannot drift between them."""
+        if not self._freed_slots:
+            return []
+        stale = [i for i in set(self._freed_slots) if not self._slots[i].active]
+        self._freed_slots.clear()
+        return stale
+
+    def _reset_stale_temps(self, stale) -> None:
+        """Fixed-size all-or-out-of-bounds temp-reset scatter (padding rows
+        drop) — one compiled shape regardless of how many slots freed. The
+        eager scatter is its own device program: recorded, because the
+        compiled_programs guarantee must not have blind spots; the warmups
+        dispatch one all-OOB reset so its first real use is never a
+        mid-traffic compile. Shared by _dev_decode and _dev_verify."""
+        self._record_program("temp-reset")
+        idxs = np.full(self.max_batch, self.max_batch, np.int32)
+        idxs[: len(stale)] = stale
+        self._temp_dev = self._temp_dev.at[jnp.asarray(idxs)].set(0.0, mode="drop")
+
     def _decode_kv_bound(self, steps: int) -> int:
         """Static pow2 cap on readable cache columns for this chunk: decode
         is cache-READ-bandwidth-bound and the masked read otherwise streams
         the full max_seq_len width for every step (measured r5, llama-3-8b
         int8 B=96: 27.9ms/step at T=256 vs 61.8 at T=1024). Device
         positions lead host positions by the in-flight pipelined chunks, so
-        the bound covers max host position + inflight + this chunk. Pow2
-        ladder from 64 keeps the compile count at O(log2 T)."""
+        the bound covers max host position + inflight + this chunk. The
+        pow2 ladder (_kv_bound_ladder — the same rungs both warmups
+        compile) keeps the compile count at O(log2 T)."""
         highest = max(
             (s.position for s in self._slots if s.active), default=0
         )
         needed = highest + self._inflight_steps + steps
-        bound = 64
-        while bound < needed:
-            bound *= 2
-        return min(bound, self.max_seq_len)
+        for bound in _kv_bound_ladder(self.max_seq_len):
+            if bound >= needed:
+                return bound
+        return self.max_seq_len
 
     def _dev_decode(self, steps: int, stale, kv_bound: Optional[int] = None) -> Any:
         """Device layer of one decode chunk (leader + SPMD followers)."""
@@ -2544,16 +2770,7 @@ class ServingEngine:
             self._injector.fire("decode")  # crashes the loop → restart path
         self._record_program("decode", steps, kv_bound or 0)
         if len(stale):
-            # fixed-size index buffer (padding rows out of bounds → dropped)
-            # so this stays ONE compiled shape regardless of how many freed.
-            # The eager scatter is its own device program: record it (the
-            # compiled_programs guarantee must not have blind spots) — the
-            # warmup dispatches one all-OOB reset so its first REAL use
-            # (first completion under traffic) is never a mid-traffic compile
-            self._record_program("temp-reset")
-            idxs = np.full(self.max_batch, self.max_batch, np.int32)
-            idxs[: len(stale)] = stale
-            self._temp_dev = self._temp_dev.at[jnp.asarray(idxs)].set(0.0, mode="drop")
+            self._reset_stale_temps(stale)
         chunk, self._tokens_dev, self._positions_dev, self._cache, self._key = (
             _decode_chunk(
                 self.params,
@@ -2570,6 +2787,129 @@ class ServingEngine:
             )
         )
         return chunk
+
+    def _dispatch_verify(self, clean: bool = True) -> tuple:
+        """Dispatch one self-speculative verify iteration: collect up to k
+        drafts per active slot from its n-gram index (host-side, free), run
+        _verify_chunk, and return the deferred-fetch entry. Slots whose
+        index has no proposal ride the fixed-shape dispatch with zero
+        drafts — their verify degenerates to a 1-token decode step (the
+        accept test compares against the model's own outputs, so a bad or
+        empty draft can never change what is emitted)."""
+        k = self.spec_tokens
+        kv_bound = self._decode_kv_bound(k + 1)
+        stale = self._collect_stale()
+        drafts = np.zeros((self.max_batch, k), np.int32)
+        proposed = np.zeros(self.max_batch, np.int32)
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            index = self._spec_index.get(i)
+            if index is None:
+                continue
+            self.spec_draft_lookups_total += 1
+            prop = index.propose(k)
+            if prop:
+                self.spec_draft_hits_total += 1
+                self.spec_draft_tokens_total += len(prop)
+                drafts[i, : len(prop)] = prop
+                proposed[i] = len(prop)
+        packed = self._dev_verify(drafts, stale, kv_bound)
+        snapshot = [
+            (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
+        ]
+        self._busy_steps += 1
+        self._last_kv_bound = kv_bound
+        self.spec_dispatches_total += 1
+        return (
+            "verify", self._fetcher.submit(packed), snapshot, proposed,
+            time.monotonic(), clean,
+        )
+
+    def _dev_verify(self, drafts: np.ndarray, stale, kv_bound: int) -> Any:
+        """Device layer of one verify iteration — the speculative engine's
+        only decode-phase dispatch, so the decode fault site fires here
+        (crash/restart drills hold under speculation too; the corrupt-type
+        ``verify`` site fires host-side at fetch processing instead, where
+        it can target ONE slot)."""
+        if self._injector is not None:
+            self._injector.fire("decode")
+        self._record_program("verify", drafts.shape[1], kv_bound or 0)
+        if len(stale):
+            self._reset_stale_temps(stale)
+        (
+            packed,
+            self._tokens_dev,
+            self._positions_dev,
+            self._cache,
+            self._key,
+        ) = _verify_chunk(
+            self.params,
+            self._tokens_dev,
+            self._positions_dev,
+            self._cache,
+            self._key,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            jnp.asarray(drafts),
+            self.config,
+            kv_bound,
+        )
+        return packed
+
+    def _process_verify(self, entry: tuple) -> None:
+        """Host half of a verify iteration: one packed fetch ([B, k+2] =
+        emitted tokens ++ accepted count), then per-slot delivery of
+        accepted+1 tokens through the same _deliver_token path as decode
+        chunks (stop/length/cancel/deadline/NaN-sentinel all behave
+        identically mid-verify)."""
+        _, packed, snapshot, proposed, t_dispatch, clean = entry
+        host = (
+            packed.result()
+            if isinstance(packed, _Fetch)
+            else np.asarray(jax.device_get(packed))
+        )
+        if self._injector is not None:
+            host = self._injector.corrupt_verify(host, snapshot)
+        out, accept = host[:, :-1], host[:, -1]
+        for idx, request in snapshot:
+            slot = self._slots[idx]
+            if slot.request is not request:  # freed/reassigned meanwhile
+                continue
+            n_acc = int(accept[idx])
+            if proposed[idx] > 0:
+                # capped at the real proposal length: padding zeros that
+                # happen to match the model are luck, not draft quality,
+                # and would push the acceptance gauge past 1.0
+                self.spec_accepted_tokens_total += min(n_acc, int(proposed[idx]))
+            self.spec_slot_steps_total += 1
+            for j in range(n_acc + 1):
+                slot.position += 1
+                token = int(out[idx, j])
+                if token >= 0:
+                    # counted per token actually DELIVERED — a request that
+                    # finishes mid-verify (length/stop/deadline) drops the
+                    # rest, and the NaN sentinel is a quarantine, not a
+                    # token; counting n_acc+1 up front overstated the
+                    # amortization gauge exactly on short-generation,
+                    # high-acceptance traffic
+                    self.spec_emitted_tokens_total += 1
+                self._deliver_token(idx, token)
+                if slot.request is not request:  # finished mid-verify
+                    break
+        # step-time gauge: a verify iteration is ONE weight read (that is
+        # the point), so it samples as one step; spec mode drains before
+        # dispatching, so dispatch→ready wall is honest here
+        now = time.monotonic()
+        if snapshot and clean:
+            step_s = now - t_dispatch
+            self._step_time_ema_s = (
+                step_s
+                if self._step_time_ema_s == 0
+                else 0.9 * self._step_time_ema_s + 0.1 * step_s
+            )
+        self._last_chunk_ready_t = now
 
     def _process_chunk(self, chunk, snapshot, steps: int) -> None:
         if isinstance(chunk, _Fetch):
@@ -2639,6 +2979,11 @@ class ServingEngine:
             finished_reason = "stop"
         else:
             slot.generated.append(token)
+            index = self._spec_index.get(idx)
+            if index is not None:
+                # the emitted token joins the slot's draft context — the
+                # next iteration's proposals continue from it
+                index.append(token)
             self.total_generated += 1
             if request.on_token is not None:
                 try:
@@ -2678,6 +3023,7 @@ class ServingEngine:
         slot.request = None
         slot.generated = []
         slot.position = 0
+        self._spec_index.pop(idx, None)
         self._freed_slots.append(idx)
 
     def _fail_all(self, error: BaseException) -> None:
@@ -2705,6 +3051,7 @@ class ServingEngine:
             ))
         self._long_queue.clear()
         self._reserved.clear()
+        self._spec_index.clear()
         for slot in self._slots:
             if slot.request is not None:
                 slot.request._finish(GenerationResult(

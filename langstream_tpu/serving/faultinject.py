@@ -14,6 +14,9 @@ Sites (where the engine asks ``fires(site)``):
             exercises quarantine + restart-under-backoff)
   nan       corrupt one active slot's fetched tokens to the NaN-guard
             sentinel (exercises per-slot quarantine + KV row reset)
+  verify    corrupt one active slot's fetched VERIFY result (self-
+            speculative decoding) to the sentinel with accept forced to 0
+            — a fault during verification must quarantine only that slot
   fetch     stall the device→host fetch thread (slow-tunnel simulation)
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
@@ -46,7 +49,7 @@ from typing import Optional
 
 log = logging.getLogger(__name__)
 
-SITES = ("prefill", "segment", "decode", "nan", "fetch", "client")
+SITES = ("prefill", "segment", "decode", "nan", "verify", "fetch", "client")
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;
 # the injector writes the same value into fetched tokens so the engine's
@@ -185,6 +188,27 @@ class FaultInjector:
         host = np.array(host)
         host[:, victim] = NAN_SENTINEL
         return host, victim
+
+    def corrupt_verify(self, packed, snapshot):
+        """``verify`` site: corrupt one active slot's row of a fetched
+        verify result (``[B, k+2]`` = emitted tokens ++ accepted count) so
+        the slot's first delivered token is the NaN-guard sentinel with
+        accept forced to 0 — exactly what speculative_verify emits when a
+        device fault poisons that slot's logits mid-verification. The
+        engine's quarantine path then runs end-to-end for ONE slot while
+        every other slot's accepted tokens deliver untouched. Victim drawn
+        from the seeded RNG; returns a writable copy when the site fires,
+        the original array otherwise."""
+        import numpy as np
+
+        if not snapshot or not self.fires("verify"):
+            return packed
+        with self._lock:
+            victim = snapshot[self._rng.randrange(len(snapshot))][0]
+        packed = np.array(packed)
+        packed[victim, 0] = NAN_SENTINEL  # first emitted token → sentinel
+        packed[victim, -1] = 0  # accept 0 → the sentinel is delivered first
+        return packed
 
     def stats(self) -> dict[str, int]:
         return dict(self.fired)

@@ -65,6 +65,17 @@ class ServingMemoryPlan:
     # largest bucket width, resident for the engine's whole lifetime. Sized
     # by the `prefix-cache-fraction` knob; 0 when the cache is off.
     prefix_pool_bytes: int = 0
+    # self-speculative verify chunk (engine._verify_chunk): the multi-token
+    # forward materializes fp32 logits for ALL k+1 positions of every slot
+    # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
+    # workspace absorbs), and the rejection sampler's FILTER branch
+    # (any slot with top-k/top-p) peaks at ~5 such buffers live at once:
+    # scaled logits, the descending sort, the rank-masked copy, softmax
+    # probs and their cumsum (serving/sampling.py _apply_filters). Charged
+    # at 5× — at B=192, k=4, V=256k that is ~4.6 GiB, and a plan that only
+    # counted the greedy path would bless configs that OOM on the first
+    # sampled request. 0 with speculation off.
+    verify_chunk_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -77,6 +88,7 @@ class ServingMemoryPlan:
             + self.bound_slice_bytes
             + self.fused_prefill_bytes
             + self.prefix_pool_bytes
+            + self.verify_chunk_bytes
         )
 
     def fits(self, hbm_bytes: int) -> bool:
@@ -92,6 +104,7 @@ class ServingMemoryPlan:
             f"long-prefill {self.long_cache_bytes / gib:.2f}GiB + "
             f"fused-prefill {self.fused_prefill_bytes / gib:.2f}GiB + "
             f"prefix-pool {self.prefix_pool_bytes / gib:.2f}GiB + "
+            f"verify-chunk {self.verify_chunk_bytes / gib:.2f}GiB + "
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
         )
@@ -123,6 +136,7 @@ def plan_serving_memory(
     prefill_streams: int = 1,
     prefix_pool_entries: int = 0,
     prefix_pool_width: int = 0,
+    speculation_tokens: int = 0,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -136,6 +150,10 @@ def plan_serving_memory(
     slice — 0 omits the term (pre-overlap accounting).
     ``prefix_pool_entries``/``prefix_pool_width``: shape of the prefix
     KV pool (serving/prefix_cache.py) — 0 omits the term (cache off).
+    ``speculation_tokens``: drafts per verify iteration (k) when
+    self-speculative decoding is on — the verify dispatch holds up to
+    ~5 [max_batch, k+1, vocab] fp32 buffers at the sampler's filtered
+    peak (see the field note); 0 omits the term (speculation off).
     ``workspace_bytes``: flat allowance for activations, XLA scratch, and
     the collectives' staging buffers — 1GiB is empirically comfortable for
     8B-class decode at B≤96.
@@ -196,6 +214,13 @@ def plan_serving_memory(
         bound_slice_bytes=cache_bytes * sliced // max_seq_len if sliced else 0,
         fused_prefill_bytes=_tree_bytes(fused_shape) if fused_shape else 0,
         prefix_pool_bytes=_tree_bytes(prefix_shape) if prefix_shape else 0,
+        # ~5 live [B, k+1, V] fp32 buffers at the sampler's filtered peak
+        # (see field note)
+        verify_chunk_bytes=(
+            5 * max_batch * (speculation_tokens + 1) * config.vocab_size * 4
+            if speculation_tokens > 0
+            else 0
+        ),
     )
 
 

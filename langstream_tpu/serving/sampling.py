@@ -49,6 +49,27 @@ def _greedy_argmax(logits: jax.Array) -> jax.Array:
     return top_group * group + offsets
 
 
+def _apply_filters(s: jax.Array, top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """top-k + top-p cutoffs over [R, V] scaled logits with per-row params
+    (0 / 1.0 = disabled); one descending sort serves both. Shared by
+    ``sample`` (R = batch) and ``speculative_verify`` (R = batch x draft
+    positions) so the two samplers cannot drift apart."""
+    v = s.shape[-1]
+    sorted_desc = jnp.sort(s, axis=-1)[:, ::-1]
+    # top-k: value at rank k-1 (k=0 → keep all → rank v-1)
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
+    # top-p on the top-k-masked distribution, masked by rank (equivalent
+    # to re-sorting the masked logits: masking keeps a sorted prefix)
+    ranks = jnp.arange(v)[None, :]
+    sorted_masked = jnp.where(ranks <= k_idx[:, None], sorted_desc, -jnp.inf)
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]  # cumulative prob EXCLUSIVE < p
+    cutoff = jnp.where(keep, sorted_masked, jnp.inf).min(axis=-1, keepdims=True)
+    return jnp.where(s < jnp.maximum(kth, cutoff), -jnp.inf, s)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def sample(
     logits: jax.Array,  # [B, V] fp32
@@ -79,26 +100,109 @@ def sample(
     any_sample = jnp.any(temperature > 0.0)
     any_filter = jnp.any((temperature > 0.0) & ((top_k > 0) | (top_p < 1.0)))
 
-    def apply_filters(s: jax.Array) -> jax.Array:
-        # one descending sort serves both cutoffs
-        sorted_desc = jnp.sort(s, axis=-1)[:, ::-1]
-        # top-k: value at rank k-1 (k=0 → keep all → rank v-1)
-        k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v) - 1, 0, v - 1)
-        kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)
-        # top-p on the top-k-masked distribution, masked by rank (equivalent
-        # to re-sorting the masked logits: masking keeps a sorted prefix)
-        ranks = jnp.arange(v)[None, :]
-        sorted_masked = jnp.where(ranks <= k_idx[:, None], sorted_desc, -jnp.inf)
-        probs = jax.nn.softmax(sorted_masked, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep = (cum - probs) < top_p[:, None]  # cumulative prob EXCLUSIVE < p
-        cutoff = jnp.where(keep, sorted_masked, jnp.inf).min(axis=-1, keepdims=True)
-        return jnp.where(s < jnp.maximum(kth, cutoff), -jnp.inf, s)
-
     def sampled_branch(s: jax.Array) -> jax.Array:
-        filtered = lax.cond(any_filter, apply_filters, lambda x: x, s)
+        filtered = lax.cond(
+            any_filter, lambda x: _apply_filters(x, top_k, top_p), lambda x: x, s
+        )
         return jax.random.categorical(key, filtered, axis=-1)
 
     sampled = lax.cond(any_sample, sampled_branch, lambda _: greedy, scaled)
     out = jnp.where(temperature <= 0.0, greedy, sampled)
     return jnp.where(finite, out, -1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def speculative_verify(
+    logits: jax.Array,  # [B, K+1, V] fp32 — per-position next-token logits
+    drafts: jax.Array,  # [B, K] int32 — the n-gram drafts being verified
+    key: jax.Array,
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B] int32, 0 = disabled
+    top_p: jax.Array,  # [B] fp32, 1.0 = disabled
+) -> tuple[jax.Array, jax.Array]:
+    """Batched draft verification for self-speculative decoding.
+
+    Position j of ``logits`` is the model's next-token distribution after
+    consuming verify input j (input 0 = the slot's current token, inputs
+    1..K = the drafts), all scored in ONE forward. Returns
+    ``(out [B, K+1] int32, accept [B] int32)``: ``accept`` drafts were
+    accepted and the emitted tokens are ``out[:, :accept+1]`` — out[:, j]
+    equals drafts[:, j] for j < accept, and out[:, accept] is the
+    correction (greedy: the argmax the draft failed to match; sampled: a
+    residual draw) or, at accept == K, the bonus token from the last
+    position. Every verify thus emits between 1 and K+1 tokens per slot.
+
+    Greedy rows (temperature <= 0) accept the longest draft prefix matching
+    the argmax chain — token-exact with non-speculative greedy decode by
+    construction, since each position's logits condition on exactly the
+    accepted prefix. Sampled rows use standard rejection sampling against
+    the point-mass draft distribution the n-gram index implies (q(d) = 1):
+    accept d with prob min(1, p(d)/q(d)) = p(d); on the first rejection
+    resample from the residual norm(max(p - q, 0)) — p with d removed,
+    renormalized — so the emitted marginal is exactly p (the lossless
+    speculative-sampling identity).
+
+    NaN guard (same contract as ``sample``): a slot with ANY non-finite
+    position among its K+1 rows emits the ``-1`` sentinel with accept 0;
+    the engine quarantines it on sight.
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    finite = jnp.all(jnp.isfinite(logits.reshape(b, -1)), axis=-1)  # [B]
+    greedy = _greedy_argmax(logits.reshape(b * k1, v)).reshape(b, k1)
+    greedy_acc = drafts == greedy[:, :k]  # [B, K]
+
+    any_sample = jnp.any(temperature > 0.0)
+    any_filter = jnp.any((temperature > 0.0) & ((top_k > 0) | (top_p < 1.0)))
+
+    def sampled_branch(_) -> tuple[jax.Array, jax.Array]:
+        temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+        flat = (logits / temp).reshape(b * k1, v)
+        # per-slot filters repeat across the K+1 positions (one request =
+        # one sampling config); the sort is gated exactly like sample()'s
+        flat = lax.cond(
+            any_filter,
+            lambda s: _apply_filters(
+                s, jnp.repeat(top_k, k1), jnp.repeat(top_p, k1)
+            ),
+            lambda s: s,
+            flat,
+        )
+        filtered = flat.reshape(b, k1, v)
+        probs = jax.nn.softmax(filtered, axis=-1)
+        key_u, key_r = jax.random.split(key)
+        u = jax.random.uniform(key_u, (b, k))
+        p_draft = jnp.take_along_axis(
+            probs[:, :k], drafts[..., None], axis=-1
+        )[..., 0]
+        acc = u < p_draft  # [B, K]
+        # corrections: residual (draft token removed) at positions 0..K-1;
+        # position K is the bonus draw — its mask index is out of bounds,
+        # so the drop-mode scatter leaves it unfiltered. A correction row
+        # is only CONSUMED when its draft was rejected (prob 1 - p(d)), so
+        # the all--inf row a p(d)=1 draft would leave can never be read.
+        mask_cols = jnp.concatenate(
+            [drafts, jnp.full((b, 1), v, jnp.int32)], axis=1
+        )
+        masked = filtered.at[
+            jnp.arange(b)[:, None], jnp.arange(k1)[None, :], mask_cols
+        ].set(-jnp.inf, mode="drop")
+        corr = jax.random.categorical(key_r, masked, axis=-1)  # [B, K+1]
+        return acc, corr
+
+    s_acc, s_corr = lax.cond(
+        any_sample, sampled_branch, lambda _: (greedy_acc, greedy), 0
+    )
+    is_greedy = (temperature <= 0.0)[:, None]
+    acc = jnp.where(is_greedy, greedy_acc, s_acc)
+    corr = jnp.where(is_greedy, greedy, s_corr)
+    # accepted length = longest all-accepted prefix
+    accept = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=-1), axis=-1)
+    drafts_padded = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1
+    )
+    positions = jnp.arange(k1)[None, :]
+    out = jnp.where(positions < accept[:, None], drafts_padded, corr)
+    accept = jnp.where(finite, accept, 0)
+    out = jnp.where(finite[:, None], out, -1)
+    return out.astype(jnp.int32), accept.astype(jnp.int32)

@@ -148,6 +148,83 @@ def test_nan_guard_quarantines_one_slot_survivor_token_exact():
 
 
 # ---------------------------------------------------------------------------
+# verify site (self-speculative decoding): a fault during verification
+# quarantines ONLY the affected slot
+# ---------------------------------------------------------------------------
+
+
+def test_injected_verify_fault_quarantines_only_victim_slot():
+    """The ``verify`` site corrupts one slot's fetched verify result to the
+    NaN sentinel (accept forced to 0): that slot must quarantine — and ONLY
+    that slot; the survivor decodes to completion token-exact vs a
+    fault-free (non-speculative — greedy speculation is token-exact by
+    construction) run, and the engine never restarts."""
+    p1, p2 = [3, 4, 5], [7, 8]
+    refs = {tuple(p1): solo_reference(p1, 24), tuple(p2): solo_reference(p2, 24)}
+
+    engine = make_engine(
+        speculation="auto", speculation_tokens=4,
+        fault_injector=FaultInjector("verify@3", seed=0),
+    )
+    try:
+        r1 = submit_and_wait_first_token(engine, p1, 24)
+        r2 = submit_and_wait_first_token(engine, p2, 24)
+        outcomes = {}
+        for req, prompt in ((r1, p1), (r2, p2)):
+            try:
+                outcomes[tuple(prompt)] = req.result(timeout=120)
+            except LogitsNaNError:
+                outcomes[tuple(prompt)] = None
+        victims = [k for k, v in outcomes.items() if v is None]
+        assert len(victims) == 1, "exactly one slot must be quarantined"
+        survivor = next(k for k in outcomes if k not in victims)
+        assert outcomes[survivor].tokens == refs[survivor]
+        stats = engine.stats()
+        assert stats["quarantined-slots-total"] == 1
+        assert stats["engine-restarts-total"] == 0
+        assert stats["fault-injection"] == {"verify": 1}
+        # the quarantined slot's KV rows were zeroed and the slot is
+        # reusable — and speculation keeps serving after the fault
+        r3 = engine.generate([9, 9], GenerationOptions(max_new_tokens=4), timeout=120)
+        assert len(r3.tokens) == 4
+    finally:
+        engine.stop()
+
+
+def test_verify_fault_spares_engine_under_sustained_speculation():
+    """Periodic verify faults across a stream of speculative requests:
+    every fault costs one request, never the engine — completed requests
+    stay token-exact and the loop never crashes/restarts. The period (~12
+    verify dispatches ≈ every 2nd-3rd request at these shapes) leaves both
+    outcomes represented."""
+    prompt = [5, 9, 11, 7] * 6
+    ref = solo_reference(prompt, 12)
+    engine = make_engine(
+        max_batch=1, speculation="auto", speculation_tokens=4,
+        fault_injector=FaultInjector("verify@5:12", seed=1),
+    )
+    try:
+        completed = failed = 0
+        for _ in range(6):
+            req = GenerationRequest(
+                prompt_tokens=list(prompt),
+                options=GenerationOptions(max_new_tokens=12),
+            )
+            engine.submit(req)
+            try:
+                assert req.result(timeout=120).tokens == ref
+                completed += 1
+            except LogitsNaNError:
+                failed += 1
+        assert completed > 0 and failed > 0
+        stats = engine.stats()
+        assert stats["engine-restarts-total"] == 0
+        assert stats["quarantined-slots-total"] == failed
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
 # decode crash: restart under backoff, untouched admissions requeued
 # ---------------------------------------------------------------------------
 

@@ -117,3 +117,18 @@ def test_fused_prefill_and_stream_terms():
     assert fused.total_bytes == (
         base.total_bytes + fused.fused_prefill_bytes + base.long_cache_bytes
     )
+
+
+def test_verify_chunk_term_scales_with_speculation_tokens():
+    """Self-speculative decoding peaks at ~5 live [B, k+1, V] fp32 buffers
+    per verify dispatch (logits + the rejection sampler's filtered-path
+    temps) — a term, not workspace noise (~4.6 GiB at gemma-2b production
+    shapes). Off ⇒ 0, and the term is linear in k+1."""
+    cfg = MODEL_PRESETS["tiny-test"]
+    base = plan_serving_memory(cfg, 4, 256, workspace_bytes=0)
+    assert base.verify_chunk_bytes == 0  # speculation off: accounting unchanged
+    spec = plan_serving_memory(cfg, 4, 256, workspace_bytes=0, speculation_tokens=4)
+    assert spec.verify_chunk_bytes == 5 * 4 * 5 * cfg.vocab_size * 4
+    assert spec.total_bytes == base.total_bytes + spec.verify_chunk_bytes
+    wider = plan_serving_memory(cfg, 4, 256, workspace_bytes=0, speculation_tokens=9)
+    assert wider.verify_chunk_bytes == 2 * spec.verify_chunk_bytes
