@@ -103,7 +103,7 @@ instance:
 def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
                  n_requests: int, max_seq_len: int, decode_chunk: int,
                  prefill_batch: "int | None" = None,
-                 kv_int8: bool = False) -> float:
+                 kv_int8: bool = False, kv_layout: str = "paged") -> float:
     import dataclasses
 
     import jax
@@ -136,6 +136,7 @@ def bench_engine(preset: str, quantize: bool, max_batch: int, new_tokens: int,
         # whole admission waves in one dispatch (the gateway phase's knob):
         # serial 8-row groups at wave boundaries were the last device gap
         prefill_batch=prefill_batch or max_batch,
+        kv_layout=kv_layout,
     )
     engine.start()
 
@@ -310,7 +311,43 @@ def bench_prefix_burst(preset: str, quantize: bool, *, preamble_len: int,
             out["prefix_cache_hit_rate"] = stats["prefix-cache-hit-rate"]
             out["prefill_tokens_saved_total"] = stats["prefill-tokens-saved-total"]
             out["prefix_pool_bytes_in_use"] = stats["prefix-pool-bytes-in-use"]
+            # paged layout (the default): hits ALIAS pages — these two are
+            # the zero-copy acceptance numbers (bytes the dense gathers
+            # would have moved; fraction of live pages shared)
+            out["prefix_copy_bytes_saved_total"] = stats[
+                "prefix-copy-bytes-saved-total"
+            ]
+            out["kv_page_alias_rate"] = stats["kv-page-alias-rate"]
         _reclaim()
+    return out
+
+
+def bench_paged_vs_dense(preset: str, quantize: bool, *, batches: tuple,
+                         new_tokens: int, n_requests: int, max_seq_len: int,
+                         decode_chunk: int, kv_int8: bool = False) -> dict:
+    """Paged-vs-dense decode pair across a batch sweep (ISSUE 6
+    acceptance): the same engine workload on the unified page pool vs the
+    dense kv_bound-ladder layout, fresh engines per point. The sweep must
+    include the shapes where the dense layout is known weak — B=128
+    regressed on cache reads from round 2 on, and the gemma opt-in ragged
+    kernel previously LOST to the dense masked path (PERF.md item 5); the
+    paged kernel's content-proportional page DMAs are the rematch."""
+    out: dict = {}
+    for b in batches:
+        for layout in ("paged", "dense"):
+            try:
+                tok_s = bench_engine(
+                    preset, quantize, b, new_tokens,
+                    max(n_requests, 2 * b), max_seq_len, decode_chunk,
+                    kv_int8=kv_int8, kv_layout=layout,
+                )
+                out[f"{layout}_b{b}_tokens_per_sec"] = round(tok_s, 2)
+            except Exception as e:  # noqa: BLE001 — record the points that ran
+                print(
+                    f"[bench] paged-vs-dense point {layout} B={b} failed: {e}",
+                    file=sys.stderr, flush=True,
+                )
+            _reclaim()
     return out
 
 
@@ -716,6 +753,21 @@ def main() -> None:
         extras.update(bench_prefix_burst(preset, quantize, **prefix_args))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] prefix burst phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # paged-vs-dense decode pair incl. the B=128 sweep point where the
+    # dense layout is known to regress on cache reads (ISSUE 6 acceptance;
+    # PERF.md round 10). On the chip this is also the gemma rematch for the
+    # ragged paged kernel that previously lost (PERF.md item 5).
+    print("[bench] paged-vs-dense phase", file=sys.stderr, flush=True)
+    try:
+        paged_batches = (96, 128, 192) if on_tpu else (max_batch,)
+        extras.update(bench_paged_vs_dense(
+            preset, quantize, batches=paged_batches,
+            new_tokens=min(new_tokens, 128), n_requests=min(n_requests, 384),
+            max_seq_len=max_seq_len, decode_chunk=decode_chunk,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] paged-vs-dense phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # self-speculative decoding on the repetitive-text workload: the
     # on/off ms-per-accepted-token pair + acceptance rate are recorded

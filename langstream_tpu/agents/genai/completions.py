@@ -110,6 +110,22 @@ class _BaseCompletionsStep(Step):
             "engine_spec_draft_hit_rate",
             "fraction of draft lookups where the n-gram index had a proposal",
         )
+        # unified paged KV pool (serving/pagepool.py): live pool pressure,
+        # aliasing effectiveness, and the copy traffic aliasing eliminated
+        self._m_kv_pages = metrics.gauge(
+            "engine_kv_pages_in_use",
+            "physical KV pages currently allocated (paged layout; 0 dense)",
+        )
+        self._m_kv_alias = metrics.gauge(
+            "engine_kv_page_alias_rate",
+            "fraction of reserved KV pages satisfied by prefix aliasing "
+            "instead of fresh allocation (cumulative; 0 when dense)",
+        )
+        self._m_prefix_copy_saved = metrics.gauge(
+            "engine_prefix_copy_bytes_saved_total",
+            "bytes of KV copy eliminated by page aliasing vs the dense "
+            "gather-per-hit design (cumulative)",
+        )
         # request lifecycle / fault recovery (serving/engine.py): sourced
         # from the engine's cumulative stats, gauges like the prefix set
         self._m_shed = metrics.gauge(
@@ -160,6 +176,9 @@ class _BaseCompletionsStep(Step):
         self._m_spec_accept.set(stats.get("spec-acceptance-rate", 0))
         self._m_spec_per_step.set(stats.get("spec-accepted-tokens-per-step", 0))
         self._m_spec_hit.set(stats.get("spec-draft-hit-rate", 0))
+        self._m_kv_pages.set(stats.get("kv-pages-in-use", 0))
+        self._m_kv_alias.set(stats.get("kv-page-alias-rate", 0))
+        self._m_prefix_copy_saved.set(stats.get("prefix-copy-bytes-saved-total", 0))
         self._m_shed.set(stats.get("shed-total", 0))
         self._m_deadline.set(stats.get("deadline-exceeded-total", 0))
         self._m_cancelled.set(stats.get("cancelled-total", 0))

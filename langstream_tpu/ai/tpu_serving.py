@@ -13,6 +13,17 @@ Resource configuration:
   tokenizer: "byte" (default) | "hf:<local path>"
   weights: "random" (default) | path to HF safetensors dir (models.loader)
   max-batch / max-seq-len / prefill-buckets / decode-chunk: engine knobs
+  kv-layout: paged (default) | dense → KV memory layout. "paged" is the
+    unified page-table-indexed device pool (serving/pagepool.py): decode,
+    chunked prefill and speculative verify all attend through per-slot
+    page tables (ONE compiled program each — the kv_bound compile ladder
+    is gone), and prefix reuse aliases pages zero-copy. "dense" is the
+    per-slot big-cache layout, kept ONE release as the escape hatch (and
+    auto-selected under SPMD / sharded meshes, which the paged wire does
+    not speak yet). `page-size` (default 64 tokens) sizes a page;
+    `kv-pages` overrides the pool's page count (default: dense-parity
+    capacity + `prefix-cache-fraction` alias headroom — see
+    docs/SERVING.md §11 for the memory-plan math and migration notes)
   overlap: true (default) → fused prefill–decode iterations (every device
     dispatch carries a token-budgeted slice of pending prefill work plus
     the decode chunk — the gateway-TTFT lever, PERF.md round 6)
@@ -185,6 +196,14 @@ class _EngineHolder:
         from langstream_tpu.serving.engine import ServingEngine
 
         mc = self.model_config()
+        layout = str(self.config.get("kv-layout", "paged")).lower()
+        if layout not in ("paged", "dense"):
+            raise ValueError(
+                f"unknown kv-layout {layout!r}; supported: paged, dense"
+            )
+        page_size = int(self.config.get("page-size", 64))
+        if page_size < 1:
+            raise ValueError(f"page-size must be >= 1, got {page_size}")
         px = self.config.get("prefix-cache", "off")
         if not isinstance(px, bool) and str(px).lower() not in ("auto", "off"):
             raise ValueError(
@@ -242,6 +261,13 @@ class _EngineHolder:
             max_prefill_streams=(
                 int(self.config["max-prefill-streams"])
                 if self.config.get("max-prefill-streams") is not None
+                else None
+            ),
+            kv_layout=layout,  # validated at the top of this method
+            page_size=page_size,
+            kv_pages=(
+                int(self.config["kv-pages"])
+                if self.config.get("kv-pages") is not None
                 else None
             ),
             prefix_cache=px,  # validated at the top of this method

@@ -172,6 +172,86 @@ def cache_width(cache: KVCache) -> int:
     return (leaf["q"] if isinstance(leaf, dict) else leaf).shape[3]
 
 
+# ---------------------------------------------------------------------------
+# Paged KV pool (ROADMAP item 1: ONE page-table-indexed device pool replaces
+# the per-slot dense caches, the prefix pool, and the kv_bound compile
+# ladder). Layout [L, P, Hkv, page_size, D] — the same head-major trailing
+# (T, D) tiling as the dense cache, with T = one page, so the Pallas paged
+# kernel blocks are (page_size, D) slices exactly like the dense kernels'.
+# Slots own PAGES through a host-side table; logical column t of slot b
+# lives at (table[b, t // ps], t % ps). Unmapped table entries carry the
+# out-of-bounds sentinel (= num_pages), so scatters DROP and gathers CLAMP —
+# the mask invariant ("columns beyond the written frontier never enter an
+# attention mask until overwritten") makes both harmless, the same way
+# bucket padding is.
+# ---------------------------------------------------------------------------
+
+
+def make_page_pool(
+    config: ModelConfig, num_pages: int, page_size: int, dtype=None
+) -> KVCache:
+    """Device page pool: ``{"k","v"}`` with leaves [L, P, Hkv, ps, D] (or the
+    int8 ``{"q","s"}`` dicts with scales [L, P, Hkv, ps]) — structurally a
+    make_kv_cache with B = pages and T = page_size, so every tree-shaped
+    helper (sharding specs, byte accounting, donation) applies unchanged."""
+    return make_kv_cache(config, num_pages, page_size, dtype=dtype)
+
+
+def _page_index(table: jax.Array, positions: jax.Array, page_size: int,
+                num_pages: int) -> tuple[jax.Array, jax.Array]:
+    """Logical position → (physical page, in-page offset), the ONE
+    definition of the table lookup rule: positions past the table
+    (pipelined-chunk overshoot at the cache end) map to the out-of-bounds
+    sentinel so scatters DROP — like the dense cache's OOB scatter did —
+    instead of clamp-landing on the slot's LAST real page."""
+    lidx = positions // page_size  # [B, S] logical page per token
+    pages = jnp.take_along_axis(
+        table, jnp.clip(lidx, 0, table.shape[1] - 1), axis=1
+    )  # [B, S] physical page per token
+    pages = jnp.where(lidx >= table.shape[1], num_pages, pages)
+    return pages, positions % page_size
+
+
+def _paged_scatter_entry(entry, vals: jax.Array, table: jax.Array,
+                         positions: jax.Array, page_size: int):
+    """Scatter per-token K/V ``vals`` [B, Hkv, S, D] into a per-layer pool
+    entry [P, Hkv, ps, D] (or its int8 dict) at the physical pages
+    ``table[b, pos // ps]``, offset ``pos % ps``. Unmapped (out-of-bounds
+    sentinel) pages drop the write — padding rows, warmups, and steps past a
+    slot's reservation all ride the same drop."""
+    num_pages = (entry["q"] if isinstance(entry, dict) else entry).shape[0]
+    pages, offs = _page_index(table, positions, page_size, num_pages)
+    hkv = vals.shape[1]
+    pidx = pages[:, None, :]  # [B, 1, S]
+    oidx = offs[:, None, :]
+    hidx = jnp.arange(hkv)[None, :, None]
+    if isinstance(entry, dict):
+        q, s = _quantize_kv(vals)
+        return {
+            "q": entry["q"].at[pidx, hidx, oidx].set(q, mode="drop"),
+            "s": entry["s"].at[pidx, hidx, oidx].set(s, mode="drop"),
+        }
+    return entry.at[pidx, hidx, oidx].set(vals.astype(entry.dtype), mode="drop")
+
+
+def _paged_gather_entry(entry, table: jax.Array, page_size: int):
+    """Materialize the dense head-major view of every slot's logical columns
+    from a per-layer pool entry: [P, Hkv, ps, D] gathered through ``table``
+    [B, Tp] → [B, Hkv, Tp×ps, D] (int8 dicts gather q and s alike, feeding
+    the existing hoisted-scale attention math untouched). This is the
+    masked-jnp fallback read — exactness-bearing on CPU; on TPU the Pallas
+    ragged-paged kernel reads pages in place instead (ops/attention.py)."""
+    def gather(a):
+        b, tp = table.shape
+        g = jnp.take(a, table, axis=0, mode="clip")  # [B, Tp, Hkv, ps, ...]
+        g = jnp.moveaxis(g, 2, 1)  # [B, Hkv, Tp, ps, ...]
+        return g.reshape((b, a.shape[1], tp * page_size) + a.shape[3:])
+
+    if isinstance(entry, dict):
+        return {"q": gather(entry["q"]), "s": gather(entry["s"])}
+    return gather(entry)
+
+
 def attention(
     q: jax.Array,  # [B, S, H, D]
     k,  # [B, Hkv, T, D] head-major array, or int8 {"q","s"} cache entry
@@ -412,12 +492,18 @@ def _layer(
     kv_bound: Optional[int] = None,
     collect_kv: bool = False,
     verify: bool = False,
+    paged_table: Optional[jax.Array] = None,  # [B, Tp] physical pages
+    page_size: int = 0,
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
     """One transformer block. If cache_kv given, k/v are written at
     cache_positions and attention runs over the full cache width. With
     ``collect_kv`` (cache-less paths) the layer's roped K/V come back
     head-major so a caller can build a cache from a full forward — the
-    ring-prefill serving path (parallel.sp.ring_prefill)."""
+    ring-prefill serving path (parallel.sp.ring_prefill). With
+    ``paged_table`` set, cache_kv are per-layer PAGE-POOL entries
+    ([P, Hkv, ps, D]): K/V scatter to the slot's pages and attention reads
+    through the table (Pallas ragged-paged kernel on decode shapes when it
+    applies, else the gathered masked-jnp view — same math either way)."""
     b, s, d = x.shape
     hd = config.resolved_head_dim
 
@@ -429,6 +515,45 @@ def _layer(
     k = apply_rope(k, sin, cos)
 
     new_cache = None
+    if paged_table is not None:
+        assert cache_kv is not None and cache_positions is not None
+        ck, cv = cache_kv  # per-layer pool entries [P, Hkv, ps, D]
+        kt, vt = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+        ck = _paged_scatter_entry(ck, kt, paged_table, cache_positions, page_size)
+        cv = _paged_scatter_entry(cv, vt, paged_table, cache_positions, page_size)
+        new_cache = (ck, cv)
+        from langstream_tpu.ops.attention import (
+            paged_pallas_ok,
+            ragged_paged_decode_attention,
+            ragged_paged_decode_attention_int8,
+        )
+
+        if s == 1 and paged_pallas_ok(config, page_size):
+            lengths = cache_positions[:, 0] + 1
+            interpret = jax.default_backend() != "tpu"
+            if isinstance(ck, dict):
+                out = ragged_paged_decode_attention_int8(
+                    q[:, 0], ck, cv, lengths, paged_table, config, page_size,
+                    interpret=interpret,
+                )
+            else:
+                out = ragged_paged_decode_attention(
+                    q[:, 0], ck, cv, lengths, paged_table, config, page_size,
+                    interpret=interpret,
+                )
+            attn = out[:, None, :]
+        else:
+            k_all = _paged_gather_entry(ck, paged_table, page_size)
+            v_all = _paged_gather_entry(cv, paged_table, page_size)
+            attn = attention(q, k_all, v_all, mask, config)
+        x = x + quantized_matmul(attn, lp["wo"])
+        ffn_in = rms_norm(x, lp["ffn_norm"], config.rms_norm_eps)
+        ffn_out = (
+            moe_ffn(ffn_in, lp, config)
+            if config.is_moe
+            else dense_ffn(ffn_in, lp, config)
+        )
+        return x + ffn_out, new_cache
     if cache_kv is not None:
         ck, cv = cache_kv  # [B, Hkv, T, D] head-major (maybe int8-quantized)
         # scatter this step's k/v into the cache at cache_positions [B, S]
@@ -545,7 +670,7 @@ def _scan_layers(
 
 def _scan_layers_inplace(
     params, x, sin, cos, mask, config, cache, cache_positions, kv_bound=None,
-    kv_offset=None, verify=False,
+    kv_offset=None, verify=False, paged_table=None, page_size=0,
 ):
     """Layer loop with the cache updated IN PLACE via a scan carry +
     dynamic-update-slice at the layer index, instead of consuming the cache
@@ -578,7 +703,8 @@ def _scan_layers_inplace(
         y, new_kv = _layer(
             x, lp, sin, cos, mask, config, cache_kv=(ck, cv),
             cache_positions=cache_positions, kv_offset=kv_offset,
-            kv_bound=kv_bound, verify=verify,
+            kv_bound=kv_bound, verify=verify, paged_table=paged_table,
+            page_size=page_size,
         )
         nck, ncv = new_kv
         cache = {"k": write(cache["k"], nck, l), "v": write(cache["v"], ncv, l)}
@@ -821,6 +947,131 @@ def verify_step_inplace(
         kv_offset=positions, verify=True,
     )
     return _unembed(params, x, config), cache
+
+
+# ---------------------------------------------------------------------------
+# Paged entry points — the bodies of the engine's ONE-program-each decode /
+# verify / segment dispatches (serving/engine.py paged mode). None of these
+# take a kv_bound: the page table already bounds what a slot can read (its
+# mapped pages), which is what deletes the pow2 compile ladder. Like the
+# *_inplace twins above, none are separately jitted.
+# ---------------------------------------------------------------------------
+
+
+def _paged_mask(table: jax.Array, page_size: int, positions: jax.Array):
+    """Causal mask over the gathered paged view: logical column t of slot b
+    is visible to query j iff t <= positions[b, j]. Columns backed by
+    unmapped (clamp-gathered garbage) pages always sit past the written
+    frontier, so the mask is also what makes the clamped gather safe."""
+    t = table.shape[1] * page_size
+    kv_pos = jnp.arange(t)[None, None, :]
+    return kv_pos <= positions[:, :, None]
+
+
+def paged_decode_step_inplace(
+    params: Params,
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    pool: KVCache,  # page pool [L, P, Hkv, ps, D]
+    table: jax.Array,  # [B, Tp] physical page per logical page
+    config: ModelConfig,
+    page_size: int,
+) -> tuple[jax.Array, KVCache]:
+    """decode_step through the page table: ONE compiled program for every
+    sequence-length mix (the dense path's (steps × kv_bound) ladder is
+    gone — a slot reads exactly its mapped pages)."""
+    pos2 = positions[:, None]
+    sin, cos = _rope_freqs(pos2, config)
+    mask = _paged_mask(table, page_size, pos2)
+    x = _embed(params, tokens[:, None], config)
+    x, pool = _scan_layers_inplace(
+        params, x, sin, cos, mask, config, cache=pool, cache_positions=pos2,
+        paged_table=table, page_size=page_size,
+    )
+    return _unembed(params, x, config)[:, 0], pool
+
+
+def paged_verify_step_inplace(
+    params: Params,
+    tokens: jax.Array,  # [B, K+1]
+    positions: jax.Array,  # [B] position of each row's FIRST token
+    pool: KVCache,
+    table: jax.Array,
+    config: ModelConfig,
+    page_size: int,
+) -> tuple[jax.Array, KVCache]:
+    """verify_step through the page table → logits [B, K+1, V]. Same
+    stale-rejected-rows invariant as the dense verify: positions advance
+    only past ACCEPTED tokens and the next dispatch overwrites the stale
+    page columns before any causal mask can reach them."""
+    b, s = tokens.shape
+    pos = positions[:, None] + jnp.arange(s)[None, :]
+    sin, cos = _rope_freqs(pos, config)
+    mask = _paged_mask(table, page_size, pos)
+    x = _embed(params, tokens, config)
+    x, pool = _scan_layers_inplace(
+        params, x, sin, cos, mask, config, cache=pool, cache_positions=pos,
+        verify=True, paged_table=table, page_size=page_size,
+    )
+    return _unembed(params, x, config), pool
+
+
+def paged_prefill_segment_inplace(
+    params: Params,
+    tokens: jax.Array,  # [B, W] one padded prompt segment per row
+    offsets: jax.Array,  # [B] global position of each row's segment start
+    seg_lengths: jax.Array,  # [B] true token count within the segment
+    pool: KVCache,
+    table: jax.Array,
+    config: ModelConfig,
+    page_size: int,
+) -> tuple[jax.Array, KVCache]:
+    """Chunked/suffix prefill straight into the slot's pages: K/V for the
+    segment scatter at global positions [offsets, offsets+W) and attention
+    reads the prefix THROUGH THE TABLE — which is what makes prefix reuse
+    zero-copy (aliased pages are simply visible; the dense path had to
+    gather them into a local cache first). offsets=0 with a fresh table is
+    a cold prefill. Returns logits at the last real token of the segment."""
+    b, s = tokens.shape
+    positions = offsets[:, None] + jnp.arange(s)[None, :]
+    sin, cos = _rope_freqs(positions, config)
+    mask = _paged_mask(table, page_size, positions)
+    x = _embed(params, tokens, config)
+    x, pool = _scan_layers_inplace(
+        params, x, sin, cos, mask, config, cache=pool,
+        cache_positions=positions, kv_offset=offsets,
+        paged_table=table, page_size=page_size,
+    )
+    last = jnp.clip(seg_lengths - 1, 0, s - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    logits = _unembed(params, x_last[:, None, :], config)[:, 0]
+    return logits, pool
+
+
+def paged_insert_cache(
+    pool: KVCache, local_cache: KVCache, tables: jax.Array, page_size: int
+) -> KVCache:
+    """Scatter a batched prefill's local cache ([L, n, Hkv, W, D], the
+    admit-group temporary) into each row's pages — the paged counterpart of
+    the dense big-cache insert. Positions are [0, W) per row; rows whose
+    table is all out-of-bounds (padding) drop every write."""
+    n = tables.shape[0]
+
+    def put(pl_entry, loc):
+        w = loc.shape[3]
+        positions = jnp.broadcast_to(jnp.arange(w)[None, :], (n, w))
+        pages, offs = _page_index(tables, positions, page_size, pl_entry.shape[1])
+        hkv = loc.shape[2]
+        pidx = pages[:, None, :]  # [n, 1, W]
+        oidx = offs[:, None, :]
+        hidx = jnp.arange(hkv)[None, :, None]
+        # leading ':' keeps the layer axis; advanced indices are adjacent so
+        # the scattered dims stay in place
+        return pl_entry.at[:, pidx, hidx, oidx].set(
+            loc.astype(pl_entry.dtype), mode="drop"
+        )
+
+    return jax.tree.map(put, pool, local_cache)
 
 
 # ---------------------------------------------------------------------------

@@ -745,6 +745,322 @@ def ragged_decode_attention_int8(
 
 
 # ---------------------------------------------------------------------------
+# Ragged PAGED decode: one query per row against a page-table-indexed KV
+# pool [P, Hkv, page_size, D] (arxiv 2502.10490 "Ragged Paged Attention" —
+# the paper's block layout: per-slot sequence lengths index pages through a
+# table, the last page clamps, (8,128) tiling on the (page_size, D) trailing
+# dims, model-dtype/int8 MXU dots with f32 accumulation). The grid is
+# (B, table_len) with every kv head inside the block — the same fat-block
+# shape that made the dense int8 ragged kernel competitive (r5: a per-head
+# grid had 8× the steps and lost) — and the index map DMAs exactly the
+# slot's mapped pages: block j loads physical page table[b, j], clamped to
+# the last valid page past the row's length so Pallas elides the HBM→VMEM
+# copy. HBM traffic therefore scales with CONTENT (sum of lengths), and no
+# kv_bound ladder is needed: the table IS the bound, one compiled program
+# for every sequence-length mix. The masked-jnp fallback (gather through
+# the table, then the stock attention math) lives in
+# models/transformer._paged_gather_entry and carries tier-1 exactness.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    lengths_ref,  # scalar-prefetch [B]
+    table_ref,  # scalar-prefetch [B * Tp] flattened page table
+    q_ref,  # [1, Hkv, G, D]
+    k_ref,  # [1, Hkv, ps, D] — ONE physical page, all kv heads
+    v_ref,  # [1, Hkv, ps, D]
+    o_ref,  # [1, Hkv, G, D]
+    m_scr,  # [Hkv, G, 128] f32
+    l_scr,  # [Hkv, G, 128] f32
+    acc_scr,  # [Hkv, G, D] f32
+    *,
+    page_size: int,
+    scale: float,
+    softcap,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)  # logical page index
+    nk = pl.num_programs(1)
+    length = lengths_ref[b]
+    k_start = j * page_size
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(k_start < length)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # [Hkv, G, D]
+        k = k_ref[0].astype(jnp.float32)  # [Hkv, ps, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hkv, G, ps]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        s = jnp.where(k_pos < length, s, _NEG)
+
+        m_prev = m_scr[:, :, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hkv, G, D]
+        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
+        m_scr[:, :, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _paged_kv_index(num_pages: int, page_size: int, table_len: int):
+    """Index map factory for page-pool blocks: grid step (b, j) loads the
+    physical page ``table[b, j]``, with j clamped to the row's last valid
+    logical page (re-referencing the same block elides the DMA — the ragged
+    bandwidth saving) and the physical index clamped in-range so an
+    unmapped sentinel entry (possible only on masked-out pages) reads SOME
+    page instead of faulting."""
+
+    def kv_index(b, j, lens, table):
+        last = jnp.maximum(pl.cdiv(lens[b], page_size) - 1, 0)
+        page = table[b * table_len + jnp.minimum(j, last)]
+        return (jnp.clip(page, 0, num_pages - 1), 0, 0, 0)
+
+    return kv_index
+
+
+def ragged_paged_decode_attention(
+    q: jax.Array,  # [B, H, D] single query per row
+    k: jax.Array,  # page pool entry [P, Hkv, ps, D]
+    v: jax.Array,
+    lengths: jax.Array,  # [B] valid logical columns per row
+    table: jax.Array,  # [B, Tp] physical page per logical page
+    config: ModelConfig,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA paged decode attention → [B, H*D]."""
+    b, h, d = q.shape
+    num_pages, hkv = k.shape[0], k.shape[1]
+    tp = table.shape[1]
+    group = h // hkv
+    qg = q.reshape(b, hkv, group, d)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        page_size=page_size,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+    kv_index = _paged_kv_index(num_pages, page_size, tp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, tp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, hkv, group, d), lambda b, j, lens, table: (b, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, hkv, page_size, d), kv_index),
+            pl.BlockSpec((1, hkv, page_size, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hkv, group, d), lambda b, j, lens, table: (b, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, group, 128), jnp.float32),
+            pltpu.VMEM((hkv, group, 128), jnp.float32),
+            pltpu.VMEM((hkv, group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        table.astype(jnp.int32).reshape(-1),
+        qg,
+        k,
+        v,
+    )
+    return out.reshape(b, h * d)
+
+
+def _paged_decode_int8_kernel(
+    lengths_ref,  # scalar-prefetch [B]
+    table_ref,  # scalar-prefetch [B * Tp]
+    q_ref,  # [1, Hkv, G, D]
+    kq_ref,  # [1, Hkv, ps, D] int8 — one physical page
+    ks_ref,  # [1, Hkv, ps, 1] f32 per-token scales
+    vq_ref,  # [1, Hkv, ps, D] int8
+    vs_ref,  # [1, Hkv, ps, 1] f32
+    o_ref,  # [1, Hkv, G, D]
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    page_size: int,
+    scale: float,
+    softcap,
+):
+    """_paged_decode_kernel over the int8 pool: pages read raw int8 from
+    HBM (+f32 scales), dequantized in VMEM — the same wire format as the
+    dense int8 ragged kernel, per page instead of per cache block."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = lengths_ref[b]
+    k_start = j * page_size
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(k_start < length)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = kq_ref[0].astype(jnp.float32) * ks_ref[0]  # [Hkv, ps, D]
+        v = vq_ref[0].astype(jnp.float32) * vs_ref[0]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        s = jnp.where(k_pos < length, s, _NEG)
+
+        m_prev = m_scr[:, :, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, :, 0] = l_scr[:, :, 0] * corr + p.sum(axis=-1)
+        pv = jax.lax.dot_general(
+            p,
+            v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scr[...] = acc_scr[...] * corr[:, :, None] + pv
+        m_scr[:, :, 0] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[:, :, None]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def ragged_paged_decode_attention_int8(
+    q: jax.Array,  # [B, H, D]
+    k: dict,  # int8 pool entry {"q": [P,Hkv,ps,D] i8, "s": [P,Hkv,ps] f32}
+    v: dict,
+    lengths: jax.Array,  # [B]
+    table: jax.Array,  # [B, Tp]
+    config: ModelConfig,
+    page_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """GQA paged decode attention over the int8 page pool → [B, H*D]."""
+    b, h, d = q.shape
+    num_pages, hkv = k["q"].shape[0], k["q"].shape[1]
+    tp = table.shape[1]
+    group = h // hkv
+    qg = q.reshape(b, hkv, group, d)
+
+    kernel = functools.partial(
+        _paged_decode_int8_kernel,
+        page_size=page_size,
+        scale=1.0 / (d**0.5),
+        softcap=config.attn_logit_softcap,
+    )
+    kv_index = _paged_kv_index(num_pages, page_size, tp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, tp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, hkv, group, d), lambda b, j, lens, table: (b, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, hkv, page_size, d), kv_index),
+            # trailing singleton: Mosaic wants the block's last two dims
+            # (8,128)-divisible or equal to the array's — [.., ps, 1]
+            pl.BlockSpec((1, hkv, page_size, 1), kv_index),
+            pl.BlockSpec((1, hkv, page_size, d), kv_index),
+            pl.BlockSpec((1, hkv, page_size, 1), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, hkv, group, d), lambda b, j, lens, table: (b, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, group, 128), jnp.float32),
+            pltpu.VMEM((hkv, group, 128), jnp.float32),
+            pltpu.VMEM((hkv, group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        interpret=interpret,
+    )(
+        lengths.astype(jnp.int32),
+        table.astype(jnp.int32).reshape(-1),
+        qg,
+        k["q"],
+        k["s"][..., None],
+        v["q"],
+        v["s"][..., None],
+    )
+    return out.reshape(b, h * d)
+
+
+def paged_pallas_ok(config: ModelConfig, page_size: int) -> bool:
+    """True when the ragged-paged decode kernel should carry the paged
+    decode read. ``attention_impl="pallas"`` forces it (interpret mode
+    off-TPU, for exactness tests); ``"auto"`` requires a real TPU plus the
+    Mosaic tiling constraints on the (page_size, D) block dims — off-TPU
+    the gathered masked-jnp view is both exact and faster. ``"jnp"``
+    disables it outright (the tier-1 reference path)."""
+    if config.attention_impl == "jnp":
+        return False
+    if config.ring_axis is not None:
+        return False
+    if config.attention_impl == "pallas":
+        return page_size % 8 == 0
+    return (
+        jax.default_backend() == "tpu"
+        and config.resolved_head_dim % 128 == 0
+        and page_size % 16 == 0
+    )
+
+
+# ---------------------------------------------------------------------------
 # Fused prefill+decode batch: one attention call whose rows mix S-token
 # prompt SEGMENTS (chunked prefill at a global offset) with single-token
 # decode queries against the same big KV cache (arxiv 2604.15464's ragged

@@ -18,6 +18,11 @@ from langstream_tpu.serving.engine import (
     ShedError,
 )
 from langstream_tpu.serving.faultinject import FaultInjector, InjectedFault
+from langstream_tpu.serving.pagepool import (
+    PagePool,
+    PrefixPageIndex,
+    pages_for_fraction,
+)
 
 __all__ = [
     "DeadlineExceededError",
@@ -27,8 +32,11 @@ __all__ = [
     "InjectedFault",
     "LogitsNaNError",
     "NGramIndex",
+    "PagePool",
+    "PrefixPageIndex",
     "ServingEngine",
     "ShedError",
+    "pages_for_fraction",
     "sample",
     "speculative_verify",
 ]

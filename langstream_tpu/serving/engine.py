@@ -33,6 +33,10 @@ from langstream_tpu.models.transformer import (
     cache_width,
     decode_step_inplace,
     make_kv_cache,
+    paged_decode_step_inplace,
+    paged_insert_cache,
+    paged_prefill_segment_inplace,
+    paged_verify_step_inplace,
     prefill,
     prefill_segment,
     verify_step_inplace,
@@ -310,6 +314,107 @@ def _prefill_segment_and_sample(
     return first, local_cache, key
 
 
+@functools.partial(
+    jax.jit, static_argnames=("steps", "config", "page_size"),
+    donate_argnames=("pool",),
+)
+def _paged_decode_chunk(
+    params, tokens, positions, pool, table, key, temp, top_k, top_p, steps,
+    config, page_size,
+):
+    """``steps`` fused decode+sample iterations against the PAGED pool in
+    ONE dispatch — the paged twin of ``_decode_chunk`` with the kv_bound
+    slice/splice dance deleted: each slot reads exactly its mapped pages,
+    so this is ONE compiled program for every sequence-length mix (the
+    (steps × pow2-bound) ladder collapses; ROADMAP item 1)."""
+
+    def body(carry, _):
+        tokens, positions, pool, key = carry
+        logits, pool = paged_decode_step_inplace(
+            params, tokens, positions, pool, table, config, page_size
+        )
+        key, sub = jax.random.split(key)
+        next_tokens = sample(logits, sub, temp, top_k, top_p)
+        return (next_tokens, positions + 1, pool, key), next_tokens
+
+    (tokens, positions, pool, key), chunk = lax.scan(
+        body, (tokens, positions, pool, key), None, length=steps
+    )
+    return chunk, tokens, positions, pool, key
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "page_size"), donate_argnames=("pool",)
+)
+def _paged_verify_chunk(
+    params, tokens, positions, pool, table, key, temp, top_k, top_p, drafts,
+    config, page_size,
+):
+    """ONE self-speculative verify iteration against the paged pool — the
+    paged twin of ``_verify_chunk``, and like the decode chunk a SINGLE
+    compiled program (no bound ladder). Same no-rewind invariant: positions
+    advance only past accepted tokens, stale draft page columns are
+    overwritten before any causal mask can reach them."""
+    inputs = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, k+1]
+    logits, pool = paged_verify_step_inplace(
+        params, inputs, positions, pool, table, config, page_size
+    )
+    key, sub = jax.random.split(key)
+    out, accept = speculative_verify(logits, drafts, sub, temp, top_k, top_p)
+    tokens = jnp.take_along_axis(out, accept[:, None], axis=1)[:, 0]
+    positions = positions + accept + 1
+    packed = jnp.concatenate([out, accept[:, None]], axis=1)  # [B, k+2]
+    return packed, tokens, positions, pool, key
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "page_size"), donate_argnames=("pool",)
+)
+def _paged_segment_and_sample(
+    params, tokens, offsets, seg_lengths, pool, table, key, temp, top_k, top_p,
+    config, page_size,
+):
+    """One chunked/suffix prefill segment straight into the slot's pages +
+    a sample of its last-token logits. Replaces the dense path's local
+    cache + final insert + (on warm admissions) the prefix gather: aliased
+    prefix pages are already visible through the table, so a warm admission
+    is ONE dispatch (plus at most one copy-on-write page copy)."""
+    logits, pool = paged_prefill_segment_inplace(
+        params, tokens, offsets, seg_lengths, pool, table, config, page_size
+    )
+    key, sub = jax.random.split(key)
+    first = sample(logits, sub, temp, top_k, top_p)
+    return first, pool, key
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def _page_copy(pool, src, dst):
+    """Copy ONE physical page (all layers/heads) — the copy-on-write a
+    prefix alias needs when the cached prefix ends mid-page. Traced
+    indices: one compiled program; an out-of-bounds ``dst`` drops (warmup).
+    Axis 1 is the page axis for both the value arrays and the int8 scale
+    arrays (page-pool layout [L, P, Hkv, ps(, D)])."""
+
+    def put(a):
+        row = lax.dynamic_index_in_dim(a, src, 1, keepdims=False)
+        return a.at[:, dst].set(row, mode="drop")
+
+    return jax.tree.map(put, pool)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def _page_zero(pool, pages):
+    """Zero physical pages (quarantine: a NaN-poisoned slot's pages must
+    not re-enter the free list carrying garbage that a later partial-page
+    publish could alias). ``pages`` is a fixed-width buffer padded with
+    out-of-bounds entries (dropped) — one compiled program for any count."""
+
+    def zero(a):
+        return a.at[:, pages].set(jnp.zeros((), a.dtype), mode="drop")
+
+    return jax.tree.map(zero, pool)
+
+
 def _make_admit_group(mesh):
     """Factory for the FUSED admission step: local-cache zeros + prefill +
     first-token sample + big-cache insert + every decode-chain scatter in
@@ -369,6 +474,47 @@ def _make_admit_group(mesh):
         top_k_dev = top_k_dev.at[slots].set(top_ks, mode="drop")
         top_p_dev = top_p_dev.at[slots].set(top_ps, mode="drop")
         return first, cache, tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev, key
+
+    return admit_group
+
+
+def _make_paged_admit_group():
+    """Factory for the paged FUSED admission step: local-cache zeros +
+    batched prefill + first-token sample + PAGE scatter + every decode-chain
+    scatter in ONE dispatch. The prefill math is byte-identical to the dense
+    admit group (same local-cache forward — the token-exactness invariant);
+    only the insert differs: rows scatter into each slot's mapped pages
+    instead of big-cache rows. Padding rows carry all-out-of-bounds tables,
+    so their writes drop exactly like the dense path's OOB slots."""
+    @functools.partial(
+        jax.jit,
+        static_argnames=("config", "page_size"),
+        donate_argnames=(
+            "pool", "tokens_dev", "positions_dev", "temp_dev",
+            "top_k_dev", "top_p_dev",
+        ),
+    )
+    def admit_group(
+        params, pool, tokens_dev, positions_dev, temp_dev, top_k_dev,
+        top_p_dev, key, tokens, meta, slots, tables, config, page_size,
+    ):
+        # tokens [P, W] int32; meta [4, P] f32; tables [P, Tp] int32
+        lengths = meta[0].astype(jnp.int32)
+        temps = meta[1]
+        top_ks = meta[2].astype(jnp.int32)
+        top_ps = meta[3]
+        n, width = tokens.shape
+        local_cache = make_kv_cache(config, n, width)  # traced zeros: free
+        logits, local_cache = prefill(params, tokens, lengths, local_cache, config)
+        key, sub = jax.random.split(key)
+        first = sample(logits, sub, temps, top_ks, top_ps)
+        pool = paged_insert_cache(pool, local_cache, tables, page_size)
+        tokens_dev = tokens_dev.at[slots].set(first, mode="drop")
+        positions_dev = positions_dev.at[slots].set(lengths, mode="drop")
+        temp_dev = temp_dev.at[slots].set(temps, mode="drop")
+        top_k_dev = top_k_dev.at[slots].set(top_ks, mode="drop")
+        top_p_dev = top_p_dev.at[slots].set(top_ps, mode="drop")
+        return first, pool, tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev, key
 
     return admit_group
 
@@ -568,6 +714,9 @@ class ServingEngine:
         overlap: bool = True,
         prefill_token_budget: Optional[int] = None,
         max_prefill_streams: Optional[int] = None,
+        kv_layout: str = "paged",
+        page_size: int = 64,
+        kv_pages: Optional[int] = None,
         prefix_cache: Any = False,
         prefix_cache_fraction: float = 0.25,
         prefix_cache_entries: Optional[int] = None,
@@ -612,13 +761,45 @@ class ServingEngine:
             )
         self.shed_policy = shed_policy
         self._slots = [_Slot() for _ in range(max_batch)]
-        self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
-        if mesh is not None:
-            from langstream_tpu.parallel.sharding import shard_serving_cache
+        # KV memory layout (ROADMAP item 1): "paged" (default) = ONE
+        # page-table-indexed device pool for decode, prefill, verify and
+        # prefix reuse — no kv_bound compile ladder, prefix hits alias
+        # pages zero-copy. "dense" = the per-slot big cache, kept one
+        # release as the escape hatch (and the only layout the SPMD
+        # follower wire and the sharded-mesh specs speak today — both fall
+        # back with a warning rather than diverge).
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(
+                f"unknown kv_layout {kv_layout!r}; supported: paged, dense"
+            )
+        if kv_layout == "paged" and (spmd is not None or mesh is not None):
+            log.warning(
+                "kv-layout=paged is not supported on %s yet; falling back "
+                "to the dense layout",
+                "multi-host SPMD replicas" if spmd is not None else "sharded meshes",
+            )
+            kv_layout = "dense"
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        self.page_size = max(1, int(page_size))
+        self._pagepool = None
+        self._prefix_index = None
+        self._cache = None
+        # deferred admissions: popped from the queue but waiting for pool
+        # pages (allocator exhaustion defers — it never corrupts); retried
+        # ahead of the queue every iteration, swept like the queue
+        self._page_deferred: list[GenerationRequest] = []
+        # physical pages to zero on the next iteration (quarantine)
+        self._pending_page_zero: list[int] = []
+        if not self._paged:
+            self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
+            if mesh is not None:
+                from langstream_tpu.parallel.sharding import shard_serving_cache
 
-            self._cache = shard_serving_cache(self._cache, mesh)
+                self._cache = shard_serving_cache(self._cache, mesh)
         self._insert_group = _make_insert_group()
         self._admit_group = _make_admit_group(mesh)
+        self._paged_admit_group = _make_paged_admit_group()
         # ring long-prefill: mesh spans a "seq" axis → long prompts run as
         # ONE sequence-sharded dispatch instead of the segment loop. On a
         # multi-host replica the leader streams the prompt to followers in
@@ -772,7 +953,7 @@ class ServingEngine:
         self.spec_draft_hits_total = 0
         self._prefix_pool = None
         pool_entries, pool_width = 0, 0
-        if enabled:
+        if enabled and not self._paged:
             from langstream_tpu.serving.prefix_cache import (
                 pool_entries_for_fraction,
             )
@@ -788,6 +969,32 @@ class ServingEngine:
                     prefix_cache_fraction,
                 )
             )
+        # paged pool sizing: dense-parity token capacity + the prefix-cache
+        # fraction as ALIAS headroom (shared pages pinned by the prefix
+        # index). prefix_cache_entries caps the INDEX (0 disables reuse);
+        # the pages themselves live in the one pool either way.
+        self._page_fraction = (
+            prefix_cache_fraction if (enabled and self._paged) else 0.0
+        )
+        self._kv_pages = 0
+        prefix_index_entries = 0
+        if self._paged:
+            from langstream_tpu.serving.pagepool import pages_for_fraction
+
+            self._kv_pages = (
+                int(kv_pages)
+                if kv_pages is not None
+                else pages_for_fraction(
+                    max_batch, self.max_seq_len, self.page_size,
+                    self._page_fraction,
+                )
+            )
+            if enabled:
+                prefix_index_entries = (
+                    int(prefix_cache_entries)
+                    if prefix_cache_entries is not None
+                    else 512
+                )
             # the device pool itself is allocated AFTER the memory plan
             # below has logged its arithmetic — an over-committed pool
             # then OOMs with the plan's numbers already on record instead
@@ -906,6 +1113,10 @@ class ServingEngine:
                 prefix_pool_entries=pool_entries,
                 prefix_pool_width=pool_width,
                 speculation_tokens=self.spec_tokens,
+                kv_layout=self.kv_layout,
+                page_size=self.page_size,
+                kv_pages=self._kv_pages,
+                page_fraction=self._page_fraction,
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -923,6 +1134,20 @@ class ServingEngine:
                 config, pool_entries, pool_width,
                 boundaries=self.prefill_buckets, mesh=mesh,
             )
+        if self._paged:
+            from langstream_tpu.serving.pagepool import PagePool, PrefixPageIndex
+
+            # allocated AFTER the memory plan logged its arithmetic, like
+            # the dense prefix pool: an over-committed pool OOMs with the
+            # numbers on record
+            self._pagepool = PagePool(
+                config, self._kv_pages, self.page_size, max_batch,
+                self.max_seq_len,
+            )
+            if prefix_index_entries > 0:
+                self._prefix_index = PrefixPageIndex(
+                    self.prefill_buckets, max_entries=prefix_index_entries
+                )
 
     # -- public API ---------------------------------------------------------
 
@@ -970,6 +1195,7 @@ class ServingEngine:
             and self._queue.qsize() == 0
             and not self._longs
             and not self._long_queue
+            and not self._page_deferred
             and self._held_back is None
         )
 
@@ -1075,23 +1301,58 @@ class ServingEngine:
             "compiled_programs": len(self._programs),
             "decode-step-ms": round(self._step_time_ema_s * 1e3, 3),
             "hbm-gbps-decode": self._achieved_hbm_gbps(),
+            # unified paged KV pool (zeros under the dense escape hatch, so
+            # the metrics exporter sets its gauges unconditionally)
+            "kv-layout": self.kv_layout,
+            "page-size": self.page_size if self._paged else 0,
+            "kv-pages-total": (
+                self._pagepool.num_pages if self._pagepool else 0
+            ),
+            "kv-pages-in-use": (
+                self._pagepool.pages_in_use if self._pagepool else 0
+            ),
+            "kv-page-alias-rate": (
+                round(
+                    self._pagepool.aliased_pages_total
+                    / max(1, self._pagepool.reserved_pages_total),
+                    4,
+                )
+                if self._pagepool
+                else 0.0
+            ),
+            "prefix-copy-bytes-saved-total": (
+                self._prefix_index.copy_bytes_saved if self._prefix_index else 0
+            ),
             # prefix KV reuse (zeros with the cache off, so the metrics
-            # exporter can set its gauges unconditionally)
-            "prefix-cache": self._prefix_pool is not None,
+            # exporter can set its gauges unconditionally); sourced from the
+            # dense pool or the paged alias index, whichever is live
+            "prefix-cache": (
+                self._prefix_pool is not None or self._prefix_index is not None
+            ),
             "prefix-cache-hit-rate": (
-                self._prefix_pool.hit_rate() if self._prefix_pool else 0.0
+                self._prefix_pool.hit_rate()
+                if self._prefix_pool
+                else self._prefix_index.hit_rate() if self._prefix_index else 0.0
             ),
             "prefill-tokens-saved-total": (
-                self._prefix_pool.tokens_saved if self._prefix_pool else 0
+                self._prefix_pool.tokens_saved
+                if self._prefix_pool
+                else self._prefix_index.tokens_saved if self._prefix_index else 0
             ),
             "prefix-pool-bytes-in-use": (
-                self._prefix_pool.bytes_in_use() if self._prefix_pool else 0
+                self._prefix_pool.bytes_in_use()
+                if self._prefix_pool
+                else self._prefix_index_bytes()
             ),
             "prefix-cache-evictions-total": (
-                self._prefix_pool.evictions if self._prefix_pool else 0
+                self._prefix_pool.evictions
+                if self._prefix_pool
+                else self._prefix_index.evictions if self._prefix_index else 0
             ),
             "prefix-cache-entries": (
-                self._prefix_pool.live_entries if self._prefix_pool else 0
+                self._prefix_pool.live_entries
+                if self._prefix_pool
+                else self._prefix_index.live_entries if self._prefix_index else 0
             ),
             # self-speculative decoding (zeros with speculation off, so the
             # metrics exporter sets its gauges unconditionally)
@@ -1144,6 +1405,15 @@ class ServingEngine:
             ),
         }
 
+    def _prefix_index_bytes(self) -> int:
+        """HBM held by pages the paged alias index references (distinct —
+        deeper entries share their shallower prefixes' pages). pages_held
+        is a counter the ENGINE thread maintains, so reading it from the
+        metrics thread never races a _live mutation."""
+        if self._prefix_index is None or self._pagepool is None:
+            return 0
+        return self._prefix_index.pages_held * self._pagepool.bytes_per_page
+
     def _achieved_hbm_gbps(self) -> float:
         """Bytes-read model per decode step (weights + the kv_bound-sliced
         cache columns, from the memory plan) over the measured step time —
@@ -1159,6 +1429,20 @@ class ServingEngine:
         live metric)."""
         if self._plan is None or self._step_time_ema_s <= 0:
             return 0.0
+        if self._paged:
+            # pages actually READ per step: each active slot streams the
+            # pages covering its written prefix — content-proportional,
+            # which is the paged layout's whole bandwidth story
+            pages_read = sum(
+                -(-(s.position + 1) // self.page_size)
+                for s in self._slots
+                if s.active
+            )
+            read = (
+                self._plan.weights_bytes
+                + self._pagepool.bytes_per_page * pages_read
+            )
+            return round(read / self._step_time_ema_s / 1e9, 2)
         bound = min(self._last_kv_bound or self.max_seq_len, self.max_seq_len)
         weights = self._plan.weights_bytes
         cache = self._plan.cache_bytes * bound // max(1, self.max_seq_len)
@@ -1266,6 +1550,55 @@ class ServingEngine:
         log.info(
             "verify ladder precompiled: bounds %s, k %d",
             bounds, self.spec_tokens,
+        )
+
+    def _warmup_paged(self) -> None:
+        """Precompile the PAGED program surface before the first request:
+        ONE decode (or verify) program — the ladder the dense layout warmed
+        rung by rung no longer exists — plus the batch-1 segment family
+        (warm suffixes + long-prompt chunks, one per bucket width), the
+        copy-on-write page copy, and the quarantine page-zero. Every
+        throwaway dispatch runs against all-out-of-bounds tables/indices:
+        writes drop, reads clamp into masked columns, so engine state is
+        untouched except the PRNG key (which advances before any request is
+        served, like the bucket warmup). The admission (paged-prefill)
+        family is warmed by _warmup_prefill_buckets as usual."""
+        if self._spec_enabled:
+            drafts = np.zeros((self.max_batch, self.spec_tokens), np.int32)
+            self._dev_verify(drafts, [self.max_batch], 0).block_until_ready()
+        else:
+            self._dev_decode(
+                self.decode_chunk, [self.max_batch], None
+            ).block_until_ready()
+            floor = min(self.ttft_chunk_floor, self.decode_chunk)
+            if floor != self.decode_chunk and not self.overlap:
+                # the TTFT-shrunk chunk is its own (steps,) program, but
+                # only the legacy (overlap off) scheduler dispatches it
+                self._dev_decode(floor, [], None).block_until_ready()
+        for ws in self.prefill_buckets:
+            if self._stop.is_set():
+                return
+            first = self._dev_paged_segment(
+                np.zeros((1, ws), np.int32), 0, 1, self.max_batch,
+                0.0, 0, 1.0, final=False, prompt_len=1,
+            )
+            jax.block_until_ready(first)
+        pool = self._pagepool
+        self._record_program("page-copy")
+        pool.dev = _page_copy(
+            pool.dev, jnp.asarray(0, jnp.int32), jnp.asarray(pool.oob, jnp.int32)
+        )
+        self._record_program("page-zero")
+        pool.dev = _page_zero(
+            pool.dev, jnp.asarray(np.full(pool.table_len, pool.oob, np.int32))
+        )
+        jax.block_until_ready(jax.tree.leaves(pool.dev)[0])
+        log.info(
+            "paged programs precompiled: ONE %s program (chunk %d), %d "
+            "segment widths, page-copy, page-zero — no kv_bound ladder",
+            "verify" if self._spec_enabled else "decode",
+            self.spec_tokens + 1 if self._spec_enabled else self.decode_chunk,
+            len(self.prefill_buckets),
         )
 
     def _warmup_prefill_buckets(self) -> None:
@@ -1495,7 +1828,11 @@ class ServingEngine:
         if self._precompile and warm:
             # restarts skip the warmups: every program is already in the jit
             # cache (shapes are unchanged), and recovery latency is the point
-            if self._spec_enabled:
+            if self._paged:
+                # the whole point of the paged layout: the decode-phase
+                # surface is ONE program (per step count), not a ladder
+                self._warmup_paged()
+            elif self._spec_enabled:
                 # a speculative engine dispatches the verify ladder instead
                 # of decode chunks — warming both would double startup time
                 # for programs it can never run
@@ -1557,11 +1894,23 @@ class ServingEngine:
         self._step_time_ema_s = 0.0
         self._last_chunk_ready_t = 0.0
         # fresh device state (same shapes → no recompiles on restart)
-        self._cache = make_kv_cache(self.config, self.max_batch, self.max_seq_len)
-        if self.mesh is not None:
-            from langstream_tpu.parallel.sharding import shard_serving_cache
+        if self._paged:
+            # pool buffer is donation-suspect like the dense cache; the
+            # allocator and every table reset with it (the in-flight slots
+            # whose pages they tracked were just failed above). Queued and
+            # page-deferred admissions keep their backlog spots.
+            self._pending_page_zero.clear()
+            self._pagepool.reset()
+            if self._prefix_index is not None:
+                self._prefix_index.reset()
+        else:
+            self._cache = make_kv_cache(
+                self.config, self.max_batch, self.max_seq_len
+            )
+            if self.mesh is not None:
+                from langstream_tpu.parallel.sharding import shard_serving_cache
 
-            self._cache = shard_serving_cache(self._cache, self.mesh)
+                self._cache = shard_serving_cache(self._cache, self.mesh)
         self._tokens_dev = jnp.zeros(self.max_batch, jnp.int32)
         self._positions_dev = jnp.zeros(self.max_batch, jnp.int32)
         self._temp_dev = jnp.zeros(self.max_batch, jnp.float32)
@@ -1584,6 +1933,8 @@ class ServingEngine:
         (the engine thread just loops this)."""
         if self._pending_row_resets:
             self._flush_row_resets()
+        if self._pending_page_zero:
+            self._flush_page_zeros()
         self._sweep_waiting()
         # chunks dispatched in previous iterations are still unfetched when
         # this iteration's dispatch computes its headroom bound — subtract
@@ -1687,9 +2038,14 @@ class ServingEngine:
             if request._done.is_set() or self._resolve_if_dead(request, now):
                 with self._waiting_lock:
                     self._waiting.pop(id(request), None)
-        # the long-prompt backlog + held-back slot are engine-thread-only
+        # the long-prompt backlog, page-deferred list + held-back slot are
+        # engine-thread-only
         self._long_queue = [
             r for r in self._long_queue
+            if not (r._done.is_set() or self._resolve_if_dead(r, now))
+        ]
+        self._page_deferred = [
+            r for r in self._page_deferred
             if not (r._done.is_set() or self._resolve_if_dead(r, now))
         ]
         if self._held_back is not None and (
@@ -1875,6 +2231,10 @@ class ServingEngine:
         pairs: list[tuple[int, GenerationRequest]] = []
         admitted_tokens = 0
         short_limit = self.prefill_buckets[-1]
+        # page exhaustion gate, sampled ONCE per iteration: while deferred
+        # admissions wait for pool pages, only they retry — the queue keeps
+        # its entries (and its submit()-side backpressure/shedding)
+        allow_new = not (self._paged and self._page_deferred)
         # a held-back long request gets first claim on freed backlog space
         if (
             self._held_back is not None
@@ -1898,7 +2258,7 @@ class ServingEngine:
                 ):
                     break
                 try:
-                    request = self._queue.get_nowait()
+                    request = self._pop_admission(allow_new)
                 except queue.Empty:
                     break
                 with self._waiting_lock:
@@ -1924,9 +2284,19 @@ class ServingEngine:
         if not pairs:
             return []
         entries: list[tuple] = []
-        # prefix reuse: peel off requests whose longest cached prefix can be
-        # extended in place (gather + suffix-only segment prefill); the rest
-        # take the batched cold admission below
+        # paged: reserve every admission's worst-case pages up front (defer
+        # on exhaustion — never corrupt) and peel prefix-ALIAS hits off to
+        # their one-dispatch warm path; the rest take the batched cold
+        # admission below with their pages already bound
+        if self._paged:
+            cold_paged: list[tuple[int, GenerationRequest]] = []
+            for idx, request in pairs:
+                if self._paged_admit_one(idx, request, entries) == "cold":
+                    cold_paged.append((idx, request))
+            pairs = cold_paged
+        # prefix reuse (dense): peel off requests whose longest cached prefix
+        # can be extended in place (gather + suffix-only segment prefill);
+        # the rest take the batched cold admission below
         if self._prefix_pool is not None:
             cold: list[tuple[int, GenerationRequest]] = []
             for idx, request in pairs:
@@ -1956,7 +2326,9 @@ class ServingEngine:
                         # Crash the replica; the pods restart together.
                         raise
                     log.exception("prefill failed for a batch of %d requests", len(sub))
-                    for _, request in sub:
+                    for idx, request in sub:
+                        if self._paged:
+                            self._pagepool.free_slot(idx)  # reserved at admit
                         request._finish(GenerationResult(
                             tokens=[], finish_reason="error", prompt_tokens=0,
                             ttft_s=0, total_s=0, error=e,
@@ -2029,6 +2401,10 @@ class ServingEngine:
             self._injector.fire("prefill")  # before any state mutates
         n = len(tokens)
         assert all(len(a) == n for a in (lengths, temps, top_ks, top_ps, slots))
+        if self._paged:
+            return self._dev_paged_prefill(
+                tokens, lengths, temps, top_ks, top_ps, slots
+            )
         self._record_program("prefill", tokens.shape[1], n)
         # pack the per-row scalars into one upload (per-op tunnel latency)
         meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
@@ -2054,6 +2430,47 @@ class ServingEngine:
             jnp.asarray(meta),
             jnp.asarray(slots),
             self.config,
+        )
+        return first
+
+    def _dev_paged_prefill(self, tokens, lengths, temps, top_ks, top_ps, slots):
+        """Paged device layer of a batched cold prefill: the SAME fused
+        local-cache forward as the dense admit group (token-exactness), but
+        the insert scatters into each row's reserved pages. Rows whose slot
+        is out of bounds (padding, warmups) carry an all-sentinel table —
+        every write drops."""
+        pool = self._pagepool
+        n = len(tokens)
+        tables = np.full((n, pool.table_len), pool.oob, np.int32)
+        for j, s in enumerate(slots):
+            if 0 <= s < self.max_batch:
+                tables[j] = pool.tables[s]
+        self._record_program("paged-prefill", tokens.shape[1], n)
+        meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
+        (
+            first,
+            pool.dev,
+            self._tokens_dev,
+            self._positions_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            self._key,
+        ) = self._paged_admit_group(
+            self.params,
+            pool.dev,
+            self._tokens_dev,
+            self._positions_dev,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            self._key,
+            jnp.asarray(tokens),
+            jnp.asarray(meta),
+            jnp.asarray(slots),
+            jnp.asarray(tables),
+            self.config,
+            self.page_size,
         )
         return first
 
@@ -2186,6 +2603,267 @@ class ServingEngine:
         )
         return first
 
+    # -- paged admission / prefix aliasing -----------------------------------
+
+    def _pop_admission(self, allow_new: bool = True) -> GenerationRequest:
+        """Admission source for _admit: page-deferred requests (popped
+        earlier, waiting for pool pages) retry ahead of the queue so
+        allocator pressure never reorders them behind newer arrivals.
+        ``allow_new=False`` (set while deferred admissions wait) stops
+        draining the queue — the deferred list must stay bounded so the
+        bounded queue keeps backpressuring submit() during exhaustion
+        instead of silently absorbing the backlog host-side."""
+        if self._page_deferred:
+            return self._page_deferred.pop(0)
+        if not allow_new:
+            raise queue.Empty
+        return self._queue.get_nowait()
+
+    def _paged_bind(self, idx: int, request: GenerationRequest) -> Optional[int]:
+        """Reserve slot ``idx``'s worst-case pages, aliasing the deepest
+        cached prefix when the index has one: full prefix pages join the
+        table by refcount bump (ZERO copies), a mid-page prefix tail gets
+        one copy-on-write page copy. Under pool pressure the LRU unpinned
+        prefix entries make room first. Returns the reuse offset (0 = cold
+        miss) or None — slot untouched — when the pool cannot cover the
+        reservation (the caller defers; exhaustion sheds upstream, it never
+        corrupts). Shared by the short-admission and long-prompt paths so
+        the alias/COW/eviction rules cannot drift between them."""
+        pool, index = self._pagepool, self._prefix_index
+        prompt = request.prompt_tokens
+        need = pool.pages_needed(len(prompt), request.options.max_new_tokens)
+        if need > pool.num_pages:
+            # only reachable with an explicit kv-pages override below the
+            # per-slot worst case: deferring would hang forever, so fail
+            # loudly with the sizing arithmetic
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error",
+                prompt_tokens=len(prompt), ttft_s=0, total_s=0,
+                error=ShedError(
+                    f"request needs {need} KV pages but the pool has only "
+                    f"{pool.num_pages}; raise kv-pages (or lower "
+                    "max-new-tokens)"
+                ),
+            ))
+            return -1  # handled — nothing reserved
+        hit = None
+        if index is not None:
+            for cand in index.candidates(prompt):
+                hit = cand  # ascending: the deepest usable prefix wins
+        shared: tuple[int, ...] = ()
+        cow_src = None
+        p, entry = 0, None
+        if hit is not None:
+            p, entry = hit
+            full = p // self.page_size
+            shared = tuple(entry.pages[:full])
+            if p % self.page_size:
+                cow_src = entry.pages[full]
+            index.acquire(entry)  # pinned: eviction below must not free it
+        try:
+            want_fresh = need - len(shared)
+            if pool.free_pages < want_fresh and index is not None:
+                index.evict_for(pool, want_fresh)
+            cow_dst = pool.reserve(idx, need, shared)
+            if cow_dst is None:
+                return None
+            if index is not None:
+                index.record_lookup(entry)
+            if entry is None:
+                return 0
+            if cow_src is not None:
+                self._record_program("page-copy")
+                pool.dev = _page_copy(
+                    pool.dev,
+                    jnp.asarray(cow_src, jnp.int32),
+                    jnp.asarray(cow_dst, jnp.int32),
+                )
+            index.tokens_saved += p
+            token_bytes = pool.bytes_per_page / self.page_size
+            saved = int(p * token_bytes) - (
+                pool.bytes_per_page if cow_src is not None else 0
+            )
+            index.copy_bytes_saved += max(saved, 0)
+            return p
+        finally:
+            if entry is not None:
+                index.release(entry)
+
+    def _paged_admit_one(self, idx: int, request: GenerationRequest,
+                         entries: list) -> str:
+        """Reserve pages and route one short admission in paged mode.
+        Returns "cold" (pages bound — join the batched group prefill),
+        "warm" (prefix alias hit — dispatched here, fetch entry appended),
+        or "deferred" (pool exhausted even after LRU prefix eviction — the
+        request waits host-side; nothing was corrupted, nothing leaked)."""
+        base = self._paged_bind(idx, request)
+        if base is None:
+            self._page_deferred.append(request)
+            return "deferred"
+        if base < 0:
+            return "failed"  # can-never-fit: _paged_bind resolved it
+        if base == 0:
+            return "cold"
+        self._paged_prefill_prefix(idx, request, base, entries)
+        return "warm"
+
+    def _paged_prefill_prefix(
+        self, idx: int, request: GenerationRequest, p: int, entries: list,
+    ) -> None:
+        """Warm paged admission: the aliased pages are ALREADY in the slot's
+        table (_paged_bind), so all that runs on device is ONE fused
+        suffix-segment dispatch. Compare the dense warm path: pool-width
+        gather + segment + insert + chain scatter — four dispatches and a
+        pool-width row duplicated per hit."""
+        pool = self._pagepool
+        prompt = request.prompt_tokens
+        suffix = prompt[p:]
+        ws = self._bucket(len(suffix))
+        tokens = np.zeros((1, ws), np.int32)
+        tokens[0, : len(suffix)] = suffix
+        opts = request.options
+        started = time.monotonic()
+        try:
+            first = self._dev_paged_segment(
+                tokens, p, len(suffix), idx,
+                opts.temperature, opts.top_k, opts.top_p,
+                final=True, prompt_len=len(prompt),
+            )
+        except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            log.exception("paged prefix-reuse prefill failed (p=%d)", p)
+            pool.free_slot(idx)
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=e,
+            ))
+            return
+        slot = self._slots[idx]
+        slot.request = request
+        slot.position = len(prompt)
+        slot.generated = []
+        slot.started_at = started
+        slot.first_token_at = 0.0
+        self.total_requests += 1
+        self._spec_admit(idx, prompt)
+        self._maybe_publish(idx, prompt)
+        entries.append(("prefill", self._fetcher.submit(first), [(idx, request)]))
+
+    def _dev_paged_segment(
+        self, tokens, s0, seg_len, idx, temperature, top_k, top_p,
+        *, final: bool, prompt_len: int,
+    ):
+        """Device layer of one paged prefill segment (warm suffix OR one
+        chunk of a long prompt): K/V scatter straight into the slot's
+        pages, attention reads the prefix through the table. On ``final``
+        the decode chain scatters — there is no insert/splice: the pages
+        ARE the cache."""
+        if self._injector is not None:
+            self._injector.fire("segment")
+        pool = self._pagepool
+        table = np.full((1, pool.table_len), pool.oob, np.int32)
+        if 0 <= idx < self.max_batch:
+            table[0] = pool.tables[idx]
+        self._record_program("paged-segment", tokens.shape[1])
+        first, pool.dev, self._key = _paged_segment_and_sample(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray([s0], jnp.int32),
+            jnp.asarray([seg_len], jnp.int32),
+            pool.dev,
+            jnp.asarray(table),
+            self._key,
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+            self.config,
+            self.page_size,
+        )
+        if final:
+            self._record_program("chain-scatter")
+            (
+                self._tokens_dev, self._positions_dev, self._temp_dev,
+                self._top_k_dev, self._top_p_dev,
+            ) = _chain_scatter(
+                self._tokens_dev, self._positions_dev, self._temp_dev,
+                self._top_k_dev, self._top_p_dev,
+                jnp.asarray(idx, jnp.int32), first, prompt_len,
+                temperature, top_k, top_p,
+            )
+        return first
+
+    def _dispatch_tables(self) -> np.ndarray:
+        """Page tables for a decode/verify dispatch, with every non-ACTIVE
+        slot's row masked to the out-of-bounds sentinel. A decode step
+        computes (garbage) K/V for inactive rows too; on the dense layout
+        those writes landed in the inactive slot's own cache row
+        (harmless), but a paged table row may belong to a RESERVED
+        long-prefill stream whose pages are mid-prefill — an unmasked
+        dispatch would scribble stale-position garbage straight into them.
+        Masked rows drop their writes and read clamped (masked) garbage,
+        exactly like the warmup dispatches."""
+        pool = self._pagepool
+        tables = pool.tables.copy()
+        inactive = [i for i, s in enumerate(self._slots) if not s.active]
+        if inactive:
+            tables[inactive] = pool.oob
+        return tables
+
+    def _page_integrity_check(self) -> None:
+        """Validate every active slot's table row against the allocator's
+        authoritative owned-page list before a decode/verify dispatch; a
+        mismatch (the ``page`` fault site, host memory corruption, or a
+        real bookkeeping bug) quarantines ONLY that slot — its request
+        fails, its pages free through the owned list (no leak) and are
+        zeroed — while every other slot keeps decoding untouched."""
+        pool = self._pagepool
+        if self._injector is not None:
+            snapshot = [
+                (i, s.request) for i, s in enumerate(self._slots) if s.active
+            ]
+            self._injector.corrupt_page_table(pool, snapshot)
+        for i, slot in enumerate(self._slots):
+            if not slot.active or pool.validate(i):
+                continue
+            self.quarantined_slots_total += 1
+            self._quarantine_pages(i)
+            self._finish_slot(
+                i, "error",
+                error=RuntimeError(
+                    f"page-table corruption detected for slot {i}; slot "
+                    "quarantined, pages freed and zeroed"
+                ),
+            )
+
+    def _quarantine_pages(self, idx: int) -> None:
+        """Paged quarantine: evict any prefix entry sharing the victim's
+        pages (poisoned KV must not be aliased into future admissions),
+        free the slot's pages through the authoritative owned list, and
+        queue the now-unreferenced ones for a coalesced zero dispatch
+        (pages, not rows — ROADMAP item 1)."""
+        pool = self._pagepool
+        pages = pool.slot_pages(idx)
+        if not pages:
+            return
+        if self._prefix_index is not None:
+            self._prefix_index.evict_touching(pool, pages)
+        self._pending_page_zero.extend(pool.free_slot(idx))
+
+    def _flush_page_zeros(self) -> None:
+        """Zero quarantined pages, coalesced into table_len-wide dispatches
+        (ONE compiled program; out-of-bounds padding drops). Runs at the top
+        of the iteration, so the zero rides the in-order stream ahead of
+        any admission that re-allocates the freed pages."""
+        pool = self._pagepool
+        pages = self._pending_page_zero
+        self._pending_page_zero = []
+        width = pool.table_len
+        for i in range(0, len(pages), width):
+            buf = np.full(width, pool.oob, np.int32)
+            chunk = pages[i : i + width]
+            buf[: len(chunk)] = chunk
+            self._record_program("page-zero")
+            pool.dev = _page_zero(pool.dev, jnp.asarray(buf))
+
     def _spec_admit(self, idx: int, prompt: list[int]) -> None:
         """Create the slot's draft index at admission, seeded with the
         prompt (prompt-lookup: the prompt is where repeated spans live).
@@ -2209,7 +2887,28 @@ class ServingEngine:
         (p ≤ len(prompt)) written by prefill — never generated-region rows,
         where a verify chunk may have written past the ACCEPTED length and
         left stale rejected-draft K/V. Accepted-length, not written-length,
-        is the only boundary the pool may ever see."""
+        is the only boundary the pool may ever see.
+
+        Paged layout: publish is pure HOST bookkeeping — the slot's leading
+        pages join the index with a refcount bump, no device copy at all
+        (the dense path's copy-on-publish gather is gone)."""
+        if self._paged:
+            index = self._prefix_index
+            if index is None:
+                return
+            p = index.publish_length(len(prompt))
+            if p <= 0 or index.has(prompt, p):
+                return
+            import math as _math
+
+            pool = self._pagepool
+            n = _math.ceil(p / self.page_size)
+            owned = pool.slot_pages(idx)
+            if len(owned) < n:
+                return  # reservation narrower than the boundary (can't
+                # happen for a prompt that reached p; guard anyway)
+            index.insert(pool, prompt, p, tuple(owned[:n]))
+            return
         pool = self._prefix_pool
         if pool is None:
             return
@@ -2342,10 +3041,27 @@ class ServingEngine:
             request = self._long_queue.pop(0)
             if not self._prequalify(request):
                 continue  # resolved in the long backlog
-            # prefix reuse for long prompts: a cached FULL-segment-width
-            # prefix lets chunked prefill start at the reuse point (the
-            # segment grid stays aligned). A hit prefers the segment loop
-            # over the ring path — skipping a whole segment of prefill
+            if self._paged:
+                # paged: reserve the whole prompt's pages up front, aliasing
+                # ANY cached prefix boundary (segments write at global
+                # offsets, so no full-segment-width alignment constraint —
+                # the dense path's local-cache grid is gone). Exhaustion
+                # defers the stream; the request keeps its backlog spot.
+                base = self._paged_bind(free, request)
+                if base is None:
+                    self._long_queue.insert(0, request)
+                    break
+                if base < 0:
+                    continue  # can-never-fit: _paged_bind resolved it
+                self._reserved.add(free)
+                self._longs[free] = {
+                    "idx": free, "request": request, "seg": 0, "base": base,
+                }
+                continue
+            # prefix reuse for long prompts (dense): a cached FULL-segment-
+            # width prefix lets chunked prefill start at the reuse point
+            # (the segment grid stays aligned). A hit prefers the segment
+            # loop over the ring path — skipping a whole segment of prefill
             # saves more than the ring's single-dispatch latency win.
             prefix = None
             if self._prefix_pool is not None:
@@ -2403,6 +3119,8 @@ class ServingEngine:
             entry = st.pop("prefix", None)
             if entry is not None and self._prefix_pool is not None:
                 self._prefix_pool.release(entry)
+            if self._paged:
+                self._pagepool.free_slot(idx)
             self._reserved.discard(idx)
             self._longs.pop(idx, None)
             self._long_caches.pop(idx, None)
@@ -2454,18 +3172,30 @@ class ServingEngine:
             ))
         prefix_entry = st.pop("prefix", None)  # only present on start
         try:
-            first = self._dev_long_segment(
-                tokens, s0, len(seg), kv_bound, t_long,
-                opts.temperature, opts.top_k, opts.top_p,
-                start=start, final=final, idx=idx, prompt_len=len(prompt),
-                prefix_row=(
-                    prefix_entry.row if prefix_entry is not None else None
-                ),
-            )
+            if self._paged:
+                # straight into the slot's pages: no local cache, no final
+                # insert/splice — the chain scatter on ``final`` is the only
+                # extra dispatch, and kv_bound/t_long do not exist here
+                first = self._dev_paged_segment(
+                    tokens, s0, len(seg), idx,
+                    opts.temperature, opts.top_k, opts.top_p,
+                    final=final, prompt_len=len(prompt),
+                )
+            else:
+                first = self._dev_long_segment(
+                    tokens, s0, len(seg), kv_bound, t_long,
+                    opts.temperature, opts.top_k, opts.top_p,
+                    start=start, final=final, idx=idx, prompt_len=len(prompt),
+                    prefix_row=(
+                        prefix_entry.row if prefix_entry is not None else None
+                    ),
+                )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
             if self._spmd is not None:
                 raise  # multi-host: crash the replica (see _admit rationale)
             log.exception("chunked prefill failed at segment %d", st["seg"])
+            if self._paged:
+                self._pagepool.free_slot(idx)
             self._reserved.discard(idx)
             self._longs.pop(idx, None)
             self._long_caches.pop(idx, None)
@@ -2693,9 +3423,15 @@ class ServingEngine:
         # shrunk (non-full) chunks run UNBOUNDED: pairing the occasional
         # short chunk with the kv_bound ladder would multiply the compiled-
         # program count (steps × bounds); a few full-width steps cost ~10ms
-        # extra read, a novel program costs a ~15-20s compile stall
+        # extra read, a novel program costs a ~15-20s compile stall.
+        # Paged layout: no bound at all — the page table is the bound, and
+        # the decode surface is ONE program per step count.
         kv_bound = (
-            self._decode_kv_bound(steps) if steps == self.decode_chunk else None
+            None
+            if self._paged
+            else self._decode_kv_bound(steps)
+            if steps == self.decode_chunk
+            else None
         )
         stale = self._collect_stale()
         if self._spmd is not None:
@@ -2768,6 +3504,33 @@ class ServingEngine:
         """Device layer of one decode chunk (leader + SPMD followers)."""
         if self._injector is not None:
             self._injector.fire("decode")  # crashes the loop → restart path
+        if self._paged:
+            self._page_integrity_check()
+            self._record_program("paged-decode", steps)
+            if len(stale):
+                self._reset_stale_temps(stale)
+            pool = self._pagepool
+            (
+                chunk,
+                self._tokens_dev,
+                self._positions_dev,
+                pool.dev,
+                self._key,
+            ) = _paged_decode_chunk(
+                self.params,
+                self._tokens_dev,
+                self._positions_dev,
+                pool.dev,
+                jnp.asarray(self._dispatch_tables()),
+                self._key,
+                self._temp_dev,
+                self._top_k_dev,
+                self._top_p_dev,
+                steps,
+                self.config,
+                self.page_size,
+            )
+            return chunk
         self._record_program("decode", steps, kv_bound or 0)
         if len(stale):
             self._reset_stale_temps(stale)
@@ -2797,7 +3560,7 @@ class ServingEngine:
         accept test compares against the model's own outputs, so a bad or
         empty draft can never change what is emitted)."""
         k = self.spec_tokens
-        kv_bound = self._decode_kv_bound(k + 1)
+        kv_bound = 0 if self._paged else self._decode_kv_bound(k + 1)
         stale = self._collect_stale()
         drafts = np.zeros((self.max_batch, k), np.int32)
         proposed = np.zeros(self.max_batch, np.int32)
@@ -2834,6 +3597,33 @@ class ServingEngine:
         it can target ONE slot)."""
         if self._injector is not None:
             self._injector.fire("decode")
+        if self._paged:
+            self._page_integrity_check()
+            self._record_program("paged-verify", drafts.shape[1])
+            if len(stale):
+                self._reset_stale_temps(stale)
+            pool = self._pagepool
+            (
+                packed,
+                self._tokens_dev,
+                self._positions_dev,
+                pool.dev,
+                self._key,
+            ) = _paged_verify_chunk(
+                self.params,
+                self._tokens_dev,
+                self._positions_dev,
+                pool.dev,
+                jnp.asarray(self._dispatch_tables()),
+                self._key,
+                self._temp_dev,
+                self._top_k_dev,
+                self._top_p_dev,
+                jnp.asarray(drafts),
+                self.config,
+                self.page_size,
+            )
+            return packed
         self._record_program("verify", drafts.shape[1], kv_bound or 0)
         if len(stale):
             self._reset_stale_temps(stale)
@@ -2947,7 +3737,12 @@ class ServingEngine:
                     f"non-finite logits for slot {idx} on an SPMD replica"
                 )
             self.quarantined_slots_total += 1
-            self._pending_row_resets.append(idx)
+            if self._paged:
+                # pages, not rows: evict prefix entries sharing the slot's
+                # pages, free them through the owned list, zero next flush
+                self._quarantine_pages(idx)
+            else:
+                self._pending_row_resets.append(idx)
             self._finish_slot(
                 idx, "error",
                 error=LogitsNaNError(
@@ -3025,6 +3820,10 @@ class ServingEngine:
         slot.position = 0
         self._spec_index.pop(idx, None)
         self._freed_slots.append(idx)
+        if self._paged:
+            # slot reset = free its table (shared pages survive through the
+            # prefix index's refcounts; exclusive ones return to the pool)
+            self._pagepool.free_slot(idx)
 
     def _fail_all(self, error: BaseException) -> None:
         self._dead = error
@@ -3050,6 +3849,12 @@ class ServingEngine:
                 ttft_s=0, total_s=0, error=error,
             ))
         self._long_queue.clear()
+        for request in self._page_deferred:
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error", prompt_tokens=0,
+                ttft_s=0, total_s=0, error=error,
+            ))
+        self._page_deferred.clear()
         self._reserved.clear()
         self._spec_index.clear()
         for slot in self._slots:

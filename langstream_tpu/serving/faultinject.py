@@ -17,6 +17,10 @@ Sites (where the engine asks ``fires(site)``):
   verify    corrupt one active slot's fetched VERIFY result (self-
             speculative decoding) to the sentinel with accept forced to 0
             — a fault during verification must quarantine only that slot
+  page      corrupt one active slot's page-table entry (paged KV layout:
+            host bookkeeping / memory corruption drill) — the engine's
+            integrity check must quarantine ONLY that slot and free its
+            pages back to the pool through the authoritative owned list
   fetch     stall the device→host fetch thread (slow-tunnel simulation)
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
@@ -49,7 +53,7 @@ from typing import Optional
 
 log = logging.getLogger(__name__)
 
-SITES = ("prefill", "segment", "decode", "nan", "verify", "fetch", "client")
+SITES = ("prefill", "segment", "decode", "nan", "verify", "page", "fetch", "client")
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;
 # the injector writes the same value into fetched tokens so the engine's
@@ -209,6 +213,21 @@ class FaultInjector:
         packed[victim, 0] = NAN_SENTINEL  # first emitted token → sentinel
         packed[victim, -1] = 0  # accept 0 → the sentinel is delivered first
         return packed
+
+    def corrupt_page_table(self, pool, snapshot):
+        """``page`` site: scramble one active slot's page-table entry in
+        the HOST table array (the device-facing derivation), leaving the
+        allocator's authoritative owned list intact — exactly the class of
+        bug/corruption the engine's pre-dispatch integrity check exists to
+        catch. Victim drawn from the seeded RNG over the active snapshot;
+        returns the victim slot or None."""
+        if not snapshot or not self.fires("page"):
+            return None
+        with self._lock:
+            victim = snapshot[self._rng.randrange(len(snapshot))][0]
+            # point the slot's first mapped entry somewhere else entirely
+            pool.tables[victim, 0] = (pool.tables[victim, 0] + 1) % pool.num_pages
+        return victim
 
     def stats(self) -> dict[str, int]:
         return dict(self.fired)

@@ -65,6 +65,14 @@ class ServingMemoryPlan:
     # largest bucket width, resident for the engine's whole lifetime. Sized
     # by the `prefix-cache-fraction` knob; 0 when the cache is off.
     prefix_pool_bytes: int = 0
+    # unified paged KV pool (serving/pagepool.py, kv_layout="paged"): ONE
+    # [L, P, Hkv, page_size, D] device pool replaces the decode cache, the
+    # prefix pool, the kv_bound slice/splice peak AND the chunked-prefill
+    # local caches (paged segments write straight into the slot's pages) —
+    # when this term is set, cache/bound_slice/long_cache/prefix_pool are 0.
+    # Sized by pages_for_fraction: dense-parity token capacity plus the
+    # prefix-cache-fraction alias headroom.
+    page_pool_bytes: int = 0
     # self-speculative verify chunk (engine._verify_chunk): the multi-token
     # forward materializes fp32 logits for ALL k+1 positions of every slot
     # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
@@ -88,6 +96,7 @@ class ServingMemoryPlan:
             + self.bound_slice_bytes
             + self.fused_prefill_bytes
             + self.prefix_pool_bytes
+            + self.page_pool_bytes
             + self.verify_chunk_bytes
         )
 
@@ -96,6 +105,16 @@ class ServingMemoryPlan:
 
     def summary(self) -> str:
         gib = 1024**3
+        if self.page_pool_bytes:
+            return (
+                f"weights {self.weights_bytes / gib:.2f}GiB + "
+                f"page-pool {self.page_pool_bytes / gib:.2f}GiB "
+                f"(+{self.scan_buffer_bytes / gib:.2f}GiB layer slices) + "
+                f"fused-prefill {self.fused_prefill_bytes / gib:.2f}GiB + "
+                f"verify-chunk {self.verify_chunk_bytes / gib:.2f}GiB + "
+                f"workspace {self.workspace_bytes / gib:.2f}GiB = "
+                f"{self.total_bytes / gib:.2f}GiB"
+            )
         return (
             f"weights {self.weights_bytes / gib:.2f}GiB + "
             f"cache {self.cache_bytes / gib:.2f}GiB "
@@ -137,6 +156,10 @@ def plan_serving_memory(
     prefix_pool_entries: int = 0,
     prefix_pool_width: int = 0,
     speculation_tokens: int = 0,
+    kv_layout: str = "dense",
+    page_size: int = 64,
+    kv_pages: int = 0,
+    page_fraction: float = 0.0,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -157,9 +180,60 @@ def plan_serving_memory(
     ``workspace_bytes``: flat allowance for activations, XLA scratch, and
     the collectives' staging buffers — 1GiB is empirically comfortable for
     8B-class decode at B≤96.
+    ``kv_layout``: "paged" swaps the dense cache + kv_bound slice +
+    long-prefill + prefix-pool terms for ONE page-pool term
+    (serving/pagepool.py): ``kv_pages`` pages of ``page_size`` tokens, or
+    ``pages_for_fraction(max_batch, max_seq_len, page_size,
+    page_fraction)`` when kv_pages is 0.
     """
     from langstream_tpu.models.quant import init_random_quantized_params
     from langstream_tpu.models.transformer import init_params, make_kv_cache
+
+    paged = kv_layout == "paged"
+    if paged:
+        from langstream_tpu.models.transformer import make_page_pool
+        from langstream_tpu.serving.pagepool import pages_for_fraction
+
+        num_pages = kv_pages or pages_for_fraction(
+            max_batch, max_seq_len, page_size, page_fraction
+        )
+        pool_shape = jax.eval_shape(
+            lambda: make_page_pool(config, num_pages, page_size)
+        )
+        pool_bytes = _tree_bytes(pool_shape)
+        fused_shape = (
+            jax.eval_shape(
+                lambda: make_kv_cache(
+                    config, prefill_batch, min(prefill_bucket, max_seq_len)
+                )
+            )
+            if prefill_batch > 0 and prefill_bucket > 0
+            else None
+        )
+        key = jax.ShapeDtypeStruct((2,), jax.numpy.uint32)
+        if quantized_weights:
+            params_shape = jax.eval_shape(
+                lambda k: init_random_quantized_params(config, k), key
+            )
+        else:
+            params_shape = jax.eval_shape(lambda k: init_params(config, k), key)
+        return ServingMemoryPlan(
+            weights_bytes=_tree_bytes(params_shape),
+            cache_bytes=0,
+            long_cache_bytes=0,  # paged segments write straight into pages
+            workspace_bytes=workspace_bytes,
+            # 2 layer slices (read + updated copy) live inside the step scan
+            scan_buffer_bytes=2 * pool_bytes // max(config.n_layers, 1),
+            bound_slice_bytes=0,  # the table IS the bound — no slice/splice
+            fused_prefill_bytes=_tree_bytes(fused_shape) if fused_shape else 0,
+            prefix_pool_bytes=0,  # aliasing shares the one pool
+            page_pool_bytes=pool_bytes,
+            verify_chunk_bytes=(
+                5 * max_batch * (speculation_tokens + 1) * config.vocab_size * 4
+                if speculation_tokens > 0
+                else 0
+            ),
+        )
 
     key = jax.ShapeDtypeStruct((2,), jax.numpy.uint32)
     if quantized_weights:

@@ -1,0 +1,458 @@
+"""Unified paged KV pool: ONE device-resident page pool + host allocator.
+
+ROADMAP item 1 collapses the engine's three KV memory schemes — per-slot
+dense caches sized by the ``kv_bound`` compile ladder, the bucket-aligned
+prefix pool with copy-on-admit gathers, and the opt-in ragged paged decode
+kernel — into a single page-table-indexed pool (PAPERS.md "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for TPU").
+This module is the HOST half:
+
+- ``PagePool``: the device tree (``models.transformer.make_page_pool`` —
+  ``[L, P, Hkv, page_size, D]``, bf16 or int8+scales) plus a free-list page
+  allocator with refcounts and per-slot page tables. A slot's table row
+  maps logical page ``t // page_size`` to a physical page; unmapped entries
+  carry the out-of-bounds sentinel (= num_pages) so device scatters drop
+  and gathers clamp into the masked region.
+- ``PrefixPageIndex``: the radix index that turns prefix reuse into page
+  ALIASING — a hit appends the shared pages to the slot's table (refcount
+  bump, zero device copies; only a final PARTIAL page is copy-on-write,
+  one page-sized dispatch) and publish-on-prefill just bumps refcounts.
+  Compare ``serving/prefix_cache.py``: the dense design needed a separate
+  pool-width device pool, a gather per hit, and a row copy per publish.
+
+Eviction and exhaustion: prefix entries are evicted LRU (unpinned only)
+when an admission cannot allocate; if the pool is STILL exhausted the
+admission defers (the engine retries next iteration and the bounded queue
+sheds upstream) — pages are never over-committed, so exhaustion can shed
+but can never corrupt. All methods run on the engine thread — no locking.
+
+The injector's ``page`` fault site corrupts a table row (host memory /
+bookkeeping corruption drill); ``_owned`` is the AUTHORITATIVE per-slot
+page list kept apart from the table array, so ``validate`` detects the
+corruption and ``free_slot`` still returns every page to the free list —
+the no-leak property the chaos suite asserts.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def table_len_for(max_seq_len: int, page_size: int) -> int:
+    """Per-slot worst-case page-table length: enough logical pages to map
+    every position a slot can ever write (the memory-plan term)."""
+    return max(1, math.ceil(max_seq_len / page_size))
+
+
+def pages_for_fraction(
+    max_batch: int, max_seq_len: int, page_size: int, fraction: float = 0.0,
+) -> int:
+    """Pool size in pages: the dense cache's token capacity (max_batch ×
+    max_seq_len — every slot can still reach max_seq_len, dense parity) plus
+    ``fraction`` headroom for refcount-pinned shared prefix pages. This is
+    the ``prefix-cache-fraction`` knob's migration target: the fraction no
+    longer sizes a SEPARATE pool-width pool, it adds alias headroom to the
+    one pool (docs/SERVING.md §11)."""
+    base = max_batch * table_len_for(max_seq_len, page_size)
+    extra = math.ceil(base * fraction) if fraction > 0 else 0
+    return base + extra
+
+
+class PagePool:
+    """Device page pool + free-list allocator + per-slot page tables."""
+
+    def __init__(
+        self,
+        config: Any,
+        num_pages: int,
+        page_size: int,
+        max_batch: int,
+        max_seq_len: int,
+    ) -> None:
+        from langstream_tpu.models.transformer import make_page_pool
+
+        if num_pages < 1 or page_size < 1:
+            raise ValueError("page pool needs >= 1 page of >= 1 token")
+        self.config = config
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_batch = int(max_batch)
+        self.table_len = table_len_for(max_seq_len, page_size)
+        self.oob = self.num_pages  # sentinel: scatters drop, gathers clamp
+        self.dev = make_page_pool(config, self.num_pages, self.page_size)
+        self.bytes_total = sum(
+            leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(self.dev)
+        )
+        self.bytes_per_page = self.bytes_total // self.num_pages
+        self.tables = np.full(
+            (self.max_batch, self.table_len), self.oob, np.int32
+        )
+        self._refs = np.zeros(self.num_pages, np.int64)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        # authoritative per-slot page lists, logical order — the table array
+        # above is the DEVICE-facing derivation; integrity checks compare
+        # the two and page frees always go through this
+        self._owned: dict[int, list[int]] = {}
+        # cumulative reservation accounting: the alias-rate gauge is the
+        # fraction of reserved pages satisfied by aliasing instead of fresh
+        # allocation (live refcounts read 0 the moment a burst drains)
+        self.reserved_pages_total = 0
+        self.aliased_pages_total = 0
+
+    # -- sizing ---------------------------------------------------------------
+
+    def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case pages a request can write: positions [0, prompt +
+        max_new), capped by the table (the host stops delivering at the
+        cache end anyway). Reserved IN FULL at admission, so decode and
+        verify dispatches never allocate — exhaustion can only defer an
+        admission, never corrupt an in-flight slot."""
+        tokens = min(prompt_len + max(1, max_new_tokens),
+                     self.table_len * self.page_size)
+        return min(self.table_len, math.ceil(tokens / self.page_size))
+
+    # -- allocator ------------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        return int(np.count_nonzero(self._refs > 1))
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            assert self._refs[p] > 0, p  # aliasing a free page is a bug
+            self._refs[p] += 1
+
+    def decref(self, pages) -> list[int]:
+        """Drop one reference per page; pages reaching zero return to the
+        free list. Returns the freed pages (quarantine zeroes them)."""
+        freed = []
+        for p in pages:
+            assert self._refs[p] > 0, p
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    def _alloc(self, n: int) -> Optional[list[int]]:
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    # -- slot binding ---------------------------------------------------------
+
+    def reserve(
+        self, slot: int, n_pages: int, shared: tuple[int, ...] = (),
+    ) -> Optional[int]:
+        """Bind slot ``slot``'s table: ``shared`` aliased pages first
+        (refcount bump — the zero-copy prefix hit), then freshly allocated
+        pages up to ``n_pages`` total. Returns the first allocated page
+        (the copy-on-write destination when the aliased prefix ends
+        mid-page) or None — with the slot untouched — when the pool cannot
+        cover the allocation."""
+        assert slot not in self._owned, slot
+        assert n_pages <= self.table_len
+        want = n_pages - len(shared)
+        assert want >= 0, (n_pages, len(shared))
+        fresh = self._alloc(want)
+        if fresh is None:
+            return None
+        self.reserved_pages_total += n_pages
+        self.aliased_pages_total += len(shared)
+        self.incref(shared)
+        owned = list(shared) + fresh
+        self._owned[slot] = owned
+        self.tables[slot, : len(owned)] = owned
+        self.tables[slot, len(owned):] = self.oob
+        return fresh[0] if fresh else -1
+
+    def slot_pages(self, slot: int) -> list[int]:
+        return list(self._owned.get(slot, ()))
+
+    def free_slot(self, slot: int) -> list[int]:
+        """Release the slot's pages (via the authoritative owned list, so a
+        corrupted table row can never leak pages) and clear its table row.
+        Returns the pages whose refcount hit zero."""
+        owned = self._owned.pop(slot, None)
+        self.tables[slot, :] = self.oob
+        if not owned:
+            return []
+        return self.decref(owned)
+
+    def validate(self, slot: int) -> bool:
+        """Table-row integrity: the device-facing row must equal the
+        authoritative owned list (+ sentinel padding). A mismatch means the
+        table was corrupted (the ``page`` fault site, or a real bookkeeping
+        bug) — dispatching it would read/write someone else's pages."""
+        owned = self._owned.get(slot, ())
+        row = self.tables[slot]
+        n = len(owned)
+        return bool(
+            np.array_equal(row[:n], np.asarray(owned, np.int32))
+            and np.all(row[n:] == self.oob)
+        )
+
+    def reset(self) -> None:
+        """Crash recovery: rebuild the device pool and forget every binding
+        (the engine fails the in-flight slots; prefix entries are reset by
+        their index)."""
+        from langstream_tpu.models.transformer import make_page_pool
+
+        self.dev = make_page_pool(self.config, self.num_pages, self.page_size)
+        self.tables[:] = self.oob
+        self._refs[:] = 0
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._owned.clear()
+
+
+# -- prefix alias index -------------------------------------------------------
+
+
+class _Node:
+    """Radix-trie node, one level per bucket boundary (the same shape as
+    serving/prefix_cache.py's trie — kept separate because the payload is a
+    page list, not a pool row)."""
+
+    __slots__ = ("parent", "edge", "children", "entry")
+
+    def __init__(self, parent: Optional["_Node"] = None, edge: tuple = ()):
+        self.parent = parent
+        self.edge = edge
+        self.children: dict[tuple, _Node] = {}
+        self.entry: Optional[PrefixPages] = None
+
+
+@dataclass
+class PrefixPages:
+    """One cached prefix: ``length`` tokens whose KV lives in ``pages``
+    (refcounted in the pool; the LAST page is partial when length % ps).
+    ``pins`` guards in-flight admissions reading the entry."""
+
+    pages: tuple[int, ...]
+    length: int
+    pins: int = 0
+    last_used: int = 0
+    node: Any = field(default=None, repr=False)
+
+
+class PrefixPageIndex:
+    """Radix-indexed prefix → pages map. Aliasing semantics that keep reuse
+    EXACT: prefix KV is a pure function of the prefix tokens, and a page
+    fully covered by a published prefix is never rewritten by its publisher
+    (positions only grow), so an aliased page always equals what a fresh
+    prefill would have written. The final partial page IS still written by
+    the publisher (its own later tokens) — readers therefore COPY that one
+    page (copy-on-write) and overwrite its tail with their own suffix; the
+    columns below the published length are stable by the same
+    positions-only-grow argument."""
+
+    def __init__(self, boundaries: tuple[int, ...], max_entries: int = 512):
+        self.boundaries = tuple(sorted({int(b) for b in boundaries if b > 0}))
+        if not self.boundaries or max_entries < 1:
+            raise ValueError("prefix index needs >= 1 boundary and >= 1 entry")
+        self.max_entries = int(max_entries)
+        self._root = _Node()
+        self._live: list[PrefixPages] = []
+        # distinct pages referenced by live entries (page → entry count):
+        # maintained on the engine thread so the bytes-in-use gauge is one
+        # len() read — stats() runs on metrics threads, which must never
+        # iterate _live mid-mutation
+        self._page_holds: dict[int, int] = {}
+        self._tick = 0
+        # stats (cumulative since engine start)
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_saved = 0
+        self.evictions = 0
+        self.copy_bytes_saved = 0
+
+    # -- trie (mirrors prefix_cache.PrefixCachePool) --------------------------
+
+    def _walk(self, tokens, limit: int, create: bool = False) -> list[_Node]:
+        path: list[_Node] = []
+        node, prev = self._root, 0
+        for b in self.boundaries:
+            if b > limit:
+                break
+            seg = tuple(tokens[prev:b])
+            child = node.children.get(seg)
+            if child is None:
+                if not create:
+                    break
+                child = _Node(parent=node, edge=seg)
+                node.children[seg] = child
+            path.append(child)
+            node, prev = child, b
+        return path
+
+    @staticmethod
+    def _subtree_entry(node: _Node) -> Optional[PrefixPages]:
+        stack = list(node.children.values())
+        while stack:
+            n = stack.pop()
+            if n.entry is not None:
+                return n.entry
+            stack.extend(n.children.values())
+        return None
+
+    def candidates(self, tokens) -> list[tuple[int, PrefixPages]]:
+        """Usable ``(reuse_length, entry)`` pairs, ascending by length; at
+        least one suffix token must remain to prefill. A deeper entry's
+        leading pages serve a shorter boundary too (same prefix KV)."""
+        out: list[tuple[int, PrefixPages]] = []
+        path = self._walk(tokens, limit=len(tokens) - 1)
+        depth = 0
+        for node, b in zip(path, self.boundaries):
+            if node.entry is not None:
+                out.append((b, node.entry))
+            depth = b
+        if path and (not out or out[-1][0] < depth):
+            sub = self._subtree_entry(path[-1])
+            if sub is not None:
+                out.append((depth, sub))
+        return out
+
+    def record_lookup(self, used: Optional[PrefixPages]) -> None:
+        self.lookups += 1
+        if used is not None:
+            self.hits += 1
+            self._tick += 1
+            used.last_used = self._tick
+
+    def has(self, tokens, length: int) -> bool:
+        path = self._walk(tokens, limit=length)
+        return bool(path) and path[-1].entry is not None and (
+            path[-1].entry.length == length
+        )
+
+    def publish_length(self, prompt_len: int) -> int:
+        best = 0
+        for b in self.boundaries:
+            if b <= prompt_len:
+                best = b
+        return best
+
+    # -- entries --------------------------------------------------------------
+
+    def acquire(self, entry: PrefixPages) -> None:
+        entry.pins += 1
+
+    def release(self, entry: PrefixPages) -> None:
+        assert entry.pins > 0
+        entry.pins -= 1
+
+    def insert(
+        self, pool: PagePool, tokens, length: int, pages: tuple[int, ...],
+    ) -> Optional[PrefixPages]:
+        """Publish ``tokens[:length]`` as an alias of ``pages`` (the
+        publishing slot's leading table entries): refcount bump only, no
+        device copy. Over the entry cap, the LRU unpinned entry makes room
+        (or the publish is skipped — never blocks)."""
+        assert length in self.boundaries, (length, self.boundaries)
+        if len(self._live) >= self.max_entries:
+            if not self.evict_lru(pool):
+                return None
+        pool.incref(pages)
+        node = self._walk(tokens, limit=length, create=True)[-1]
+        self._tick += 1
+        entry = PrefixPages(
+            pages=tuple(pages), length=length, last_used=self._tick, node=node
+        )
+        if node.entry is not None:
+            # re-publish of the same prefix raced an eviction: keep newest
+            self._drop(pool, node.entry)
+        node.entry = entry
+        self._live.append(entry)
+        for p in entry.pages:
+            self._page_holds[p] = self._page_holds.get(p, 0) + 1
+        return entry
+
+    def _drop(self, pool: PagePool, entry: PrefixPages) -> None:
+        node = entry.node
+        if node.entry is entry:
+            node.entry = None
+            while (
+                node is not None
+                and node.parent is not None
+                and node.entry is None
+                and not node.children
+            ):
+                parent = node.parent
+                del parent.children[node.edge]
+                node = parent
+        self._live.remove(entry)
+        for p in entry.pages:
+            left = self._page_holds.get(p, 0) - 1
+            if left > 0:
+                self._page_holds[p] = left
+            else:
+                self._page_holds.pop(p, None)
+        pool.decref(entry.pages)
+
+    def evict_lru(self, pool: PagePool) -> bool:
+        """Evict the least-recently-used UNPINNED entry. False when every
+        entry is pinned by an in-flight admission."""
+        victims = [e for e in self._live if e.pins == 0]
+        if not victims:
+            return False
+        self._drop(pool, min(victims, key=lambda e: e.last_used))
+        self.evictions += 1
+        return True
+
+    def evict_for(self, pool: PagePool, need_pages: int) -> bool:
+        """Free pool pages by evicting LRU entries until ``need_pages`` fit
+        (or nothing evictable remains). Eviction only helps when it drops a
+        page's LAST reference, so progress is re-checked per eviction."""
+        while pool.free_pages < need_pages:
+            if not self.evict_lru(pool):
+                return False
+        return True
+
+    def evict_touching(self, pool: PagePool, pages) -> int:
+        """Evict every entry referencing any of ``pages`` — the quarantine
+        path: a poisoned slot's published prefixes must not outlive it."""
+        touched = set(pages)
+        victims = [e for e in self._live if touched.intersection(e.pages)]
+        for e in victims:
+            self._drop(pool, e)
+            self.evictions += 1
+        return len(victims)
+
+    def reset(self) -> None:
+        """Crash recovery (the pool itself was rebuilt — page refs are gone
+        with it, so entries just vanish; counters are cumulative)."""
+        self._root = _Node()
+        self._live = []
+        self._page_holds = {}
+        self._tick = 0
+
+    # -- stats ----------------------------------------------------------------
+
+    @property
+    def live_entries(self) -> int:
+        return len(self._live)
+
+    @property
+    def pages_held(self) -> int:
+        """Distinct pages live entries reference — a single len() read, safe
+        from the metrics thread (GIL-atomic snapshot of a size)."""
+        return len(self._page_holds)
+
+    def hit_rate(self) -> float:
+        return round(self.hits / self.lookups, 4) if self.lookups else 0.0

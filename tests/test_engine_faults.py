@@ -400,7 +400,10 @@ def test_deadline_in_long_prompt_backlog_resolves_promptly():
 
 
 def test_deadline_mid_decode_returns_partial_tokens():
-    engine = make_engine(max_batch=1, max_seq_len=1024)
+    # max_seq 4096: the deadline must fire MID-decode, and the paged layout
+    # (no kv_bound slice/splice per chunk) decodes a 1024-wide cache to its
+    # end in under the 1s deadline on CPU — reason "length" instead
+    engine = make_engine(max_batch=1, max_seq_len=4096)
     try:
         # warm the compile caches first, else the first-dispatch compile
         # (~2s on CPU) eats the whole deadline before any token lands

@@ -82,6 +82,9 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_prefill_tokens_saved_total",
         "engine_prefix_pool_bytes_in_use",
         "engine_prefix_cache_evictions_total",
+        "engine_kv_pages_in_use",
+        "engine_kv_page_alias_rate",
+        "engine_prefix_copy_bytes_saved_total",
         "engine_spec_acceptance_rate",
         "engine_spec_accepted_tokens_per_step",
         "engine_spec_draft_hit_rate",

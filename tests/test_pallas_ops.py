@@ -250,3 +250,63 @@ def test_fused_segment_decode_batch_matches_both_references():
         np.testing.assert_allclose(
             np.asarray(dec_ref), np.asarray(dec_out), rtol=1e-5, atol=1e-5
         )
+
+
+def test_ragged_paged_decode_matches_gathered_reference():
+    """The ragged-paged decode kernel (interpret mode) must match the
+    gathered masked-jnp view bit-for-bit-ish: same pages, same logical
+    order, same mask — the kernel only changes WHERE the read happens."""
+    from langstream_tpu.models.transformer import _paged_gather_entry
+    from langstream_tpu.ops.attention import ragged_paged_decode_attention
+
+    b, h, hkv, d, ps, pages, tp = 3, 8, 4, 8, 8, 16, 4
+    q = rand(0, b, h, d)
+    k = rand(1, pages, hkv, ps, d)
+    v = rand(2, pages, hkv, ps, d)
+    # ragged tables: unmapped entries carry the OOB sentinel (= pages)
+    table = jnp.asarray(
+        np.array(
+            [[3, 1, pages, pages], [0, 2, 5, pages], [7, pages, pages, pages]],
+            np.int32,
+        )
+    )
+    lengths = jnp.asarray([13, 26, 5], jnp.int32)
+    k_all = _paged_gather_entry(k, table, ps)
+    v_all = _paged_gather_entry(v, table, ps)
+    mask = jnp.arange(tp * ps)[None, None, :] < lengths[:, None, None]
+    ref = attention(q[:, None], k_all, v_all, mask, CFG)[:, 0]
+    out = ragged_paged_decode_attention(
+        q, k, v, lengths, table, CFG, ps, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ragged_paged_decode_int8_matches_dequantized_reference():
+    """int8 paged kernel vs attention over the dequantized gathered view.
+    Like the dense int8 ragged kernel, q stays full-precision in the
+    kernel (the jnp int8 path re-quantizes q), so the comparison is
+    against the dequantized-K/V reference with a quantization tolerance."""
+    from langstream_tpu.models.transformer import _paged_gather_entry
+    from langstream_tpu.ops.attention import ragged_paged_decode_attention_int8
+
+    b, h, hkv, d, ps, pages, tp = 2, 8, 4, 8, 8, 8, 3
+    q = rand(0, b, h, d)
+    kq = jax.random.randint(jax.random.PRNGKey(1), (pages, hkv, ps, d), -127, 127, jnp.int8)
+    ks = jax.random.uniform(jax.random.PRNGKey(2), (pages, hkv, ps)) * 0.05 + 0.01
+    vq = jax.random.randint(jax.random.PRNGKey(3), (pages, hkv, ps, d), -127, 127, jnp.int8)
+    vs = jax.random.uniform(jax.random.PRNGKey(4), (pages, hkv, ps)) * 0.05 + 0.01
+    k = {"q": kq, "s": ks}
+    v = {"q": vq, "s": vs}
+    table = jnp.asarray(np.array([[2, 0, pages], [5, 4, 1]], np.int32))
+    lengths = jnp.asarray([11, 22], jnp.int32)
+
+    def dense(entry):
+        g = _paged_gather_entry(entry, table, ps)
+        return g["q"].astype(jnp.float32) * g["s"][..., None]
+
+    mask = jnp.arange(tp * ps)[None, None, :] < lengths[:, None, None]
+    ref = attention(q[:, None], dense(k), dense(v), mask, CFG)[:, 0]
+    out = ragged_paged_decode_attention_int8(
+        q, k, v, lengths, table, CFG, ps, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)

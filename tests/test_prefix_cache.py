@@ -254,10 +254,11 @@ def test_memory_plan_accounts_prefix_pool():
     assert with_pool.prefix_pool_bytes > 0
     assert with_pool.total_bytes == base.total_bytes + with_pool.prefix_pool_bytes
     assert "prefix-pool" in with_pool.summary()
-    # engine surfaces the pool in its own plan
+    # engine surfaces the pool in its own plan (dense layout: the paged
+    # layout folds prefix reuse into the one page pool — test_pagepool.py)
     engine = ServingEngine(
         CFG, PARAMS, max_batch=2, max_seq_len=128, prefill_buckets=(16, 32),
-        prefix_cache="auto", prefix_cache_entries=3,
+        prefix_cache="auto", prefix_cache_entries=3, kv_layout="dense",
     )
     assert engine._plan is not None
     assert engine._plan.prefix_pool_bytes > 0

@@ -54,9 +54,13 @@ def test_loopback_follower_stays_in_lockstep():
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=4, spmd=channel,
     )
+    # kv_layout pinned dense: a REAL follower process passes the SpmdChannel
+    # to its engine (tpu_serving.build_engine) and falls back to dense
+    # automatically; the loopback emulation builds the follower without the
+    # channel, so it must pin the layout the replayed ops speak
     follower = ServingEngine(
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
-        prefill_buckets=(16, 32), prefill_batch=4,
+        prefill_buckets=(16, 32), prefill_batch=4, kv_layout="dense",
     )
     follower_thread = threading.Thread(
         target=follower_loop, args=(follower, channel), daemon=True
@@ -264,7 +268,7 @@ def test_loopback_lockstep_with_precompiled_ladder():
     follower = ServingEngine(
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=4,
-        ttft_chunk_floor=2,
+        ttft_chunk_floor=2, kv_layout="dense",  # see loopback note above
     )
     follower_thread = threading.Thread(
         target=follower_loop, args=(follower, channel), daemon=True
