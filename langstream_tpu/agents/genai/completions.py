@@ -18,6 +18,7 @@ from langstream_tpu.agents.genai import el
 from langstream_tpu.agents.genai.mutable import MutableRecord
 from langstream_tpu.agents.genai.steps import Step
 from langstream_tpu.ai.provider import ChatChunk, ChatMessage
+from langstream_tpu.tracing import TRACE_HEADER, TRACER
 
 
 def _set_result_field(record: MutableRecord, field: Optional[str], content: str) -> None:
@@ -150,6 +151,29 @@ class _BaseCompletionsStep(Step):
             "engine-loop restarts after a crash (bounded-backoff recovery), "
             "cumulative",
         )
+        # observability layer (serving/observability.py, docs/SERVING.md
+        # §12): the engine-derived load score the replica balancer routes
+        # on, the flight-recorder dump counter, and the full streaming-
+        # latency histogram set. The engine owns the live histograms; the
+        # exporter MIRRORS their snapshots into the Prometheus registry so
+        # /metrics carries real _bucket/_sum/_count series (the Grafana
+        # TTFT heatmap reads them).
+        self._m_load = metrics.gauge(
+            "engine_load_score",
+            "queue-wait p90 (s) + slot occupancy + page-pool pressure — "
+            "relative load signal for cache-aware replica balancing",
+        )
+        self._m_flight_dumps = metrics.gauge(
+            "engine_flight_dumps_total",
+            "flight-recorder postmortem dumps produced (quarantines, "
+            "restarts, shed bursts, on-demand), cumulative",
+        )
+        from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
+
+        self._m_hists = {
+            name: metrics.histogram(name, spec["help"], spec["buckets"])
+            for name, spec in ENGINE_HISTOGRAMS.items()
+        }
 
     def _record_metrics(self, result: Any) -> None:
         self._m_calls.count()
@@ -184,6 +208,15 @@ class _BaseCompletionsStep(Step):
         self._m_cancelled.set(stats.get("cancelled-total", 0))
         self._m_quarantined.set(stats.get("quarantined-slots-total", 0))
         self._m_restarts.set(stats.get("engine-restarts-total", 0))
+        self._m_load.set(stats.get("load-score", 0))
+        self._m_flight_dumps.set(stats.get("flight-dumps-total", 0))
+        for name, snap in (stats.get("histograms") or {}).items():
+            mirror = self._m_hists.get(name)
+            if mirror is not None:
+                try:
+                    mirror.load(snap)
+                except ValueError:  # bucket-spec drift — skip, don't crash
+                    pass
 
     async def close(self) -> None:
         if self._producer is not None:
@@ -204,7 +237,10 @@ class _BaseCompletionsStep(Step):
         opts["min-chunks-per-message"] = self.min_chunks
         return opts
 
-    def _chunk_writer(self, record: MutableRecord, loop, futures: list) -> Any:
+    def _chunk_writer(
+        self, record: MutableRecord, loop, futures: list,
+        trace_id: Optional[str] = None,
+    ) -> Any:
         """Returns a chunks_consumer that writes each chunk as its own record
         to the stream topic. May be invoked from the engine thread → schedule
         onto the agent event loop; the write futures are collected so
@@ -226,6 +262,13 @@ class _BaseCompletionsStep(Step):
             copy.properties["stream-id"] = chunk.answer_id
             copy.properties["stream-index"] = str(chunk.index)
             copy.properties["stream-last-message"] = str(chunk.last).lower()
+            if trace_id:
+                # echo the trace id on every streamed chunk EXPLICITLY:
+                # this callback runs on the engine thread, outside the
+                # agent span context, so the producer's contextvars-based
+                # stamping cannot reach it — without this the client-side
+                # and engine-side traces never join (docs/SERVING.md §12)
+                copy.properties.setdefault(TRACE_HEADER, trace_id)
             _set_result_field(copy, step.stream_response_field, chunk.content)
             out = copy.to_record()
             if step._producer is not None:
@@ -249,11 +292,19 @@ class _BaseCompletionsStep(Step):
         session_id = record.properties.get(SESSION_HEADER)
         if session_id:
             options["cancel-key"] = str(session_id)
+        # trace propagation: the record's gateway-stamped ls-trace-id (or
+        # the agent span the runner opened for this batch) rides into the
+        # GenerationRequest AND back out on every streamed chunk, so the
+        # gateway→engine→fetch path stitches into ONE trace on /traces
+        trace_id = record.properties.get(TRACE_HEADER) or TRACER.current_trace_id()
+        if trace_id:
+            options["trace-id"] = str(trace_id)
         chunks_consumer = None
         chunk_futures: list = []
         if self.stream_to_topic:
             chunks_consumer = self._chunk_writer(
-                record, asyncio.get_running_loop(), chunk_futures
+                record, asyncio.get_running_loop(), chunk_futures,
+                trace_id=str(trace_id) if trace_id else None,
             )
         result = await self._complete(record, options, chunks_consumer)
         self._record_metrics(result)

@@ -55,6 +55,14 @@ Resource configuration:
     seconds before the hard stop
   fault-injection / fault-seed / fault-stall-s: deterministic fault drills
     (serving/faultinject.py; also via LSTPU_FAULTS env)
+  observability: true (default) → streaming latency histograms (TTFT,
+    inter-token, queue wait, dispatch/fetch times → stats()["histograms"],
+    /metrics exposition and the Grafana heatmap), per-request lifecycle
+    spans on /traces, the derived load score, and the flight recorder.
+    `flight-recorder-iterations` (default 256) sizes the ring of engine
+    iterations dumped on NaN/page quarantines, restarts and shed bursts;
+    `flight-dir` (or LSTPU_FLIGHT_DIR) writes dump JSON files there
+    (docs/SERVING.md §12)
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
   quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
     models stage on the host so the bf16 tree never needs device HBM)
@@ -293,6 +301,18 @@ class _EngineHolder:
             ),
             max_restarts=int(self.config.get("engine-max-restarts", 5)),
             fault_injector=self._fault_injector(),
+            # observability layer (docs/SERVING.md §12): histograms +
+            # request spans + flight recorder; off is the escape hatch for
+            # the measured (<1%) hot-loop overhead
+            observability=bool(self.config.get("observability", True)),
+            flight_iterations=int(
+                self.config.get("flight-recorder-iterations", 256)
+            ),
+            flight_dir=(
+                str(self.config["flight-dir"])
+                if self.config.get("flight-dir")
+                else None
+            ),
         )
         if start:
             engine.start()
@@ -477,11 +497,19 @@ class TpuCompletionsService(CompletionsService):
                 lambda: done.done() or done.set_result(res)
             )
 
+        # trace correlation: the record's propagated ls-trace-id (forwarded
+        # by the completions step) wins; else join whatever agent span is
+        # active so the engine's request spans stitch into the pipeline
+        # trace on /traces either way
+        from langstream_tpu.tracing import TRACER
+
+        trace_id = str(options.get("trace-id") or "") or TRACER.current_trace_id()
         request = GenerationRequest(
             prompt_tokens=tokenizer.encode(prompt),
             options=gen_options,
             on_token=on_token,
             on_done=_on_done,
+            trace_id=trace_id,
         )
         # client-disconnect wiring: the gateway cancels every request
         # registered under the record's session header when the websocket

@@ -3,13 +3,17 @@
 Hierarchical reporters: ``with_prefix`` returns a child whose counters are
 namespaced; the runtime installs a Prometheus-text implementation, tests use
 the in-memory default. TPU additions: gauges for tokens/sec, TTFT, batch
-occupancy, HBM use (SURVEY §5 observability note).
+occupancy, HBM use (SURVEY §5 observability note), and fixed-bucket
+``Histogram``s for streaming latency distributions (TTFT, inter-token,
+queue wait — the tail telemetry averages-and-counters cannot carry;
+docs/SERVING.md §12).
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 
 class Counter:
@@ -46,12 +50,175 @@ class Gauge:
         return self._value
 
 
+def log_buckets(lo: float, hi: float, per_decade: int = 4) -> tuple[float, ...]:
+    """Log-spaced histogram bucket upper bounds from ``lo`` to (at least)
+    ``hi``, ``per_decade`` buckets per decade. Fixed at construction — a
+    streaming histogram must never reshape under load."""
+    if lo <= 0 or hi <= lo:
+        raise ValueError(f"need 0 < lo < hi, got lo={lo} hi={hi}")
+    step = 10.0 ** (1.0 / max(1, int(per_decade)))
+    out: list[float] = []
+    v = lo
+    while v < hi * (1.0 + 1e-9):
+        # round to 4 significant digits so exposition `le` labels are stable
+        out.append(float(f"{v:.4g}"))
+        v *= step
+    if out[-1] < hi:
+        out.append(float(f"{hi:.4g}"))
+    return tuple(dict.fromkeys(out))
+
+
+class Histogram:
+    """Fixed-bucket streaming histogram (Prometheus semantics: cumulative
+    ``_bucket{le=...}`` counts plus ``_sum``/``_count``). ``record`` is the
+    hot-loop call: one bisect + three int/float updates under a lock —
+    cheap enough for per-token instrumentation (the engine's overhead
+    bound test measures it against the decode step)."""
+
+    __slots__ = ("name", "help", "_bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(
+        self, name: str, help_: str = "", buckets: Optional[Sequence[float]] = None
+    ) -> None:
+        self.name = name
+        self.help = help_
+        bounds = tuple(sorted(buckets)) if buckets else log_buckets(1e-3, 60.0)
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # +1 = the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: float) -> None:
+        # LOCK-FREE on purpose: every engine histogram has exactly ONE
+        # writer thread (engine thread or fetch thread), so there are no
+        # lost updates to guard against; readers (snapshot/percentile,
+        # metrics thread) tolerate a value landing between their reads of
+        # counts and sum. load()/reset() swap whole objects atomically
+        # (GIL), so the worst interleaving is one dropped sample. This is
+        # the hot-loop call the ≤1%-of-decode-step bound is measured on.
+        i = bisect.bisect_left(self._bounds, value)
+        self._counts[i] += 1
+        self._sum += value
+        self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def reset(self) -> None:
+        """Zero all state (bounds keep). Benches reset after their warmup
+        request so compile-time TTFT outliers don't own the tail."""
+        with self._lock:
+            self._counts = [0] * (len(self._bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    def _pct_from(self, counts: list, total: int, p: float) -> float:
+        """p-quantile over ONE captured counts list: linear interpolation
+        inside the winning bucket, the standard `histogram_quantile`
+        estimator. 0.0 when empty; values past the last finite bound clamp
+        to it (the +Inf bucket has no width)."""
+        if total == 0:
+            return 0.0
+        rank = p * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= rank:
+                if i >= len(self._bounds):
+                    return self._bounds[-1]
+                hi = self._bounds[i]
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                if c == 0:
+                    return hi
+                frac = (rank - (seen - c)) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self._bounds[-1]
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        return self._pct_from(counts, total, p)
+
+    def snapshot(self) -> dict:
+        """Plain-dict snapshot, safe to serialize: cumulative bucket counts
+        keyed by upper bound, plus sum/count and derived percentiles — all
+        computed from ONE captured copy, so the percentiles can never
+        disagree with the buckets they ship next to."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        cum: list[list[float]] = []
+        acc = 0
+        for bound, c in zip(self._bounds, counts):
+            acc += c
+            cum.append([bound, acc])
+        return {
+            "buckets": cum,
+            "sum": round(s, 6),
+            "count": total,
+            "p50": round(self._pct_from(counts, total, 0.50), 6),
+            "p90": round(self._pct_from(counts, total, 0.90), 6),
+            "p99": round(self._pct_from(counts, total, 0.99), 6),
+        }
+
+    def load(self, snapshot: dict) -> None:
+        """Overwrite this histogram's state from a ``snapshot()`` dict with
+        the SAME bucket bounds — the exporter mirror path: the engine owns
+        the live histogram, the metrics reporter re-exposes it."""
+        cum = snapshot.get("buckets") or []
+        if len(cum) != len(self._bounds):
+            raise ValueError(
+                f"snapshot has {len(cum)} buckets, histogram {self.name} "
+                f"has {len(self._bounds)}"
+            )
+        counts = []
+        prev = 0
+        for _, acc in cum:
+            counts.append(int(acc) - prev)
+            prev = int(acc)
+        total = int(snapshot.get("count", prev))
+        counts.append(max(0, total - prev))  # +Inf bucket
+        with self._lock:
+            self._counts = counts
+            self._count = total
+            self._sum = float(snapshot.get("sum", 0.0))
+
+    def exposition(self, safe_name: str) -> list[str]:
+        """Prometheus text lines (TYPE/HELP emitted by the reporter)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        lines = []
+        acc = 0
+        for bound, c in zip(self._bounds, counts):
+            acc += c
+            le = f"{bound:g}"
+            lines.append(f'{safe_name}_bucket{{le="{le}"}} {acc}')
+        lines.append(f'{safe_name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{safe_name}_sum {s}")
+        lines.append(f"{safe_name}_count {total}")
+        return lines
+
+
 class MetricsReporter:
     """In-memory reporter; also the base class for exporters."""
 
     def __init__(self, prefix: str = "", registry: Optional[dict] = None) -> None:
         self._prefix = prefix
-        self._registry: dict[str, Counter | Gauge] = registry if registry is not None else {}
+        self._registry: dict[str, Counter | Gauge | Histogram] = (
+            registry if registry is not None else {}
+        )
 
     def with_prefix(self, prefix: str) -> "MetricsReporter":
         joined = f"{self._prefix}_{prefix}" if self._prefix else prefix
@@ -76,14 +243,28 @@ class MetricsReporter:
             self._registry[full] = g
         return g
 
+    def histogram(
+        self, name: str, help_: str = "", buckets: Optional[Sequence[float]] = None
+    ) -> Histogram:
+        full = self._full(name)
+        h = self._registry.get(full)
+        if not isinstance(h, Histogram):
+            h = Histogram(full, help_, buckets)
+            self._registry[full] = h
+        return h
+
     def prometheus_text(self) -> str:
         """Render all metrics in Prometheus text exposition format."""
         lines: list[str] = []
         for name, m in sorted(self._registry.items()):
             safe = name.replace("-", "_").replace(".", "_")
-            kind = "counter" if isinstance(m, Counter) else "gauge"
             if m.help:
                 lines.append(f"# HELP {safe} {m.help}")
+            if isinstance(m, Histogram):
+                lines.append(f"# TYPE {safe} histogram")
+                lines.extend(m.exposition(safe))
+                continue
+            kind = "counter" if isinstance(m, Counter) else "gauge"
             lines.append(f"# TYPE {safe} {kind}")
             lines.append(f"{safe} {m.value}")
         return "\n".join(lines) + "\n"

@@ -283,8 +283,30 @@ class GatewayServer:
         return ws
 
     @staticmethod
-    async def _safe_produce(produce: ProduceGateway, payload: str) -> dict[str, Any]:
+    async def _safe_produce(
+        produce: ProduceGateway, payload: str, ensure_trace: bool = False
+    ) -> dict[str, Any]:
         try:
+            if ensure_trace:
+                # chat messages get a trace id at the FRONT DOOR (client-
+                # supplied header wins): the pipeline propagates it record
+                # to record, the completions step hands it to the serving
+                # engine, and the streamed answer chunks echo it back — so
+                # a chat request's whole gateway→engine→fetch path
+                # stitches into one trace on /traces. Clients correlate by
+                # the id they sent, or read the stamped one off any chunk
+                # (chat sockets do not ack successful produces).
+                from langstream_tpu.tracing import TRACE_HEADER
+
+                request = ProduceGateway.parse_produce_request(payload)
+                headers = request.get("headers")
+                if not isinstance(headers, dict):
+                    headers = {}
+                if not headers.get(TRACE_HEADER):
+                    headers[TRACE_HEADER] = uuid.uuid4().hex[:16]
+                request["headers"] = headers
+                await produce.produce(request)
+                return {"status": "OK", "reason": None}
             await produce.produce_payload(payload)
             return {"status": "OK", "reason": None}
         except ProduceException as e:
@@ -347,7 +369,9 @@ class GatewayServer:
             async for msg in ws:
                 if msg.type != WSMsgType.TEXT:
                     continue
-                response = await self._safe_produce(produce, msg.data)
+                response = await self._safe_produce(
+                    produce, msg.data, ensure_trace=True
+                )
                 if response["status"] != "OK":
                     await ws.send_json(response)
         finally:

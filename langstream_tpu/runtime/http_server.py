@@ -35,9 +35,19 @@ class RuntimeHttpServer:
                 web.get("/metrics", self._metrics),
                 web.get("/info", self._info),
                 web.get("/traces", self._traces),
+                web.get("/flight", self._flight),
                 web.get("/healthz", self._healthz),
             ]
         )
+
+    async def _flight(self, request: web.Request) -> web.Response:
+        """Recent flight-recorder dumps (serving/observability.py): the
+        incident endpoint — after a quarantine/restart/shed burst, curl
+        this for the last-N-iterations postmortem artifacts instead of
+        ssh-ing for log archaeology (docs/SERVING.md §12). Newest last."""
+        from langstream_tpu.serving.observability import recent_dumps
+
+        return web.json_response(recent_dumps())
 
     async def _traces(self, request: web.Request) -> web.Response:
         from langstream_tpu.tracing import TRACER

@@ -42,6 +42,11 @@ from langstream_tpu.models.transformer import (
     verify_step_inplace,
 )
 from langstream_tpu.serving.faultinject import FaultInjector
+from langstream_tpu.serving.observability import (
+    EngineObservability,
+    emit_request_spans,
+    load_score,
+)
 from langstream_tpu.serving.sampling import sample, speculative_verify
 from langstream_tpu.serving.speculation import NGramIndex
 
@@ -81,6 +86,10 @@ class GenerationRequest:
     # fan-out at the thread-pool size)
     on_done: Optional[Callable[["GenerationResult"], None]] = None
     submitted_at: float = field(default_factory=time.monotonic)
+    # distributed-tracing correlation id (the gateway/agent ``ls-trace-id``
+    # header): the engine's request-lifecycle spans join this trace, so a
+    # chat request's gateway→agent→engine path stitches on /traces
+    trace_id: Optional[str] = None
     _done: threading.Event = field(default_factory=threading.Event)
     _result: Optional["GenerationResult"] = None
     _cancelled: threading.Event = field(default_factory=threading.Event)
@@ -143,10 +152,24 @@ class _Slot:
     generated: list[int] = field(default_factory=list)
     started_at: float = 0.0
     first_token_at: float = 0.0
+    # observability (docs/SERVING.md §12): lifecycle-span attributes and
+    # the inter-token histogram's per-slot clock — host bookkeeping only
+    last_token_at: float = 0.0
+    path: str = "cold"  # cold | warm | long | ring (admission route)
+    prefill_chunks: int = 0
+    decode_iters: int = 0
+    verify_iters: int = 0
 
     @property
     def active(self) -> bool:
         return self.request is not None
+
+    def reset_obs(self, path: str, chunks: int) -> None:
+        self.last_token_at = 0.0
+        self.path = path
+        self.prefill_chunks = chunks
+        self.decode_iters = 0
+        self.verify_iters = 0
 
 
 @functools.partial(
@@ -625,10 +648,15 @@ class _TokenFetcher:
     while this thread blocks on the previous one's bytes. One FIFO queue +
     one worker keeps results strictly in submission (= chunk) order."""
 
-    def __init__(self, injector: Optional[FaultInjector] = None) -> None:
+    def __init__(
+        self,
+        injector: Optional[FaultInjector] = None,
+        obs: Optional[EngineObservability] = None,
+    ) -> None:
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
         self._thread: Optional[threading.Thread] = None
         self._injector = injector
+        self._obs = obs
 
     def alive(self) -> bool:
         t = self._thread
@@ -662,7 +690,12 @@ class _TokenFetcher:
             try:
                 if self._injector is not None:
                     self._injector.stall("fetch")
+                t0 = time.monotonic()
                 handle._value = np.asarray(jax.device_get(handle.array))
+                if self._obs is not None and self._obs.on:
+                    # the tunnel fetch IS a latency tail source (PERF.md
+                    # round 7) — its distribution belongs on /metrics
+                    self._obs.record("engine_fetch_s", time.monotonic() - t0)
             except BaseException as e:  # noqa: BLE001 — surface at result()
                 handle._value = e
             handle._event.set()
@@ -727,6 +760,9 @@ class ServingEngine:
         restart_backoff_s: float = 0.1,
         max_restarts: int = 5,
         fault_injector: Optional[FaultInjector] = None,
+        observability: bool = True,
+        flight_iterations: int = 256,
+        flight_dir: Optional[str] = None,
     ) -> None:
         """``mesh``: a jax Mesh with a "model" (and optionally "expert") axis.
         ``params`` must already be sharded over it (parallel.sharding);
@@ -1036,9 +1072,21 @@ class ServingEngine:
         self._injector = (
             fault_injector if fault_injector is not None else FaultInjector.from_env()
         )
+        # observability layer (serving/observability.py): streaming
+        # histograms + request-lifecycle spans + the flight recorder.
+        # ``observability: off`` is the measured-overhead escape hatch (and
+        # the bench's off leg); everything hot-path gates on one flag.
+        self._obs = EngineObservability(
+            enabled=observability,
+            flight_capacity=flight_iterations,
+            flight_dir=flight_dir,
+        )
+        # engine iterations, idle included (the flight recorder's clock)
+        self._iterations_total = 0
         # dedicated device→host token fetch thread (started with the loop);
-        # carries the injector for the fetch-stall site
-        self._fetcher = _TokenFetcher(self._injector)
+        # carries the injector for the fetch-stall site and the fetch
+        # histogram
+        self._fetcher = _TokenFetcher(self._injector, self._obs)
         # EMA of observed queue wait (submit → admission), feeding the
         # hopeless-deadline shed decision and ShedError.retry_after_s
         self._queue_wait_ema_s = 0.0
@@ -1050,11 +1098,12 @@ class ServingEngine:
         # skipped at pop time
         self._waiting: dict[int, GenerationRequest] = {}  # id() → request
         self._waiting_lock = threading.Lock()
-        # lifecycle counters (stats() → genai gauges → Grafana). shed_total
-        # is the one counter written from arbitrary submitter threads
-        # (concurrent submit() calls), so its += goes through this lock;
-        # the rest are engine-thread single-writer
-        self._shed_lock = threading.Lock()
+        # lifecycle counters (stats() → genai gauges → Grafana). ONE lock
+        # covers every counter mutation AND the whole stats() read, so a
+        # stats() snapshot is internally consistent (shed totals cannot
+        # disagree with queue depth read a microsecond later) — the
+        # uncontended acquire is ~100ns, noise next to any dispatch
+        self._stats_lock = threading.Lock()
         self.shed_total = 0
         self.cancelled_total = 0
         self.deadline_queue_total = 0
@@ -1215,8 +1264,7 @@ class ServingEngine:
         # immediately and feeding the inflated wait into the shed EMA
         request.submitted_at = time.monotonic()
         if self._draining:
-            with self._shed_lock:
-                self.shed_total += 1
+            self._count_shed()
             raise ShedError("serving engine is draining", retry_after_s=5.0)
         limit = self.max_seq_len - 1
         if len(request.prompt_tokens) > limit:
@@ -1228,8 +1276,7 @@ class ServingEngine:
         if deadline_s is not None:
             est_wait = self._queue_wait_ema_s
             if deadline_s <= 0 or (self._queue.qsize() > 0 and est_wait >= deadline_s):
-                with self._shed_lock:
-                    self.shed_total += 1
+                self._count_shed()
                 raise ShedError(
                     f"deadline of {deadline_s:.2f}s cannot survive the "
                     f"current ~{est_wait:.2f}s queue wait",
@@ -1242,8 +1289,7 @@ class ServingEngine:
                 try:
                     self._queue.put_nowait(request)
                 except queue.Full:
-                    with self._shed_lock:
-                        self.shed_total += 1
+                    self._count_shed()
                     raise ShedError(
                         f"admission queue full ({self._queue.maxsize} deep)",
                         retry_after_s=max(self._queue_wait_ema_s, 0.1),
@@ -1279,7 +1325,88 @@ class ServingEngine:
             req.cancel()
             raise
 
-    def stats(self) -> dict[str, Any]:
+    def _count_shed(self) -> None:
+        """Shed bookkeeping shared by every shed site: count under the
+        stats lock, then let the flight recorder's sliding window decide
+        whether this shed completes a BURST worth a postmortem dump (an
+        isolated shed is routine backpressure, not an incident)."""
+        with self._stats_lock:
+            self.shed_total += 1
+        if self._obs.on and self._obs.flight.note_shed():
+            self._flight_dump("shed-burst")
+
+    def _flight_dump(self, reason: str, extra: Optional[dict] = None,
+                     force: bool = False) -> Optional[dict]:
+        """Snapshot the flight ring into a dump artifact, stamped with the
+        lifecycle counters at dump time. Callable from ANY thread (the
+        shed path runs on submitters); debounced per reason inside the
+        recorder."""
+        if not self._obs.on:
+            return None
+        extra = dict(extra or {})
+        if self._injector is not None:
+            # which injected fault preceded this incident (chaos drills)
+            extra["injector-events"] = self._injector.events_snapshot()
+        return self._obs.flight.dump(
+            reason, counters=self._counters_snapshot(), extra=extra,
+            force=force,
+        )
+
+    def reset_histograms(self) -> None:
+        """Zero the streaming histograms (buckets keep). Bench phases call
+        this after their warmup request so one compile-heavy cold TTFT
+        doesn't own p99 of a steady-state distribution."""
+        self._obs.reset_histograms()
+
+    def _counters_snapshot(self) -> dict[str, Any]:
+        with self._stats_lock:
+            return {
+                "shed": self.shed_total,
+                "cancelled": self.cancelled_total,
+                "deadline-queue": self.deadline_queue_total,
+                "deadline-decode": self.deadline_decode_total,
+                "quarantined-slots": self.quarantined_slots_total,
+                "nan-guard": self.nan_guard_total,
+                "engine-restarts": self.engine_restarts_total,
+                "total-requests": self.total_requests,
+                "total-generated-tokens": self.total_generated,
+                "queued": self._queue.qsize(),
+                "active-slots": sum(1 for s in self._slots if s.active),
+            }
+
+    def stats(self, dump: bool = False) -> dict[str, Any]:
+        """One CONSISTENT snapshot: every counter below is read under the
+        same lock their writers hold, so shed totals, queue depth and the
+        deadline counters can never disagree mid-iteration. Values are
+        plain ints/floats/strs/dicts — safe to json.dumps as-is.
+        ``dump=True`` additionally snapshots the flight recorder (an
+        on-demand postmortem artifact; see docs/SERVING.md §12)."""
+        # histogram snapshots take the per-histogram locks only — compute
+        # BEFORE the stats lock so lock order is always hist→stats-free
+        hist = self._obs.histograms()
+        queue_wait_p90 = hist.get("engine_queue_wait_s", {}).get("p90", 0.0)
+        with self._stats_lock:
+            out = self._stats_locked()
+        out["observability"] = self._obs.on
+        out["histograms"] = hist
+        # load score (ROADMAP item 3): the replica-balancer routing signal
+        pool = self._pagepool
+        page_pressure = (
+            pool.pages_in_use / max(1, pool.num_pages)
+            if pool is not None
+            else min(1.0, out["queued"] / max(1, self._queue.maxsize))
+        )
+        out["load-score"] = load_score(
+            queue_wait_p90,
+            out["active-slots"] / max(1, self.max_batch),
+            page_pressure,
+        )
+        out["flight-dumps-total"] = self._obs.flight.dumps_total
+        if dump:
+            out["flight-recorder"] = self._flight_dump("on-demand", force=True)
+        return out
+
+    def _stats_locked(self) -> dict[str, Any]:
         active = sum(1 for s in self._slots if s.active)
         return {
             "active-slots": active,
@@ -1784,7 +1911,14 @@ class ServingEngine:
                         self._fail_all(e)
                         return
                     restarts += 1
-                    self.engine_restarts_total += 1
+                    with self._stats_lock:
+                        self.engine_restarts_total += 1
+                    # dump BEFORE _recover clears state: the ring holds the
+                    # iterations that led to the crash — the postmortem
+                    self._flight_dump(
+                        "engine-restart",
+                        extra={"error": type(e).__name__, "restart": restarts},
+                    )
                     log.exception(
                         "serving engine loop crashed; quarantining %d in-flight "
                         "slot(s), restarting in %.2fs (restart %d/%d)",
@@ -1882,7 +2016,8 @@ class ServingEngine:
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=error,
             ))
-        self.quarantined_slots_total += quarantined
+        with self._stats_lock:
+            self.quarantined_slots_total += quarantined
         self._longs.clear()
         self._long_caches.clear()
         self._reserved.clear()
@@ -1931,11 +2066,15 @@ class ServingEngine:
         interleave at iteration granularity and neither backlog starves the
         other. Extracted from _run so tests can drive exactly one iteration
         (the engine thread just loops this)."""
+        obs_on = self._obs.on
+        self._iterations_total += 1
+        t0 = time.monotonic() if obs_on else 0.0
         if self._pending_row_resets:
             self._flush_row_resets()
         if self._pending_page_zero:
             self._flush_page_zeros()
         self._sweep_waiting()
+        t_sweep = time.monotonic() if obs_on else 0.0
         # chunks dispatched in previous iterations are still unfetched when
         # this iteration's dispatch computes its headroom bound — subtract
         # ALL of them
@@ -1957,6 +2096,7 @@ class ServingEngine:
         self._mid_iteration = True
         try:
             new_pending, spent = self._long_step(budget)
+            n_long_entries = len(new_pending)
             if budget is not None:
                 budget = max(0, budget - spent)
             new_pending.extend(self._admit(budget))  # deferred first-token fetches
@@ -1965,6 +2105,20 @@ class ServingEngine:
         # prefill dispatched this iteration rides the in-order stream AHEAD
         # of the chunk below — its chunk must not feed the step-time gauge
         prefill_ahead = bool(new_pending) or spent > 0
+        t_prefill = time.monotonic() if obs_on else 0.0
+        n_admitted = sum(
+            len(e[2]) for e in new_pending if e[0] == "prefill"
+        )
+        # prefill tokens this iteration = long-segment tokens (``spent``) +
+        # the ADMISSION groups' prompts (entries past the _long_step slice
+        # — a long prompt's final-segment entry must not double-count the
+        # segments already in ``spent``)
+        prefill_tokens = spent + sum(
+            len(req.prompt_tokens)
+            for e in new_pending[n_long_entries:]
+            if e[0] == "prefill"
+            for _, req in e[2]
+        )
         if new_pending and not had_active:
             # cold start (nothing was decoding): there is no compute
             # to overlap the deferred fetch with, and on a tunneled
@@ -1998,6 +2152,9 @@ class ServingEngine:
                 new_pending.append(self._dispatch_verify(
                     clean=not prefill_ahead
                 ))
+                disp_kind, disp_steps = "verify", self.spec_tokens + 1
+            else:
+                disp_kind, disp_steps = "", 0
         elif any(s.active for s in self._slots):
             new_pending.append(self._dispatch_chunk(
                 clean=not prefill_ahead,
@@ -2008,8 +2165,12 @@ class ServingEngine:
                 # predecessor still running at dispatch time)
                 pipelined=self._inflight_steps > 0,
             ))
-        elif not new_pending and not pending and not self._longs:
-            time.sleep(0.001)
+            disp_kind, disp_steps = "decode", new_pending[-1][3]
+        else:
+            disp_kind, disp_steps = "", 0
+            if not new_pending and not pending and not self._longs:
+                time.sleep(0.001)
+        t_dispatch = time.monotonic() if obs_on else 0.0
         pending.append(new_pending)
         # process the oldest batch when its device arrays are READY
         # (no host block, completions/first tokens discovered at
@@ -2022,6 +2183,38 @@ class ServingEngine:
         ):
             for entry in pending.popleft():
                 self._process_entry(entry)
+        if obs_on and (disp_kind or n_admitted or spent or had_active):
+            # flight-recorder frame — idle iterations (nothing active,
+            # nothing dispatched) are skipped so the ring holds ~N frames
+            # of actual WORK leading up to an incident, not sleep noise.
+            # One dict build + deque append per iteration (not per token).
+            t_end = time.monotonic()
+            self._obs.flight.record({
+                "i": self._iterations_total,
+                "t": round(time.time(), 3),
+                "active": sum(1 for s in self._slots if s.active),
+                "queued": self._queue.qsize(),
+                "longs": len(self._longs),
+                "admitted": n_admitted,
+                "prefill_tokens": prefill_tokens,
+                "dispatch": disp_kind,
+                "steps": disp_steps,
+                "kv_pages": (
+                    self._pagepool.pages_in_use if self._pagepool else 0
+                ),
+                "programs": len(self._programs),
+                "injector": (
+                    dict(self._injector.fired)
+                    if self._injector is not None
+                    else {}
+                ),
+                "phase_ms": {
+                    "sweep": round((t_sweep - t0) * 1e3, 3),
+                    "prefill": round((t_prefill - t_sweep) * 1e3, 3),
+                    "dispatch": round((t_dispatch - t_prefill) * 1e3, 3),
+                    "process": round((t_end - t_dispatch) * 1e3, 3),
+                },
+            })
 
     def _sweep_waiting(self) -> None:
         """Resolve queued-but-unadmitted requests that died while waiting
@@ -2105,35 +2298,57 @@ class ServingEngine:
                 if slot.request is not request:
                     continue
                 slot.first_token_at = now
+                slot.last_token_at = now  # inter-token clock starts here
+                if self._obs.on:
+                    self._obs.record(
+                        "engine_ttft_s", now - request.submitted_at
+                    )
                 self._deliver_token(idx, int(first[j]))
         elif kind == "verify":
             self._process_verify(entry)
         else:
             _, chunk, snapshot, steps, t_dispatch, clean, pipelined = entry
-            self._process_chunk(chunk, snapshot, steps)
-            # achieved-bandwidth gauge. Only CLEAN chunks (no prefill ahead
-            # on the stream that iteration) are sampled. A PIPELINED chunk
-            # (dispatched while its predecessor still ran) executes
-            # back-to-back on the in-order stream, so its device time is
-            # the interval since the PREVIOUS chunk's completion —
-            # dispatch→ready wall would count the predecessor's remaining
-            # execution too and read ~2× at steady state. A non-pipelined
-            # chunk (idle stream) uses dispatch→ready wall directly. EMA
-            # smooths tunnel jitter; the model side is _achieved_hbm_gbps.
-            now = time.monotonic()
-            step_s = None
-            if snapshot and clean:
-                if pipelined and self._last_chunk_ready_t > 0:
-                    step_s = (now - self._last_chunk_ready_t) / max(1, steps)
-                elif not pipelined:
-                    step_s = (now - t_dispatch) / max(1, steps)
-            if step_s is not None:
-                self._step_time_ema_s = (
-                    step_s
-                    if self._step_time_ema_s == 0
-                    else 0.9 * self._step_time_ema_s + 0.1 * step_s
-                )
-            self._last_chunk_ready_t = now
+            self._process_chunk(
+                chunk, snapshot, steps, t_dispatch, clean, pipelined
+            )
+
+    def _sample_step_time(
+        self, snapshot, steps: int, t_dispatch: float, clean: bool,
+        pipelined: bool,
+    ) -> None:
+        """Achieved-bandwidth gauge sample, taken the moment the chunk's
+        bytes LAND (before token delivery: a request finishing mid-chunk
+        wakes its waiter inside the delivery loop, and the gauge must
+        already be current when that caller reads stats() — sampling after
+        delivery both raced that read and charged host delivery work to
+        device step time). Only CLEAN chunks (no prefill ahead on the
+        stream that iteration) are sampled. A PIPELINED chunk (dispatched
+        while its predecessor still ran) executes back-to-back on the
+        in-order stream, so its device time is the interval since the
+        PREVIOUS chunk's completion — dispatch→ready wall would count the
+        predecessor's remaining execution too and read ~2× at steady
+        state. A non-pipelined chunk (idle stream) uses dispatch→ready
+        wall directly. EMA smooths tunnel jitter; the model side is
+        _achieved_hbm_gbps."""
+        now = time.monotonic()
+        step_s = None
+        if snapshot and clean:
+            if pipelined and self._last_chunk_ready_t > 0:
+                step_s = (now - self._last_chunk_ready_t) / max(1, steps)
+            elif not pipelined:
+                step_s = (now - t_dispatch) / max(1, steps)
+        if step_s is not None:
+            self._step_time_ema_s = (
+                step_s
+                if self._step_time_ema_s == 0
+                else 0.9 * self._step_time_ema_s + 0.1 * step_s
+            )
+            if self._obs.on:
+                # per-STEP device time — the EMA's distribution; a fat
+                # p99 with a clean p50 is the mid-traffic-compile (or
+                # tunnel-hiccup) signature §12 documents
+                self._obs.record("engine_decode_step_s", step_s)
+        self._last_chunk_ready_t = now
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -2162,16 +2377,19 @@ class ServingEngine:
             return True  # already resolved elsewhere — don't double-count
         wait = now - request.submitted_at
         if request.cancelled:
-            self.cancelled_total += 1
+            with self._stats_lock:
+                self.cancelled_total += 1
             request._finish(GenerationResult(
                 tokens=[], finish_reason="cancelled",
                 prompt_tokens=len(request.prompt_tokens),
                 ttft_s=0, total_s=wait,
             ))
+            self._emit_queued_death_spans(request, "cancelled", now)
             return True
         if self._expired(request, now):
             opts = request.options
-            self.deadline_queue_total += 1
+            with self._stats_lock:
+                self.deadline_queue_total += 1
             request._finish(GenerationResult(
                 tokens=[], finish_reason="deadline",
                 prompt_tokens=len(request.prompt_tokens),
@@ -2182,8 +2400,29 @@ class ServingEngine:
                     f"max-queue-wait={opts.max_queue_wait_s}"
                 ),
             ))
+            self._emit_queued_death_spans(request, "deadline", now)
             return True
         return False
+
+    def _emit_queued_death_spans(
+        self, request: GenerationRequest, reason: str, now: float
+    ) -> None:
+        """Trace a request that died before admission: root + queued child
+        only (no slot, no prefill, no tokens)."""
+        if not self._obs.on:
+            return
+        emit_request_spans(
+            request.trace_id,
+            {"submitted": request.submitted_at, "finished": now},
+            {
+                "slot": -1,
+                "path": "queued",
+                "prompt_tokens": len(request.prompt_tokens),
+                "generated_tokens": 0,
+                "finish_reason": reason,
+            },
+            status="ok" if reason == "cancelled" else f"error: {reason}",
+        )
 
     def _prequalify(self, request: GenerationRequest) -> bool:
         """Queue-exit gate (engine thread): True = still worth admitting;
@@ -2193,11 +2432,16 @@ class ServingEngine:
         if self._resolve_if_dead(request, now):
             return False
         wait = now - request.submitted_at
-        self._queue_wait_ema_s = (
-            wait
-            if self._queue_wait_ema_s == 0
-            else 0.8 * self._queue_wait_ema_s + 0.2 * wait
-        )
+        with self._stats_lock:
+            self._queue_wait_ema_s = (
+                wait
+                if self._queue_wait_ema_s == 0
+                else 0.8 * self._queue_wait_ema_s + 0.2 * wait
+            )
+        if self._obs.on:
+            # the DISTRIBUTION the EMA flattens: queue-wait p90 is the
+            # dominant term of the load score the balancer routes on
+            self._obs.record("engine_queue_wait_s", wait)
         return True
 
     def _admit(self, budget: Optional[int] = None) -> list[tuple]:
@@ -2380,6 +2624,10 @@ class ServingEngine:
                 top_ps=top_ps,
             ))
         first = self._dev_prefill(width, tokens, lengths, temps, top_ks, top_ps, slots)
+        if self._obs.on:
+            self._obs.record(
+                "engine_prefill_dispatch_s", time.monotonic() - started
+            )
 
         for idx, request in group:
             slot = self._slots[idx]
@@ -2388,7 +2636,9 @@ class ServingEngine:
             slot.generated = []
             slot.started_at = started
             slot.first_token_at = 0.0  # stamped when the deferred fetch lands
-            self.total_requests += 1
+            slot.reset_obs("cold", 1)
+            with self._stats_lock:
+                self.total_requests += 1
             self._spec_admit(idx, request.prompt_tokens)
             self._maybe_publish(idx, request.prompt_tokens)
         return [("prefill", self._fetcher.submit(first), list(group))]
@@ -2539,13 +2789,19 @@ class ServingEngine:
         finally:
             pool.release(entry)
         pool.tokens_saved += p
+        if self._obs.on:
+            self._obs.record(
+                "engine_prefill_dispatch_s", time.monotonic() - started
+            )
         slot = self._slots[idx]
         slot.request = request
         slot.position = len(prompt)
         slot.generated = []
         slot.started_at = started
         slot.first_token_at = 0.0
-        self.total_requests += 1
+        slot.reset_obs("warm", 1)
+        with self._stats_lock:
+            self.total_requests += 1
         self._spec_admit(idx, prompt)
         # the prompt may extend past the reused prefix's bucket boundary:
         # publish the deeper prefix so the next lookup reuses more
@@ -2737,13 +2993,19 @@ class ServingEngine:
                 ttft_s=0, total_s=0, error=e,
             ))
             return
+        if self._obs.on:
+            self._obs.record(
+                "engine_prefill_dispatch_s", time.monotonic() - started
+            )
         slot = self._slots[idx]
         slot.request = request
         slot.position = len(prompt)
         slot.generated = []
         slot.started_at = started
         slot.first_token_at = 0.0
-        self.total_requests += 1
+        slot.reset_obs("warm", 1)
+        with self._stats_lock:
+            self.total_requests += 1
         self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         entries.append(("prefill", self._fetcher.submit(first), [(idx, request)]))
@@ -2824,8 +3086,10 @@ class ServingEngine:
         for i, slot in enumerate(self._slots):
             if not slot.active or pool.validate(i):
                 continue
-            self.quarantined_slots_total += 1
+            with self._stats_lock:
+                self.quarantined_slots_total += 1
             self._quarantine_pages(i)
+            self._flight_dump("page-quarantine", extra={"slot": i})
             self._finish_slot(
                 i, "error",
                 error=RuntimeError(
@@ -3125,19 +3389,35 @@ class ServingEngine:
             self._longs.pop(idx, None)
             self._long_caches.pop(idx, None)
             if request.cancelled:
-                self.cancelled_total += 1
+                with self._stats_lock:
+                    self.cancelled_total += 1
                 reason = "cancelled"
             else:
                 # mid-PREFILL expiry: zero tokens generated, so this is
                 # the waiting bucket (prefill backlog), not mid-decode —
                 # the queue/decode split is what operators alert on
-                self.deadline_queue_total += 1
+                with self._stats_lock:
+                    self.deadline_queue_total += 1
                 reason = "deadline"
             request._finish(GenerationResult(
                 tokens=[], finish_reason=reason,
                 prompt_tokens=len(request.prompt_tokens),
                 ttft_s=0, total_s=now - request.submitted_at,
             ))
+            if self._obs.on:
+                emit_request_spans(
+                    request.trace_id,
+                    {"submitted": request.submitted_at, "finished": now},
+                    {
+                        "slot": idx,
+                        "path": "long",
+                        "prompt_tokens": len(request.prompt_tokens),
+                        "generated_tokens": 0,
+                        "finish_reason": reason,
+                        "prefill_chunks": st["seg"],
+                    },
+                    status="ok" if reason == "cancelled" else f"error: {reason}",
+                )
             return []
         prompt = request.prompt_tokens
         width = self.prefill_buckets[-1]
@@ -3171,6 +3451,7 @@ class ServingEngine:
                 top_ps=np.asarray([opts.top_p], np.float32),
             ))
         prefix_entry = st.pop("prefix", None)  # only present on start
+        t_disp = time.monotonic()
         try:
             if self._paged:
                 # straight into the slot's pages: no local cache, no final
@@ -3210,6 +3491,10 @@ class ServingEngine:
         if prefix_entry is not None:
             self._prefix_pool.tokens_saved += st.get("base", 0)
         st["seg"] += 1
+        if self._obs.on:
+            self._obs.record(
+                "engine_prefill_dispatch_s", time.monotonic() - t_disp
+            )
         if not final:
             return []  # more segments to go
 
@@ -3222,7 +3507,9 @@ class ServingEngine:
         slot.generated = []
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
-        self.total_requests += 1
+        slot.reset_obs("long", st["seg"])
+        with self._stats_lock:
+            self.total_requests += 1
         self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
@@ -3273,7 +3560,9 @@ class ServingEngine:
         slot.generated = []
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
-        self.total_requests += 1
+        slot.reset_obs("ring", 1)
+        with self._stats_lock:
+            self.total_requests += 1
         self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
@@ -3448,7 +3737,8 @@ class ServingEngine:
         snapshot = [
             (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
         ]
-        self._busy_steps += steps
+        with self._stats_lock:
+            self._busy_steps += steps
         self._last_kv_bound = kv_bound or self.max_seq_len
         # hand the chunk to the fetch thread NOW: it blocks on the bytes
         # while this thread keeps dispatching — the ~100ms tunnel fetch is
@@ -3570,20 +3860,23 @@ class ServingEngine:
             index = self._spec_index.get(i)
             if index is None:
                 continue
-            self.spec_draft_lookups_total += 1
             prop = index.propose(k)
+            with self._stats_lock:
+                self.spec_draft_lookups_total += 1
+                if prop:
+                    self.spec_draft_hits_total += 1
+                    self.spec_draft_tokens_total += len(prop)
             if prop:
-                self.spec_draft_hits_total += 1
-                self.spec_draft_tokens_total += len(prop)
                 drafts[i, : len(prop)] = prop
                 proposed[i] = len(prop)
         packed = self._dev_verify(drafts, stale, kv_bound)
         snapshot = [
             (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
         ]
-        self._busy_steps += 1
+        with self._stats_lock:
+            self._busy_steps += 1
+            self.spec_dispatches_total += 1
         self._last_kv_bound = kv_bound
-        self.spec_dispatches_total += 1
         return (
             "verify", self._fetcher.submit(packed), snapshot, proposed,
             time.monotonic(), clean,
@@ -3662,18 +3955,39 @@ class ServingEngine:
         )
         if self._injector is not None:
             host = self._injector.corrupt_verify(host, snapshot)
+        # step-time gauge BEFORE delivery (same race rationale as
+        # _sample_step_time): a verify iteration is ONE weight read (that
+        # is the point), so it samples as one step; spec mode drains
+        # before dispatching, so dispatch→ready wall is honest here
+        now = time.monotonic()
+        if snapshot and clean:
+            step_s = now - t_dispatch
+            self._step_time_ema_s = (
+                step_s
+                if self._step_time_ema_s == 0
+                else 0.9 * self._step_time_ema_s + 0.1 * step_s
+            )
+            if self._obs.on:
+                self._obs.record("engine_decode_step_s", step_s)
+        self._last_chunk_ready_t = now
         out, accept = host[:, :-1], host[:, -1]
         for idx, request in snapshot:
             slot = self._slots[idx]
             if slot.request is not request:  # freed/reassigned meanwhile
                 continue
+            slot.verify_iters += 1
+            t_prev = slot.last_token_at
             n_acc = int(accept[idx])
-            if proposed[idx] > 0:
-                # capped at the real proposal length: padding zeros that
-                # happen to match the model are luck, not draft quality,
-                # and would push the acceptance gauge past 1.0
-                self.spec_accepted_tokens_total += min(n_acc, int(proposed[idx]))
-            self.spec_slot_steps_total += 1
+            with self._stats_lock:
+                if proposed[idx] > 0:
+                    # capped at the real proposal length: padding zeros that
+                    # happen to match the model are luck, not draft quality,
+                    # and would push the acceptance gauge past 1.0
+                    self.spec_accepted_tokens_total += min(
+                        n_acc, int(proposed[idx])
+                    )
+                self.spec_slot_steps_total += 1
+            delivered = 0
             for j in range(n_acc + 1):
                 slot.position += 1
                 token = int(out[idx, j])
@@ -3684,39 +3998,62 @@ class ServingEngine:
                     # token; counting n_acc+1 up front overstated the
                     # amortization gauge exactly on short-generation,
                     # high-acceptance traffic
-                    self.spec_emitted_tokens_total += 1
+                    with self._stats_lock:
+                        self.spec_emitted_tokens_total += 1
+                    delivered += 1
                 self._deliver_token(idx, token)
                 if slot.request is not request:  # finished mid-verify
                     break
-        # step-time gauge: a verify iteration is ONE weight read (that is
-        # the point), so it samples as one step; spec mode drains before
-        # dispatching, so dispatch→ready wall is honest here
-        now = time.monotonic()
-        if snapshot and clean:
-            step_s = now - t_dispatch
-            self._step_time_ema_s = (
-                step_s
-                if self._step_time_ema_s == 0
-                else 0.9 * self._step_time_ema_s + 0.1 * step_s
-            )
-        self._last_chunk_ready_t = now
+            if self._obs.on and delivered:
+                self._obs.record("engine_accepted_tokens_per_step", delivered)
+            self._record_intertoken(slot, request, t_prev, delivered)
 
-    def _process_chunk(self, chunk, snapshot, steps: int) -> None:
+    def _process_chunk(
+        self, chunk, snapshot, steps: int, t_dispatch: float = 0.0,
+        clean: bool = False, pipelined: bool = False,
+    ) -> None:
         if isinstance(chunk, _Fetch):
             host = chunk.result()  # [steps, B], fetched by the fetch thread
         else:
             host = np.asarray(jax.device_get(chunk))  # [steps, B]
+        # gauge BEFORE delivery: see _sample_step_time's rationale
+        self._sample_step_time(snapshot, steps, t_dispatch, clean, pipelined)
         if self._injector is not None:
             host, _ = self._injector.corrupt_tokens(host, snapshot)
         for idx, request in snapshot:
             slot = self._slots[idx]
             if slot.request is not request:  # freed/reassigned meanwhile
                 continue
+            slot.decode_iters += 1
+            t_prev = slot.last_token_at
+            delivered = 0
             for s in range(steps):
                 slot.position += 1
                 self._deliver_token(idx, int(host[s, idx]))
+                delivered += 1
                 if slot.request is not request:  # finished mid-chunk
                     break
+            self._record_intertoken(slot, request, t_prev, delivered)
+
+    def _record_intertoken(
+        self, slot: _Slot, request: GenerationRequest, t_prev: float,
+        delivered: int,
+    ) -> None:
+        """One inter-token sample per slot per processed chunk: the MEAN
+        per-token gap across the chunk ((now - previous chunk's clock) /
+        tokens delivered). Deliberately chunk-granular, not per-token —
+        in-chunk host gaps are ~µs noise while the chunk boundary carries
+        the real dispatch+fetch interval, and per-token monotonic+record
+        was the single biggest hot-loop instrumentation cost (measured
+        1.0µs/token ≈ 1.6% of a tiny-model CPU step — over the §12 ≤1%
+        bound this code ships under)."""
+        if not self._obs.on or not delivered:
+            return
+        now_t = time.monotonic()
+        if t_prev:
+            self._obs.record("engine_intertoken_s", (now_t - t_prev) / delivered)
+        if slot.request is request:  # not freed mid-chunk
+            slot.last_token_at = now_t
 
     def _deliver_token(self, idx: int, token: int) -> None:
         slot = self._slots[idx]
@@ -3731,18 +4068,24 @@ class ServingEngine:
             # while every other slot keeps decoding untouched. SPMD keeps
             # crash-only semantics (the row-reset dispatch is not on the
             # follower wire, and a leader-only reset would diverge).
-            self.nan_guard_total += 1
+            with self._stats_lock:
+                self.nan_guard_total += 1
             if self._spmd is not None:
                 raise LogitsNaNError(
                     f"non-finite logits for slot {idx} on an SPMD replica"
                 )
-            self.quarantined_slots_total += 1
+            with self._stats_lock:
+                self.quarantined_slots_total += 1
             if self._paged:
                 # pages, not rows: evict prefix entries sharing the slot's
                 # pages, free them through the owned list, zero next flush
                 self._quarantine_pages(idx)
             else:
                 self._pending_row_resets.append(idx)
+            # the postmortem artifact: the last N iterations that LED here
+            # (batch mix, pages, programs, injector firings) — the evidence
+            # a counter bump discards
+            self._flight_dump("nan-quarantine", extra={"slot": idx})
             self._finish_slot(
                 idx, "error",
                 error=LogitsNaNError(
@@ -3755,12 +4098,14 @@ class ServingEngine:
             # chunk-boundary cancellation: the slot frees NOW; tokens from
             # the rest of this (and any in-flight) chunk are dropped by the
             # snapshot identity check
-            self.cancelled_total += 1
+            with self._stats_lock:
+                self.cancelled_total += 1
             self._finish_slot(idx, "cancelled")
             return
         deadline = request.deadline_at()
         if deadline is not None and time.monotonic() >= deadline:
-            self.deadline_decode_total += 1
+            with self._stats_lock:
+                self.deadline_decode_total += 1
             self._finish_slot(idx, "deadline")
             return
         if self._injector is not None:
@@ -3779,7 +4124,8 @@ class ServingEngine:
                 # the emitted token joins the slot's draft context — the
                 # next iteration's proposals continue from it
                 index.append(token)
-            self.total_generated += 1
+            with self._stats_lock:
+                self.total_generated += 1
             if request.on_token is not None:
                 try:
                     request.on_token(token)
@@ -3803,7 +4149,10 @@ class ServingEngine:
         request = slot.request
         assert request is not None
         now = time.monotonic()
-        request._finish(GenerationResult(
+        pages_held = (
+            len(self._pagepool.slot_pages(idx)) if self._paged else 0
+        )
+        result = GenerationResult(
             tokens=list(slot.generated),
             finish_reason=reason,
             prompt_tokens=len(request.prompt_tokens),
@@ -3814,16 +4163,47 @@ class ServingEngine:
             ),
             total_s=now - request.submitted_at,
             error=error,
-        ))
+        )
+        stamps = {
+            "submitted": request.submitted_at,
+            "admitted": slot.started_at or None,
+            "first_token": slot.first_token_at or None,
+            "finished": now,
+        }
+        attrs = {
+            "slot": idx,
+            "path": slot.path,
+            "prompt_tokens": len(request.prompt_tokens),
+            "generated_tokens": len(slot.generated),
+            "finish_reason": reason,
+            "prefill_chunks": slot.prefill_chunks,
+            "decode_iterations": slot.decode_iters,
+            "verify_dispatches": slot.verify_iters,
+            "kv_pages": pages_held,
+        }
+        # release the slot and its pages BEFORE resolving the request: the
+        # waiter wakes inside _finish, and anything it reads right away —
+        # free-page counts, active-slot counts, stats() — must already
+        # reflect the completion (sampled pool state mid-teardown is how
+        # the page-leak test flaked when span emission sat in this gap)
         slot.request = None
         slot.generated = []
         slot.position = 0
+        slot.last_token_at = 0.0
         self._spec_index.pop(idx, None)
         self._freed_slots.append(idx)
         if self._paged:
             # slot reset = free its table (shared pages survive through the
             # prefix index's refcounts; exclusive ones return to the pool)
             self._pagepool.free_slot(idx)
+        request._finish(result)
+        if self._obs.on:
+            # the request's whole lifecycle becomes ONE span tree here —
+            # a single emission per request, nothing on the token loop
+            emit_request_spans(
+                request.trace_id, stamps, attrs,
+                status="ok" if error is None else f"error: {type(error).__name__}",
+            )
 
     def _fail_all(self, error: BaseException) -> None:
         self._dead = error

@@ -136,6 +136,12 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._calls: dict[str, int] = {s: 0 for s in self._rules}
         self.fired: dict[str, int] = {s: 0 for s in self._rules}
+        # recent firings (site, call number, wall time) — the flight
+        # recorder folds these into its dumps so a postmortem shows WHICH
+        # injected fault preceded the quarantine/restart it captured
+        from collections import deque
+
+        self.events: "deque[dict]" = deque(maxlen=32)
 
     @classmethod
     def from_env(cls, env=os.environ) -> Optional["FaultInjector"]:
@@ -157,6 +163,11 @@ class FaultInjector:
             hit = rule.fires(self._calls[site], self._rng)
             if hit:
                 self.fired[site] += 1
+                self.events.append({
+                    "site": site,
+                    "call": self._calls[site],
+                    "t": round(time.time(), 3),
+                })
                 log.warning(
                     "fault injection: %s fires (call %d, total %d)",
                     site, self._calls[site], self.fired[site],
@@ -228,6 +239,13 @@ class FaultInjector:
             # point the slot's first mapped entry somewhere else entirely
             pool.tables[victim, 0] = (pool.tables[victim, 0] + 1) % pool.num_pages
         return victim
+
+    def events_snapshot(self) -> list[dict]:
+        """Copy of the recent-firings ring, taken under the injector lock —
+        iterating the deque lock-free races fires() appends from the
+        engine/fetch threads (deque mutation during iteration raises)."""
+        with self._lock:
+            return list(self.events)
 
     def stats(self) -> dict[str, int]:
         return dict(self.fired)

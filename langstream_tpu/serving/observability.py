@@ -1,0 +1,423 @@
+"""Engine observability: streaming histograms, request-lifecycle trace
+emission, and the flight recorder.
+
+The engine's five interacting fast paths (overlap, prefix aliasing,
+speculation, paged pool, fault recovery) used to report averages and
+counters only — `stats()` EMAs say nothing about tails, and when a
+quarantine or restart fired the evidence of *why* was already gone. This
+module is the missing layer (PAPERS.md "DeepServe" and "STREAM" both treat
+per-request tail telemetry as the *control signal* for scheduling):
+
+- **Histograms** (`ENGINE_HISTOGRAMS` + `api/metrics.py Histogram`):
+  log-spaced fixed-bucket distributions for TTFT, inter-token latency,
+  queue wait, prefill/decode dispatch time, accepted-tokens-per-step, and
+  fetch latency. The engine owns the live instances; `stats()` snapshots
+  them and the completions exporter mirrors them into the Prometheus
+  registry (`_bucket`/`_sum`/`_count` on `/metrics`).
+- **Load score** (`load_score`): queue-wait p90 + slot occupancy +
+  page-pool pressure — the per-engine signal ROADMAP item 3's cache-aware
+  balancer routes on. Dimensionally it is seconds + two fractions; it is a
+  RELATIVE ordering score across replicas, not a physical quantity.
+- **Request spans** (`emit_request_spans`): one `engine.request` span per
+  request with `engine.queued` / `engine.prefill` / `engine.decode`
+  children, assembled from phase timestamps at completion (one emission
+  point — nothing on the token hot loop) and joined to the gateway trace
+  via the propagated ``ls-trace-id``.
+- **Flight recorder** (`FlightRecorder`): a lock-cheap ring of the last N
+  engine iterations (phase timings, batch composition, pages in use,
+  compiled-program count, injector firings). Snapshotted and dumped as
+  JSON — redacted of token content by construction — whenever a NaN or
+  page-integrity quarantine, an engine restart, or a shed burst fires,
+  and on demand via `stats(dump=True)`.
+
+No jax imports: tests and the metrics-artifact guards load this module
+without building an engine.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+from langstream_tpu.api.metrics import Histogram, log_buckets
+from langstream_tpu.tracing import TRACER, Span
+
+log = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# Histogram taxonomy (docs/SERVING.md §12 — names, units, what moves them)
+# ---------------------------------------------------------------------------
+
+ENGINE_HISTOGRAMS: dict[str, dict[str, Any]] = {
+    "engine_ttft_s": {
+        "help": "time to first token, submit to first delivered token (s)",
+        "buckets": log_buckets(1e-3, 120.0, 4),
+    },
+    "engine_intertoken_s": {
+        "help": "inter-token latency per slot, consecutive deliveries (s)",
+        "buckets": log_buckets(1e-4, 10.0, 4),
+    },
+    "engine_queue_wait_s": {
+        "help": "admission queue wait, submit to queue exit (s)",
+        "buckets": log_buckets(1e-4, 120.0, 4),
+    },
+    "engine_prefill_dispatch_s": {
+        "help": "host wall time of one prefill/segment dispatch (s)",
+        "buckets": log_buckets(1e-4, 60.0, 4),
+    },
+    "engine_decode_step_s": {
+        "help": "device decode/verify step time, per token step (s)",
+        "buckets": log_buckets(1e-5, 10.0, 4),
+    },
+    "engine_accepted_tokens_per_step": {
+        "help": "tokens emitted per slot per verify dispatch (speculation)",
+        "buckets": (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0),
+    },
+    "engine_fetch_s": {
+        "help": "device-to-host token fetch latency per chunk (s)",
+        "buckets": log_buckets(1e-4, 10.0, 4),
+    },
+}
+
+
+def build_histograms() -> dict[str, Histogram]:
+    return {
+        name: Histogram(name, spec["help"], spec["buckets"])
+        for name, spec in ENGINE_HISTOGRAMS.items()
+    }
+
+
+def load_score(
+    queue_wait_p90_s: float, occupancy: float, page_pressure: float
+) -> float:
+    """Per-engine load score for the (future) cache-aware balancer:
+    queue-wait p90 (seconds — the dominant term under real overload) +
+    slot occupancy (0..1) + page-pool pressure (0..1). Higher = more
+    loaded; compare across replicas, not against a threshold."""
+    return round(
+        max(0.0, queue_wait_p90_s)
+        + min(max(occupancy, 0.0), 1.0)
+        + min(max(page_pressure, 0.0), 1.0),
+        4,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Request-lifecycle spans
+# ---------------------------------------------------------------------------
+
+
+def _span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def emit_request_spans(
+    trace_id: Optional[str],
+    stamps: dict[str, float],
+    attributes: dict[str, Any],
+    status: str = "ok",
+) -> Optional[str]:
+    """Emit the per-request span tree from monotonic phase ``stamps``
+    (``submitted`` required; ``admitted`` / ``first_token`` / ``finished``
+    optional — missing phases collapse: a request cancelled in queue gets
+    only the root + ``engine.queued``). Returns the trace id used.
+
+    Called ONCE per request at completion, from the engine thread (or the
+    expiry sweep) — never from the token delivery loop."""
+    if not TRACER.enabled:
+        return trace_id
+    submitted = stamps.get("submitted")
+    if submitted is None:
+        return trace_id
+    now_mono = time.monotonic()
+    finished = stamps.get("finished", now_mono)
+    offset = time.time() - now_mono  # monotonic → wall conversion
+    trace_id = trace_id or uuid.uuid4().hex[:16]
+    root = Span(
+        name="engine.request",
+        trace_id=trace_id,
+        span_id=_span_id(),
+        parent_id=None,
+        start_s=submitted + offset,
+        duration_s=max(0.0, finished - submitted),
+        attributes=dict(attributes),
+        status=status,
+    )
+    children: list[Span] = []
+
+    def child(name: str, start: float, end: float, **attrs: Any) -> None:
+        children.append(
+            Span(
+                name=name,
+                trace_id=trace_id,
+                span_id=_span_id(),
+                parent_id=root.span_id,
+                start_s=start + offset,
+                duration_s=max(0.0, end - start),
+                attributes=attrs,
+            )
+        )
+
+    admitted = stamps.get("admitted")
+    first_token = stamps.get("first_token")
+    child(
+        "engine.queued",
+        submitted,
+        admitted if admitted is not None else finished,
+        slot=attributes.get("slot", -1),
+    )
+    if admitted is not None:
+        child(
+            "engine.prefill",
+            admitted,
+            first_token if first_token is not None else finished,
+            slot=attributes.get("slot", -1),
+            path=attributes.get("path", ""),
+            prefill_chunks=attributes.get("prefill_chunks", 0),
+        )
+    if first_token is not None:
+        child(
+            "engine.decode",
+            first_token,
+            finished,
+            slot=attributes.get("slot", -1),
+            decode_iterations=attributes.get("decode_iterations", 0),
+            verify_dispatches=attributes.get("verify_dispatches", 0),
+        )
+    # children first so /traces consumers see a complete tree the moment
+    # the root appears
+    for span in children:
+        TRACER.emit(span)
+    TRACER.emit(root)
+    return trace_id
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+FLIGHT_SCHEMA = "lstpu-flight-v1"
+
+# every ring entry carries at least these (engine._iterate builds them);
+# extra keys are allowed, token CONTENT is not (see validate_flight_dump)
+ITERATION_FIELDS = (
+    "i",        # engine iteration number (monotonic, counts idle too)
+    "t",        # wall-clock seconds
+    "active",   # active decode slots
+    "queued",   # admission queue depth
+    "dispatch", # "decode" | "verify" | "" (nothing dispatched)
+    "steps",    # decode steps (or k+1 verify width) dispatched
+    "kv_pages", # physical pages in use (0 under the dense layout)
+    "programs", # distinct compiled device programs so far
+    "phase_ms", # {"sweep","prefill","dispatch","process"} host-wall ms
+)
+
+# token content must never reach a dump: dumps travel to incident channels
+_FORBIDDEN_KEYS = frozenset(
+    {"tokens", "token", "prompt", "prompt_tokens", "generated", "text",
+     "drafts", "value"}
+)
+
+DUMP_REASONS = (
+    "nan-quarantine", "page-quarantine", "engine-restart", "shed-burst",
+    "on-demand",
+)
+
+# process-global recent dumps (newest last): the runtime HTTP server's
+# /flight endpoint reads this without holding an engine reference. The
+# lock covers append AND copy — iterating a deque while another thread
+# appends raises, and /flight must not 500 at the exact moment an
+# incident produces a dump
+RECENT_DUMPS: deque = deque(maxlen=8)
+_RECENT_LOCK = threading.Lock()
+
+
+def recent_dumps() -> list[dict[str, Any]]:
+    with _RECENT_LOCK:
+        return list(RECENT_DUMPS)
+
+
+class FlightRecorder:
+    """Bounded ring of per-iteration engine records. ``record`` is engine-
+    thread-only and lock-cheap (one deque append under a lock); ``dump``
+    may be called from any thread (submit-side shed bursts) and is
+    debounced per reason so a fault storm produces one artifact, not
+    hundreds."""
+
+    def __init__(
+        self,
+        capacity: int = 256,
+        dump_dir: Optional[str] = None,
+        min_dump_interval_s: float = 2.0,
+    ) -> None:
+        self.capacity = max(8, int(capacity))
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.dump_dir = dump_dir
+        self.min_dump_interval_s = float(min_dump_interval_s)
+        self._last_dump_t: dict[str, float] = {}
+        self._seq = 0
+        self.dumps_total = 0
+        self.last_dump: Optional[dict[str, Any]] = None
+        # shed-burst detection: sheds within a sliding 1s window
+        self._shed_window: deque = deque(maxlen=64)
+        self.shed_burst_threshold = 5
+
+    def record(self, entry: dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(entry)
+
+    def iterations(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def note_shed(self) -> bool:
+        """Register one shed; True when the 1s sliding window crosses the
+        burst threshold (the caller then dumps with reason shed-burst)."""
+        now = time.monotonic()
+        with self._lock:
+            self._shed_window.append(now)
+            recent = sum(1 for t in self._shed_window if now - t <= 1.0)
+        return recent >= self.shed_burst_threshold
+
+    def dump(
+        self,
+        reason: str,
+        counters: Optional[dict[str, Any]] = None,
+        extra: Optional[dict[str, Any]] = None,
+        force: bool = False,
+    ) -> Optional[dict[str, Any]]:
+        """Snapshot the ring into a postmortem artifact. Returns the dump
+        dict (also kept as ``last_dump``, appended to ``RECENT_DUMPS`` and
+        written under ``dump_dir`` when set), or None when debounced."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_dump_t.get(reason, -1e9) < (
+                self.min_dump_interval_s
+            ):
+                return None
+            self._last_dump_t[reason] = now
+            iterations = list(self._ring)
+            self._seq += 1
+            seq = self._seq
+        doc: dict[str, Any] = {
+            "schema": FLIGHT_SCHEMA,
+            "reason": reason,
+            "at": round(time.time(), 3),
+            "seq": seq,
+            "iterations": iterations,
+            "counters": dict(counters or {}),
+            "extra": dict(extra or {}),
+        }
+        self.last_dump = doc
+        self.dumps_total += 1
+        with _RECENT_LOCK:
+            RECENT_DUMPS.append(doc)
+        if self.dump_dir:
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                path = os.path.join(
+                    self.dump_dir, f"flight-{seq:04d}-{reason}.json"
+                )
+                with open(path, "w") as f:
+                    json.dump(doc, f, indent=1)
+                log.warning("flight recorder dumped %d iteration(s) to %s "
+                            "(reason: %s)", len(iterations), path, reason)
+            except OSError:
+                log.exception("flight recorder dump write failed")
+        else:
+            log.warning(
+                "flight recorder dumped %d iteration(s) in memory (reason: %s)",
+                len(iterations), reason,
+            )
+        return doc
+
+
+def _walk_forbidden(obj: Any, path: str) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if str(k) in _FORBIDDEN_KEYS:
+                raise ValueError(
+                    f"flight dump carries token-content key {k!r} at {path}"
+                )
+            _walk_forbidden(v, f"{path}.{k}")
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _walk_forbidden(v, f"{path}[{i}]")
+
+
+def validate_flight_dump(doc: dict[str, Any]) -> bool:
+    """Validate a dump against the documented schema (docs/SERVING.md §12):
+    raises ValueError with the first violation, returns True when clean.
+    Used by the chaos CI step and the observability tests — the schema IS
+    the contract incident tooling parses."""
+    if not isinstance(doc, dict):
+        raise ValueError("flight dump must be a JSON object")
+    if doc.get("schema") != FLIGHT_SCHEMA:
+        raise ValueError(f"unknown flight schema {doc.get('schema')!r}")
+    if doc.get("reason") not in DUMP_REASONS:
+        raise ValueError(f"unknown dump reason {doc.get('reason')!r}")
+    if not isinstance(doc.get("at"), (int, float)):
+        raise ValueError("dump missing numeric 'at' timestamp")
+    iterations = doc.get("iterations")
+    if not isinstance(iterations, list):
+        raise ValueError("dump missing 'iterations' list")
+    for j, entry in enumerate(iterations):
+        if not isinstance(entry, dict):
+            raise ValueError(f"iteration {j} is not an object")
+        for key in ITERATION_FIELDS:
+            if key not in entry:
+                raise ValueError(f"iteration {j} missing field {key!r}")
+    if not isinstance(doc.get("counters"), dict):
+        raise ValueError("dump missing 'counters' object")
+    _walk_forbidden(doc, "$")
+    json.dumps(doc)  # must be plain-serializable end to end
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Engine-facing bundle
+# ---------------------------------------------------------------------------
+
+
+class EngineObservability:
+    """Everything the engine consults, behind one ``on`` flag so the
+    `observability: off` escape hatch (and the overhead bench's off leg)
+    is a single branch on the hot paths."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        flight_capacity: int = 256,
+        flight_dir: Optional[str] = None,
+    ) -> None:
+        self.on = bool(enabled)
+        self.hist: dict[str, Histogram] = build_histograms() if self.on else {}
+        self.flight = FlightRecorder(
+            capacity=flight_capacity,
+            dump_dir=flight_dir
+            if flight_dir is not None
+            else (os.environ.get("LSTPU_FLIGHT_DIR") or None),
+        )
+
+    def record(self, name: str, value: float) -> None:
+        h = self.hist.get(name)
+        if h is not None:
+            h.record(value)
+
+    def histograms(self) -> dict[str, dict[str, Any]]:
+        return {name: h.snapshot() for name, h in self.hist.items()}
+
+    def percentile(self, name: str, p: float) -> float:
+        h = self.hist.get(name)
+        return h.percentile(p) if h is not None else 0.0
+
+    def reset_histograms(self) -> None:
+        for h in self.hist.values():
+            h.reset()

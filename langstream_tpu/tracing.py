@@ -94,9 +94,30 @@ class Tracer:
             with self._lock:
                 self._finished.append(span)
 
+    def emit(self, span: Span) -> None:
+        """Append an already-finished span built by hand — the serving
+        engine's request-lifecycle spans are assembled from phase
+        timestamps at request completion (one emission point, nothing on
+        the token hot loop) rather than held open across engine-thread
+        iterations, so the context-manager form cannot carry them."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._finished.append(span)
+
     def current_trace_id(self) -> Optional[str]:
         span = _current_span.get()
         return span.trace_id if span is not None else None
+
+    def find(self, name: str, trace_id: Optional[str] = None) -> list[Span]:
+        """Finished spans by name (and optionally trace) — tests/debugging."""
+        with self._lock:
+            items = list(self._finished)
+        return [
+            s
+            for s in items
+            if s.name == name and (trace_id is None or s.trace_id == trace_id)
+        ]
 
     def spans(self, limit: int = 500) -> list[dict[str, Any]]:
         with self._lock:

@@ -16,11 +16,19 @@ SRC_DIR = Path(__file__).parent.parent / "langstream_tpu"
 
 
 def registered_metric_suffixes() -> set[str]:
-    """Every name passed to .counter()/.gauge() anywhere in the source."""
-    pat = re.compile(r"\.(?:counter|gauge)\(\s*\"([a-z0-9_]+)\"")
+    """Every name passed to .counter()/.gauge()/.histogram() anywhere in
+    the source, plus the engine histogram taxonomy (registered via the
+    ENGINE_HISTOGRAMS spec rather than string literals)."""
+    from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
+
+    pat = re.compile(r"\.(?:counter|gauge|histogram)\(\s*\"([a-z0-9_]+)\"")
     names: set[str] = set()
     for py in SRC_DIR.rglob("*.py"):
         names.update(pat.findall(py.read_text()))
+    names.update(ENGINE_HISTOGRAMS)
+    # a histogram name X exposes X_bucket / X_sum / X_count series
+    for h in ENGINE_HISTOGRAMS:
+        names.update({f"{h}_bucket", f"{h}_sum", f"{h}_count"})
     assert names, "no metric registrations found in source"
     return names
 
@@ -63,6 +71,8 @@ def test_dashboard_regexes_match_live_exposition():
     """Register the real serving + runner metric names the way the agents do
     and verify each dashboard __name__ regex matches at least one line of the
     rendered Prometheus exposition."""
+    from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
+
     reporter = MetricsReporter()
     runner_scope = reporter.with_prefix("agent_step1")
     for n in ("source_out_total", "sink_in_total", "errors_total"):
@@ -70,7 +80,11 @@ def test_dashboard_regexes_match_live_exposition():
     serving = reporter.with_prefix("agent_chat_completions")
     for n in ("num_calls_total", "completion_tokens_total", "prompt_tokens_total"):
         serving.counter(n)
+    for name, spec in ENGINE_HISTOGRAMS.items():
+        serving.histogram(name, spec["help"], spec["buckets"])
     for n in (
+        "engine_load_score",
+        "engine_flight_dumps_total",
         "last_ttft_ms",
         "last_tokens_per_sec",
         "engine_active_slots",
@@ -96,7 +110,9 @@ def test_dashboard_regexes_match_live_exposition():
     ):
         serving.gauge(n)
     exposed = {
-        line.split()[0]
+        # histogram bucket lines carry a {le="…"} label — strip it so the
+        # dashboard __name__ matchers compare against the series name
+        line.split()[0].split("{")[0]
         for line in reporter.prometheus_text().splitlines()
         if line and not line.startswith("#")
     }
@@ -106,6 +122,30 @@ def test_dashboard_regexes_match_live_exposition():
         assert any(matcher.fullmatch(name) for name in exposed), (
             f"dashboard regex {regex!r} matches no exported metric"
         )
+
+
+def test_observability_panels_present():
+    """The round-11 observability panels must survive dashboard edits: the
+    TTFT histogram HEATMAP (reads the engine histogram's _bucket series
+    with a heatmap-format target) and the load-score panel (the replica
+    balancer's routing signal, ROADMAP item 3)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    by_title = {p.get("title", ""): p for p in doc["panels"]}
+    heat = next(
+        (p for t, p in by_title.items() if "heatmap" in t.lower()), None
+    )
+    assert heat is not None, "TTFT histogram heatmap panel missing"
+    assert heat["type"] == "heatmap"
+    heat_exprs = " ".join(t["expr"] for t in heat["targets"])
+    assert "engine_ttft_s_bucket" in heat_exprs
+    assert "by (le)" in heat_exprs, "heatmap must aggregate by bucket label"
+    load = next(
+        (p for t, p in by_title.items() if "load score" in t.lower()), None
+    )
+    assert load is not None, "engine load-score panel missing"
+    assert any(
+        "engine_load_score" in t["expr"] for t in load["targets"]
+    )
 
 
 def test_grafana_provisioning_parses():
