@@ -609,6 +609,189 @@ def bench_degradation(preset: str, quantize: bool, max_batch: int,
     }
 
 
+def _spawn_fleet(n_replicas: int, config_base: dict) -> tuple[list, list]:
+    """Launch ``n_replicas`` standalone replica processes (CPU engines —
+    JAX_PLATFORMS pinned, so the fleet phase also runs on TPU hosts without
+    fighting over the chip) and return (procs, HttpReplica handles). Each
+    worker prints one JSON line with its URL once its engine is warm."""
+    import os
+    import subprocess
+
+    from langstream_tpu.serving.fleet import HttpReplica
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("LSTPU_FAULTS", None)  # the fleet phase measures, not drills
+    procs = []
+    for i in range(n_replicas):
+        cfg = dict(config_base)
+        cfg["fleet-replica-id"] = f"r{i}"
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "langstream_tpu.serving.fleet",
+                    "--config", json.dumps(cfg),
+                ],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                env=env,
+                text=True,
+            )
+        )
+    replicas = []
+    for i, p in enumerate(procs):
+        line = p.stdout.readline()
+        if not line:
+            raise RuntimeError(f"fleet replica {i} died before serving")
+        replicas.append(HttpReplica(f"r{i}", json.loads(line)["url"]))
+    return procs, replicas
+
+
+def _stop_fleet(procs: list) -> None:
+    for p in procs:
+        try:
+            p.stdin.close()  # workers exit on stdin EOF
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — last resort
+            p.kill()
+
+
+def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
+               new_tokens: int, lam: float) -> dict:
+    """One measured arm over a FRESH fleet: one seed request per preamble
+    group (cold prefill + publish, wherever the cold route lands),
+    histogram reset, then the 10× concurrent burst — ``burst_mult``
+    requests per group, groups interleaved. Affinity keeps each group on
+    the replica that owns its preamble; round-robin scatters every group
+    across every replica, re-prefilling each preamble per replica."""
+    import threading
+
+    from langstream_tpu.serving.engine import ShedError
+    from langstream_tpu.serving.fleet import FleetRouter, FleetShedError
+
+    router = FleetRouter(
+        replicas, policy=policy, lam=lam, refresh_interval_s=0.2,
+    )
+    router.start()  # background beacon refresh: load spills mid-burst
+    opts = {"max-tokens": new_tokens, "temperature": 0.0}
+    for g, preamble in enumerate(preambles):
+        router.generate(preamble + [1], opts)  # seed: cold prefill + publish
+    time.sleep(0.5)  # one refresh so the burst sees the published prefixes
+    for r in replicas:
+        r.reset_histograms()  # the pair is WARM p50, not compile time
+    ttfts: list = []
+    sheds = [0]
+    lock = threading.Lock()
+    prompts = [
+        preambles[i % len(preambles)] + [2 + i]
+        for i in range(burst_mult * len(preambles))
+    ]
+    # SHUFFLE the arrival order (seeded): an interleaved order with
+    # n_groups == n_replicas would hand round-robin a perfect
+    # group-per-replica alignment by pure stride coincidence — the control
+    # arm must be BLIND dispatch, not accidental affinity
+    import numpy as _np
+
+    _np.random.default_rng(3).shuffle(prompts)
+    n_requests = len(prompts)
+
+    def one(i: int) -> None:
+        try:
+            out, _decision = router.generate(prompts[i], opts)
+            with lock:
+                ttfts.append(out["ttft_s"])
+        except (ShedError, FleetShedError):
+            with lock:
+                sheds[0] += 1
+
+    threads = [
+        threading.Thread(target=one, args=(i,)) for i in range(n_requests)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    beacons = [r.fetch_beacon() for r in replicas]
+    stats = router.stats()
+    router.stop()
+    ttfts.sort()
+    return {
+        "p50_ttft_ms": round(_pct(ttfts, 0.50) * 1e3, 1) if ttfts else None,
+        "p99_ttft_ms": round(_pct(ttfts, 0.99) * 1e3, 1) if ttfts else None,
+        # per-replica engine-histogram p50s (the beacon carries them) —
+        # the replica(s) that actually served show the warm number
+        "replica_p50s_ms": [b["ttft_p50_ms"] for b in beacons],
+        "prefill_tokens_saved": sum(
+            b["prefill_tokens_saved_total"] for b in beacons
+        ),
+        "hit_rates": [b["prefix_hit_rate"] for b in beacons],
+        "shed_rate": round(sheds[0] / max(1, n_requests), 3),
+        "completed": len(ttfts),
+        "wall_s": round(wall, 2),
+        "routed_affinity": stats["fleet-routed-affinity-total"]
+        + stats["fleet-routed-sticky-total"],
+        "routed_balanced": stats["fleet-routed-balanced-total"],
+        "dispatch_p50_ms": stats["fleet-dispatch-p50-ms"],
+        "dispatch_p99_ms": stats["fleet-dispatch-p99-ms"],
+    }
+
+
+def bench_fleet(*, n_replicas: int = 3, n_groups: int = 4,
+                preamble_len: int = 256, burst_mult: int = 10,
+                new_tokens: int = 16, lam: float = 128.0) -> dict:
+    """Fleet phase (ISSUE 8 acceptance): a multi-process CPU fleet (the
+    SPMD tests' subprocess pattern) under a 10× shared-preamble burst —
+    ``n_groups`` distinct preambles (multi-tenant chat: different system
+    prompts), ``burst_mult`` requests per group, all concurrent — measured
+    twice on FRESH replicas: prefix-affinity routing vs blind round-robin
+    at equal replica count. Affinity must win warm p50 TTFT AND aggregate
+    prefill-tokens-saved (round-robin re-prefills every preamble on every
+    replica it touches); the router itself must cost <1 ms p50 per
+    dispatch (its histogram is part of the record). Each arm gets its own
+    processes: a shared fleet would hand the second arm pre-warmed
+    replicas and fake the delta."""
+    import numpy as np
+
+    rng = np.random.default_rng(12)
+    preambles = [
+        rng.integers(1, 200, size=preamble_len).tolist()
+        for _ in range(n_groups)
+    ]
+    config = {
+        "model": "tiny-test",
+        "max-batch": 4,
+        "max-seq-len": 1024,
+        "prefill-buckets": (64, 128, 256, 512),
+        "decode-chunk": 8,
+        "prefix-cache": "auto",
+        "prefix-cache-entries": 2 * n_groups,
+        "precompile": True,
+    }
+    out: dict = {
+        "fleet_replicas": n_replicas,
+        "fleet_preamble_groups": n_groups,
+        "fleet_burst_requests": n_groups * burst_mult,
+        "fleet_preamble": preamble_len,
+        "fleet_lambda": lam,
+    }
+    for policy, key in (("affinity", "affinity"), ("round-robin", "rr")):
+        procs, replicas = _spawn_fleet(n_replicas, config)
+        try:
+            arm = _fleet_arm(
+                policy, replicas, preambles, burst_mult, new_tokens, lam
+            )
+        finally:
+            _stop_fleet(procs)
+        out.update({f"fleet_{key}_{k}": v for k, v in arm.items()})
+        print(f"[bench] fleet {policy}: {arm}", file=sys.stderr, flush=True)
+    return out
+
+
 async def bench_gateway(preset: str, quantize: bool, max_batch: int, new_tokens: int,
                         n_sessions: int, max_seq_len: int, decode_chunk: int,
                         prefill_batch: int, overlap: bool = True) -> dict:
@@ -877,6 +1060,18 @@ def main() -> None:
         ))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] degradation phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # fleet routing pair (ISSUE 8 acceptance): 3-process CPU fleet,
+    # shared-preamble 10× burst, prefix-affinity vs round-robin — the
+    # workers pin JAX_PLATFORMS=cpu, so this phase runs identically on
+    # TPU hosts (the router tier is host code; engine perf has its own
+    # phases)
+    print("[bench] fleet (affinity vs round-robin) phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_fleet())
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] fleet phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     if on_tpu:
         # flagship phase: BASELINE.md's headline model (llama-3-8b, ≥2000

@@ -168,6 +168,23 @@ class _BaseCompletionsStep(Step):
             "flight-recorder postmortem dumps produced (quarantines, "
             "restarts, shed bursts, on-demand), cumulative",
         )
+        # fleet routing tier (serving/fleet.py, docs/SERVING.md §13):
+        # router-cumulative counters carried as gauges like the engine
+        # sets; zeros while fleet: off so the exporter is unconditional
+        self._m_fleet_affinity = metrics.gauge(
+            "fleet_routed_affinity_total",
+            "requests routed by prefix affinity (incl. sticky sessions) — "
+            "the cache-aware hits, cumulative",
+        )
+        self._m_fleet_balanced = metrics.gauge(
+            "fleet_routed_balanced_total",
+            "requests routed by load only (no usable prefix anywhere), "
+            "cumulative",
+        )
+        self._m_fleet_replicas = metrics.gauge(
+            "fleet_replica_count",
+            "replicas the fleet router fronts (routable or not)",
+        )
         from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
 
         self._m_hists = {
@@ -210,6 +227,13 @@ class _BaseCompletionsStep(Step):
         self._m_restarts.set(stats.get("engine-restarts-total", 0))
         self._m_load.set(stats.get("load-score", 0))
         self._m_flight_dumps.set(stats.get("flight-dumps-total", 0))
+        fleet = getattr(self._service, "fleet_stats", lambda: None)() or {}
+        self._m_fleet_affinity.set(
+            fleet.get("fleet-routed-affinity-total", 0)
+            + fleet.get("fleet-routed-sticky-total", 0)
+        )
+        self._m_fleet_balanced.set(fleet.get("fleet-routed-balanced-total", 0))
+        self._m_fleet_replicas.set(fleet.get("fleet-replica-count", 0))
         for name, snap in (stats.get("histograms") or {}).items():
             mirror = self._m_hists.get(name)
             if mirror is not None:

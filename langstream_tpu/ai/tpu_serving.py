@@ -63,6 +63,21 @@ Resource configuration:
     iterations dumped on NaN/page quarantines, restarts and shed bursts;
     `flight-dir` (or LSTPU_FLIGHT_DIR) writes dump JSON files there
     (docs/SERVING.md §12)
+  fleet: auto | off (default off) → resolve each completion through the
+    fleet router (serving/fleet.py): prefix-affinity-first, load-second
+    dispatch across this engine plus the peer replicas in
+    `fleet-replicas` (list of beacon base-URLs or {id,url} dicts).
+    `fleet-lambda` (default 256) trades warm-prefix tokens against load;
+    `fleet-policy` (affinity | round-robin | least-loaded) exists for
+    benches; `fleet-replica-id`/`fleet-self-url` identify THIS replica in
+    beacons; `fleet-beacon-ttl-s`/`fleet-refresh-interval-s`/
+    `fleet-sticky-ttl-s` tune health and session stickiness
+    (docs/SERVING.md §13). The /state beacon and /fleet/generate endpoint
+    are served regardless of this knob — fleet: off only means THIS
+    process routes nothing.
+  compile-cache-dir: persistent XLA compile cache directory — a scale-up
+    replica pointed at a warm (shared) cache dir skips the warmup
+    ladder's compile wall and serves in seconds (fleet cold-start lever)
   mesh: {model: N, data: M, expert: K} → shard weights over the local mesh
   quantization: "int8" → weight-only int8 (halves weight HBM traffic; big
     models stage on the host so the bf16 tree never needs device HBM)
@@ -110,6 +125,8 @@ class _EngineHolder:
         self._params = None
         self._embed_fn = None
         self._mesh = None
+        self._fleet_router = None
+        self._fleet_replica_id: Optional[str] = None
 
     def mesh(self):
         """Device mesh for TP/EP sharding when `mesh` is configured."""
@@ -203,6 +220,17 @@ class _EngineHolder:
         from langstream_tpu.parallel.multihost import DistributedConfig
         from langstream_tpu.serving.engine import ServingEngine
 
+        # persistent XLA compile cache (fleet fast cold start): a scale-up
+        # replica pointed at a warm shared cache dir deserializes every
+        # warmup program instead of recompiling — seconds instead of the
+        # compile wall. Must be set BEFORE any jit below runs.
+        cache_dir = self.config.get("compile-cache-dir")
+        if cache_dir:
+            from langstream_tpu.serving.engine import (
+                enable_persistent_compile_cache,
+            )
+
+            enable_persistent_compile_cache(str(cache_dir))
         mc = self.model_config()
         layout = str(self.config.get("kv-layout", "paged")).lower()
         if layout not in ("paged", "dense"):
@@ -316,6 +344,25 @@ class _EngineHolder:
         )
         if start:
             engine.start()
+            # publish this engine's state beacon + fleet dispatch endpoint
+            # on the runtime HTTP server (serving/fleet.py registry): GET
+            # /state and POST /fleet/generate work in every topology, not
+            # just fleet-mode ones (the router on ANOTHER pod reads them)
+            from langstream_tpu.serving import fleet as fleet_mod
+
+            rid = str(self.config.get("fleet-replica-id") or "local")
+            url = str(self.config.get("fleet-self-url") or "")
+            self._fleet_replica_id = rid
+            fleet_mod.register_local(
+                rid,
+                beacon_fn=lambda: fleet_mod.beacon_from_engine(
+                    rid, engine, url=url
+                ),
+                generate_fn=lambda payload: fleet_mod.engine_generate(
+                    engine, payload
+                ),
+                reset_fn=engine.reset_histograms,
+            )
         return engine
 
     def _fault_injector(self):
@@ -339,6 +386,61 @@ class _EngineHolder:
             if self._engine is None:
                 self._engine = self.build_engine(start=True)
             return self._engine
+
+    def fleet_router(self):
+        """The fleet router when `fleet: auto` is configured, else None.
+        The router fronts THIS engine (InProcessReplica — local requests
+        never pay an HTTP hop) plus every peer URL in `fleet-replicas`;
+        its beacon refresher starts with it (docs/SERVING.md §13)."""
+        mode = self.config.get("fleet", "off")
+        mode_s = str(mode).lower()
+        if mode is False or mode_s in ("off", "false", "none", ""):
+            return None
+        if mode is not True and mode_s != "auto":
+            raise ValueError(f"unknown fleet mode {mode!r}; supported: auto, off")
+        engine = self.engine()  # outside the lock: engine() takes it
+        with self._lock:
+            if self._fleet_router is None:
+                from langstream_tpu.serving.fleet import (
+                    FleetRouter,
+                    HttpReplica,
+                    InProcessReplica,
+                )
+
+                rid = self._fleet_replica_id or "local"
+                replicas: list[Any] = [
+                    InProcessReplica(
+                        rid, engine,
+                        url=str(self.config.get("fleet-self-url") or ""),
+                    )
+                ]
+                for peer in self.config.get("fleet-replicas") or []:
+                    if isinstance(peer, dict):
+                        replicas.append(
+                            HttpReplica(
+                                str(peer.get("id") or peer["url"]),
+                                str(peer["url"]),
+                            )
+                        )
+                    else:
+                        replicas.append(HttpReplica(str(peer), str(peer)))
+                router = FleetRouter(
+                    replicas,
+                    lam=float(self.config.get("fleet-lambda", 256.0)),
+                    policy=str(self.config.get("fleet-policy", "affinity")),
+                    beacon_ttl_s=float(
+                        self.config.get("fleet-beacon-ttl-s", 10.0)
+                    ),
+                    refresh_interval_s=float(
+                        self.config.get("fleet-refresh-interval-s", 0.5)
+                    ),
+                    sticky_ttl_s=float(
+                        self.config.get("fleet-sticky-ttl-s", 600.0)
+                    ),
+                )
+                router.start()
+                self._fleet_router = router
+            return self._fleet_router
 
     def embed_fn(self):
         with self._lock:
@@ -369,6 +471,14 @@ class _EngineHolder:
 
     def close(self) -> None:
         with self._lock:
+            if self._fleet_router is not None:
+                self._fleet_router.stop()
+                self._fleet_router = None
+            if self._fleet_replica_id is not None:
+                from langstream_tpu.serving import fleet as fleet_mod
+
+                fleet_mod.unregister_local(self._fleet_replica_id)
+                self._fleet_replica_id = None
             if self._engine is not None:
                 # graceful teardown: drain (finish in-flight, reject new)
                 # for a bounded grace period, THEN stop — stop() alone
@@ -439,6 +549,12 @@ class TpuCompletionsService(CompletionsService):
         engine = self.holder._engine
         return engine.stats() if engine is not None else {}
 
+    def fleet_stats(self) -> dict[str, Any]:
+        """Router counters for the fleet gauges (empty when fleet: off or
+        the router was never built — never force a build to report zeros)."""
+        router = self.holder._fleet_router
+        return router.stats() if router is not None else {}
+
     def _render_prompt(self, messages: list[ChatMessage]) -> str:
         tok = self.holder.tokenizer()
         hf = getattr(tok, "_tok", None)
@@ -468,6 +584,106 @@ class TpuCompletionsService(CompletionsService):
     ) -> ChatCompletionsResult:
         return await self._generate("\n".join(prompt), options, chunks_consumer)
 
+    def _finish_result(
+        self,
+        tokens: list[int],
+        finish_reason: str,
+        prompt_tokens: int,
+        ttft_s: float,
+        total_s: float,
+        options: dict[str, Any],
+        stream_state: Optional["_StreamState"],
+    ) -> ChatCompletionsResult:
+        if stream_state is not None:
+            stream_state.finish()
+        content = self.holder.tokenizer().decode(tokens)
+        # string-level stop sequences (token-level stops handled in-engine)
+        for stop in options.get("stop") or []:
+            cut = content.find(stop)
+            if cut >= 0:
+                content = content[:cut]
+        return ChatCompletionsResult(
+            content=content,
+            finish_reason=finish_reason,
+            prompt_tokens=prompt_tokens,
+            completion_tokens=len(tokens),
+            ttft_ms=ttft_s * 1000.0,
+            total_ms=total_s * 1000.0,
+        )
+
+    async def _fleet_dispatch(
+        self,
+        router: Any,
+        prompt_tokens: list[int],
+        options: dict[str, Any],
+        chunks_consumer: Optional[StreamingChunksConsumer],
+    ) -> Optional[ChatCompletionsResult]:
+        """Resolve one request through the fleet router. Returns None when
+        the route lands on THIS replica (the caller runs the normal local
+        streaming path — no HTTP hop, per-token chunks) and the completed
+        result when it was dispatched to a peer. A peer that dies
+        mid-dispatch is quarantined and the request fails over COLD
+        (docs/SERVING.md §13); fleet sheds surface as the engine's
+        ShedError so the pipeline's 429 handling is one code path."""
+        import asyncio
+
+        from langstream_tpu.serving.engine import ShedError
+        from langstream_tpu.serving.fleet import FleetShedError, ReplicaError
+
+        session_id = str(options.get("cancel-key") or "") or None
+        # cross-process dispatch: the cancel registry is process-local, so
+        # the peer cannot see this session's disconnects — deadlines bound
+        # orphan decode there (the §9-documented gap, unchanged)
+        remote_options = {k: v for k, v in options.items() if k != "cancel-key"}
+        loop = asyncio.get_running_loop()
+        excluded: set = set()
+        last_shed: Optional[FleetShedError] = None
+        for _ in range(max(2, router.replica_count)):
+            try:
+                decision = router.route(
+                    prompt_tokens, session_id=session_id, exclude=excluded
+                )
+            except FleetShedError as e:
+                raise ShedError(str(e), retry_after_s=e.retry_after_s) from e
+            if decision.handle.is_local:
+                return None
+            try:
+                out = await loop.run_in_executor(
+                    None,
+                    lambda d=decision: d.handle.generate(
+                        prompt_tokens, remote_options, 600.0
+                    ),
+                )
+            except FleetShedError as e:
+                last_shed = e
+                excluded.add(decision.replica_id)
+                continue
+            except ReplicaError:
+                router.note_failover(decision.replica_id)
+                excluded.add(decision.replica_id)
+                continue
+            stream_state = None
+            if chunks_consumer is not None:
+                stream_state = _StreamState(
+                    self.holder.tokenizer(),
+                    chunks_consumer,
+                    int(options.get("min-chunks-per-message", 20)),
+                )
+                for t in out["tokens"]:
+                    stream_state.on_token(int(t))
+            return self._finish_result(
+                [int(t) for t in out["tokens"]],
+                str(out.get("finish_reason", "stop")),
+                int(out.get("prompt_tokens", len(prompt_tokens))),
+                float(out.get("ttft_s", 0.0)),
+                float(out.get("total_s", 0.0)),
+                options,
+                stream_state,
+            )
+        if last_shed is not None:
+            raise ShedError(str(last_shed), retry_after_s=last_shed.retry_after_s)
+        return None  # every peer died: serve locally (cold) rather than fail
+
     async def _generate(
         self,
         prompt: str,
@@ -479,6 +695,14 @@ class TpuCompletionsService(CompletionsService):
         engine = self.holder.engine()
         tokenizer = self.holder.tokenizer()
         gen_options = GenerationOptions.from_dict(options)
+        prompt_tokens = tokenizer.encode(prompt)
+        router = self.holder.fleet_router()
+        if router is not None:
+            remote = await self._fleet_dispatch(
+                router, prompt_tokens, options, chunks_consumer
+            )
+            if remote is not None:
+                return remote
         stream_state = None
         on_token = None
         if chunks_consumer is not None:
@@ -505,7 +729,7 @@ class TpuCompletionsService(CompletionsService):
 
         trace_id = str(options.get("trace-id") or "") or TRACER.current_trace_id()
         request = GenerationRequest(
-            prompt_tokens=tokenizer.encode(prompt),
+            prompt_tokens=prompt_tokens,
             options=gen_options,
             on_token=on_token,
             on_done=_on_done,
@@ -556,22 +780,14 @@ class TpuCompletionsService(CompletionsService):
         # through normally (the record commits, the dead client's answer
         # goes unread) — raising here would only trigger pipeline retries
         # for work the client already abandoned
-        if stream_state is not None:
-            stream_state.finish()
-
-        content = tokenizer.decode(result.tokens)
-        # string-level stop sequences (token-level stops handled in-engine)
-        for stop in options.get("stop") or []:
-            cut = content.find(stop)
-            if cut >= 0:
-                content = content[:cut]
-        return ChatCompletionsResult(
-            content=content,
-            finish_reason=result.finish_reason,
-            prompt_tokens=result.prompt_tokens,
-            completion_tokens=len(result.tokens),
-            ttft_ms=result.ttft_s * 1000.0,
-            total_ms=result.total_s * 1000.0,
+        return self._finish_result(
+            result.tokens,
+            result.finish_reason,
+            result.prompt_tokens,
+            result.ttft_s,
+            result.total_s,
+            options,
+            stream_state,
         )
 
 

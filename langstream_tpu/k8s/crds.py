@@ -100,6 +100,12 @@ class AgentCustomResource:
     size: int = 1
     disk: Optional[dict[str, Any]] = None  # {enabled,type,size}
     tpu: Optional[dict[str, Any]] = None  # {type,topology,chips,mesh}
+    # fleet autoscaling (serving/fleet.py, docs/SERVING.md §13):
+    # {enabled, min-replicas, max-replicas}. The DESIRED count itself is
+    # runtime state — the router's queue-wait-EMA hint, written to
+    # status.fleet.desiredReplicas by the ops loop — so a scale decision
+    # never touches the spec checksum (no pod rollout, just more pods)
+    autoscale: Optional[dict[str, Any]] = None
     status: dict[str, Any] = field(default_factory=dict)
     generation: int = 1
 
@@ -134,6 +140,7 @@ class AgentCustomResource:
                     "size": self.size,
                     "disk": self.disk,
                     "tpu": self.tpu,
+                    "autoscale": self.autoscale,
                 },
             },
             "status": dict(self.status),
@@ -159,6 +166,7 @@ class AgentCustomResource:
             size=int(resources.get("size", 1)),
             disk=resources.get("disk"),
             tpu=resources.get("tpu"),
+            autoscale=resources.get("autoscale"),
             status=dict(m.get("status", {})),
             generation=int(meta.get("generation", 1)),
         )

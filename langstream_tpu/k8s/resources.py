@@ -148,6 +148,26 @@ class AgentResourcesFactory:
             },
         }
 
+    @staticmethod
+    def fleet_consumers(agent: AgentCustomResource) -> int:
+        """Broker-consumer replica count for the StatefulSet: spec
+        parallelism, unless fleet autoscaling is enabled AND the ops loop
+        has written the router's desired-replica hint into
+        ``status.fleet.desiredReplicas`` — then the hint wins, clamped to
+        the spec's ``min-replicas``/``max-replicas`` bounds so a runaway
+        signal can never scale past what the operator budgeted
+        (docs/SERVING.md §13)."""
+        base = max(1, agent.parallelism)
+        auto = agent.autoscale or {}
+        if not auto.get("enabled"):
+            return base
+        hint = (agent.status.get("fleet") or {}).get("desiredReplicas")
+        if hint is None:
+            return base
+        lo = max(1, int(auto.get("min-replicas", 1)))
+        hi = max(lo, int(auto.get("max-replicas", max(base, 8))))
+        return max(lo, min(int(hint), hi))
+
     def generate_stateful_set(self, agent: AgentCustomResource) -> dict[str, Any]:
         size = min(agent.size, self.config.max_units)
         cpu = self.config.cpu_per_unit * size
@@ -287,11 +307,15 @@ class AgentResourcesFactory:
                 },
             },
             "spec": {
-                # replicas = parallelism × hosts (diverges from reference
-                # :295,:526-556 by design): parallelism multiplies broker
+                # replicas = consumers × hosts (diverges from reference
+                # :295,:526-556 by design): consumers multiply broker
                 # consumers; hosts are the pods of ONE consumer's multi-host
-                # process group (pods o..o+hosts-1 form replica o//hosts)
-                "replicas": agent.parallelism * hosts,
+                # process group (pods o..o+hosts-1 form replica o//hosts).
+                # Consumers default to spec parallelism; with autoscale
+                # enabled the fleet router's queue-wait-EMA hint
+                # (status.fleet.desiredReplicas) overrides it within the
+                # spec's min/max bounds (serving/fleet.py desired_replicas)
+                "replicas": self.fleet_consumers(agent) * hosts,
                 "podManagementPolicy": "Parallel",
                 "serviceName": agent.name,
                 "selector": {"matchLabels": self.labels(agent)},

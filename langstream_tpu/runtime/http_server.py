@@ -36,9 +36,63 @@ class RuntimeHttpServer:
                 web.get("/info", self._info),
                 web.get("/traces", self._traces),
                 web.get("/flight", self._flight),
+                web.get("/state", self._state),
+                web.post("/fleet/generate", self._fleet_generate),
+                web.post("/fleet/reset", self._fleet_reset),
                 web.get("/healthz", self._healthz),
             ]
         )
+
+    async def _state(self, request: web.Request) -> web.Response:
+        """Fleet state beacon (serving/fleet.py, docs/SERVING.md §13): the
+        per-replica load score, queue/drain/quarantine signals and top-K
+        prefix digests the cache-aware router scores replicas by. Served
+        from the process-global registry (like /flight) so the server
+        never holds an engine reference; empty replica list when no
+        serving engine runs in this process."""
+        from langstream_tpu.serving.fleet import local_state
+
+        return web.json_response(local_state())
+
+    async def _fleet_generate(self, request: web.Request) -> web.Response:
+        """Fleet-internal dispatch: the router forwards a tokenized request
+        to the replica it chose. Blocking engine work runs off-loop; engine
+        sheds map to 429 + Retry-After (the same contract the in-process
+        completions path gets from ShedError)."""
+        import asyncio
+
+        from langstream_tpu.serving.fleet import (
+            FleetShedError,
+            ReplicaError,
+            local_generate,
+        )
+
+        try:
+            payload = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="body must be JSON") from None
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(None, local_generate, payload)
+        except FleetShedError as e:
+            return web.json_response(
+                {"error": "shed", "retry_after_s": e.retry_after_s},
+                status=429,
+                headers={"Retry-After": f"{e.retry_after_s:.3f}"},
+            )
+        except (ReplicaError, RuntimeError) as e:
+            return web.json_response({"error": str(e)}, status=503)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from None
+        return web.json_response(result)
+
+    async def _fleet_reset(self, request: web.Request) -> web.Response:
+        """Zero the local engine's streaming histograms (bench warmup
+        hygiene — bench_fleet resets after the compile-heavy first burst)."""
+        from langstream_tpu.serving.fleet import local_reset
+
+        local_reset()
+        return web.json_response({"status": "OK"})
 
     async def _flight(self, request: web.Request) -> web.Response:
         """Recent flight-recorder dumps (serving/observability.py): the

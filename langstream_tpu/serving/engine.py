@@ -53,6 +53,34 @@ from langstream_tpu.serving.speculation import NGramIndex
 log = logging.getLogger(__name__)
 
 
+def enable_persistent_compile_cache(cache_dir: str) -> None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (the
+    ``compile-cache-dir`` resource knob): every XLA executable compiled by
+    this process is serialized there, and a LATER process compiling the
+    same program deserializes instead of recompiling. This is the fleet's
+    fast-cold-start lever — a scale-up replica pointed at a warm cache dir
+    (shared volume / persistent disk) skips the warmup ladder's compile
+    wall and is serving in seconds (docs/SERVING.md §13).
+
+    Thresholds are forced to cache-everything: the engine's small host-side
+    helper programs (row resets, chain scatters) compile fast but there are
+    MANY of them, and the default min-compile-time filter would skip
+    exactly the long tail that makes a cold warmup slow. Idempotent; safe
+    to call before any engine is built."""
+    import jax
+    from jax._src import compilation_cache as _cc
+
+    current = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if current != str(cache_dir):
+        # the cache singleton latches its enabled/dir decision on first
+        # use — reset so a dir configured AFTER jax already compiled
+        # something (tests, multi-engine processes) still takes effect
+        _cc.reset_cache()
+
+
 class ShedError(RuntimeError):
     """Admission rejected by load shedding (full queue, hopeless deadline,
     or a draining engine). ``retry_after_s`` is the engine's estimate of
@@ -1357,6 +1385,19 @@ class ServingEngine:
         this after their warmup request so one compile-heavy cold TTFT
         doesn't own p99 of a steady-state distribution."""
         self._obs.reset_histograms()
+
+    def prefix_advertisement(
+        self, top_k: int = 32,
+    ) -> tuple[tuple[int, ...], list[tuple[str, int]]]:
+        """The fleet beacon's affinity payload: the prefix index's bucket
+        boundaries plus its most-recently-used ``top_k`` prefixes as
+        ``(digest, length)`` pairs (serving/fleet.py). Non-mutating and
+        thread-safe — beacon building runs on the runtime HTTP thread and
+        must neither touch LRU recency nor leak token content."""
+        index = self._prefix_index if self._prefix_index is not None else self._prefix_pool
+        if index is None:
+            return (), []
+        return tuple(index.boundaries), index.advertised(top_k)
 
     def _counters_snapshot(self) -> dict[str, Any]:
         with self._stats_lock:

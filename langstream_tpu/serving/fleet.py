@@ -1,0 +1,890 @@
+"""Fleet router: radix-prefix-affinity routing + cache-aware load balancing
+across N serving-engine replicas (ROADMAP item 3).
+
+One engine is fast (BENCH_r05), but a second replica placed blindly HALVES
+the prefix hit rate: requests sharing a preamble land on whichever replica
+the balancer felt like, each replica re-prefills the preamble cold, and the
+paged pool's zero-copy aliasing (PR 5) never fires. This module is the tier
+that millions of users actually hit — the piece between the gateway and the
+engines:
+
+- **Beacons** (`beacon_from_engine`, served at ``GET /state`` by the
+  runtime HTTP server): each replica periodically advertises a compact
+  state document — its ``load_score`` (queue-wait p90 + occupancy + page
+  pressure, serving/observability.py), queue-wait EMA, free KV pages,
+  drain/quarantine flags, and the top-K prefix DIGESTS its radix index
+  holds (``pagepool.prefix_digest`` — 8-byte hashes, never token content;
+  the same redaction stance as the flight recorder). The non-mutating
+  ``match_len`` probes exist so beacon building and router probing never
+  touch LRU recency: advertising a prefix must not pin it.
+
+- **Router** (`FleetRouter`): dispatches each request by *prefix affinity
+  first, load second*. It hashes the incoming prompt at every advertised
+  boundary length and scores each replica
+
+      score(r) = expected_match_tokens(r) − λ · load_score(r)
+
+  routing to the argmax; when no replica holds a usable prefix the request
+  goes to the least-loaded replica instead. λ (tokens per load-score unit,
+  default 256) is the knob that decides when a hot replica is TOO hot to be
+  worth its warm cache — see docs/SERVING.md §13 for tuning. Sticky
+  sessions (``langstream-client-session-id`` → replica) keep multi-turn
+  chats on the replica whose pages they aliased. Overload sheds against
+  the replicas' EXPORTED signals (every routable replica's admission queue
+  full, or every queue-wait EMA past the bound) rather than a blind
+  request cap, and a replica that dies mid-burst is quarantined and its
+  requests re-routed — in-flight work fails over COLD to a survivor
+  (DeepServe's affinity-and-load dispatch, PAPERS.md).
+
+- **Autoscale hint** (`FleetRouter.desired_replicas`): the k8s planner's
+  scale signal, derived from the fleet-wide queue-wait EMA (scale-up) and
+  occupancy (scale-down) — surfaced as the ``langstream.ai/desired-replicas``
+  annotation k8s/resources.py honors on the agent StatefulSet.
+
+The routing tier is deliberately ABOVE the engines and programmable
+(PAPERS.md "Software-Defined Agentic Serving"): transports are duck-typed
+(`InProcessReplica` for tests/embedded runners, `HttpReplica` over the
+runtime HTTP server for real pods), and the policy is a constructor knob
+(``affinity`` | ``round-robin`` | ``least-loaded`` — round-robin exists as
+the bench control arm, not a production mode).
+
+Run ``python -m langstream_tpu.serving.fleet --config '<json>'`` to serve
+one replica (engine + /state + /fleet/generate) as a standalone process —
+the multi-process CPU fleet bench (bench.py bench_fleet) and the failure
+drills are built on this.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from langstream_tpu.api.metrics import Histogram, log_buckets
+from langstream_tpu.serving.pagepool import prefix_digest
+
+log = logging.getLogger(__name__)
+
+BEACON_SCHEMA = "lstpu-beacon-v1"
+STATE_SCHEMA = "lstpu-state-v1"
+
+# λ default: tokens of expected prefix match one unit of load score is
+# worth. load_score ≈ queue-wait p90 seconds + occupancy (0..1) + page
+# pressure (0..1); at λ=256 a fully-busy replica (occupancy+pages ≈ 2)
+# still wins the route when it holds ≥512 more warm prefix tokens than an
+# idle one, but one second of queue wait erases a 256-token advantage.
+DEFAULT_LAMBDA = 256.0
+
+
+class FleetShedError(RuntimeError):
+    """The fleet cannot place this request right now (every routable
+    replica is saturated, or none is routable). Callers surface it exactly
+    like the engine's ShedError — HTTP 429 with Retry-After."""
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(reason)
+        self.retry_after_s = retry_after_s
+
+
+class ReplicaError(RuntimeError):
+    """A dispatch to one replica failed (process died, HTTP unreachable,
+    engine stopped). The router quarantines the replica and fails the
+    request over to a survivor — this error type is what separates
+    'replica is broken' from 'replica said no' (FleetShedError)."""
+
+
+# ---------------------------------------------------------------------------
+# Beacon
+# ---------------------------------------------------------------------------
+
+
+def beacon_from_engine(
+    replica_id: str, engine: Any, url: str = "", top_k: int = 32,
+) -> dict[str, Any]:
+    """Build the compact state beacon one replica advertises. Token content
+    never appears — prefixes travel as (digest, length) pairs. Safe to call
+    from any thread (engine.stats() and the advertisement registries take
+    their own locks)."""
+    stats = engine.stats()
+    adv = getattr(engine, "prefix_advertisement", None)
+    boundaries, prefixes = adv(top_k) if adv is not None else ((), [])
+    hist = stats.get("histograms") or {}
+    ttft = hist.get("engine_ttft_s") or {}
+    thread = getattr(engine, "_thread", None)
+    dead = getattr(engine, "_dead", None) is not None or (
+        thread is None or not thread.is_alive()
+    )
+    pages_total = stats.get("kv-pages-total", 0)
+    return {
+        "schema": BEACON_SCHEMA,
+        "id": str(replica_id),
+        "url": url,
+        "at": round(time.time(), 3),
+        "load_score": stats.get("load-score", 0.0),
+        "queue_wait_ema_s": stats.get("queue-wait-ema-s", 0.0),
+        "active_slots": stats.get("active-slots", 0),
+        "max_batch": stats.get("max-batch", 0),
+        "queued": stats.get("queued", 0),
+        "queue_depth": int(getattr(engine, "_queue", None).maxsize or 0)
+        if getattr(engine, "_queue", None) is not None
+        else 0,
+        "shed_policy": getattr(engine, "shed_policy", "block"),
+        "shed_total": stats.get("shed-total", 0),
+        "kv_pages_total": pages_total,
+        "kv_pages_free": max(0, pages_total - stats.get("kv-pages-in-use", 0)),
+        "draining": bool(stats.get("draining", False)),
+        "quarantined": bool(dead),
+        "prefix_hit_rate": stats.get("prefix-cache-hit-rate", 0.0),
+        "prefill_tokens_saved_total": stats.get("prefill-tokens-saved-total", 0),
+        "ttft_p50_ms": round(float(ttft.get("p50", 0.0)) * 1e3, 3),
+        "ttft_p99_ms": round(float(ttft.get("p99", 0.0)) * 1e3, 3),
+        "boundaries": [int(b) for b in boundaries],
+        "prefixes": [[d, int(n)] for d, n in prefixes],
+    }
+
+
+def validate_beacon(doc: dict[str, Any]) -> bool:
+    """Schema check for one beacon (docs/SERVING.md §13): raises ValueError
+    on the first violation. Enforces the redaction contract — a beacon
+    carries digests, never tokens."""
+    if not isinstance(doc, dict):
+        raise ValueError("beacon must be a JSON object")
+    if doc.get("schema") != BEACON_SCHEMA:
+        raise ValueError(f"unknown beacon schema {doc.get('schema')!r}")
+    for key in (
+        "id", "at", "load_score", "queue_wait_ema_s", "draining",
+        "quarantined", "prefixes",
+    ):
+        if key not in doc:
+            raise ValueError(f"beacon missing field {key!r}")
+    for j, pair in enumerate(doc["prefixes"]):
+        if (
+            not isinstance(pair, (list, tuple))
+            or len(pair) != 2
+            or not isinstance(pair[0], str)
+            or not isinstance(pair[1], int)
+        ):
+            raise ValueError(f"prefix advertisement {j} is not [digest, length]")
+    for forbidden in ("tokens", "prompt", "text", "prompt_tokens"):
+        if forbidden in doc:
+            raise ValueError(f"beacon carries token-content key {forbidden!r}")
+    json.dumps(doc)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Local replica registry (the runtime HTTP server's /state + /fleet/generate
+# read this — same process-global pattern as observability.RECENT_DUMPS, so
+# the server never holds an engine reference)
+# ---------------------------------------------------------------------------
+
+_LOCAL_LOCK = threading.Lock()
+_LOCAL: dict[str, dict[str, Callable]] = {}
+
+
+def register_local(
+    replica_id: str,
+    beacon_fn: Callable[[], dict],
+    generate_fn: Optional[Callable[[dict], dict]] = None,
+    reset_fn: Optional[Callable[[], None]] = None,
+) -> None:
+    """Expose this process's engine on the runtime HTTP server: ``GET
+    /state`` serves ``beacon_fn``, ``POST /fleet/generate`` runs
+    ``generate_fn`` (fleet-internal dispatch), ``POST /fleet/reset`` runs
+    ``reset_fn`` (bench warmup hygiene)."""
+    with _LOCAL_LOCK:
+        _LOCAL[str(replica_id)] = {
+            "beacon": beacon_fn, "generate": generate_fn, "reset": reset_fn,
+        }
+
+
+def unregister_local(replica_id: str) -> None:
+    with _LOCAL_LOCK:
+        _LOCAL.pop(str(replica_id), None)
+
+
+def local_state() -> dict[str, Any]:
+    """The /state document: every engine registered in this process (one,
+    for every real topology)."""
+    with _LOCAL_LOCK:
+        entries = list(_LOCAL.items())
+    replicas = []
+    for replica_id, fns in entries:
+        try:
+            replicas.append(fns["beacon"]())
+        except Exception:  # noqa: BLE001 — a crashed engine still beacons
+            log.exception("beacon build failed for %s", replica_id)
+            replicas.append(
+                {
+                    "schema": BEACON_SCHEMA, "id": replica_id, "url": "",
+                    "at": round(time.time(), 3), "load_score": 1e9,
+                    "queue_wait_ema_s": 0.0, "draining": False,
+                    "quarantined": True, "prefixes": [],
+                }
+            )
+    return {"schema": STATE_SCHEMA, "replicas": replicas}
+
+
+def local_generate(payload: dict[str, Any]) -> dict[str, Any]:
+    """Fleet-internal dispatch into this process's engine (the POST
+    /fleet/generate body). Blocking — the HTTP server runs it in an
+    executor. Raises ReplicaError when no engine is registered (the
+    router treats that as a dead replica and fails over)."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            raise ReplicaError("no serving engine registered in this process")
+        fns = next(iter(_LOCAL.values()))
+    gen = fns.get("generate")
+    if gen is None:
+        raise ReplicaError("registered engine does not accept fleet dispatch")
+    return gen(payload)
+
+
+def local_reset() -> None:
+    with _LOCAL_LOCK:
+        entries = list(_LOCAL.values())
+    for fns in entries:
+        reset = fns.get("reset")
+        if reset is not None:
+            reset()
+
+
+def engine_generate(
+    engine: Any, payload: dict[str, Any], timeout_s: float = 600.0,
+) -> dict[str, Any]:
+    """The canonical ``generate_fn`` for ``register_local``: run one
+    completion on the local engine from a fleet-dispatch payload
+    (``{"prompt_tokens": [...], "options": {...}}``) and return a plain
+    JSON-able result. Engine sheds propagate as FleetShedError so the HTTP
+    layer can answer 429 + Retry-After."""
+    from langstream_tpu.models.configs import GenerationOptions
+    from langstream_tpu.serving.engine import ShedError
+
+    tokens = [int(t) for t in payload.get("prompt_tokens") or []]
+    if not tokens:
+        raise ValueError("fleet dispatch payload carries no prompt_tokens")
+    opts = GenerationOptions.from_dict(payload.get("options") or {})
+    try:
+        result = engine.generate(tokens, opts, timeout=timeout_s)
+    except ShedError as e:
+        raise FleetShedError(str(e), retry_after_s=e.retry_after_s) from e
+    return {
+        "tokens": [int(t) for t in result.tokens],
+        "finish_reason": result.finish_reason,
+        "prompt_tokens": result.prompt_tokens,
+        "ttft_s": round(result.ttft_s, 6),
+        "total_s": round(result.total_s, 6),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Replica transports (duck-typed: .replica_id, .fetch_beacon(), .generate())
+# ---------------------------------------------------------------------------
+
+
+class InProcessReplica:
+    """A replica living in this process — the unit-test / embedded-runner
+    transport, and the 'self' handle when the completions service fronts
+    its own engine plus remote peers."""
+
+    is_local = True
+
+    def __init__(self, replica_id: str, engine: Any, url: str = "") -> None:
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.url = url or f"local:{replica_id}"
+
+    def fetch_beacon(self) -> dict[str, Any]:
+        return beacon_from_engine(self.replica_id, self.engine, url=self.url)
+
+    def generate(
+        self, tokens, options: Optional[dict] = None, timeout_s: float = 600.0,
+    ) -> dict[str, Any]:
+        try:
+            return engine_generate(
+                self.engine,
+                {"prompt_tokens": list(tokens), "options": options or {}},
+                timeout_s=timeout_s,
+            )
+        except (FleetShedError, ValueError):
+            # sheds re-route; a BAD REQUEST is the caller's bug — neither
+            # may quarantine the replica (a malformed request retried
+            # across the fleet would mark every replica failed)
+            raise
+        except Exception as e:  # noqa: BLE001 — stopped/crashed engine
+            raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+
+    def reset_histograms(self) -> None:
+        self.engine.reset_histograms()
+
+
+class HttpReplica:
+    """A replica behind its runtime HTTP server (entrypoint pods, the
+    bench's subprocess fleet). Uses stdlib urllib — these calls run on the
+    router's refresher thread and dispatch executors, never an event loop."""
+
+    is_local = False
+
+    def __init__(
+        self, replica_id: str, base_url: str,
+        beacon_timeout_s: float = 2.0, generate_timeout_s: float = 600.0,
+    ) -> None:
+        self.replica_id = str(replica_id)
+        self.url = base_url.rstrip("/")
+        self.beacon_timeout_s = beacon_timeout_s
+        self.generate_timeout_s = generate_timeout_s
+
+    def _get(self, path: str, timeout_s: float) -> dict[str, Any]:
+        with urllib.request.urlopen(self.url + path, timeout=timeout_s) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def fetch_beacon(self) -> dict[str, Any]:
+        try:
+            doc = self._get("/state", self.beacon_timeout_s)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+        replicas = doc.get("replicas") or []
+        for b in replicas:
+            if b.get("id") == self.replica_id:
+                return b
+        if replicas:
+            return replicas[0]
+        raise ReplicaError(f"replica {self.replica_id}: empty /state")
+
+    def generate(
+        self, tokens, options: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> dict[str, Any]:
+        body = json.dumps(
+            {"prompt_tokens": list(map(int, tokens)), "options": options or {}}
+        ).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + "/fleet/generate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_s or self.generate_timeout_s
+            ) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                retry = float(e.headers.get("Retry-After") or 1.0)
+                raise FleetShedError(
+                    f"replica {self.replica_id} shed", retry_after_s=retry
+                ) from e
+            if 400 <= e.code < 500:
+                # the REQUEST is bad, not the replica: retrying it on the
+                # rest of the fleet would brown out every replica
+                raise ValueError(
+                    f"replica {self.replica_id} rejected request: "
+                    f"HTTP {e.code} {e.reason}"
+                ) from e
+            raise ReplicaError(
+                f"replica {self.replica_id}: HTTP {e.code}"
+            ) from e
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+
+    def reset_histograms(self) -> None:
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(
+                    self.url + "/fleet/reset", data=b"{}", method="POST",
+                    headers={"Content-Type": "application/json"},
+                ),
+                timeout=self.beacon_timeout_s,
+            ).read()
+        except (urllib.error.URLError, OSError) as e:
+            raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _ReplicaState:
+    handle: Any
+    beacon: dict[str, Any] = field(default_factory=dict)
+    beacon_at: float = -1e18  # monotonic of last SUCCESSFUL refresh
+    failed_at: float = -1e18  # monotonic of last mark_failed
+    digests: dict[str, int] = field(default_factory=dict)  # digest → length
+
+
+@dataclass
+class RouteDecision:
+    replica_id: str
+    handle: Any
+    kind: str  # affinity | sticky | balanced
+    expected_match: int
+    score: float
+
+
+class FleetRouter:
+    """Prefix-affinity-first, load-second dispatch across replicas.
+
+    ``route()`` is pure host bookkeeping under one lock — no I/O, no
+    hashing beyond one digest per advertised boundary length (<1 ms p50,
+    histogram-enforced by the bench). Beacons refresh on a background
+    thread (``start()``); a replica whose beacon goes stale, whose process
+    stops answering, or that advertises drain/quarantine simply drops out
+    of the routable set — requests re-route, nothing hangs."""
+
+    POLICIES = ("affinity", "round-robin", "least-loaded")
+
+    def __init__(
+        self,
+        replicas: list[Any],
+        *,
+        lam: float = DEFAULT_LAMBDA,
+        policy: str = "affinity",
+        beacon_ttl_s: float = 10.0,
+        refresh_interval_s: float = 0.5,
+        sticky_ttl_s: float = 600.0,
+        fail_cooldown_s: float = 5.0,
+        shed_queue_wait_s: float = 30.0,
+    ) -> None:
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"unknown fleet policy {policy!r}; supported: {self.POLICIES}"
+            )
+        if not replicas:
+            raise ValueError("fleet router needs >= 1 replica")
+        self.lam = float(lam)
+        self.policy = policy
+        self.beacon_ttl_s = float(beacon_ttl_s)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self.sticky_ttl_s = float(sticky_ttl_s)
+        self.fail_cooldown_s = float(fail_cooldown_s)
+        self.shed_queue_wait_s = float(shed_queue_wait_s)
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _ReplicaState] = {}
+        for r in replicas:
+            if r.replica_id in self._replicas:
+                raise ValueError(f"duplicate replica id {r.replica_id!r}")
+            self._replicas[r.replica_id] = _ReplicaState(handle=r)
+        self._sticky: dict[str, tuple[str, float]] = {}
+        self._rr = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # counters (under _lock) + the dispatch-overhead histogram the
+        # acceptance criterion reads
+        self.routed_affinity_total = 0
+        self.routed_sticky_total = 0
+        self.routed_balanced_total = 0
+        self.shed_total = 0
+        self.failover_total = 0
+        self._hist_lock = threading.Lock()
+        self.dispatch_hist = Histogram(
+            "fleet_dispatch_s",
+            "router route() host wall time per dispatch (s)",
+            log_buckets(1e-7, 1.0, 4),
+        )
+
+    # -- beacon refresh -----------------------------------------------------
+
+    def refresh_all(self) -> int:
+        """Fetch every replica's beacon once (synchronously). Returns how
+        many refreshed successfully. Failures just leave the old beacon to
+        age out — route() treats stale as unroutable."""
+        ok = 0
+        for state in list(self._replicas.values()):
+            try:
+                beacon = state.handle.fetch_beacon()
+            except ReplicaError as e:
+                log.debug("beacon refresh failed: %s", e)
+                continue
+            except Exception:  # noqa: BLE001 — refresher must never die
+                log.exception(
+                    "beacon refresh crashed for %s", state.handle.replica_id
+                )
+                continue
+            with self._lock:
+                state.beacon = beacon
+                state.beacon_at = time.monotonic()
+                state.digests = {
+                    d: int(n) for d, n in (beacon.get("prefixes") or [])
+                }
+            ok += 1
+        return ok
+
+    def start(self, initial_refresh: bool = True) -> None:
+        if initial_refresh:
+            self.refresh_all()
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._refresh_loop, name="fleet-beacons", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self.refresh_interval_s):
+            self.refresh_all()
+
+    # -- health -------------------------------------------------------------
+
+    @property
+    def replica_count(self) -> int:
+        return len(self._replicas)
+
+    def note_failover(self, replica_id: str) -> None:
+        """A caller-observed mid-dispatch death: quarantine the replica AND
+        count the failover — the completions path's failover loop must show
+        up in fleet stats exactly like router.generate's own."""
+        self.mark_failed(replica_id)
+        with self._lock:
+            self.failover_total += 1
+
+    def mark_failed(self, replica_id: str) -> None:
+        """A dispatch to this replica failed: quarantine it for
+        ``fail_cooldown_s`` (and until a FRESH beacon proves it back). Its
+        sticky sessions fail over cold at their next request."""
+        with self._lock:
+            state = self._replicas.get(replica_id)
+            if state is None:
+                return
+            now = time.monotonic()
+            state.failed_at = now
+            # the beacon that routed us here predates the failure — drop it
+            # so recovery requires a refresh newer than the incident
+            state.beacon_at = -1e18
+
+    def _routable(self, state: _ReplicaState, now: float) -> bool:
+        if now - state.failed_at < self.fail_cooldown_s:
+            return False
+        if now - state.beacon_at > self.beacon_ttl_s:
+            return False
+        b = state.beacon
+        return not (b.get("draining") or b.get("quarantined"))
+
+    # -- routing ------------------------------------------------------------
+
+    @staticmethod
+    def _load(beacon: dict[str, Any]) -> float:
+        return float(beacon.get("load_score", 0.0) or 0.0)
+
+    def route(
+        self,
+        tokens,
+        session_id: Optional[str] = None,
+        exclude: Optional[set] = None,
+    ) -> RouteDecision:
+        """Pick the replica for one request. Raises FleetShedError when no
+        replica is routable or every routable replica is saturated (full
+        admission queue, or queue-wait EMA past ``shed_queue_wait_s``)."""
+        t0 = time.perf_counter()
+        try:
+            return self._route(list(tokens), session_id, exclude or set())
+        finally:
+            # Histogram.record is single-writer by contract (the engine's
+            # histograms have exactly one writer thread); route() runs on
+            # many dispatch threads, so the router serializes its own
+            # recording
+            with self._hist_lock:
+                self.dispatch_hist.record(time.perf_counter() - t0)
+
+    def _route(
+        self, tokens: list, session_id: Optional[str], exclude: set,
+    ) -> RouteDecision:
+        now = time.monotonic()
+        with self._lock:
+            live = [
+                s
+                for rid, s in self._replicas.items()
+                if rid not in exclude and self._routable(s, now)
+            ]
+            if not live:
+                self.shed_total += 1
+                raise FleetShedError(
+                    "no routable replica (all stale, draining, quarantined "
+                    "or excluded)",
+                    retry_after_s=max(self.refresh_interval_s, 0.5),
+                )
+            # fleet-level shed: every routable replica says it cannot take
+            # more — the replicas' OWN exported signals, not a blind bound
+            saturated = [
+                s
+                for s in live
+                if (
+                    s.beacon.get("queue_depth", 0) > 0
+                    and s.beacon.get("queued", 0)
+                    >= s.beacon.get("queue_depth", 0)
+                )
+                or float(s.beacon.get("queue_wait_ema_s", 0.0))
+                >= self.shed_queue_wait_s
+            ]
+            if len(saturated) == len(live):
+                self.shed_total += 1
+                retry = min(
+                    max(float(s.beacon.get("queue_wait_ema_s", 0.0)), 0.1)
+                    for s in live
+                )
+                raise FleetShedError(
+                    f"all {len(live)} routable replicas saturated",
+                    retry_after_s=retry,
+                )
+            if self.policy == "round-robin":
+                state = live[self._rr % len(live)]
+                self._rr += 1
+                self.routed_balanced_total += 1
+                return self._decide(state, "balanced", 0, session_id, now)
+            # sticky: same session stays on its replica while that replica
+            # stays routable (its aliased pages are live there)
+            if session_id:
+                self._prune_sticky(now)
+                held = self._sticky.get(session_id)
+                if held is not None:
+                    rid, last_used = held
+                    state = self._replicas.get(rid)
+                    if (
+                        now - last_used <= self.sticky_ttl_s
+                        and state is not None
+                        and state in live
+                    ):
+                        self.routed_sticky_total += 1
+                        return self._decide(state, "sticky", 0, session_id, now)
+                    # replica gone or the session idled past its TTL (its
+                    # pages are likely evicted by now): fall through — the
+                    # session re-routes cold to whatever wins below
+                    self._sticky.pop(session_id, None)
+            if self.policy == "least-loaded":
+                state = min(live, key=lambda s: self._load(s.beacon))
+                self.routed_balanced_total += 1
+                return self._decide(state, "balanced", 0, session_id, now)
+            # affinity scoring: hash the prompt once per advertised length
+            lengths = sorted(
+                {
+                    n
+                    for s in live
+                    for n in s.digests.values()
+                    if n <= len(tokens) - 1
+                }
+            )
+            probe = {n: prefix_digest(tokens[:n]) for n in lengths}
+            best, best_score, best_match = None, None, 0
+            for s in live:
+                match = 0
+                for n in lengths:
+                    if s.digests.get(probe[n]) == n and n > match:
+                        match = n
+                score = match - self.lam * self._load(s.beacon)
+                if best_score is None or score > best_score:
+                    best, best_score, best_match = s, score, match
+            assert best is not None
+            if best_match > 0:
+                self.routed_affinity_total += 1
+                kind = "affinity"
+            else:
+                # nobody holds a usable prefix: least-loaded fallback (the
+                # scored argmax already IS least-loaded when match==0 for
+                # everyone, since score reduces to −λ·load)
+                self.routed_balanced_total += 1
+                kind = "balanced"
+            return self._decide(best, kind, best_match, session_id, now)
+
+    def _decide(
+        self,
+        state: _ReplicaState,
+        kind: str,
+        match: int,
+        session_id: Optional[str],
+        now: float,
+    ) -> RouteDecision:
+        rid = state.handle.replica_id
+        if session_id:
+            self._sticky[session_id] = (rid, now)
+        return RouteDecision(
+            replica_id=rid,
+            handle=state.handle,
+            kind=kind,
+            expected_match=match,
+            score=match - self.lam * self._load(state.beacon),
+        )
+
+    def _prune_sticky(self, now: float) -> None:
+        if len(self._sticky) < 4096:
+            return
+        self._sticky = {
+            k: v
+            for k, v in self._sticky.items()
+            if now - v[1] <= self.sticky_ttl_s
+        }
+
+    # -- dispatch with failover ----------------------------------------------
+
+    def generate(
+        self,
+        tokens,
+        options: Optional[dict] = None,
+        session_id: Optional[str] = None,
+        timeout_s: float = 600.0,
+    ) -> tuple[dict[str, Any], RouteDecision]:
+        """Route + dispatch one request, failing over COLD to a surviving
+        replica when the chosen one dies mid-flight (ReplicaError). A
+        replica that merely sheds is excluded and the rest get a chance;
+        when everyone sheds, the fleet-level FleetShedError propagates with
+        the smallest retry-after observed."""
+        tokens = list(tokens)
+        excluded: set = set()
+        last_shed: Optional[FleetShedError] = None
+        for _ in range(self.replica_count):
+            decision = self.route(tokens, session_id, exclude=excluded)
+            try:
+                out = decision.handle.generate(
+                    tokens, options or {}, timeout_s
+                )
+                return out, decision
+            except FleetShedError as e:
+                last_shed = e
+                excluded.add(decision.replica_id)
+            except ReplicaError as e:
+                log.warning(
+                    "replica %s failed mid-dispatch (%s); failing over",
+                    decision.replica_id, e,
+                )
+                self.note_failover(decision.replica_id)
+                excluded.add(decision.replica_id)
+        if last_shed is not None:
+            with self._lock:
+                self.shed_total += 1
+            raise last_shed
+        raise FleetShedError(
+            "every replica failed or shed this request", retry_after_s=1.0
+        )
+
+    # -- autoscale hint -------------------------------------------------------
+
+    def desired_replicas(
+        self,
+        target_queue_wait_s: float = 0.5,
+        min_replicas: int = 1,
+        max_replicas: int = 64,
+    ) -> int:
+        """The k8s planner's scale hint, from the fleet-wide queue-wait EMA:
+        scale OUT proportionally when the mean routable queue wait exceeds
+        the target (capped at 4× per step so one burst can't quadruple the
+        fleet), scale IN one replica at a time only when queues are empty
+        AND occupancy is low (conservative — killing a warm replica throws
+        away its aliased pages). With no routable beacon the hint holds the
+        current size: never scale on missing data."""
+        now = time.monotonic()
+        with self._lock:
+            total = len(self._replicas)
+            live = [
+                s.beacon
+                for s in self._replicas.values()
+                if self._routable(s, now)
+            ]
+        if not live:
+            return max(min_replicas, min(total, max_replicas))
+        n = len(live)
+        ema = sum(float(b.get("queue_wait_ema_s", 0.0)) for b in live) / n
+        occ = sum(
+            float(b.get("active_slots", 0)) / max(1, b.get("max_batch", 1))
+            for b in live
+        ) / n
+        if ema > target_queue_wait_s:
+            want = math.ceil(n * min(ema / target_queue_wait_s, 4.0))
+        elif ema < 0.1 * target_queue_wait_s and occ < 0.5 and n > 1:
+            want = n - 1
+        else:
+            want = n
+        return max(min_replicas, min(want, max_replicas))
+
+    # -- stats ----------------------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            routable = sum(
+                1 for s in self._replicas.values() if self._routable(s, now)
+            )
+            out = {
+                "fleet-policy": self.policy,
+                "fleet-lambda": self.lam,
+                "fleet-replica-count": len(self._replicas),
+                "fleet-routable-replicas": routable,
+                "fleet-routed-affinity-total": self.routed_affinity_total,
+                "fleet-routed-sticky-total": self.routed_sticky_total,
+                "fleet-routed-balanced-total": self.routed_balanced_total,
+                "fleet-shed-total": self.shed_total,
+                "fleet-failover-total": self.failover_total,
+                "fleet-sticky-sessions": len(self._sticky),
+            }
+        out["fleet-dispatch-p50-ms"] = round(
+            self.dispatch_hist.percentile(0.50) * 1e3, 4
+        )
+        out["fleet-dispatch-p99-ms"] = round(
+            self.dispatch_hist.percentile(0.99) * 1e3, 4
+        )
+        out["fleet-desired-replicas"] = self.desired_replicas()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Standalone replica server (bench_fleet / failure drills):
+#   python -m langstream_tpu.serving.fleet --config '{"model": "tiny-test"}'
+# prints one JSON line {"url": ..., "replica": ...} once the engine is warm,
+# then serves /state + /fleet/generate until stdin closes.
+# ---------------------------------------------------------------------------
+
+
+async def _serve(config: dict[str, Any], host: str, port: int) -> None:
+    import asyncio
+    import sys
+
+    from langstream_tpu.ai.tpu_serving import _EngineHolder
+    from langstream_tpu.runtime.http_server import RuntimeHttpServer
+
+    holder = _EngineHolder(config)
+    engine = holder.engine()  # builds + starts + registers the beacon
+    replica_id = str(config.get("fleet-replica-id") or "replica-0")
+    server = RuntimeHttpServer(
+        metrics_text=lambda: "",
+        agents_info=lambda: [{"replica": replica_id, "role": "fleet-replica"}],
+        host=host,
+        port=port,
+    )
+    await server.start()
+    print(
+        json.dumps({"url": server.url, "replica": replica_id}), flush=True
+    )
+    loop = asyncio.get_running_loop()
+    # parent closes our stdin to stop us (portable subprocess lifecycle)
+    await loop.run_in_executor(None, sys.stdin.read)
+    await server.stop()
+    holder.close()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(description="serve one fleet replica")
+    p.add_argument("--config", required=True, help="tpu-serving config JSON")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    config = json.loads(args.config)
+    asyncio.run(_serve(config, args.host, args.port))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — subprocess entry
+    raise SystemExit(main())

@@ -35,12 +35,26 @@ the no-leak property the chaos suite asserts.
 
 from __future__ import annotations
 
+import hashlib
 import math
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+
+def prefix_digest(tokens) -> str:
+    """Stable, process-independent digest of a token prefix (blake2b over
+    the int32 byte image). This is what replicas ADVERTISE in their fleet
+    beacon instead of the tokens themselves — prompt content must never
+    leave the engine (same redaction stance as the flight recorder), and a
+    16-hex digest is 8 bytes of beacon per prefix instead of kilobytes.
+    The router hashes an incoming prompt at the advertised lengths and
+    matches digests, so both sides must use THIS function."""
+    arr = np.asarray(list(tokens), np.int32)
+    return hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
 
 
 def table_len_for(max_seq_len: int, page_size: int) -> int:
@@ -249,6 +263,7 @@ class PrefixPages:
     pins: int = 0
     last_used: int = 0
     node: Any = field(default=None, repr=False)
+    digest: str = ""  # prefix_digest(tokens[:length]) — beacon advertisement
 
 
 class PrefixPageIndex:
@@ -275,6 +290,12 @@ class PrefixPageIndex:
         # iterate _live mid-mutation
         self._page_holds: dict[int, int] = {}
         self._tick = 0
+        # beacon advertisement: digest → [length, recency tick], mutated on
+        # the engine thread (insert/drop/hit) but READ from the runtime
+        # HTTP server's /state thread — the one index surface that crosses
+        # threads, hence the one lock in this module
+        self._ads: dict[str, list] = {}
+        self._ad_lock = threading.Lock()
         # stats (cumulative since engine start)
         self.lookups = 0
         self.hits = 0
@@ -334,6 +355,31 @@ class PrefixPageIndex:
             self.hits += 1
             self._tick += 1
             used.last_used = self._tick
+            if used.digest:
+                with self._ad_lock:
+                    ad = self._ads.get(used.digest)
+                    if ad is not None:
+                        ad[1] = self._tick
+
+    def match_len(self, tokens) -> int:
+        """Non-mutating probe: the longest cached prefix length usable for
+        ``tokens`` (at least one suffix token must remain to prefill), or 0.
+        Touches NEITHER the LRU recency ticks NOR the hit/lookup counters —
+        the fleet router and the /state beacon probe constantly, and a probe
+        that refreshed recency would pin whatever the router asks about,
+        inverting the eviction order real admissions deserve."""
+        cands = self.candidates(tokens)
+        return cands[-1][0] if cands else 0
+
+    def advertised(self, top_k: int = 32) -> list[tuple[str, int]]:
+        """Most-recently-used ``top_k`` prefix digests as ``(digest,
+        length)`` pairs — the beacon's affinity advertisement. Thread-safe
+        (the /state endpoint serves this from the HTTP thread)."""
+        with self._ad_lock:
+            items = sorted(
+                self._ads.items(), key=lambda kv: kv[1][1], reverse=True
+            )[: max(0, top_k)]
+        return [(digest, ad[0]) for digest, ad in items]
 
     def has(self, tokens, length: int) -> bool:
         path = self._walk(tokens, limit=length)
@@ -372,7 +418,8 @@ class PrefixPageIndex:
         node = self._walk(tokens, limit=length, create=True)[-1]
         self._tick += 1
         entry = PrefixPages(
-            pages=tuple(pages), length=length, last_used=self._tick, node=node
+            pages=tuple(pages), length=length, last_used=self._tick, node=node,
+            digest=prefix_digest(tokens[:length]),
         )
         if node.entry is not None:
             # re-publish of the same prefix raced an eviction: keep newest
@@ -381,6 +428,10 @@ class PrefixPageIndex:
         self._live.append(entry)
         for p in entry.pages:
             self._page_holds[p] = self._page_holds.get(p, 0) + 1
+        # advertise AFTER the re-publish _drop above, which removed the
+        # same digest (same tokens, same length)
+        with self._ad_lock:
+            self._ads[entry.digest] = [entry.length, entry.last_used]
         return entry
 
     def _drop(self, pool: PagePool, entry: PrefixPages) -> None:
@@ -403,6 +454,9 @@ class PrefixPageIndex:
                 self._page_holds[p] = left
             else:
                 self._page_holds.pop(p, None)
+        if entry.digest:
+            with self._ad_lock:
+                self._ads.pop(entry.digest, None)
         pool.decref(entry.pages)
 
     def evict_lru(self, pool: PagePool) -> bool:
@@ -440,6 +494,8 @@ class PrefixPageIndex:
         self._root = _Node()
         self._live = []
         self._page_holds = {}
+        with self._ad_lock:
+            self._ads = {}
         self._tick = 0
 
     # -- stats ----------------------------------------------------------------

@@ -37,10 +37,13 @@ compile surface identical to the engine's existing ladder.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 import jax
+
+from langstream_tpu.serving.pagepool import prefix_digest
 
 
 def pool_entries_for_fraction(
@@ -78,6 +81,7 @@ class PrefixEntry:
     refs: int = 0  # admissions currently reading this row
     last_used: int = 0  # LRU tick
     node: Any = field(default=None, repr=False)
+    digest: str = ""  # prefix_digest(tokens[:length]) — beacon advertisement
 
 
 class PrefixCachePool:
@@ -117,6 +121,11 @@ class PrefixCachePool:
         self._live: dict[int, PrefixEntry] = {}  # row → entry
         self._free = list(range(self.entries - 1, -1, -1))
         self._tick = 0
+        # beacon advertisement: digest → [length, recency tick] — the one
+        # surface read off-thread (the /state endpoint), mirroring
+        # pagepool.PrefixPageIndex
+        self._ads: dict[str, list] = {}
+        self._ad_lock = threading.Lock()
         # stats (cumulative since engine start)
         self.lookups = 0
         self.hits = 0
@@ -141,6 +150,8 @@ class PrefixCachePool:
         self._root = _Node()
         self._live = {}
         self._free = list(range(self.entries - 1, -1, -1))
+        with self._ad_lock:
+            self._ads = {}
         self._tick = 0
 
     # -- index ---------------------------------------------------------------
@@ -207,6 +218,27 @@ class PrefixCachePool:
             self.hits += 1
             self._tick += 1
             used.last_used = self._tick
+            if used.digest:
+                with self._ad_lock:
+                    ad = self._ads.get(used.digest)
+                    if ad is not None:
+                        ad[1] = self._tick
+
+    def match_len(self, tokens) -> int:
+        """Non-mutating probe: longest cached prefix length usable for
+        ``tokens``, or 0. Touches neither LRU recency nor hit counters —
+        see pagepool.PrefixPageIndex.match_len for why that matters."""
+        cands = self.candidates(tokens)
+        return cands[-1][0] if cands else 0
+
+    def advertised(self, top_k: int = 32) -> list[tuple[str, int]]:
+        """Most-recently-used ``top_k`` ``(digest, length)`` pairs for the
+        fleet beacon; thread-safe."""
+        with self._ad_lock:
+            items = sorted(
+                self._ads.items(), key=lambda kv: kv[1][1], reverse=True
+            )[: max(0, top_k)]
+        return [(digest, ad[0]) for digest, ad in items]
 
     def has(self, tokens, length: int) -> bool:
         path = self._walk(tokens, limit=length)
@@ -261,6 +293,9 @@ class PrefixCachePool:
             node = parent
         del self._live[entry.row]
         self._free.append(entry.row)
+        if entry.digest:
+            with self._ad_lock:
+                self._ads.pop(entry.digest, None)
         self.evictions += 1
 
     def insert(self, tokens, length: int, row: int) -> PrefixEntry:
@@ -270,9 +305,14 @@ class PrefixCachePool:
         assert length in self.boundaries, (length, self.boundaries)
         node = self._walk(tokens, limit=length, create=True)[-1]
         self._tick += 1
-        entry = PrefixEntry(row=row, length=length, last_used=self._tick, node=node)
+        entry = PrefixEntry(
+            row=row, length=length, last_used=self._tick, node=node,
+            digest=prefix_digest(tokens[:length]),
+        )
         node.entry = entry
         self._live[row] = entry
+        with self._ad_lock:
+            self._ads[entry.digest] = [entry.length, entry.last_used]
         return entry
 
     # -- stats ---------------------------------------------------------------

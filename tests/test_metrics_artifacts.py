@@ -107,6 +107,9 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_cancelled_total",
         "engine_quarantined_slots_total",
         "engine_restarts_total",
+        "fleet_routed_affinity_total",
+        "fleet_routed_balanced_total",
+        "fleet_replica_count",
     ):
         serving.gauge(n)
     exposed = {
@@ -146,6 +149,31 @@ def test_observability_panels_present():
     assert any(
         "engine_load_score" in t["expr"] for t in load["targets"]
     )
+
+
+def test_fleet_panels_present():
+    """The round-12 fleet panels must survive dashboard edits: routing
+    decisions (affinity vs balanced — the cache-aware dispatch signal,
+    serving/fleet.py) and the replica-count panel paired with the
+    autoscale-hint story (docs/SERVING.md §13)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    routing = next(
+        (e for t, e in exprs_by_title.items() if "fleet routing" in t.lower()),
+        None,
+    )
+    assert routing is not None, "fleet routing-decisions panel missing"
+    assert "fleet_routed_affinity_total" in routing
+    assert "fleet_routed_balanced_total" in routing
+    replicas = next(
+        (e for t, e in exprs_by_title.items() if "fleet replicas" in t.lower()),
+        None,
+    )
+    assert replicas is not None, "fleet replica-count panel missing"
+    assert "fleet_replica_count" in replicas
 
 
 def test_grafana_provisioning_parses():
