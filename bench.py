@@ -741,6 +741,95 @@ def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
     }
 
 
+def bench_spmd_wire(*, preset: str = "tiny-test", new_tokens: int = 48,
+                    n_requests: int = 6, max_seq_len: int = 256,
+                    decode_chunk: int = 8, preamble_len: int = 64) -> dict:
+    """SPMD fast-path parity phase (ISSUE 9 acceptance): a loopback
+    leader+follower pair on a TP mesh over ALL local devices, with the
+    full round-13 fast-path stack on the wire — prefix-cache auto,
+    speculation auto, kv_layout=paged — serving a shared-preamble burst.
+    Records decode throughput WITH the wire active and the MEASURED
+    ControlBlock overhead (bytes/announce, announces and bytes per engine
+    iteration, wire bytes per generated token). On CPU (or virtual
+    devices) the tok/s is a smoke number; the wire-bytes numbers are
+    exact everywhere — they depend only on the protocol's fixed shapes,
+    which derive from the engine config."""
+    import threading
+
+    import jax as _jax
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.parallel.mesh import build_mesh
+    from langstream_tpu.parallel.sharding import shard_params
+    from langstream_tpu.parallel.spmd_serving import LoopbackChannel, follower_loop
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+    from langstream_tpu.serving.pagepool import table_len_for
+
+    config = MODEL_PRESETS[preset]
+    if config.dtype != "float32" and _jax.default_backend() != "tpu":
+        import dataclasses as _dc
+
+        config = _dc.replace(config, dtype="float32")
+    devices = _jax.devices()
+    mesh = build_mesh({"model": len(devices)}, devices)
+    params = shard_params(init_params(config, _jax.random.PRNGKey(0)), mesh, config)
+    page_size = 16
+    buckets = (32, 64, 128)
+    kw = dict(
+        max_batch=4, max_seq_len=max_seq_len, decode_chunk=decode_chunk,
+        prefill_buckets=buckets, prefill_batch=4, mesh=mesh,
+        kv_layout="paged", page_size=page_size,
+        prefix_cache="auto", speculation="auto", speculation_tokens=4,
+    )
+    channel = LoopbackChannel(
+        prefill_batch=4, max_width=max(buckets), max_batch=4,
+        table_len=table_len_for(max_seq_len, page_size), spec_tokens=4,
+    )
+    leader = ServingEngine(config, params, spmd=channel, **kw)
+    follower = ServingEngine(config, params, **kw)
+    t = threading.Thread(target=follower_loop, args=(follower, channel), daemon=True)
+    t.start()
+    leader.start()
+    rng = __import__("numpy").random.default_rng(9)
+    preamble = rng.integers(1, config.vocab_size, size=preamble_len).tolist()
+    opts = GenerationOptions(max_new_tokens=new_tokens, temperature=0.0)
+    try:
+        leader.generate(preamble + [1], opts, timeout=600)  # warm + publish
+        t0 = time.monotonic()
+        reqs = [
+            leader.submit(GenerationRequest(
+                prompt_tokens=preamble + [2 + i], options=opts,
+            ))
+            for i in range(n_requests)
+        ]
+        generated = sum(len(r.result(600).tokens) for r in reqs)
+        wall = time.monotonic() - t0
+        stats = leader.stats()
+        iters = leader._iterations_total
+    finally:
+        leader.stop()
+        t.join(timeout=60)
+    announces = stats["spmd-announces-total"]
+    wire_bytes = stats["spmd-announce-bytes-total"]
+    return {
+        "spmd_devices": len(devices),
+        "spmd_backend": _jax.default_backend(),
+        "spmd_tokens_per_sec": round(generated / wall, 1),
+        "spmd_prefix_hit_rate": stats["prefix-cache-hit-rate"],
+        "spmd_spec_accepted_per_step": stats["spec-accepted-tokens-per-step"],
+        "spmd_wire_announces_total": announces,
+        "spmd_wire_bytes_total": wire_bytes,
+        "spmd_wire_bytes_per_announce": round(wire_bytes / max(1, announces), 1),
+        # engine iterations INCLUDE idle polls (no announce): the per-
+        # iteration overhead under load is announces/iter × bytes/announce
+        "spmd_wire_engine_iterations": iters,
+        "spmd_wire_bytes_per_generated_token": round(
+            wire_bytes / max(1, generated), 1
+        ),
+    }
+
+
 def bench_fleet(*, n_replicas: int = 3, n_groups: int = 4,
                 preamble_len: int = 256, burst_mult: int = 10,
                 new_tokens: int = 16, lam: float = 128.0) -> dict:
@@ -1072,6 +1161,17 @@ def main() -> None:
         extras.update(bench_fleet())
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] fleet phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # SPMD fast-path wire (ISSUE 9 acceptance): loopback leader+follower
+    # on a TP mesh over all local devices with prefix + speculation +
+    # paged ON — throughput with the wire active plus the MEASURED
+    # ControlBlock bytes/announce/iteration (PERF.md round 13)
+    print("[bench] SPMD wire (fast-path parity) phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_spmd_wire())
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] SPMD wire phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     if on_tpu:
         # flagship phase: BASELINE.md's headline model (llama-3-8b, ≥2000

@@ -17,10 +17,13 @@ Resource configuration:
     unified page-table-indexed device pool (serving/pagepool.py): decode,
     chunked prefill and speculative verify all attend through per-slot
     page tables (ONE compiled program each — the kv_bound compile ladder
-    is gone), and prefix reuse aliases pages zero-copy. "dense" is the
-    per-slot big-cache layout, kept ONE release as the escape hatch (and
-    auto-selected under SPMD / sharded meshes, which the paged wire does
-    not speak yet). `page-size` (default 64 tokens) sizes a page;
+    is gone), and prefix reuse aliases pages zero-copy. Legal under
+    multi-host SPMD (allocator events ride the leader→follower wire,
+    docs/SERVING.md §14) and sharded meshes (the pool shards kv heads on
+    "model"). "dense" is the per-slot big-cache layout, kept ONE release
+    as the escape hatch (it also carries the ring long-prefill path,
+    which paged does not speak yet). `page-size` (default 64 tokens)
+    sizes a page;
     `kv-pages` overrides the pool's page count (default: dense-parity
     capacity + `prefix-cache-fraction` alias headroom — see
     docs/SERVING.md §11 for the memory-plan math and migration notes)
@@ -43,8 +46,9 @@ Resource configuration:
     prompt-lookup drafts verified k+1-at-a-time in one device dispatch —
     one weight read emits up to k+1 tokens per slot on repetitive text.
     `speculation-tokens` (default 4) is k, fixed engine-wide (one compiled
-    verify ladder). Disabled automatically under SPMD; composes with
-    overlap, prefix-cache, and both KV dtypes (docs/SERVING.md §10).
+    verify ladder). Runs under SPMD too (drafts ride the wire, §14);
+    composes with overlap, prefix-cache, and both KV dtypes
+    (docs/SERVING.md §10).
   queue-depth / shed-policy: bounded admission queue; "block" (default)
     backpressures the broker poll loop, "reject" sheds with a retry-after
     (ShedError) so front doors degrade to fast 429s under overload
@@ -75,6 +79,11 @@ Resource configuration:
     (docs/SERVING.md §13). The /state beacon and /fleet/generate endpoint
     are served regardless of this knob — fleet: off only means THIS
     process routes nothing.
+  spmd-parity-echo: false (default) → on multi-host replicas, re-broadcast
+    every processed decode/verify chunk's tokens so followers verify them
+    against their own device results (one extra broadcast per chunk; a
+    mismatch dumps the flight recorder and crashes the replica —
+    docs/SERVING.md §14 divergence semantics)
   compile-cache-dir: persistent XLA compile cache directory — a scale-up
     replica pointed at a warm (shared) cache dir skips the warmup
     ladder's compile wall and serves in seconds (fleet cold-start lever)
@@ -260,23 +269,36 @@ class _EngineHolder:
         )
         max_batch = int(self.config.get("max-batch", 8))
         prefill_batch = self.config.get("prefill-batch")
+        max_seq = int(self.config.get("max-seq-len", min(2048, mc.max_seq_len)))
         spmd = None
         dist = DistributedConfig.from_env()
         if dist.is_multihost:
-            # every process of the replica builds an IDENTICAL channel; the
-            # leader announces, followers replay (parallel/spmd_serving.py)
+            # every process of the replica builds an IDENTICAL channel
+            # (page/draft buffer sizes derive from the shared config); the
+            # leader announces, followers replay (parallel/spmd_serving.py,
+            # docs/SERVING.md §14 — prefix reuse, speculation and the
+            # paged allocator all ride the wire since round 13)
             from langstream_tpu.parallel.spmd_serving import SpmdChannel
+            from langstream_tpu.serving.pagepool import table_len_for
 
             spmd = SpmdChannel(
                 prefill_batch=int(prefill_batch or ServingEngine.PREFILL_BATCH),
                 max_width=max(buckets),
                 max_batch=max_batch,
+                table_len=(
+                    table_len_for(max_seq, page_size)
+                    if layout == "paged"
+                    else 0
+                ),
+                spec_tokens=spec_tokens,
+                echo=bool(self.config.get("spmd-parity-echo", False)),
+                decode_chunk=int(self.config.get("decode-chunk", 16)),
             )
         engine = ServingEngine(
             mc,
             self.params(),
             max_batch=max_batch,
-            max_seq_len=int(self.config.get("max-seq-len", min(2048, mc.max_seq_len))),
+            max_seq_len=max_seq,
             eos_token_id=self.tokenizer().eos_token_id,
             prefill_buckets=buckets,
             mesh=self.mesh(),
@@ -627,14 +649,18 @@ class TpuCompletionsService(CompletionsService):
         ShedError so the pipeline's 429 handling is one code path."""
         import asyncio
 
+        from langstream_tpu.serving import lifecycle
         from langstream_tpu.serving.engine import ShedError
         from langstream_tpu.serving.fleet import FleetShedError, ReplicaError
 
         session_id = str(options.get("cancel-key") or "") or None
-        # cross-process dispatch: the cancel registry is process-local, so
-        # the peer cannot see this session's disconnects — deadlines bound
-        # orphan decode there (the §9-documented gap, unchanged)
-        remote_options = {k: v for k, v in options.items() if k != "cancel-key"}
+        # cross-process cancel (ROADMAP 3b): the cancel-key RIDES to the
+        # peer — engine_generate registers the request in the peer's
+        # process-local lifecycle registry — and the owning replica is
+        # recorded here, so lifecycle.cancel() on a client disconnect
+        # forwards POST /fleet/cancel and the remote decode dies at the
+        # next chunk boundary instead of at its deadline
+        remote_options = dict(options)
         loop = asyncio.get_running_loop()
         excluded: set = set()
         last_shed: Optional[FleetShedError] = None
@@ -647,6 +673,10 @@ class TpuCompletionsService(CompletionsService):
                 raise ShedError(str(e), retry_after_s=e.retry_after_s) from e
             if decision.handle.is_local:
                 return None
+            owner_url = str(getattr(decision.handle, "url", "") or "")
+            remote = not owner_url.startswith("local:")
+            if session_id and remote:
+                lifecycle.register_remote(session_id, owner_url)
             try:
                 out = await loop.run_in_executor(
                     None,
@@ -662,6 +692,9 @@ class TpuCompletionsService(CompletionsService):
                 router.note_failover(decision.replica_id)
                 excluded.add(decision.replica_id)
                 continue
+            finally:
+                if session_id and remote:
+                    lifecycle.unregister_remote(session_id, owner_url)
             stream_state = None
             if chunks_consumer is not None:
                 stream_state = _StreamState(

@@ -371,6 +371,130 @@ class AgentResourcesFactory:
         return {"phase": worst, "agents": agents}
 
 
+class FleetAutoscaleReconciler:
+    """The in-cluster ops loop for the fleet autoscale hint (ROADMAP 3c).
+
+    ``fleet_consumers`` above already lets ``status.fleet.desiredReplicas``
+    drive the StatefulSet's replica count — but until now NOTHING computed
+    that field in-cluster: the router's ``desired_replicas()`` hint
+    (serving/fleet.py — queue-wait-EMA scale-out capped at 4×/step,
+    conservative scale-in) lived and died inside the serving process. This
+    reconciler closes the loop: it reads the hint from ``desired_fn`` (the
+    router's bound method, or any callable returning an int) and patches it
+    into the Agent CR's status, where the AgentController's next reconcile
+    turns it into pods.
+
+    Design points:
+    - Status-only writes (``patch_status``): a scale decision never touches
+      the spec checksum, so scaling is "more pods", never a rollout.
+    - No-op patches are SKIPPED: an unconditional patch bumps
+      resourceVersion and emits a MODIFIED watch event every interval —
+      the self-triggered reconcile storm ``_patch_status_if_changed``
+      (k8s/controllers.py) exists to prevent.
+    - Autoscale gating stays in ``fleet_consumers``: the reconciler writes
+      the hint unconditionally (it is pure status), and the STS generation
+      ignores it unless ``resources.autoscale.enabled`` — so flipping
+      autoscale on/off needs no reconciler restart.
+    - Crash-tolerant: a failed read/patch logs and retries next tick; the
+      hint is advisory, so staleness degrades to "no scaling", never to a
+      wrong spec.
+
+    Works against any client with ``get(kind, ns, name)`` +
+    ``patch_status(kind, ns, name, status)`` — the in-cluster HTTPS client
+    (k8s/client.py) and the fake server (tests) share that surface."""
+
+    def __init__(
+        self,
+        kube: Any,
+        desired_fn: Any,  # Callable[[], int]
+        namespace: str,
+        name: str,
+        kind: str = AgentCustomResource.KIND,
+        interval_s: float = 15.0,
+    ) -> None:
+        import threading
+
+        self.kube = kube
+        self.desired_fn = desired_fn
+        self.namespace = namespace
+        self.name = name
+        self.kind = kind
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[Any] = None
+        self.patches_total = 0
+        self.skipped_total = 0
+
+    def reconcile_once(self) -> Optional[int]:
+        """One tick: read the hint, patch ``status.fleet.desiredReplicas``
+        if it moved. Returns the hint written, or None when nothing was
+        patched (CR missing, API unreachable, hint unavailable, or
+        already current). Every external call is caught — the loop thread
+        must survive any transient failure to the next tick."""
+        import logging
+
+        log = logging.getLogger(__name__)
+        try:
+            desired = int(self.desired_fn())
+        except Exception:  # noqa: BLE001 — advisory signal; retry next tick
+            log.exception("fleet autoscale hint unavailable")
+            return None
+        try:
+            manifest = self.kube.get(self.kind, self.namespace, self.name)
+        except Exception:  # noqa: BLE001 — API blip; retry next tick
+            log.exception("autoscale CR read failed")
+            return None
+        if manifest is None:
+            log.debug(
+                "agent %s/%s not found; autoscale hint %d not written",
+                self.namespace, self.name, desired,
+            )
+            return None
+        fleet = dict((manifest.get("status") or {}).get("fleet") or {})
+        if fleet.get("desiredReplicas") == desired:
+            self.skipped_total += 1
+            return None
+        fleet["desiredReplicas"] = desired
+        try:
+            # patch ONLY the fleet subtree: the real client's merge-patch
+            # then cannot clobber status fields another controller wrote
+            # between our read and this write (the AgentController owns
+            # phase/agents and rewrites them every reconcile anyway)
+            self.kube.patch_status(
+                self.kind, self.namespace, self.name, {"fleet": fleet}
+            )
+        except Exception:  # noqa: BLE001 — transient API failure; next tick
+            log.exception("autoscale status patch failed")
+            return None
+        self.patches_total += 1
+        log.info(
+            "fleet autoscale: %s/%s status.fleet.desiredReplicas ← %d",
+            self.namespace, self.name, desired,
+        )
+        return desired
+
+    def start(self) -> None:
+        import threading
+
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="fleet-autoscale", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.reconcile_once()
+
+
 class AppResourcesFactory:
     """Application CR → setup Job + deployer Job + RBAC
     (reference AppResourcesFactory.java:590)."""

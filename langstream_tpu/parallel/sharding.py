@@ -80,6 +80,51 @@ def serving_cache_specs(n_kv_heads: int, mesh: Mesh) -> dict[str, P]:
     return {"k": spec, "v": spec}
 
 
+def constrain_serving_local_cache(local_cache: dict, n_kv_heads: int, mesh: Mesh) -> dict:
+    """Sharding constraint for a TRACED admission local cache (inside the
+    fused admit-group jits): kv heads on "model" per serving_cache_specs,
+    int8 scale trees mirroring the values minus the trailing axis. The ONE
+    definition both the dense and the paged admit groups apply, so their
+    sharding policies cannot drift (they must stay byte-identical — the
+    token-exactness invariant rides on the same forward)."""
+    from jax.lax import with_sharding_constraint
+
+    quantized = isinstance(local_cache["k"], dict)
+    specs = serving_cache_specs(n_kv_heads, mesh)
+    if quantized:
+        specs = {k: _kv_entry_specs(s, True) for k, s in specs.items()}
+    return jax.tree.map(
+        lambda x, s: with_sharding_constraint(x, NamedSharding(mesh, s)),
+        local_cache,
+        specs,
+    )
+
+
+def page_pool_specs(n_kv_heads: int, mesh: Mesh) -> P:
+    """Paged KV pool [L, P, Hkv, page_size, D]: kv heads on "model" when
+    they divide the axis, replicated otherwise — the same policy (and the
+    same Megatron kv-replication fallback) as ``serving_cache_specs``. The
+    page axis stays replicated: page ids are runtime table indices, and a
+    gather that crossed shard boundaries on the page axis would turn every
+    decode read into a collective."""
+    model_ways = int(mesh.shape.get("model", 1))
+    if model_ways > 1 and n_kv_heads % model_ways == 0:
+        return P(None, None, "model", None, None)
+    return P(None, None, None, None, None)
+
+
+def shard_page_pool(pool_dev: dict, mesh: Mesh) -> dict:
+    """Place a page-pool device tree (models.transformer.make_page_pool)
+    onto the mesh. int8 pools carry {"q": [L,P,Hkv,ps,D], "s": [L,P,Hkv,ps]}
+    entries — the scale tree shards like the values minus the trailing
+    head-dim axis, exactly like the dense serving cache."""
+    quantized = isinstance(pool_dev["k"], dict)
+    values = pool_dev["k"]["q"] if quantized else pool_dev["k"]
+    spec = page_pool_specs(values.shape[2], mesh)
+    entry = _kv_entry_specs(spec, quantized)
+    return jax.device_put(pool_dev, _named(mesh, {"k": entry, "v": entry}))
+
+
 def shard_serving_cache(cache: dict, mesh: Mesh) -> dict:
     quantized = isinstance(cache["k"], dict)
     values = cache["k"]["q"] if quantized else cache["k"]

@@ -8,7 +8,44 @@ before every device dispatch, a fixed-shape CONTROL BLOCK describing the
 call (op + host-side inputs); followers sit in a replay loop executing the
 identical `_dev_*` engine methods with the received inputs
 (`serving/engine.py` call sites). Design sketched in round 2
-(`parallel/multihost.py` caveat), now implemented.
+(`parallel/multihost.py` caveat), implemented in round 3.
+
+Protocol v2 (round 13 — docs/SERVING.md §14): every host-side decision the
+FAST paths make now rides the wire, so prefix reuse, self-speculative
+decoding and ``kv_layout="paged"`` run under SPMD instead of being
+construction-disabled:
+
+- ``OP_VERIFY`` ships the leader's n-gram drafts (the index itself is
+  deterministic given the replayed token stream, so only the drafts need
+  the wire — acceptance is computed ON DEVICE identically on every host).
+- ``OP_PREFIX_ADMIT`` / ``OP_PREFIX_PUBLISH`` replay the dense prefix
+  cache's gather+suffix-segment admissions and copy-on-publish rows (the
+  pool ROW index rides the wire; the radix trie stays leader-only).
+- ``OP_PAGE_BIND`` / ``OP_PAGE_FREE`` / ``OP_PAGE_ZERO`` replay the paged
+  allocator's observable RESULTS — the page lists bound to a slot
+  (aliased prefix pages included, plus the one copy-on-write pair), table
+  clears, and quarantine page-zero dispatches. Followers keep only the
+  per-slot TABLES (what device dispatches read); the free list, refcounts
+  and the prefix page index remain leader-only state.
+- Every ``OP_DECODE``/``OP_VERIFY`` block carries an explicit ACTIVE-slot
+  mask: the leader's slot liveness (a host-side property followers cannot
+  observe — completions are discovered at fetch time) masks non-active
+  page-table rows to the out-of-bounds sentinel on every host.
+- ``OP_ROW_RESET`` replays the dense NaN-quarantine row zero, so an SPMD
+  replica quarantines a poisoned slot victim-only (round-8 semantics)
+  instead of crashing the whole replica.
+- ``OP_WARMUP`` replays a whole precompile family (decode ladder, verify
+  ladder, paged surface, prefill buckets, prefix programs) as ONE
+  announcement — both sides run the identical deterministic dispatch
+  sequence from shared config, so the warmups stay off the hot wire.
+
+Every announcement carries a monotonically increasing ``seq``; followers
+verify contiguity. With ``echo`` enabled on the channel the leader also
+re-broadcasts each decode/verify chunk's FETCHED tokens (``OP_ECHO``)
+and the follower compares them against its own device results — a
+mismatch emits a flight-recorder dump tagged with the ControlBlock seq
+(reason ``spmd-divergence``) and crashes the replica. Divergence is never
+silently survived.
 
 The transport is ``jax.experimental.multihost_utils.broadcast_one_to_all``
 — a psum over the global device mesh, so every announcement is itself a
@@ -18,7 +55,9 @@ thread, preserving a single total order.
 
 Fixed shapes: collectives require every process to present identical
 shapes, so the block is padded to (prefill_batch, max bucket width) and
-sliced host-side after receipt.
+sliced host-side after receipt. The page/draft/echo payloads get their own
+fixed-shape buffers (sized from ``table_len`` / ``spec_tokens`` at
+construction — identical on every process because the engine config is).
 """
 
 from __future__ import annotations
@@ -34,8 +73,28 @@ OP_LONG_SEG = 2
 OP_DECODE = 3
 OP_STOP = 4
 OP_RING = 5  # ring long-prefill: padded prompt streamed in token chunks
+OP_VERIFY = 6  # speculative verify dispatch (drafts payload)
+OP_PREFIX_ADMIT = 7  # dense warm admission: gather + suffix segment
+OP_PREFIX_PUBLISH = 8  # dense copy-on-publish into a pool row
+OP_PAGE_BIND = 9  # paged reservation result: slot's page list (+ COW pair)
+OP_PAGE_FREE = 10  # slot's table clears (completion / quarantine / abort)
+OP_PAGE_ZERO = 11  # quarantine page-zero dispatch
+OP_ROW_RESET = 12  # dense NaN-quarantine row zero dispatch
+OP_ECHO = 13  # leader's fetched chunk result (divergence check, optional)
+OP_WARMUP = 14  # replay a whole precompile family (count = WARMUP_* kind)
 
-# head vector layout (int32[12])
+# OP_WARMUP kinds (ControlBlock.count)
+WARMUP_DECODE_LADDER = 0
+WARMUP_VERIFY_LADDER = 1
+WARMUP_PAGED = 2
+WARMUP_PREFILL_BUCKETS = 3
+WARMUP_PREFIX_PROGRAMS = 4
+
+# OP_ECHO kinds (ControlBlock.long_idx)
+ECHO_DECODE = 0
+ECHO_VERIFY = 1
+
+# head vector layout (int32[_HEAD_LEN])
 _H_OP = 0
 _H_WIDTH = 1
 _H_STEPS = 2
@@ -48,7 +107,12 @@ _H_LONG_FINAL = 8
 _H_LONG_IDX = 9
 _H_PROMPT_LEN = 10
 _H_T_LONG = 11
-_HEAD_LEN = 12
+_H_ENTRY_ROW = 12  # prefix pool row (dense admit/publish, long warm start); -1 = none
+_H_COW_SRC = 13  # copy-on-write source page (paged bind); -1 = none
+_H_COW_DST = 14  # copy-on-write destination page; -1 = none
+_H_SEQ = 15  # announcement sequence number (follower verifies contiguity)
+_H_COUNT = 16  # page count / echo element count / warmup kind
+_HEAD_LEN = 17
 
 
 @dataclass
@@ -67,24 +131,83 @@ class ControlBlock:
     long_idx: int = 0
     prompt_len: int = 0
     t_long: int = 0
+    entry_row: int = -1
+    cow_src: int = -1
+    cow_dst: int = -1
+    seq: int = 0
+    count: int = 0
     tokens: Optional[np.ndarray] = None  # [n_rows, width] int32
     lengths: Optional[np.ndarray] = None  # [n_rows]
     slots: Optional[np.ndarray] = None  # [n_rows] (or stale idxs for DECODE)
     temps: Optional[np.ndarray] = None
     top_ks: Optional[np.ndarray] = None
     top_ps: Optional[np.ndarray] = None
+    # active-slot mask [max_batch] (decode/verify: the leader's host-side
+    # slot liveness — followers mask page-table rows with it)
+    mask: Optional[np.ndarray] = None
+    drafts: Optional[np.ndarray] = None  # [max_batch, k] int32 (OP_VERIFY)
+    pages: Optional[np.ndarray] = None  # [count] int32 (bind/zero)
+    echo: Optional[np.ndarray] = None  # flat int32[count] (OP_ECHO)
 
 
 class SpmdChannel:
-    """Fixed-shape broadcast channel between the replica's processes."""
+    """Fixed-shape broadcast channel between the replica's processes.
 
-    def __init__(self, prefill_batch: int, max_width: int, max_batch: int) -> None:
+    ``table_len`` (paged layouts), ``spec_tokens`` (speculation) and
+    ``decode_chunk`` size the page/draft/echo payload buffers; all derive
+    from the engine config, so every process builds the identical channel.
+    ``echo=True`` adds the leader→follower result echo after every
+    processed decode/verify chunk (one extra broadcast per chunk — the
+    divergence-detection mode the parity suite runs under; off by default
+    in production)."""
+
+    def __init__(
+        self,
+        prefill_batch: int,
+        max_width: int,
+        max_batch: int,
+        table_len: int = 0,
+        spec_tokens: int = 0,
+        echo: bool = False,
+        decode_chunk: int = 64,
+    ) -> None:
         self.prefill_batch = int(prefill_batch)
         self.max_width = int(max_width)
         self.max_batch = int(max_batch)
+        self.table_len = int(table_len)
+        self.spec_tokens = int(spec_tokens)
+        self.echo = bool(echo)
+        self.decode_chunk = int(decode_chunk)
         # slots/stale padded to max(prefill rows, batch) so DECODE's stale
         # list and PREFILL's slot list share one field
         self.n_pad = max(self.prefill_batch, self.max_batch)
+        self.page_pad = max(1, self.table_len)
+        self.draft_pad = max(1, self.spec_tokens)
+        # echo buffer: big enough for a full decode chunk ([steps ≤
+        # decode_chunk, B] — a chunk never exceeds the engine's configured
+        # chunk size; the ctor default covers every chunk the engine knob
+        # allows by default) and a verify result ([B, k+2]); announce()
+        # asserts the fit so a mis-sized config fails loudly on the
+        # leader, never as a silent truncation
+        self.echo_pad = max(
+            self.prefill_batch * self.max_width,
+            self.max_batch * (self.draft_pad + 2),
+            self.max_batch * max(1, self.decode_chunk),
+        )
+        # wire accounting (PERF.md round 13): bytes broadcast per announce
+        # — the measured ControlBlock overhead per engine iteration
+        self.announces_total = 0
+        self.bytes_announced_total = 0
+        self._seq = 0
+        # immutable zero templates: _pack copies ONLY the arrays an op
+        # actually writes (head/slots/mask + its payload kind) and passes
+        # the shared read-only blanks for the rest — a head-only OP_DECODE
+        # on the hot path must not allocate the (large) echo/drafts/token
+        # buffers it never ships. recv() reuses the blanks as pure shape
+        # templates (broadcast returns new arrays; inputs are not mutated).
+        self._blank = self._zeros()
+        for a in self._blank:
+            a.setflags(write=False)
 
     # -- packing -------------------------------------------------------------
 
@@ -97,10 +220,28 @@ class SpmdChannel:
             np.zeros(self.n_pad, np.float32),  # temps
             np.zeros(self.n_pad, np.int32),  # top_ks
             np.ones(self.n_pad, np.float32),  # top_ps
+            np.zeros(self.max_batch, np.int32),  # active mask
+            np.zeros((self.max_batch, self.draft_pad), np.int32),  # drafts
+            np.full(self.page_pad, -1, np.int32),  # pages
+            np.zeros(self.echo_pad, np.int32),  # echo
         )
 
     def _pack(self, block: ControlBlock) -> tuple:
-        head, tokens, lengths, slots, temps, top_ks, top_ps = self._zeros()
+        blank = self._blank
+        kind = self._payload_kind(block.op)
+        head, slots, mask = blank[0].copy(), blank[3].copy(), blank[7].copy()
+        if kind == "tokens":
+            tokens, lengths = blank[1].copy(), blank[2].copy()
+            temps, top_ks, top_ps = (
+                blank[4].copy(), blank[5].copy(), blank[6].copy()
+            )
+        else:
+            tokens, lengths, temps, top_ks, top_ps = (
+                blank[1], blank[2], blank[4], blank[5], blank[6]
+            )
+        drafts = blank[8].copy() if kind == "drafts" else blank[8]
+        pages = blank[9].copy() if kind == "pages" else blank[9]
+        echo = blank[10].copy() if kind == "echo" else blank[10]
         head[_H_OP] = block.op
         head[_H_WIDTH] = block.width
         head[_H_STEPS] = block.steps
@@ -113,6 +254,11 @@ class SpmdChannel:
         head[_H_LONG_IDX] = block.long_idx
         head[_H_PROMPT_LEN] = block.prompt_len
         head[_H_T_LONG] = block.t_long
+        head[_H_ENTRY_ROW] = block.entry_row
+        head[_H_COW_SRC] = block.cow_src
+        head[_H_COW_DST] = block.cow_dst
+        head[_H_SEQ] = block.seq
+        head[_H_COUNT] = block.count
 
         def fill(dst: np.ndarray, src: Optional[np.ndarray]) -> None:
             if src is not None and len(src):
@@ -126,14 +272,39 @@ class SpmdChannel:
         fill(temps, block.temps)
         fill(top_ks, block.top_ks)
         fill(top_ps, block.top_ps)
-        return head, tokens, lengths, slots, temps, top_ks, top_ps
+        fill(mask, block.mask)
+        if block.drafts is not None:
+            n, k = block.drafts.shape
+            assert k <= self.draft_pad, (
+                f"drafts k={k} exceed the channel's spec_tokens={self.draft_pad}"
+            )
+            drafts[:n, :k] = block.drafts
+        if block.pages is not None:
+            assert len(block.pages) <= self.page_pad, (
+                f"{len(block.pages)} pages exceed the channel's "
+                f"table_len={self.page_pad}"
+            )
+            pages[: len(block.pages)] = block.pages
+        if block.echo is not None:
+            flat = np.asarray(block.echo, np.int32).reshape(-1)
+            assert len(flat) <= self.echo_pad, (
+                f"echo of {len(flat)} elements exceeds the channel's "
+                f"{self.echo_pad}-element buffer"
+            )
+            echo[: len(flat)] = flat
+        return (
+            head, tokens, lengths, slots, temps, top_ks, top_ps,
+            mask, drafts, pages, echo,
+        )
 
     def _unpack(self, packed: tuple) -> ControlBlock:
-        head, tokens, lengths, slots, temps, top_ks, top_ps = (
-            np.asarray(x) for x in packed
-        )
+        (
+            head, tokens, lengths, slots, temps, top_ks, top_ps,
+            mask, drafts, pages, echo,
+        ) = (np.asarray(x) for x in packed)
         n = int(head[_H_NROWS])
         w = int(head[_H_WIDTH])
+        count = int(head[_H_COUNT])
         return ControlBlock(
             op=int(head[_H_OP]),
             width=w,
@@ -147,12 +318,21 @@ class SpmdChannel:
             long_idx=int(head[_H_LONG_IDX]),
             prompt_len=int(head[_H_PROMPT_LEN]),
             t_long=int(head[_H_T_LONG]),
+            entry_row=int(head[_H_ENTRY_ROW]),
+            cow_src=int(head[_H_COW_SRC]),
+            cow_dst=int(head[_H_COW_DST]),
+            seq=int(head[_H_SEQ]),
+            count=count,
             tokens=tokens[:n, :w] if w else tokens[:n],
             lengths=lengths[:n],
             slots=slots[:n],
             temps=temps[:n],
             top_ks=top_ks[:n],
             top_ps=top_ps[:n],
+            mask=mask,
+            drafts=drafts,
+            pages=pages[:count],
+            echo=echo[:count],
         )
 
     # -- transport -----------------------------------------------------------
@@ -163,32 +343,89 @@ class SpmdChannel:
         return multihost_utils.broadcast_one_to_all(payload)
 
     @staticmethod
-    def _needs_payload(op: int) -> bool:
-        # DECODE/STOP/IDLE carry everything in the head + slots vector; only
-        # prefill ops ship the (prefill_batch x max_width) token buffer —
-        # two-phase keeps the per-decode-chunk hot path to two small arrays
-        return op in (OP_PREFILL, OP_LONG_SEG, OP_RING)
+    def _payload_kind(op: int) -> Optional[str]:
+        """Which second-phase payload an op ships. DECODE/STOP/IDLE and the
+        page/row bookkeeping ops carry everything in the head + phase-1
+        vectors — two-phase keeps the per-decode-chunk hot path small."""
+        if op in (OP_PREFILL, OP_LONG_SEG, OP_RING, OP_PREFIX_ADMIT):
+            return "tokens"
+        if op == OP_VERIFY:
+            return "drafts"
+        if op in (OP_PAGE_BIND, OP_PAGE_ZERO):
+            return "pages"
+        if op == OP_ECHO:
+            return "echo"
+        return None
+
+    @classmethod
+    def _phases(cls, packed: tuple, op: int) -> tuple[tuple, Optional[tuple]]:
+        """Split one packed block into its broadcast phases: the phase-1
+        triple every announcement ships, plus the op's payload phase (or
+        None). The ONE definition both transports (broadcast + loopback)
+        and both directions (announce + recv) build from, so the protocol
+        cannot drift between them — the wire-byte accounting PERF.md
+        presents as exact is summed off these same tuples."""
+        (
+            head, tokens, lengths, slots, temps, top_ks, top_ps,
+            mask, drafts, pages, echo,
+        ) = packed
+        phase1 = (head, slots, mask)
+        kind = cls._payload_kind(op)
+        if kind == "tokens":
+            return phase1, (tokens, lengths, temps, top_ks, top_ps)
+        if kind == "drafts":
+            return phase1, (drafts,)
+        if kind == "pages":
+            return phase1, (pages,)
+        if kind == "echo":
+            return phase1, (echo,)
+        return phase1, None
+
+    # seq is carried in an int32 head slot: wrap BELOW 2^31 so a replica
+    # that lives through billions of announcements keeps running instead
+    # of dying on a numpy OverflowError (followers wrap identically)
+    SEQ_MOD = 0x7FFFFFFF
+
+    def _next_seq(self) -> int:
+        self._seq = self._seq % self.SEQ_MOD + 1
+        return self._seq
 
     def announce(self, block: ControlBlock) -> None:
         """Leader: publish the next device dispatch (engine thread only —
         announcements must form one total order)."""
-        head, tokens, lengths, slots, temps, top_ks, top_ps = self._pack(block)
-        self._broadcast((head, slots))
-        if self._needs_payload(block.op):
-            self._broadcast((tokens, lengths, temps, top_ks, top_ps))
+        block.seq = self._next_seq()
+        phase1, payload = self._phases(self._pack(block), block.op)
+        self._broadcast(phase1)
+        sent = sum(a.nbytes for a in phase1)
+        if payload is not None:
+            self._broadcast(payload)
+            sent += sum(a.nbytes for a in payload)
+        self.announces_total += 1
+        self.bytes_announced_total += sent
 
     def recv(self) -> ControlBlock:
         """Follower: block until the leader's next dispatch."""
-        zeros = self._zeros()
-        head, slots = self._broadcast((zeros[0], zeros[3]))
+        zeros = self._blank  # shape templates only; broadcast never mutates
+        head, slots, mask = self._broadcast((zeros[0], zeros[3], zeros[7]))
         tokens, lengths, temps, top_ks, top_ps = (
             zeros[1], zeros[2], zeros[4], zeros[5], zeros[6]
         )
-        if self._needs_payload(int(np.asarray(head)[_H_OP])):
+        drafts, pages, echo = zeros[8], zeros[9], zeros[10]
+        kind = self._payload_kind(int(np.asarray(head)[_H_OP]))
+        if kind == "tokens":
             tokens, lengths, temps, top_ks, top_ps = self._broadcast(
                 (tokens, lengths, temps, top_ks, top_ps)
             )
-        return self._unpack((head, tokens, lengths, slots, temps, top_ks, top_ps))
+        elif kind == "drafts":
+            (drafts,) = self._broadcast((drafts,))
+        elif kind == "pages":
+            (pages,) = self._broadcast((pages,))
+        elif kind == "echo":
+            (echo,) = self._broadcast((echo,))
+        return self._unpack((
+            head, tokens, lengths, slots, temps, top_ks, top_ps,
+            mask, drafts, pages, echo,
+        ))
 
 
 class LoopbackChannel(SpmdChannel):
@@ -198,45 +435,128 @@ class LoopbackChannel(SpmdChannel):
     leader engine and a follower engine sharing one process (and one
     device mesh) — the state-lockstep property is identical."""
 
-    def __init__(self, prefill_batch: int, max_width: int, max_batch: int) -> None:
-        super().__init__(prefill_batch, max_width, max_batch)
+    def __init__(
+        self,
+        prefill_batch: int,
+        max_width: int,
+        max_batch: int,
+        table_len: int = 0,
+        spec_tokens: int = 0,
+        echo: bool = False,
+        decode_chunk: int = 64,
+    ) -> None:
+        super().__init__(
+            prefill_batch, max_width, max_batch,
+            table_len=table_len, spec_tokens=spec_tokens, echo=echo,
+            decode_chunk=decode_chunk,
+        )
         import queue as _queue
 
         self._q: Any = _queue.Queue()
 
     def announce(self, block: ControlBlock) -> None:
-        self._q.put(self._pack(block))
+        block.seq = self._next_seq()
+        packed = self._pack(block)
+        # phase-1 + the op's payload phase, from the SAME splitter the
+        # broadcast transport uses — loopback benches measure the real
+        # per-iteration wire overhead
+        phase1, payload = self._phases(packed, block.op)
+        self.announces_total += 1
+        self.bytes_announced_total += sum(a.nbytes for a in phase1) + (
+            sum(a.nbytes for a in payload) if payload is not None else 0
+        )
+        self._q.put(packed)
 
     def recv(self) -> ControlBlock:
         return self._unpack(self._q.get())
 
 
+class SpmdDivergenceError(RuntimeError):
+    """Leader and follower state provably disagree (echo mismatch, sequence
+    gap, or an un-replayable block). The replica must crash and restart
+    together — continuing would serve garbage from half the mesh."""
+
+
 def follower_loop(engine: Any, channel: SpmdChannel) -> None:
     """Replay the leader's dispatches on a follower process. ``engine`` is
     a ServingEngine constructed with the SAME config/params/mesh/seed but
-    never start()ed — only its device-touching ``_dev_*`` methods run, so
-    its sharded state evolves in lockstep with the leader's.
+    never start()ed — only its device-touching ``_dev_*`` methods (and the
+    page-table bookkeeping the wire replays) run, so its sharded state
+    evolves in lockstep with the leader's.
 
     A dispatch failure here is fatal by design: the leader and follower
-    states may have diverged, so the exception propagates, the process
-    exits, and the replica's pods restart together (crash-only)."""
+    states may have diverged, so a flight-recorder dump tagged with the
+    ControlBlock seq is emitted (reason ``spmd-divergence`` — SPMD
+    incidents leave evidence like single-host ones, docs/SERVING.md §14),
+    the exception propagates, the process exits, and the replica's pods
+    restart together (crash-only)."""
     import logging
+    from collections import deque
 
     log = logging.getLogger(__name__)
+    # a follower must never fire its own faults: the leader's announced ops
+    # already reflect ITS injector, and an independent follower schedule
+    # would diverge the replicas by construction
+    engine._injector = None
+    # device results of replayed decode/verify dispatches, kept only while
+    # the channel runs in echo (divergence-check) mode; OP_ECHO pops the
+    # oldest — leader processes fetches in dispatch order, so FIFO order
+    # matches by construction
+    pending_echo: deque = deque()
+    last_seq = 0
     while True:
         block = channel.recv()
+        expected = last_seq % SpmdChannel.SEQ_MOD + 1  # leader's wrap rule
+        if block.seq and last_seq and block.seq != expected:
+            _fail_divergence(
+                engine, block,
+                f"announcement sequence gap: got seq {block.seq} after "
+                f"{last_seq} (a block was lost or reordered)",
+            )
+        if block.seq:
+            last_seq = block.seq
         if block.op == OP_STOP:
             return
         if block.op == OP_IDLE:
             continue
         try:
-            _replay(engine, block)
+            _replay(engine, block, channel, pending_echo)
+        except SpmdDivergenceError:
+            raise
         except Exception:
             log.exception("SPMD replay failed (op=%d); crashing replica", block.op)
+            _dump_divergence(engine, block, "replay raised")
             raise
 
 
-def _replay(engine: Any, block: ControlBlock) -> None:
+def _dump_divergence(engine: Any, block: ControlBlock, why: str) -> None:
+    """Best-effort flight-recorder dump before the replica crashes — the
+    SPMD incident artifact (satellite: follower-divergence flight dump)."""
+    try:
+        engine._flight_dump(
+            "spmd-divergence",
+            extra={"seq": block.seq, "op": block.op, "why": why},
+            force=True,
+        )
+    except Exception:  # noqa: BLE001 — the crash must proceed regardless
+        import logging
+
+        logging.getLogger(__name__).exception("divergence dump failed")
+
+
+def _fail_divergence(engine: Any, block: ControlBlock, why: str) -> None:
+    _dump_divergence(engine, block, why)
+    raise SpmdDivergenceError(
+        f"SPMD divergence at seq {block.seq} (op {block.op}): {why}"
+    )
+
+
+def _replay(
+    engine: Any,
+    block: ControlBlock,
+    channel: SpmdChannel,
+    pending_echo,
+) -> None:
     if block.op == OP_PREFILL:
         engine._dev_prefill(
             block.width,
@@ -248,20 +568,36 @@ def _replay(engine: Any, block: ControlBlock) -> None:
             block.slots,
         )
     elif block.op == OP_LONG_SEG:
-        engine._dev_long_segment(
-            block.tokens,
-            block.s0,
-            block.seg_len,
-            block.kv_bound,
-            block.t_long,
-            float(block.temps[0]),
-            int(block.top_ks[0]),
-            float(block.top_ps[0]),
-            start=block.long_start,
-            final=block.long_final,
-            idx=block.long_idx,
-            prompt_len=block.prompt_len,
-        )
+        if engine._paged:
+            # paged segments (long-prompt chunks AND warm suffix segments)
+            # write straight into the slot's wire-bound pages
+            engine._dev_paged_segment(
+                block.tokens,
+                block.s0,
+                block.seg_len,
+                block.long_idx,
+                float(block.temps[0]),
+                int(block.top_ks[0]),
+                float(block.top_ps[0]),
+                final=block.long_final,
+                prompt_len=block.prompt_len,
+            )
+        else:
+            engine._dev_long_segment(
+                block.tokens,
+                block.s0,
+                block.seg_len,
+                block.kv_bound,
+                block.t_long,
+                float(block.temps[0]),
+                int(block.top_ks[0]),
+                float(block.top_ps[0]),
+                start=block.long_start,
+                final=block.long_final,
+                idx=block.long_idx,
+                prompt_len=block.prompt_len,
+                prefix_row=block.entry_row if block.entry_row >= 0 else None,
+            )
     elif block.op == OP_RING:
         # the padded prompt streams in (prefill_batch*max_width)-token
         # chunks; the final chunk triggers the one-dispatch ring admit,
@@ -290,4 +626,111 @@ def _replay(engine: Any, block: ControlBlock) -> None:
             )
     elif block.op == OP_DECODE:
         # kv_bound=0 replays pre-bound announcements as unbounded
-        engine._dev_decode(block.steps, block.slots, block.kv_bound or None)
+        chunk = engine._dev_decode(
+            block.steps, block.slots, block.kv_bound or None, mask=block.mask
+        )
+        if channel.echo:
+            pending_echo.append((ECHO_DECODE, chunk))
+    elif block.op == OP_VERIFY:
+        k = block.steps  # drafts per slot (engine.spec_tokens on the leader)
+        packed = engine._dev_verify(
+            np.asarray(block.drafts[:, :k], np.int32),
+            block.slots,
+            block.kv_bound,
+            mask=block.mask,
+        )
+        if channel.echo:
+            pending_echo.append((ECHO_VERIFY, packed))
+    elif block.op == OP_PREFIX_ADMIT:
+        engine._dev_prefix_admit(
+            block.tokens,
+            block.s0,
+            block.seg_len,
+            block.kv_bound,
+            block.entry_row,
+            float(block.temps[0]),
+            int(block.top_ks[0]),
+            float(block.top_ps[0]),
+            block.long_idx,
+        )
+    elif block.op == OP_PREFIX_PUBLISH:
+        engine._dev_prefix_publish(block.long_idx, block.entry_row)
+    elif block.op == OP_PAGE_BIND:
+        engine._spmd_apply_bind(
+            block.long_idx,
+            list(block.pages),
+            block.cow_src if block.cow_src >= 0 else None,
+            block.cow_dst if block.cow_dst >= 0 else None,
+        )
+    elif block.op == OP_PAGE_FREE:
+        # the follower tracks TABLES only (never the free list/refcounts —
+        # future reservations arrive as explicit BIND results)
+        engine._pagepool.free_slot(block.long_idx)
+    elif block.op == OP_PAGE_ZERO:
+        engine._dev_page_zero(list(block.pages))
+    elif block.op == OP_ROW_RESET:
+        engine._dev_row_reset(list(block.slots))
+    elif block.op == OP_WARMUP:
+        _replay_warmup(engine, block)
+    elif block.op == OP_ECHO:
+        _check_echo(engine, block, pending_echo)
+    else:
+        _fail_divergence(engine, block, f"unknown op {block.op}")
+
+
+def _replay_warmup(engine: Any, block: ControlBlock) -> None:
+    """Run the announced precompile family locally — both sides execute the
+    identical deterministic dispatch sequence (same config ⇒ same shapes,
+    same PRNG consumption), so the warmups cost ONE announcement each."""
+    kind = block.count
+    if kind == WARMUP_DECODE_LADDER:
+        engine._warmup_decode_ladder()
+    elif kind == WARMUP_VERIFY_LADDER:
+        engine._warmup_verify_ladder()
+    elif kind == WARMUP_PAGED:
+        engine._warmup_paged()
+    elif kind == WARMUP_PREFILL_BUCKETS:
+        engine._warmup_prefill_buckets()
+    elif kind == WARMUP_PREFIX_PROGRAMS:
+        engine._warmup_prefix_programs()
+    else:
+        _fail_divergence(engine, block, f"unknown warmup kind {kind}")
+
+
+def _check_echo(engine: Any, block: ControlBlock, pending_echo) -> None:
+    """Compare the leader's fetched chunk tokens against the follower's own
+    device result for the same dispatch — the strongest per-chunk
+    divergence check the protocol offers (opt-in: one device→host sync per
+    chunk on the follower)."""
+    import jax
+
+    if not pending_echo:
+        _fail_divergence(
+            engine, block, "echo arrived with no pending replayed dispatch"
+        )
+    kind, dev = pending_echo.popleft()
+    if kind != block.long_idx:
+        _fail_divergence(
+            engine, block,
+            f"echo kind mismatch: leader says {block.long_idx}, follower "
+            f"replayed {kind}",
+        )
+    full = np.asarray(jax.device_get(dev), np.int32).reshape(-1)
+    if len(full) != block.count:
+        # a shape drift (e.g. mismatched spec_tokens/decode_chunk config)
+        # must report as the divergence it is — checked against the FULL
+        # follower result, in either direction, before any truncation
+        _fail_divergence(
+            engine, block,
+            f"echo length mismatch: leader sent {block.count} elements, "
+            f"follower's replayed result has {len(full)}",
+        )
+    mine = full[: block.count]
+    theirs = np.asarray(block.echo[: block.count], np.int32)
+    if not np.array_equal(mine, theirs):
+        bad = int(np.argmax(mine != theirs))
+        _fail_divergence(
+            engine, block,
+            f"token divergence at element {bad}: leader {int(theirs[bad])} "
+            f"vs follower {int(mine[bad])}",
+        )

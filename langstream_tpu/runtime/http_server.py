@@ -38,6 +38,7 @@ class RuntimeHttpServer:
                 web.get("/flight", self._flight),
                 web.get("/state", self._state),
                 web.post("/fleet/generate", self._fleet_generate),
+                web.post("/fleet/cancel", self._fleet_cancel),
                 web.post("/fleet/reset", self._fleet_reset),
                 web.get("/healthz", self._healthz),
             ]
@@ -85,6 +86,25 @@ class RuntimeHttpServer:
         except ValueError as e:
             raise web.HTTPBadRequest(reason=str(e)) from None
         return web.json_response(result)
+
+    async def _fleet_cancel(self, request: web.Request) -> web.Response:
+        """Cross-process session cancellation (ROADMAP 3b, docs/SERVING.md
+        §13): the gateway that saw the client disconnect forwards the
+        session key here when this replica owns the session's fleet-routed
+        request (serving/lifecycle.py records the owner at dispatch).
+        Cancels through the process-local registry — the remote decode
+        frees its slot at the next chunk boundary instead of burning to
+        its deadline."""
+        from langstream_tpu.serving import lifecycle
+
+        try:
+            payload = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="body must be JSON") from None
+        session = str(payload.get("session") or "")
+        if not session:
+            raise web.HTTPBadRequest(reason="missing 'session'")
+        return web.json_response({"cancelled": lifecycle.cancel(session)})
 
     async def _fleet_reset(self, request: web.Request) -> web.Response:
         """Zero the local engine's streaming histograms (bench warmup

@@ -41,6 +41,7 @@ from langstream_tpu.models.transformer import (
     prefill_segment,
     verify_step_inplace,
 )
+from langstream_tpu.parallel import spmd_serving as wire
 from langstream_tpu.serving.faultinject import FaultInjector
 from langstream_tpu.serving.observability import (
     EngineObservability,
@@ -493,22 +494,12 @@ def _make_admit_group(mesh):
         n, width = tokens.shape
         local_cache = make_kv_cache(config, n, width)  # traced zeros: free
         if mesh is not None:
-            from jax.lax import with_sharding_constraint
-            from jax.sharding import NamedSharding
-
             from langstream_tpu.parallel.sharding import (
-                _kv_entry_specs,
-                serving_cache_specs,
+                constrain_serving_local_cache,
             )
 
-            quantized = isinstance(local_cache["k"], dict)
-            specs = serving_cache_specs(config.n_kv_heads, mesh)
-            if quantized:
-                specs = {k: _kv_entry_specs(s, True) for k, s in specs.items()}
-            local_cache = jax.tree.map(
-                lambda x, s: with_sharding_constraint(x, NamedSharding(mesh, s)),
-                local_cache,
-                specs,
+            local_cache = constrain_serving_local_cache(
+                local_cache, config.n_kv_heads, mesh
             )
         logits, local_cache = prefill(params, tokens, lengths, local_cache, config)
         key, sub = jax.random.split(key)
@@ -529,14 +520,16 @@ def _make_admit_group(mesh):
     return admit_group
 
 
-def _make_paged_admit_group():
+def _make_paged_admit_group(mesh=None):
     """Factory for the paged FUSED admission step: local-cache zeros +
     batched prefill + first-token sample + PAGE scatter + every decode-chain
     scatter in ONE dispatch. The prefill math is byte-identical to the dense
     admit group (same local-cache forward — the token-exactness invariant);
     only the insert differs: rows scatter into each slot's mapped pages
     instead of big-cache rows. Padding rows carry all-out-of-bounds tables,
-    so their writes drop exactly like the dense path's OOB slots."""
+    so their writes drop exactly like the dense path's OOB slots. Under a
+    mesh the transient local cache is constrained like the dense admit
+    group's, so the page scatter stays shard-local."""
     @functools.partial(
         jax.jit,
         static_argnames=("config", "page_size"),
@@ -556,6 +549,14 @@ def _make_paged_admit_group():
         top_ps = meta[3]
         n, width = tokens.shape
         local_cache = make_kv_cache(config, n, width)  # traced zeros: free
+        if mesh is not None:
+            from langstream_tpu.parallel.sharding import (
+                constrain_serving_local_cache,
+            )
+
+            local_cache = constrain_serving_local_cache(
+                local_cache, config.n_kv_heads, mesh
+            )
         logits, local_cache = prefill(params, tokens, lengths, local_cache, config)
         key, sub = jax.random.split(key)
         first = sample(logits, sub, temps, top_ks, top_ps)
@@ -829,20 +830,14 @@ class ServingEngine:
         # page-table-indexed device pool for decode, prefill, verify and
         # prefix reuse — no kv_bound compile ladder, prefix hits alias
         # pages zero-copy. "dense" = the per-slot big cache, kept one
-        # release as the escape hatch (and the only layout the SPMD
-        # follower wire and the sharded-mesh specs speak today — both fall
-        # back with a warning rather than diverge).
+        # release as the escape hatch. Paged is legal under multi-host
+        # SPMD (allocator events ride the leader→follower wire — round
+        # 13, docs/SERVING.md §14) and under sharded meshes (the pool
+        # shards its kv heads over "model" like the dense serving cache).
         if kv_layout not in ("paged", "dense"):
             raise ValueError(
                 f"unknown kv_layout {kv_layout!r}; supported: paged, dense"
             )
-        if kv_layout == "paged" and (spmd is not None or mesh is not None):
-            log.warning(
-                "kv-layout=paged is not supported on %s yet; falling back "
-                "to the dense layout",
-                "multi-host SPMD replicas" if spmd is not None else "sharded meshes",
-            )
-            kv_layout = "dense"
         self.kv_layout = kv_layout
         self._paged = kv_layout == "paged"
         self.page_size = max(1, int(page_size))
@@ -863,15 +858,19 @@ class ServingEngine:
                 self._cache = shard_serving_cache(self._cache, mesh)
         self._insert_group = _make_insert_group()
         self._admit_group = _make_admit_group(mesh)
-        self._paged_admit_group = _make_paged_admit_group()
+        self._paged_admit_group = _make_paged_admit_group(mesh)
         # ring long-prefill: mesh spans a "seq" axis → long prompts run as
         # ONE sequence-sharded dispatch instead of the segment loop. On a
         # multi-host replica the leader streams the prompt to followers in
         # fixed-shape chunks first (OP_RING), then every process makes the
-        # identical dispatch.
+        # identical dispatch. DENSE layout only: the ring admit splices
+        # into the big cache; under the paged layout long prompts take the
+        # budgeted segment loop (which writes straight into pages and has
+        # no divisibility constraint) until a paged ring splice exists.
         self._ring_admit = (
             _make_ring_admit(mesh)
             if mesh is not None
+            and not self._paged
             and "seq" in getattr(mesh, "shape", {})
             and mesh.shape["seq"] > 1
             else None
@@ -971,34 +970,26 @@ class ServingEngine:
         # cache layout. Warm admissions gather the cached prefix and prefill
         # ONLY the suffix (one segment at the reuse offset); every completed
         # prefill publishes its bucket-aligned prefix back (copy-on-publish,
-        # refcounted, LRU-evicted). Off under SPMD: the gather/publish
-        # dispatches are not on the follower wire protocol yet.
+        # refcounted, LRU-evicted). Legal under SPMD since round 13: the
+        # admission (gather+segment) and publish dispatches ride the wire
+        # as OP_PREFIX_ADMIT/OP_PREFIX_PUBLISH with the pool ROW index —
+        # the radix trie itself stays leader-only host state.
         enabled = (
             prefix_cache is True
             or str(prefix_cache).lower() in ("auto", "on", "true", "1")
         )
-        if enabled and spmd is not None:
-            log.warning(
-                "prefix-cache disabled: not supported on multi-host SPMD "
-                "replicas yet (gather/publish ops are not announced)"
-            )
-            enabled = False
         # self-speculative decoding (prompt-lookup drafts + one-dispatch
         # multi-token verification): host-side per-slot n-gram indexes
         # propose up to ``speculation_tokens`` drafts per iteration; the
         # _verify_chunk program scores them all in ONE weight read and
-        # advances each slot by accepted+1 tokens. Off under SPMD like the
-        # prefix cache: the verify dispatch is not on the follower wire.
+        # advances each slot by accepted+1 tokens. Legal under SPMD since
+        # round 13: drafts ride OP_VERIFY (acceptance is computed on
+        # device, identically on every host — only the proposals need the
+        # wire; the n-gram index stays leader-only).
         spec_on = (
             speculation is True
             or str(speculation).lower() in ("auto", "on", "true", "1")
         )
-        if spec_on and spmd is not None:
-            log.warning(
-                "speculation disabled: not supported on multi-host SPMD "
-                "replicas yet (the verify dispatch is not announced)"
-            )
-            spec_on = False
         self._spec_enabled = spec_on
         # ONE static k engine-wide: every distinct k is a separate compiled
         # verify ladder (k × the pow2 bounds), and a 15-23s mid-traffic
@@ -1198,9 +1189,14 @@ class ServingEngine:
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
             log.info(
-                "serving memory plan (%s, B=%d, T=%d, %d device%s): %s",
+                "serving memory plan (%s, B=%d, T=%d, %d device%s): %s%s",
                 config.name, max_batch, self.max_seq_len, devices,
                 "s" if devices != 1 else "", plan.summary(),
+                (
+                    f" (~{plan.per_chip_bytes(devices) / 1024**3:.2f}GiB/chip)"
+                    if devices > 1
+                    else ""
+                ),
             )
         except Exception:  # noqa: BLE001 — accounting must never block serving
             log.debug("serving memory plan unavailable", exc_info=True)
@@ -1221,6 +1217,13 @@ class ServingEngine:
                 config, self._kv_pages, self.page_size, max_batch,
                 self.max_seq_len,
             )
+            if mesh is not None:
+                # kv heads on "model" (replicated when they don't divide),
+                # same policy as the dense serving cache — every paged
+                # program then propagates the sharding from the pool input
+                from langstream_tpu.parallel.sharding import shard_page_pool
+
+                self._pagepool.dev = shard_page_pool(self._pagepool.dev, mesh)
             if prefix_index_entries > 0:
                 self._prefix_index = PrefixPageIndex(
                     self.prefill_buckets, max_entries=prefix_index_entries
@@ -1332,16 +1335,26 @@ class ServingEngine:
 
     def generate(
         self,
-        prompt_tokens: list[int],
+        prompt_tokens: Optional[list[int]] = None,
         options: Optional[GenerationOptions] = None,
         on_token: Optional[Callable[[int], None]] = None,
         timeout: float = 300.0,
+        request: Optional[GenerationRequest] = None,
     ) -> GenerationResult:
         """Blocking convenience wrapper (submit + wait). A wait timeout
         CANCELS the request — before cancellation existed, the caller got
         its TimeoutError while the engine kept decoding the orphan to
-        max_new_tokens, burning a slot nobody would ever read."""
-        req = GenerationRequest(
+        max_new_tokens, burning a slot nobody would ever read.
+
+        ``request``: submit a caller-BUILT request instead of constructing
+        one (the fleet dispatch path pre-builds it so the peer can
+        register it for cross-process cancel before submitting);
+        prompt_tokens/options/on_token are ignored then."""
+        if request is None and prompt_tokens is None:
+            # fail at the call site, not as a confusing empty-prompt
+            # generation three layers later
+            raise ValueError("generate() needs prompt_tokens or request")
+        req = request if request is not None else GenerationRequest(
             prompt_tokens=list(prompt_tokens),
             options=options or GenerationOptions(),
             on_token=on_token,
@@ -1571,6 +1584,19 @@ class ServingEngine:
             "fault-injection": (
                 self._injector.stats() if self._injector is not None else None
             ),
+            # SPMD wire accounting (PERF.md round 13: ControlBlock
+            # bytes/iteration is a MEASURED number, not an estimate)
+            "spmd": self._spmd is not None,
+            "spmd-announces-total": (
+                getattr(self._spmd, "announces_total", 0)
+                if self._spmd is not None
+                else 0
+            ),
+            "spmd-announce-bytes-total": (
+                getattr(self._spmd, "bytes_announced_total", 0)
+                if self._spmd is not None
+                else 0
+            ),
         }
 
     def _prefix_index_bytes(self) -> int:
@@ -1645,20 +1671,11 @@ class ServingEngine:
         the engine thread; slots are all free, so the garbage the warmup
         writes into cache/token buffers is dead state (admission rewrites
         every row it activates) — positions/tokens are reset anyway. SPMD:
-        announced like any decode so followers warm the same shapes."""
+        the whole family is announced as ONE OP_WARMUP block and the
+        follower runs this same function — both sides make the identical
+        deterministic dispatch sequence (docs/SERVING.md §14)."""
         def warm(steps: int, bound: Optional[int], stale=()) -> None:
-            stale = list(stale)
-            if self._spmd is not None:
-                from langstream_tpu.parallel.spmd_serving import (
-                    OP_DECODE,
-                    ControlBlock,
-                )
-
-                self._spmd.announce(ControlBlock(
-                    op=OP_DECODE, steps=steps, n_rows=len(stale),
-                    slots=np.asarray(stale, np.int32), kv_bound=bound or 0,
-                ))
-            self._dev_decode(steps, stale, bound).block_until_ready()
+            self._dev_decode(steps, list(stale), bound).block_until_ready()
 
         bounds = _kv_bound_ladder(self.max_seq_len)
         for i, bound in enumerate(bounds):
@@ -1678,10 +1695,8 @@ class ServingEngine:
             warm(floor, None)
         # no buffer reset: admission rewrites every row it activates, and
         # leaving the (deterministic) garbage in place keeps SPMD followers
-        # — which replay these warmups but not a leader-local reset — in
-        # exact lockstep
-        if self._spmd is None:
-            self._warmup_row_reset()
+        # — which replay this same warmup — in exact lockstep
+        self._warmup_row_reset()
         log.info(
             "decode ladder precompiled: bounds %s, chunk %d",
             bounds, self.decode_chunk,
@@ -1690,8 +1705,10 @@ class ServingEngine:
     def _warmup_row_reset(self) -> None:
         """Quarantine row-reset, warmed all-out-of-bounds (every write
         drops, state untouched) so the first NaN-guard trip under traffic
-        is never a compile. Not warmed under SPMD: the guard crashes the
-        replica there instead of quarantining."""
+        is never a compile. Under SPMD both sides warm it inside the
+        replayed warmup family — the quarantine dispatch itself rides the
+        wire as OP_ROW_RESET (round 13: victim-only quarantine replaced
+        the crash-only NaN contract)."""
         self._record_program("row-reset")
         idxs = np.full(self.max_batch, self.max_batch, np.int32)
         self._cache = _reset_rows(self._cache, jnp.asarray(idxs))
@@ -1705,8 +1722,8 @@ class ServingEngine:
         programs a speculative engine dispatches — is compiled before the
         first request. The first rung also warms the stale-slot temp-reset
         scatter and the tail warms the quarantine row-reset, both with
-        all-out-of-bounds indexes (every write drops). Never runs under
-        SPMD: speculation is disabled there at construction."""
+        all-out-of-bounds indexes (every write drops). Under SPMD the
+        family replays whole (OP_WARMUP), like the decode ladder."""
         drafts = np.zeros((self.max_batch, self.spec_tokens), np.int32)
         bounds = _kv_bound_ladder(self.max_seq_len)
         for i, bound in enumerate(bounds):
@@ -1778,8 +1795,8 @@ class ServingEngine:
         warmup chat happened to use the only configured bucket). All rows
         are padding (slots out of bounds → every scatter drops), so engine
         state is untouched except the PRNG key, which advances before any
-        request is served. SPMD: announced like a real prefill so followers
-        warm and key-advance identically."""
+        request is served. SPMD: the family replays whole (OP_WARMUP) so
+        followers warm and key-advance identically."""
         n_pad = self.prefill_batch
         for width in self.prefill_buckets:
             if self._stop.is_set():
@@ -1790,17 +1807,6 @@ class ServingEngine:
             top_ks = np.zeros(n_pad, np.int32)
             top_ps = np.ones(n_pad, np.float32)
             slots = np.full(n_pad, self.max_batch, np.int32)  # all dropped
-            if self._spmd is not None:
-                from langstream_tpu.parallel.spmd_serving import (
-                    OP_PREFILL,
-                    ControlBlock,
-                )
-
-                self._spmd.announce(ControlBlock(
-                    op=OP_PREFILL, width=width, n_rows=n_pad, tokens=tokens,
-                    lengths=lengths, slots=slots, temps=temps, top_ks=top_ks,
-                    top_ps=top_ps,
-                ))
             self._dev_prefill(
                 width, tokens, lengths, temps, top_ks, top_ps, slots
             ).block_until_ready()
@@ -1986,10 +1992,8 @@ class ServingEngine:
                 # forever while the leader pod looks alive. Announcements
                 # only ever come from this thread, so STOP is totally
                 # ordered after every dispatch.
-                from langstream_tpu.parallel.spmd_serving import OP_STOP, ControlBlock
-
                 try:
-                    self._spmd.announce(ControlBlock(op=OP_STOP))
+                    self._spmd.announce(wire.ControlBlock(op=wire.OP_STOP))
                 except Exception:  # noqa: BLE001 — transport may be gone too
                     log.exception("failed to announce STOP to SPMD followers")
 
@@ -2002,20 +2006,35 @@ class ServingEngine:
         pending: deque[list[tuple]] = deque()
         if self._precompile and warm:
             # restarts skip the warmups: every program is already in the jit
-            # cache (shapes are unchanged), and recovery latency is the point
+            # cache (shapes are unchanged), and recovery latency is the point.
+            # SPMD: each family is ONE OP_WARMUP announcement — the follower
+            # runs the same function, so both sides make the identical
+            # deterministic dispatch sequence without per-dispatch wire
+            # traffic (docs/SERVING.md §14)
+            def announce_warmup(kind: int) -> None:
+                if self._spmd is not None:
+                    self._spmd.announce(
+                        wire.ControlBlock(op=wire.OP_WARMUP, count=kind)
+                    )
+
             if self._paged:
                 # the whole point of the paged layout: the decode-phase
                 # surface is ONE program (per step count), not a ladder
+                announce_warmup(wire.WARMUP_PAGED)
                 self._warmup_paged()
             elif self._spec_enabled:
                 # a speculative engine dispatches the verify ladder instead
                 # of decode chunks — warming both would double startup time
                 # for programs it can never run
+                announce_warmup(wire.WARMUP_VERIFY_LADDER)
                 self._warmup_verify_ladder()
             else:
+                announce_warmup(wire.WARMUP_DECODE_LADDER)
                 self._warmup_decode_ladder()
+            announce_warmup(wire.WARMUP_PREFILL_BUCKETS)
             self._warmup_prefill_buckets()
             if self._prefix_pool is not None:
+                announce_warmup(wire.WARMUP_PREFIX_PROGRAMS)
                 self._warmup_prefix_programs()
         while not self._stop.is_set():
             self._iterate(pending)
@@ -2077,6 +2096,12 @@ class ServingEngine:
             # page-deferred admissions keep their backlog spots.
             self._pending_page_zero.clear()
             self._pagepool.reset()
+            if self.mesh is not None:
+                from langstream_tpu.parallel.sharding import shard_page_pool
+
+                self._pagepool.dev = shard_page_pool(
+                    self._pagepool.dev, self.mesh
+                )
             if self._prefix_index is not None:
                 self._prefix_index.reset()
         else:
@@ -2290,12 +2315,24 @@ class ServingEngine:
 
     def _flush_row_resets(self) -> None:
         """Zero the KV rows of NaN-quarantined slots, coalesced into one
-        row-reset dispatch per iteration (never called under SPMD — the
-        guard raises there instead, preserving crash-only lockstep)."""
+        row-reset dispatch per iteration. SPMD: the dispatch rides the
+        wire (OP_ROW_RESET) so followers zero the same rows — victim-only
+        quarantine holds on every host (docs/SERVING.md §14)."""
         stale = sorted(set(self._pending_row_resets))
         self._pending_row_resets.clear()
+        if self._spmd is not None:
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_ROW_RESET, n_rows=len(stale),
+                slots=np.asarray(stale, np.int32),
+            ))
+        self._dev_row_reset(stale)
+
+    def _dev_row_reset(self, stale) -> None:
+        """Device layer of the coalesced quarantine row zero (leader + SPMD
+        followers): one fixed-shape traced-index dispatch, out-of-bounds
+        padding rows drop."""
         idxs = np.full(self.max_batch, self.max_batch, np.int32)
-        idxs[: len(stale)] = stale
+        idxs[: len(stale)] = list(stale)
         self._record_program("row-reset")
         self._cache = _reset_rows(self._cache, jnp.asarray(idxs))
 
@@ -2613,7 +2650,7 @@ class ServingEngine:
                     log.exception("prefill failed for a batch of %d requests", len(sub))
                     for idx, request in sub:
                         if self._paged:
-                            self._pagepool.free_slot(idx)  # reserved at admit
+                            self._free_slot_pages(idx)  # reserved at admit
                         request._finish(GenerationResult(
                             tokens=[], finish_reason="error", prompt_tokens=0,
                             ttft_s=0, total_s=0, error=e,
@@ -2657,10 +2694,8 @@ class ServingEngine:
         for j, (idx, _) in enumerate(group):
             slots[j] = idx
         if self._spmd is not None:
-            from langstream_tpu.parallel.spmd_serving import OP_PREFILL, ControlBlock
-
-            self._spmd.announce(ControlBlock(
-                op=OP_PREFILL, width=width, n_rows=n_pad, tokens=tokens,
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_PREFILL, width=width, n_rows=n_pad, tokens=tokens,
                 lengths=lengths, slots=slots, temps=temps, top_ks=top_ks,
                 top_ps=top_ps,
             ))
@@ -2815,12 +2850,26 @@ class ServingEngine:
         opts = request.options
         started = time.monotonic()
         pool.acquire(entry)
+        if self._spmd is not None:
+            # warm admission on the wire: the follower replays the same
+            # gather(entry.row) + suffix segment + insert + chain scatter
+            # (the radix lookup that CHOSE the entry stays leader-only)
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_PREFIX_ADMIT, width=ws, n_rows=1, tokens=tokens,
+                s0=p, seg_len=len(suffix), kv_bound=kv_bound,
+                entry_row=entry.row, long_idx=idx,
+                temps=np.asarray([opts.temperature], np.float32),
+                top_ks=np.asarray([opts.top_k], np.int32),
+                top_ps=np.asarray([opts.top_p], np.float32),
+            ))
         try:
             first = self._dev_prefix_admit(
                 tokens, p, len(suffix), kv_bound, entry.row,
                 opts.temperature, opts.top_k, opts.top_p, idx,
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            if self._spmd is not None:
+                raise  # multi-host: crash the replica (see _admit rationale)
             log.exception("prefix-reuse prefill failed (p=%d)", p)
             request._finish(GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
@@ -2964,6 +3013,18 @@ class ServingEngine:
             cow_dst = pool.reserve(idx, need, shared)
             if cow_dst is None:
                 return None
+            if self._spmd is not None:
+                # the reservation RESULT rides the wire: followers bind the
+                # same physical pages to the same slot table (aliased
+                # prefix pages included) and make the same COW copy — the
+                # free list / refcounts / prefix index stay leader-only
+                owned = pool.slot_pages(idx)
+                self._spmd.announce(wire.ControlBlock(
+                    op=wire.OP_PAGE_BIND, long_idx=idx, count=len(owned),
+                    pages=np.asarray(owned, np.int32),
+                    cow_src=cow_src if cow_src is not None else -1,
+                    cow_dst=cow_dst if cow_src is not None else -1,
+                ))
             if index is not None:
                 index.record_lookup(entry)
             if entry is None:
@@ -3020,6 +3081,17 @@ class ServingEngine:
         tokens[0, : len(suffix)] = suffix
         opts = request.options
         started = time.monotonic()
+        if self._spmd is not None:
+            # one warm paged admission = one suffix segment against pages
+            # the preceding OP_PAGE_BIND already aliased on every host
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_LONG_SEG, width=ws, n_rows=1, tokens=tokens,
+                s0=p, seg_len=len(suffix), long_idx=idx,
+                long_final=True, prompt_len=len(prompt),
+                temps=np.asarray([opts.temperature], np.float32),
+                top_ks=np.asarray([opts.top_k], np.int32),
+                top_ps=np.asarray([opts.top_p], np.float32),
+            ))
         try:
             first = self._dev_paged_segment(
                 tokens, p, len(suffix), idx,
@@ -3027,8 +3099,10 @@ class ServingEngine:
                 final=True, prompt_len=len(prompt),
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
+            if self._spmd is not None:
+                raise  # multi-host: crash the replica (see _admit rationale)
             log.exception("paged prefix-reuse prefill failed (p=%d)", p)
-            pool.free_slot(idx)
+            self._free_slot_pages(idx)
             request._finish(GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=e,
@@ -3094,7 +3168,17 @@ class ServingEngine:
             )
         return first
 
-    def _dispatch_tables(self) -> np.ndarray:
+    def _active_mask(self) -> np.ndarray:
+        """Per-slot liveness for a decode/verify dispatch (1 = active).
+        Computed ONCE at dispatch and — under SPMD — shipped on the wire:
+        followers cannot observe completions (those are discovered from
+        fetched tokens on the leader), so the mask is part of the dispatch
+        description, not derivable state."""
+        return np.asarray(
+            [1 if s.active else 0 for s in self._slots], np.int32
+        )
+
+    def _dispatch_tables(self, mask: Optional[np.ndarray] = None) -> np.ndarray:
         """Page tables for a decode/verify dispatch, with every non-ACTIVE
         slot's row masked to the out-of-bounds sentinel. A decode step
         computes (garbage) K/V for inactive rows too; on the dense layout
@@ -3103,10 +3187,13 @@ class ServingEngine:
         long-prefill stream whose pages are mid-prefill — an unmasked
         dispatch would scribble stale-position garbage straight into them.
         Masked rows drop their writes and read clamped (masked) garbage,
-        exactly like the warmup dispatches."""
+        exactly like the warmup dispatches. ``mask`` (SPMD followers: the
+        leader's wire-shipped liveness) overrides the local slot view."""
         pool = self._pagepool
         tables = pool.tables.copy()
-        inactive = [i for i, s in enumerate(self._slots) if not s.active]
+        if mask is None:
+            mask = self._active_mask()
+        inactive = [i for i in range(self.max_batch) if not mask[i]]
         if inactive:
             tables[inactive] = pool.oob
         return tables
@@ -3144,30 +3231,94 @@ class ServingEngine:
         pages (poisoned KV must not be aliased into future admissions),
         free the slot's pages through the authoritative owned list, and
         queue the now-unreferenced ones for a coalesced zero dispatch
-        (pages, not rows — ROADMAP item 1)."""
+        (pages, not rows — ROADMAP item 1). SPMD followers see the free
+        (OP_PAGE_FREE) and the zero (OP_PAGE_ZERO on the next flush)."""
         pool = self._pagepool
         pages = pool.slot_pages(idx)
         if not pages:
             return
         if self._prefix_index is not None:
             self._prefix_index.evict_touching(pool, pages)
-        self._pending_page_zero.extend(pool.free_slot(idx))
+        self._pending_page_zero.extend(self._free_slot_pages(idx))
+
+    def _free_slot_pages(self, idx: int) -> list[int]:
+        """Release slot ``idx``'s pages (completion, quarantine, abort, or
+        a failed admission), announcing the table clear to SPMD followers
+        FIRST — their dispatch tables must stop referencing the pages
+        before any later OP_PAGE_BIND re-issues them. Returns the pages
+        whose refcount hit zero (the quarantine path zeroes those). The
+        single gateway every ``free_slot`` call goes through, so a call
+        site can never silently skip the wire. A slot that owns nothing
+        (already freed — e.g. _finish_slot after a quarantine) skips the
+        announce: the follower's table is already clear, and a redundant
+        broadcast per quarantine is pure wire noise."""
+        if self._spmd is not None and self._pagepool.slot_pages(idx):
+            self._spmd.announce(
+                wire.ControlBlock(op=wire.OP_PAGE_FREE, long_idx=idx)
+            )
+        return self._pagepool.free_slot(idx)
+
+    def _spmd_echo(self, kind: int, host: np.ndarray) -> None:
+        """Re-broadcast a processed chunk's fetched tokens to followers in
+        echo (divergence-check) mode: the follower compares them against
+        its own device result for the same dispatch and crashes with a
+        flight dump on mismatch (docs/SERVING.md §14). One extra broadcast
+        per processed chunk — off in production, on in the parity suite."""
+        if self._spmd is None or not getattr(self._spmd, "echo", False):
+            return
+        flat = np.asarray(host, np.int32).reshape(-1)
+        self._spmd.announce(wire.ControlBlock(
+            op=wire.OP_ECHO, long_idx=kind, count=len(flat), echo=flat,
+        ))
+
+    def _spmd_apply_bind(
+        self, idx: int, pages: list, cow_src: Optional[int],
+        cow_dst: Optional[int],
+    ) -> None:
+        """Follower half of OP_PAGE_BIND: adopt the leader's reservation
+        RESULT into this process's dispatch tables (tables are the only
+        allocator state a follower keeps — parallel/spmd_serving.py) and
+        make the same copy-on-write page copy, in the same stream order."""
+        pool = self._pagepool
+        if pages:
+            pool.tables[idx, : len(pages)] = pages
+            pool.tables[idx, len(pages):] = pool.oob
+        if cow_src is not None and cow_dst is not None:
+            self._record_program("page-copy")
+            pool.dev = _page_copy(
+                pool.dev,
+                jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(cow_dst, jnp.int32),
+            )
 
     def _flush_page_zeros(self) -> None:
         """Zero quarantined pages, coalesced into table_len-wide dispatches
         (ONE compiled program; out-of-bounds padding drops). Runs at the top
         of the iteration, so the zero rides the in-order stream ahead of
-        any admission that re-allocates the freed pages."""
+        any admission that re-allocates the freed pages. SPMD: each zero
+        dispatch rides the wire (OP_PAGE_ZERO) so followers scrub the same
+        physical pages."""
         pool = self._pagepool
         pages = self._pending_page_zero
         self._pending_page_zero = []
         width = pool.table_len
         for i in range(0, len(pages), width):
-            buf = np.full(width, pool.oob, np.int32)
             chunk = pages[i : i + width]
-            buf[: len(chunk)] = chunk
-            self._record_program("page-zero")
-            pool.dev = _page_zero(pool.dev, jnp.asarray(buf))
+            if self._spmd is not None:
+                self._spmd.announce(wire.ControlBlock(
+                    op=wire.OP_PAGE_ZERO, count=len(chunk),
+                    pages=np.asarray(chunk, np.int32),
+                ))
+            self._dev_page_zero(chunk)
+
+    def _dev_page_zero(self, pages) -> None:
+        """Device layer of one quarantine page-zero dispatch (leader + SPMD
+        followers): fixed table_len-wide buffer, OOB padding drops."""
+        pool = self._pagepool
+        buf = np.full(pool.table_len, pool.oob, np.int32)
+        buf[: len(pages)] = list(pages)
+        self._record_program("page-zero")
+        pool.dev = _page_zero(pool.dev, jnp.asarray(buf))
 
     def _spec_admit(self, idx: int, prompt: list[int]) -> None:
         """Create the slot's draft index at admission, seeded with the
@@ -3223,14 +3374,26 @@ class ServingEngine:
         row = pool.allocate()
         if row is None:
             return  # every row pinned — skip, don't stall admission
+        if self._spmd is not None:
+            # the allocate/evict decision above is leader-only host state;
+            # only the device copy (slot row → pool row) needs the wire
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_PREFIX_PUBLISH, long_idx=idx, entry_row=row,
+            ))
+        self._dev_prefix_publish(idx, row)
+        pool.insert(prompt, p, row)
+
+    def _dev_prefix_publish(self, idx: int, row: int) -> None:
+        """Device layer of the dense copy-on-publish (leader + SPMD
+        followers): one jitted gather-scatter, slot cache rows → pool row."""
         from langstream_tpu.ops.kvcopy import publish_prefix_rows
 
+        pool = self._prefix_pool
         self._record_program("prefix-publish")
         pool.dev = publish_prefix_rows(
             pool.dev, self._cache,
             jnp.asarray(idx, jnp.int32), jnp.asarray(row, jnp.int32),
         )
-        pool.insert(prompt, p, row)
 
     def _chunk_steps(self) -> int:
         """Power-of-two chunk bounded by every active slot's cache headroom.
@@ -3425,7 +3588,7 @@ class ServingEngine:
             if entry is not None and self._prefix_pool is not None:
                 self._prefix_pool.release(entry)
             if self._paged:
-                self._pagepool.free_slot(idx)
+                self._free_slot_pages(idx)
             self._reserved.discard(idx)
             self._longs.pop(idx, None)
             self._long_caches.pop(idx, None)
@@ -3479,19 +3642,22 @@ class ServingEngine:
         idx = st["idx"]
         start = st["seg"] == 0
         final = s0 + width >= len(prompt)
+        prefix_entry = st.pop("prefix", None)  # only present on start
         if self._spmd is not None:
-            from langstream_tpu.parallel.spmd_serving import OP_LONG_SEG, ControlBlock
-
-            self._spmd.announce(ControlBlock(
-                op=OP_LONG_SEG, width=width, n_rows=1, tokens=tokens,
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_LONG_SEG, width=width, n_rows=1, tokens=tokens,
                 s0=s0, seg_len=len(seg), kv_bound=kv_bound, t_long=t_long,
                 long_start=start, long_final=final, long_idx=idx,
                 prompt_len=len(prompt),
+                # dense warm start: the follower seeds its local cache from
+                # the same pool row (paged segments ignore this field)
+                entry_row=(
+                    prefix_entry.row if prefix_entry is not None else -1
+                ),
                 temps=np.asarray([opts.temperature], np.float32),
                 top_ks=np.asarray([opts.top_k], np.int32),
                 top_ps=np.asarray([opts.top_p], np.float32),
             ))
-        prefix_entry = st.pop("prefix", None)  # only present on start
         t_disp = time.monotonic()
         try:
             if self._paged:
@@ -3517,7 +3683,7 @@ class ServingEngine:
                 raise  # multi-host: crash the replica (see _admit rationale)
             log.exception("chunked prefill failed at segment %d", st["seg"])
             if self._paged:
-                self._pagepool.free_slot(idx)
+                self._free_slot_pages(idx)
             self._reserved.discard(idx)
             self._longs.pop(idx, None)
             self._long_caches.pop(idx, None)
@@ -3614,8 +3780,6 @@ class ServingEngine:
         fixed-shape SPMD channel in (prefill_batch × max_width)-token
         chunks; the final chunk carries the sampling params and fires the
         follower's _dev_ring."""
-        from langstream_tpu.parallel.spmd_serving import OP_RING, ControlBlock
-
         flat = tokens.reshape(-1)[:prompt_len]
         chunk_cap = self._spmd.prefill_batch * self._spmd.max_width
         total = len(flat)
@@ -3624,8 +3788,8 @@ class ServingEngine:
             rows = -(-len(piece) // self._spmd.max_width)
             padded = np.zeros(rows * self._spmd.max_width, np.int32)
             padded[: len(piece)] = piece
-            self._spmd.announce(ControlBlock(
-                op=OP_RING,
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_RING,
                 width=self._spmd.max_width,
                 n_rows=rows,
                 tokens=padded.reshape(rows, self._spmd.max_width),
@@ -3749,6 +3913,11 @@ class ServingEngine:
         inter-completion interval instead of dispatch→ready wall (which
         would read ~2× at steady state, the predecessor's remaining
         execution counted into this chunk's)."""
+        if self._paged:
+            # validate BEFORE the announce: a quarantine here frees pages
+            # (announced as OP_PAGE_FREE) and deactivates the slot, and the
+            # mask announced below must already reflect both
+            self._page_integrity_check()
         steps = self._chunk_steps()
         # shrunk (non-full) chunks run UNBOUNDED: pairing the occasional
         # short chunk with the kv_bound ladder would multiply the compiled-
@@ -3764,17 +3933,20 @@ class ServingEngine:
             else None
         )
         stale = self._collect_stale()
+        mask = self._active_mask()
         if self._spmd is not None:
-            from langstream_tpu.parallel.spmd_serving import OP_DECODE, ControlBlock
-
-            self._spmd.announce(ControlBlock(
-                op=OP_DECODE, steps=steps, n_rows=len(stale),
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_DECODE, steps=steps, n_rows=len(stale),
                 slots=np.asarray(stale, np.int32),
                 # unbounded (shrunk) chunks ride as 0 — the int32 wire
                 # header can't carry None; followers decode 0 back to None
                 kv_bound=kv_bound or 0,
+                # slot liveness is leader-only host state (completions are
+                # discovered at fetch time): ship the mask so followers
+                # sentinel the same page-table rows
+                mask=mask,
             ))
-        chunk = self._dev_decode(steps, stale, kv_bound)
+        chunk = self._dev_decode(steps, stale, kv_bound, mask=mask)
         snapshot = [
             (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
         ]
@@ -3831,12 +4003,17 @@ class ServingEngine:
                 return bound
         return self.max_seq_len
 
-    def _dev_decode(self, steps: int, stale, kv_bound: Optional[int] = None) -> Any:
-        """Device layer of one decode chunk (leader + SPMD followers)."""
+    def _dev_decode(
+        self, steps: int, stale, kv_bound: Optional[int] = None,
+        mask: Optional[np.ndarray] = None,
+    ) -> Any:
+        """Device layer of one decode chunk (leader + SPMD followers).
+        ``mask``: the dispatch's active-slot liveness (paged table
+        masking); None derives it from the local slots — followers always
+        pass the leader's wire-shipped mask."""
         if self._injector is not None:
             self._injector.fire("decode")  # crashes the loop → restart path
         if self._paged:
-            self._page_integrity_check()
             self._record_program("paged-decode", steps)
             if len(stale):
                 self._reset_stale_temps(stale)
@@ -3852,7 +4029,7 @@ class ServingEngine:
                 self._tokens_dev,
                 self._positions_dev,
                 pool.dev,
-                jnp.asarray(self._dispatch_tables()),
+                jnp.asarray(self._dispatch_tables(mask)),
                 self._key,
                 self._temp_dev,
                 self._top_k_dev,
@@ -3890,6 +4067,8 @@ class ServingEngine:
         drafts — their verify degenerates to a 1-token decode step (the
         accept test compares against the model's own outputs, so a bad or
         empty draft can never change what is emitted)."""
+        if self._paged:
+            self._page_integrity_check()  # before the announce (see chunk)
         k = self.spec_tokens
         kv_bound = 0 if self._paged else self._decode_kv_bound(k + 1)
         stale = self._collect_stale()
@@ -3910,7 +4089,17 @@ class ServingEngine:
             if prop:
                 drafts[i, : len(prop)] = prop
                 proposed[i] = len(prop)
-        packed = self._dev_verify(drafts, stale, kv_bound)
+        mask = self._active_mask()
+        if self._spmd is not None:
+            # speculation on the wire: ship the PROPOSALS (steps = k, the
+            # drafts-per-slot width) — acceptance is computed on device,
+            # identically on every host, so accepts need no forward wire
+            self._spmd.announce(wire.ControlBlock(
+                op=wire.OP_VERIFY, steps=k, n_rows=len(stale),
+                slots=np.asarray(stale, np.int32), kv_bound=kv_bound,
+                drafts=drafts, mask=mask,
+            ))
+        packed = self._dev_verify(drafts, stale, kv_bound, mask=mask)
         snapshot = [
             (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
         ]
@@ -3923,7 +4112,10 @@ class ServingEngine:
             time.monotonic(), clean,
         )
 
-    def _dev_verify(self, drafts: np.ndarray, stale, kv_bound: int) -> Any:
+    def _dev_verify(
+        self, drafts: np.ndarray, stale, kv_bound: int,
+        mask: Optional[np.ndarray] = None,
+    ) -> Any:
         """Device layer of one verify iteration — the speculative engine's
         only decode-phase dispatch, so the decode fault site fires here
         (crash/restart drills hold under speculation too; the corrupt-type
@@ -3932,7 +4124,6 @@ class ServingEngine:
         if self._injector is not None:
             self._injector.fire("decode")
         if self._paged:
-            self._page_integrity_check()
             self._record_program("paged-verify", drafts.shape[1])
             if len(stale):
                 self._reset_stale_temps(stale)
@@ -3948,7 +4139,7 @@ class ServingEngine:
                 self._tokens_dev,
                 self._positions_dev,
                 pool.dev,
-                jnp.asarray(self._dispatch_tables()),
+                jnp.asarray(self._dispatch_tables(mask)),
                 self._key,
                 self._temp_dev,
                 self._top_k_dev,
@@ -3994,6 +4185,10 @@ class ServingEngine:
             if isinstance(packed, _Fetch)
             else np.asarray(jax.device_get(packed))
         )
+        # divergence echo BEFORE the injector's host-side corruption: the
+        # echo is the DEVICE truth both sides must agree on — a leader-host
+        # corruption drill must not read as an SPMD divergence
+        self._spmd_echo(wire.ECHO_VERIFY, host)
         if self._injector is not None:
             host = self._injector.corrupt_verify(host, snapshot)
         # step-time gauge BEFORE delivery (same race rationale as
@@ -4059,6 +4254,7 @@ class ServingEngine:
             host = np.asarray(jax.device_get(chunk))  # [steps, B]
         # gauge BEFORE delivery: see _sample_step_time's rationale
         self._sample_step_time(snapshot, steps, t_dispatch, clean, pipelined)
+        self._spmd_echo(wire.ECHO_DECODE, host)  # before host-side corruption
         if self._injector is not None:
             host, _ = self._injector.corrupt_tokens(host, snapshot)
         for idx, request in snapshot:
@@ -4105,17 +4301,14 @@ class ServingEngine:
         if token < 0:
             # sampling's NaN guard sentinel: this slot's logits went
             # non-finite. Quarantine ONLY this slot — fail its request,
-            # zero its KV rows (next iteration, one coalesced dispatch) —
-            # while every other slot keeps decoding untouched. SPMD keeps
-            # crash-only semantics (the row-reset dispatch is not on the
-            # follower wire, and a leader-only reset would diverge).
+            # zero its KV rows/pages (next iteration, one coalesced
+            # dispatch) — while every other slot keeps decoding untouched.
+            # SPMD replicas quarantine victim-only too since round 13: the
+            # row-reset / page-free / page-zero dispatches ride the wire,
+            # so a poisoned slot degrades one request, not the replica
+            # (docs/SERVING.md §14).
             with self._stats_lock:
                 self.nan_guard_total += 1
-            if self._spmd is not None:
-                raise LogitsNaNError(
-                    f"non-finite logits for slot {idx} on an SPMD replica"
-                )
-            with self._stats_lock:
                 self.quarantined_slots_total += 1
             if self._paged:
                 # pages, not rows: evict prefix entries sharing the slot's
@@ -4236,7 +4429,7 @@ class ServingEngine:
         if self._paged:
             # slot reset = free its table (shared pages survive through the
             # prefix index's refcounts; exclusive ones return to the pool)
-            self._pagepool.free_slot(idx)
+            self._free_slot_pages(idx)
         request._finish(result)
         if self._obs.on:
             # the request's whole lifecycle becomes ONE span tree here —

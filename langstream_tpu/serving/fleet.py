@@ -262,18 +262,37 @@ def engine_generate(
     completion on the local engine from a fleet-dispatch payload
     (``{"prompt_tokens": [...], "options": {...}}``) and return a plain
     JSON-able result. Engine sheds propagate as FleetShedError so the HTTP
-    layer can answer 429 + Retry-After."""
+    layer can answer 429 + Retry-After.
+
+    Cross-process cancel (ROADMAP 3b): when the options carry a
+    ``cancel-key`` (the client session id the dispatching gateway routes
+    disconnects by), the in-flight request registers in THIS process's
+    lifecycle registry, so a forwarded ``POST /fleet/cancel`` from the
+    gateway frees the slot at the next chunk boundary."""
     from langstream_tpu.models.configs import GenerationOptions
-    from langstream_tpu.serving.engine import ShedError
+    from langstream_tpu.serving import lifecycle
+    from langstream_tpu.serving.engine import GenerationRequest, ShedError
 
     tokens = [int(t) for t in payload.get("prompt_tokens") or []]
     if not tokens:
         raise ValueError("fleet dispatch payload carries no prompt_tokens")
-    opts = GenerationOptions.from_dict(payload.get("options") or {})
+    options = payload.get("options") or {}
+    opts = GenerationOptions.from_dict(options)
+    cancel_key = str(options.get("cancel-key") or "")
+    # pre-built so it can register for cross-process cancel BEFORE the
+    # submit; engine.generate keeps the submit/wait/cancel-on-timeout
+    # contract in one place
+    request = GenerationRequest(prompt_tokens=tokens, options=opts)
+    if cancel_key:
+        lifecycle.register(cancel_key, request)
     try:
-        result = engine.generate(tokens, opts, timeout=timeout_s)
-    except ShedError as e:
-        raise FleetShedError(str(e), retry_after_s=e.retry_after_s) from e
+        try:
+            result = engine.generate(request=request, timeout=timeout_s)
+        except ShedError as e:
+            raise FleetShedError(str(e), retry_after_s=e.retry_after_s) from e
+    finally:
+        if cancel_key:
+            lifecycle.unregister(cancel_key, request)
     return {
         "tokens": [int(t) for t in result.tokens],
         "finish_reason": result.finish_reason,

@@ -13,10 +13,17 @@ runner's embedded gateway, the standalone runner + agent pod):
   - the gateway's ClientDisconnected paths call ``cancel(session_id)``,
   - the engine frees the cancelled slots at the next chunk boundary.
 
-Cross-process topologies (standalone gateway pod, broker-separated agents)
-get no cancellation from this — the disconnect event and the engine are in
-different processes. That is a documented gap (docs/SERVING.md §9), not a
-silent one: the deadline knobs bound orphan decode time there.
+Cross-process FLEET routes are covered too (ROADMAP 3b): when the fleet
+router dispatches a session's request to a REMOTE replica, the completions
+step records the owning replica's base URL here (``register_remote``), the
+peer's ``engine_generate`` registers the in-flight request in ITS
+process-local registry under the same session key, and ``cancel()``
+forwards ``POST /fleet/cancel`` to every recorded owner — so a
+disconnected client's remote decode dies at the next chunk boundary
+instead of at its deadline. Forwarding is best-effort on a background
+thread (a dead peer must not stall the gateway's disconnect path); the
+deadline knobs remain the backstop for topologies with no runtime HTTP
+server between the processes (docs/SERVING.md §9).
 """
 
 from __future__ import annotations
@@ -39,6 +46,65 @@ class Cancellable(Protocol):
 
 _lock = threading.Lock()
 _by_key: dict[str, dict[int, Any]] = {}
+# session → {replica base URL: refcount}: which REMOTE replicas currently
+# own in-flight work for the session (fleet dispatch). Refcounted — a
+# session can have overlapping requests on the same peer.
+_remote_by_key: dict[str, dict[str, int]] = {}
+
+
+def register_remote(key: str, base_url: str) -> None:
+    """Record that session ``key`` has an in-flight request on the replica
+    at ``base_url`` (the fleet dispatch path). cancel() forwards there."""
+    if not key or not base_url:
+        return
+    with _lock:
+        owners = _remote_by_key.setdefault(key, {})
+        owners[base_url] = owners.get(base_url, 0) + 1
+
+
+def unregister_remote(key: str, base_url: str) -> None:
+    if not key or not base_url:
+        return
+    with _lock:
+        owners = _remote_by_key.get(key)
+        if owners is None:
+            return
+        left = owners.get(base_url, 0) - 1
+        if left > 0:
+            owners[base_url] = left
+        else:
+            owners.pop(base_url, None)
+        if not owners:
+            _remote_by_key.pop(key, None)
+
+
+def _forward_cancel(key: str, urls: list[str]) -> None:
+    """POST /fleet/cancel to each owning replica. Runs on a daemon thread:
+    best-effort — a dead peer's requests die by deadline as before, and
+    the gateway's disconnect path must never stall on a peer timeout."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    for url in urls:
+        try:
+            req = urllib.request.Request(
+                url.rstrip("/") + "/fleet/cancel",
+                data=_json.dumps({"session": key}).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=2.0) as r:
+                out = _json.loads(r.read().decode("utf-8"))
+            log.info(
+                "forwarded cancel for session %r to %s (%s cancelled there)",
+                key, url, out.get("cancelled"),
+            )
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            log.warning(
+                "cancel forward to %s failed for session %r: %s "
+                "(deadline remains the backstop)", url, key, e,
+            )
 
 
 def register(key: str, request: Cancellable) -> None:
@@ -62,13 +128,22 @@ def unregister(key: str, request: Cancellable) -> None:
 
 def cancel(key: str) -> int:
     """Cancel every in-flight request registered under ``key``; returns the
-    number cancelled. Requests stay registered until their owner
+    number cancelled LOCALLY. Requests stay registered until their owner
     unregisters (cancellation resolves them through the engine, which is
-    what triggers the owner's unregister)."""
+    what triggers the owner's unregister). Sessions whose work was fleet-
+    routed to a remote replica additionally get the cancel FORWARDED to
+    the owning replica's /fleet/cancel endpoint (background thread,
+    best-effort — ROADMAP 3b)."""
     if not key:
         return 0
     with _lock:
         requests = list(_by_key.get(key, {}).values())
+        remote_urls = list(_remote_by_key.get(key, {}))
+    if remote_urls:
+        threading.Thread(
+            target=_forward_cancel, args=(key, remote_urls),
+            name="fleet-cancel-forward", daemon=True,
+        ).start()
     for request in requests:
         try:
             request.cancel()

@@ -103,6 +103,18 @@ class ServingMemoryPlan:
     def fits(self, hbm_bytes: int) -> bool:
         return self.total_bytes <= hbm_bytes
 
+    def per_chip_bytes(self, devices: int) -> int:
+        """First-order per-chip share on a sharded mesh: the plan's trees
+        are GLOBAL, and the big terms (weights on model×expert, the dense
+        cache / paged pool on model when the kv heads divide) shard across
+        the mesh while the workspace allowance replicates per chip.
+        Dividing everything except the workspace by the device count is
+        the right startup-log read now that the paged pool is legal under
+        meshes too (round 13); the achieved-bandwidth gauge does the exact
+        per-axis split at runtime (engine._achieved_hbm_gbps)."""
+        d = max(1, int(devices))
+        return self.workspace_bytes + (self.total_bytes - self.workspace_bytes) // d
+
     def summary(self) -> str:
         gib = 1024**3
         if self.page_pool_bytes:

@@ -227,6 +227,10 @@ _FORBIDDEN_KEYS = frozenset(
 DUMP_REASONS = (
     "nan-quarantine", "page-quarantine", "engine-restart", "shed-burst",
     "on-demand",
+    # SPMD leader/follower disagreement (echo mismatch, sequence gap, or a
+    # failed replay): dumped on the FOLLOWER, tagged with the ControlBlock
+    # seq, before the replica crashes — docs/SERVING.md §14
+    "spmd-divergence",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

@@ -31,6 +31,15 @@ jax.config.update("jax_default_matmul_precision", "highest")
 from langstream_tpu.messaging.memory import MemoryBroker  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` under a hard 870 s timeout (ROADMAP.md);
+    # slow-marked suites (2-process SPMD, engine-pair-heavy parity tests)
+    # run in the chaos CI step and on demand instead
+    config.addinivalue_line(
+        "markers", "slow: excluded from tier-1 (runs in the chaos CI step)"
+    )
+
+
 @pytest.fixture(autouse=True)
 def _reset_memory_broker():
     MemoryBroker.reset()

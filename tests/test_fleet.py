@@ -397,6 +397,86 @@ def test_k8s_statefulset_honors_autoscale_hint():
     assert rt.autoscale == auto
 
 
+def test_fleet_autoscale_reconciler_writes_hint():
+    """The ops loop (ROADMAP 3c): FleetAutoscaleReconciler reads
+    desired_replicas() and writes status.fleet.desiredReplicas — the field
+    the StatefulSet already honors but nothing computed in-cluster. No-op
+    patches are skipped (no self-triggered watch storms), other status
+    fields survive, and the STS replica count follows the hint."""
+    from langstream_tpu.k8s.crds import AgentCustomResource
+    from langstream_tpu.k8s.fake import FakeKubeServer
+    from langstream_tpu.k8s.resources import (
+        AgentResourcesFactory,
+        FleetAutoscaleReconciler,
+    )
+
+    kube = FakeKubeServer()
+    agent = AgentCustomResource(
+        name="a", namespace="ns", tenant="t", agent_id="a",
+        application_id="app", agent_type="ai-chat-completions",
+        component_type="PROCESSOR", config_secret_ref="s",
+        config_checksum="c", parallelism=2,
+        autoscale={"enabled": True, "min-replicas": 1, "max-replicas": 8},
+        status={"phase": "DEPLOYED"},
+    )
+    kube.apply(agent.to_manifest())
+    # record the patch bodies: the reconciler must send ONLY the fleet
+    # subtree, so the real client's merge-patch can never clobber status
+    # fields another controller wrote between read and write
+    patches: list = []
+    real_patch = kube.patch_status
+
+    def recording_patch(kind, ns, name, status):
+        patches.append(status)
+        return real_patch(kind, ns, name, status)
+
+    kube.patch_status = recording_patch
+
+    desired = {"n": 5}
+    rec = FleetAutoscaleReconciler(
+        kube, lambda: desired["n"], namespace="ns", name="a",
+    )
+    assert rec.reconcile_once() == 5
+    assert patches == [{"fleet": {"desiredReplicas": 5}}], (
+        "patch must be the narrow fleet subtree (merge-patch safety)"
+    )
+    manifest = kube.get(AgentCustomResource.KIND, "ns", "a")
+    assert manifest["status"]["fleet"]["desiredReplicas"] == 5
+    rv = manifest["metadata"]["resourceVersion"]
+
+    # unchanged hint → NO patch (resourceVersion must not move)
+    assert rec.reconcile_once() is None
+    assert rec.skipped_total == 1
+    assert (
+        kube.get(AgentCustomResource.KIND, "ns", "a")["metadata"][
+            "resourceVersion"
+        ]
+        == rv
+    )
+
+    # the hint the reconciler wrote drives the StatefulSet replica count
+    updated = AgentCustomResource.from_manifest(manifest)
+    assert AgentResourcesFactory.fleet_consumers(updated) == 5
+
+    # hint moves → patched again; an API blip or vanished CR is a no-op
+    # for this tick, never a reconciler-thread death
+    desired["n"] = 3
+    assert rec.reconcile_once() == 3
+    real_get = kube.get
+
+    def failing_get(*a, **k):
+        raise RuntimeError("apiserver 503")
+
+    kube.get = failing_get
+    desired["n"] = 9
+    assert rec.reconcile_once() is None
+    kube.get = real_get
+    kube.delete(AgentCustomResource.KIND, "ns", "a")
+    desired["n"] = 7
+    assert rec.reconcile_once() is None
+    assert rec.patches_total == 2
+
+
 # ---------------------------------------------------------------------------
 # Beacon schema + redaction
 # ---------------------------------------------------------------------------
@@ -615,6 +695,108 @@ def test_http_state_and_generate_roundtrip():
         thread.join(timeout=10)
         loop.close()
         engine.stop()
+
+
+@pytest.mark.slow
+def test_cross_process_fleet_cancel_e2e():
+    """ROADMAP 3b end-to-end, REAL process boundary: a session's request
+    fleet-routed to a subprocess replica dies at the next chunk boundary
+    when the gateway-side lifecycle.cancel() fires — the cancel-key rides
+    the dispatch payload into the peer's process-local registry
+    (fleet.engine_generate), the owning replica URL is recorded on the
+    gateway side (register_remote, what _fleet_dispatch does), and the
+    forwarded POST /fleet/cancel resolves the remote decode with
+    finish_reason=cancelled long before its deadline. Marked slow (one
+    subprocess engine build); the chaos CI step runs it."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    from langstream_tpu.serving import lifecycle
+
+    config = {
+        "model": "tiny-test",
+        "max-batch": 2,
+        "max-seq-len": 256,
+        "prefill-buckets": (16, 32),
+        "decode-chunk": 4,
+        # the client stall site slows token delivery so the generation is
+        # still mid-decode when the cancel lands (50 ms × 200 tokens ≈ 10 s)
+        "fault-injection": "client@1+",
+        "fault-seed": 0,
+        "fault-stall-s": 0.05,
+        "fleet-replica-id": "peer-0",
+    }
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("LSTPU_FAULTS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "langstream_tpu.serving.fleet",
+            "--config", _json.dumps(config),
+        ],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True,
+    )
+    done: list = []
+    try:
+        line = proc.stdout.readline()
+        assert line, "replica died before serving"
+        url = _json.loads(line)["url"]
+        replica = HttpReplica("peer-0", url)
+        session = "sess-cancel-e2e"
+        # what TpuCompletionsService._fleet_dispatch does around a remote
+        # route: record the owner, ship the cancel-key with the options
+        lifecycle.register_remote(session, url)
+        options = {
+            "max-tokens": 200, "temperature": 0.0, "deadline": 120.0,
+            "cancel-key": session,
+        }
+
+        def dispatch():
+            done.append(replica.generate([5, 6, 7], options, timeout_s=120.0))
+
+        t0 = time.monotonic()
+        worker = threading.Thread(target=dispatch, daemon=True)
+        worker.start()
+        # wait until the peer is actually mid-decode (its beacon exports
+        # active slots), then "disconnect": gateway-side cancel forwards
+        deadline = time.monotonic() + 30
+        while True:
+            assert time.monotonic() < deadline, "request never went active"
+            try:
+                if replica.fetch_beacon().get("active_slots", 0) > 0:
+                    break
+            except ReplicaError:
+                pass
+            time.sleep(0.05)
+        assert lifecycle.cancel(session) == 0  # nothing LOCAL to cancel
+        worker.join(timeout=30)
+        assert not worker.is_alive(), "remote decode did not die on cancel"
+        assert done and done[0]["finish_reason"] == "cancelled"
+        took = time.monotonic() - t0
+        assert took < 30, f"cancel took {took:.1f}s — deadline-ish, not prompt"
+        assert len(done[0]["tokens"]) < 200, "generation ran to completion"
+        lifecycle.unregister_remote(session, url)
+        # endpoint hygiene: a missing session is a 400, not a crash
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            url + "/fleet/cancel", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 400
+    finally:
+        try:
+            proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — last resort
+            proc.kill()
 
 
 def test_http_replica_maps_429_to_shed():

@@ -156,7 +156,9 @@ def test_ring_long_prefill_engine_matches_single_device():
 
     mesh = build_mesh({"model": 2, "seq": 4})
     sharded = shard_params(params, mesh, config)
-    ring = ServingEngine(config, sharded, mesh=mesh, **kw)
+    # ring long-prefill is a dense-layout path (the admit splices into the
+    # big cache); the paged default takes the segment loop instead
+    ring = ServingEngine(config, sharded, mesh=mesh, kv_layout="dense", **kw)
     assert ring._ring_admit is not None, "seq mesh axis must enable ring admit"
     ring.start()
     try:

@@ -34,30 +34,35 @@ CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
 
 def _assert_lockstep(leader, follower) -> None:
     """Leader/follower device state must be bit-identical (the property
-    every multi-host replica depends on)."""
+    every multi-host replica depends on). Compares the decode chain plus
+    whichever KV store the layout uses (dense big cache or the page
+    pool)."""
     for attr in ("_tokens_dev", "_positions_dev"):
         np.testing.assert_array_equal(
             np.asarray(jax.device_get(getattr(leader, attr))),
             np.asarray(jax.device_get(getattr(follower, attr))),
         )
-    for a, b in zip(
-        jax.tree.leaves(jax.device_get(leader._cache)),
-        jax.tree.leaves(jax.device_get(follower._cache)),
-    ):
+    store = lambda e: (  # noqa: E731
+        e._pagepool.dev if e._paged else e._cache
+    )
+    assert leader._paged == follower._paged
+    leaves_a = jax.tree.leaves(jax.device_get(store(leader)))
+    leaves_b = jax.tree.leaves(jax.device_get(store(follower)))
+    assert leaves_a and len(leaves_a) == len(leaves_b)
+    for a, b in zip(leaves_a, leaves_b):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_loopback_follower_stays_in_lockstep():
+    # this file is the DENSE-wire tier (pinned on both sides); the paged /
+    # prefix / speculation wire is covered by tests/test_spmd_parity.py
     params = init_params(CFG, jax.random.PRNGKey(0))
     channel = LoopbackChannel(prefill_batch=4, max_width=32, max_batch=2)
     leader = ServingEngine(
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=4, spmd=channel,
+        kv_layout="dense",
     )
-    # kv_layout pinned dense: a REAL follower process passes the SpmdChannel
-    # to its engine (tpu_serving.build_engine) and falls back to dense
-    # automatically; the loopback emulation builds the follower without the
-    # channel, so it must pin the layout the replayed ops speak
     follower = ServingEngine(
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=4, kv_layout="dense",
@@ -159,9 +164,12 @@ def test_loopback_ring_prefill_lockstep():
     mesh = build_mesh({"model": 2, "seq": 4})
     params = shard_params(init_params(CFG, jax.random.PRNGKey(1)), mesh, CFG)
     channel = LoopbackChannel(prefill_batch=2, max_width=32, max_batch=2)
+    # ring long-prefill is a dense-layout path (the admit splices into the
+    # big cache); paged long prompts take the segment loop instead
     mk = lambda spmd: ServingEngine(  # noqa: E731
         CFG, params, max_batch=2, max_seq_len=512, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=2, mesh=mesh, spmd=spmd,
+        kv_layout="dense",
     )
     leader, follower = mk(channel), mk(None)
     assert leader._ring_admit is not None and follower._ring_admit is not None
@@ -212,6 +220,7 @@ def test_loopback_moe_lockstep_on_expert_mesh():
     mk = lambda spmd: ServingEngine(  # noqa: E731
         config, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=2, mesh=mesh, spmd=spmd,
+        kv_layout="dense",  # the dense-wire tier; paged → test_spmd_parity
     )
     leader, follower = mk(channel), mk(None)
     follower_thread = threading.Thread(
@@ -263,12 +272,12 @@ def test_loopback_lockstep_with_precompiled_ladder():
     leader = ServingEngine(
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=4, spmd=channel,
-        precompile=True, ttft_chunk_floor=2,
+        precompile=True, ttft_chunk_floor=2, kv_layout="dense",
     )
     follower = ServingEngine(
         CFG, params, max_batch=2, max_seq_len=64, decode_chunk=4,
         prefill_buckets=(16, 32), prefill_batch=4,
-        ttft_chunk_floor=2, kv_layout="dense",  # see loopback note above
+        ttft_chunk_floor=2, kv_layout="dense",
     )
     follower_thread = threading.Thread(
         target=follower_loop, args=(follower, channel), daemon=True
