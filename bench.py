@@ -502,6 +502,121 @@ def bench_speculation(preset: str, quantize: bool, *, max_batch: int,
     return out
 
 
+def bench_adapters(preset: str, quantize: bool, *, max_batch: int,
+                   n_requests: int, new_tokens: int, max_seq_len: int,
+                   decode_chunk: int, rank: int = 8) -> dict:
+    """The agentic tier's cost model (docs/SERVING.md §15), measured as
+    pairs on fresh engines over the same params:
+
+    - decode throughput BASE (adapter pool resident but every slot base)
+      vs ONE adapter vs EIGHT concurrent adapters mixed in the batch —
+      the gathered grouped matmul's price, and proof the mixed batch rides
+      one program (compiled_programs recorded);
+    - constrained ON vs OFF ms/step on the same workload — the device-side
+      mask overhead per step (one [B, V] int16/int32 gather + masked
+      sample), the number the `constrained-decoding` knob trades."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+    from langstream_tpu.serving.tokenizer import ByteTokenizer
+
+    config = MODEL_PRESETS[preset]
+    if quantize:
+        from langstream_tpu.models.quant import init_random_quantized_params
+
+        params = init_random_quantized_params(config, jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+    else:
+        params = init_params(config, jax.random.PRNGKey(0))
+
+    adapters = [
+        {"name": f"tenant-{i}", "rank": rank, "scale": 1.0, "seed": i + 1}
+        for i in range(8)
+    ]
+    rng = np.random.default_rng(5)
+    prompts = [
+        rng.integers(1, min(config.vocab_size, 255), size=24).tolist()
+        for _ in range(n_requests)
+    ]
+    opts = dict(max_new_tokens=new_tokens, temperature=0.0)
+    out: dict = {"adapter_rank": rank}
+
+    def run(tag: str, engine_kw: dict, request_opts) -> dict:
+        engine = ServingEngine(
+            config, params, max_batch=max_batch,
+            max_seq_len=min(max_seq_len, config.max_seq_len),
+            prefill_buckets=(64,), decode_chunk=decode_chunk,
+            prefill_batch=max_batch, precompile=True, **engine_kw,
+        )
+        engine.start()
+        try:
+            engine.submit(GenerationRequest(
+                prompt_tokens=list(prompts[0]), options=request_opts(0),
+            )).result(timeout=1200)
+            engine.reset_histograms()
+            start = time.monotonic()
+            requests = [
+                engine.submit(GenerationRequest(
+                    prompt_tokens=list(p), options=request_opts(j),
+                ))
+                for j, p in enumerate(prompts)
+            ]
+            results = [r.result(timeout=1200) for r in requests]
+            elapsed = time.monotonic() - start
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        total = sum(len(r.tokens) for r in results)
+        out[f"{tag}_tokens_per_sec"] = round(total / elapsed, 2)
+        out[f"{tag}_ms_per_token"] = round(1e3 * elapsed / max(1, total), 4)
+        out[f"{tag}_compiled_programs"] = stats["compiled_programs"]
+        _reclaim()
+        return stats
+
+    # -- adapter sweep: base vs 1 vs 8 concurrent tenants -------------------
+    pool_kw = dict(adapters=adapters, adapter_pool_rows=9,
+                   constrained_decoding="off")
+    run("adapters_base", pool_kw,
+        lambda j: GenerationOptions(**opts))
+    run("adapters_1", pool_kw,
+        lambda j: GenerationOptions(**opts, adapter="tenant-0"))
+    st8 = run("adapters_8", pool_kw,
+              lambda j: GenerationOptions(**opts, adapter=f"tenant-{j % 8}"))
+    out["adapters_8_swaps"] = st8["adapter-swaps-total"]
+    # no-pool control: the engine without any adapter plumbing at all
+    run("adapters_off", dict(constrained_decoding="off"),
+        lambda j: GenerationOptions(**opts))
+
+    # -- constrained on/off: device mask overhead per step ------------------
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string", "maxLength": 16},
+            "count": {"type": "integer"},
+        },
+    }
+    rf = {"type": "json_schema", "json_schema": {"schema": schema}}
+    con_kw = dict(constrained_decoding="auto", grammar_tokenizer=tok)
+    st_on = run("constrained_on", con_kw,
+                lambda j: GenerationOptions(**opts, response_format=rf))
+    out["constrained_requests"] = st_on["constrained-requests-total"]
+    out["constrain_host_overhead_ms"] = st_on["constrain-overhead-ms"]
+    run("constrained_off", dict(constrained_decoding="off"),
+        lambda j: GenerationOptions(**opts))
+    if out.get("constrained_off_ms_per_token"):
+        out["constrained_mask_overhead_ms_per_step"] = round(
+            out["constrained_on_ms_per_token"]
+            - out["constrained_off_ms_per_token"], 4,
+        )
+    return out
+
+
 def bench_degradation(preset: str, quantize: bool, max_batch: int,
                       new_tokens: int, n_requests: int, max_seq_len: int,
                       decode_chunk: int) -> dict:
@@ -1124,6 +1239,20 @@ def main() -> None:
         ))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] speculation phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # the agentic tier (ISSUE 10 acceptance): base vs 1 vs 8 concurrent
+    # LoRA adapters in the SAME batch, and the constrained-decoding
+    # per-step mask overhead pair (docs/SERVING.md §15)
+    print("[bench] adapters + constrained-decoding phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_adapters(
+            preset, quantize, max_batch=max_batch,
+            n_requests=min(n_requests, 32), new_tokens=min(new_tokens, 64),
+            max_seq_len=max_seq_len, decode_chunk=decode_chunk,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] adapters phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # observability overhead pair: histograms + spans + flight recorder on
     # vs off over the same decode workload (§12; PERF.md round 11) — the

@@ -151,6 +151,27 @@ class _BaseCompletionsStep(Step):
             "engine-loop restarts after a crash (bounded-backoff recovery), "
             "cumulative",
         )
+        # the agentic serving tier (serving/adapters.py + constrain.py,
+        # docs/SERVING.md §15): adapter residency/swap pressure and the
+        # constrained-decoding volume + host-side mask overhead
+        self._m_adapters_resident = metrics.gauge(
+            "engine_adapters_resident",
+            "LoRA adapters currently resident in the device pool",
+        )
+        self._m_adapter_swaps = metrics.gauge(
+            "engine_adapter_swaps_total",
+            "adapter hot-swaps onto the device (LRU residency misses), "
+            "cumulative — sustained growth means the pool is too small",
+        )
+        self._m_constrained = metrics.gauge(
+            "engine_constrained_requests_total",
+            "requests decoded under a response_format grammar, cumulative",
+        )
+        self._m_constrain_overhead = metrics.gauge(
+            "engine_constrain_overhead_ms",
+            "host-side constrained-decoding bookkeeping per dispatch "
+            "(grammar swaps + verify state tables), EMA ms",
+        )
         # observability layer (serving/observability.py, docs/SERVING.md
         # §12): the engine-derived load score the replica balancer routes
         # on, the flight-recorder dump counter, and the full streaming-
@@ -225,6 +246,10 @@ class _BaseCompletionsStep(Step):
         self._m_cancelled.set(stats.get("cancelled-total", 0))
         self._m_quarantined.set(stats.get("quarantined-slots-total", 0))
         self._m_restarts.set(stats.get("engine-restarts-total", 0))
+        self._m_adapters_resident.set(stats.get("adapters-resident", 0))
+        self._m_adapter_swaps.set(stats.get("adapter-swaps-total", 0))
+        self._m_constrained.set(stats.get("constrained-requests-total", 0))
+        self._m_constrain_overhead.set(stats.get("constrain-overhead-ms", 0))
         self._m_load.set(stats.get("load-score", 0))
         self._m_flight_dumps.set(stats.get("flight-dumps-total", 0))
         fleet = getattr(self._service, "fleet_stats", lambda: None)() or {}
@@ -254,6 +279,11 @@ class _BaseCompletionsStep(Step):
                 "max-tokens", "temperature", "top-p", "top-k", "stop",
                 "logit-bias", "user", "presence-penalty", "frequency-penalty",
                 "options", "deadline", "max-queue-wait",
+                # the agentic tier (docs/SERVING.md §15): per-request
+                # adapter selection + structured-output grammar — these
+                # MUST be forwarded or the documented knobs are dead code
+                # (the round-8 whitelist lesson)
+                "adapter", "response-format",
             )
             if self.config.get(k) is not None
         }

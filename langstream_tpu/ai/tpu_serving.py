@@ -49,6 +49,24 @@ Resource configuration:
     verify ladder). Runs under SPMD too (drafts ride the wire, §14);
     composes with overlap, prefix-cache, and both KV dtypes
     (docs/SERVING.md §10).
+  adapters: list of LoRA adapters to register at startup — each entry
+    {name, rank (8), scale (1.0), path (HF/peft safetensors dir) | seed
+    (random init)}. One engine then serves base + every adapter MIXED in
+    the same decode dispatch (serving/adapters.py; per-request selection
+    via the completion option `adapter: <name>`). `adapter-pool-fraction`
+    (default 0.1) sizes the hot device pool as a fraction of weight HBM —
+    adapters beyond it stay registered and hot-swap in LRU (watch
+    engine_adapter_swaps_total); `adapter-rank` pads all adapters to one
+    pool rank; `adapter-pool-rows` overrides the row count directly.
+    Not yet on the SPMD wire (single-host engines only); docs §15
+  constrained-decoding: auto (default) | off → grammar-constrained
+    decoding (serving/constrain.py): a request carrying
+    `response-format: {type: json_schema|regex, ...}` compiles to a
+    token-level DFA and the sampler masks illegal tokens every step, so
+    structured output is guaranteed valid — including through the
+    speculative verify path. `grammar-slots` (default 4) and
+    `grammar-states` (default 128) size the device DFA pool; the memory
+    plan logs the V-linear cost (≈0.7GiB at a 256k vocab — docs §15)
   queue-depth / shed-policy: bounded admission queue; "block" (default)
     backpressures the broker poll loop, "reject" sheds with a retry-after
     (ShedError) so front doors degrade to fast 429s under overload
@@ -264,6 +282,14 @@ class _EngineHolder:
             raise ValueError(
                 f"speculation-tokens must be >= 1, got {spec_tokens}"
             )
+        constrained = self.config.get("constrained-decoding", "auto")
+        if not isinstance(constrained, bool) and str(constrained).lower() not in (
+            "auto", "off",
+        ):
+            raise ValueError(
+                f"unknown constrained-decoding {constrained!r}; "
+                "supported: auto, off"
+            )
         buckets = tuple(
             self.config.get("prefill-buckets", (32, 64, 128, 256, 512, 1024, 2048))
         )
@@ -339,6 +365,26 @@ class _EngineHolder:
             ),
             speculation=spec,  # validated at the top of this method
             speculation_tokens=spec_tokens,
+            # the agentic tier (docs/SERVING.md §15): multi-LoRA adapters +
+            # grammar-constrained decoding
+            adapters=list(self.config.get("adapters") or []),
+            adapter_pool_fraction=float(
+                self.config.get("adapter-pool-fraction", 0.1)
+            ),
+            adapter_rank=(
+                int(self.config["adapter-rank"])
+                if self.config.get("adapter-rank") is not None
+                else None
+            ),
+            adapter_pool_rows=(
+                int(self.config["adapter-pool-rows"])
+                if self.config.get("adapter-pool-rows") is not None
+                else None
+            ),
+            constrained_decoding=constrained,
+            grammar_slots=int(self.config.get("grammar-slots", 4)),
+            grammar_states=int(self.config.get("grammar-states", 128)),
+            grammar_tokenizer=self.tokenizer(),
             # request lifecycle / fault recovery (docs/SERVING.md §9)
             queue_depth=(
                 int(self.config["queue-depth"])
@@ -667,7 +713,8 @@ class TpuCompletionsService(CompletionsService):
         for _ in range(max(2, router.replica_count)):
             try:
                 decision = router.route(
-                    prompt_tokens, session_id=session_id, exclude=excluded
+                    prompt_tokens, session_id=session_id, exclude=excluded,
+                    adapter=(str(options.get("adapter") or "") or None),
                 )
             except FleetShedError as e:
                 raise ShedError(str(e), retry_after_s=e.retry_after_s) from e

@@ -219,6 +219,15 @@ class GenerationOptions:
     deadline_s: Optional[float] = None
     # cap on time spent waiting for a slot; exceeded → fails in queue
     max_queue_wait_s: Optional[float] = None
+    # multi-LoRA multiplexing (serving/adapters.py): name of a registered
+    # adapter to serve this request with — the per-request POLICY input of
+    # the agentic tier. None/"" = the base model (device pool row 0).
+    adapter: Optional[str] = None
+    # constrained decoding (serving/constrain.py): OpenAI-style
+    # response_format — {"type": "json_schema", "json_schema": {...}} or
+    # {"type": "regex", "regex": "..."}. The engine compiles it to a
+    # token DFA at submit and guarantees the completion stays inside it.
+    response_format: Optional[dict] = None
 
     @staticmethod
     def from_dict(d: dict) -> "GenerationOptions":
@@ -227,6 +236,7 @@ class GenerationOptions:
         queue_wait = d.get(
             "max-queue-wait", d.get("max-queue-wait-s", d.get("max_queue_wait_s"))
         )
+        response_format = d.get("response-format", d.get("response_format"))
         return GenerationOptions(
             max_new_tokens=int(d.get("max-tokens", d.get("max_new_tokens", 256))),
             temperature=float(d.get("temperature", 0.0)),
@@ -236,4 +246,8 @@ class GenerationOptions:
             seed=d.get("seed"),
             deadline_s=float(deadline) if deadline is not None else None,
             max_queue_wait_s=float(queue_wait) if queue_wait is not None else None,
+            adapter=(str(d["adapter"]) if d.get("adapter") else None),
+            response_format=(
+                dict(response_format) if response_format else None
+            ),
         )

@@ -155,6 +155,73 @@ def _check_shapes(params: Params, config: ModelConfig) -> None:
         )
 
 
+_LORA_PROJ_HF = {
+    "wq": "self_attn.q_proj",
+    "wk": "self_attn.k_proj",
+    "wv": "self_attn.v_proj",
+    "wo": "self_attn.o_proj",
+    "w_gate": "mlp.gate_proj",
+    "w_up": "mlp.up_proj",
+    "w_down": "mlp.down_proj",
+}
+
+
+def load_lora_params(
+    path: str | Path, config: ModelConfig, rank: int
+) -> dict[str, dict[str, np.ndarray]]:
+    """Load a HF/peft LoRA checkpoint into the stacked per-layer factor
+    trees ``serving/adapters.py`` uploads: per projection
+    ``{"a": [L, din, rank], "b": [L, rank, dout]}``.
+
+    peft stores ``...layers.{i}.{proj}.lora_A.weight`` as [r, in] and
+    ``lora_B.weight`` as [out, r] (torch [out, in] convention per factor);
+    our matmuls are ``(x @ A) @ B``, so A loads as the transpose [in, r]
+    and B as [r, out] — the same transpose-on-load rule as load_params.
+    Projections ABSENT from the checkpoint (a q/v-only adapter, the common
+    peft default) load as zeros: a zero factor contributes exactly nothing
+    to the gathered delta. MoE configs load attention projections only
+    (the pool carries no expert-FFN rows — serving/adapters.py)."""
+    from langstream_tpu.serving.adapters import _proj_dims
+
+    raw = load_raw_tensors(path)
+    L = config.n_layers
+    t = np.transpose
+
+    def find(i: int, hf_proj: str, factor: str) -> np.ndarray | None:
+        suffix = f"layers.{i}.{hf_proj}.{factor}.weight"
+        for key, value in raw.items():
+            if key.endswith(suffix):
+                return value
+        return None
+
+    out: dict[str, dict[str, np.ndarray]] = {}
+    found_any = False
+    for proj, (din, dout) in _proj_dims(config).items():
+        a = np.zeros((L, din, rank), np.float32)
+        b = np.zeros((L, rank, dout), np.float32)
+        for i in range(L):
+            raw_a = find(i, _LORA_PROJ_HF[proj], "lora_A")
+            raw_b = find(i, _LORA_PROJ_HF[proj], "lora_B")
+            if raw_a is None or raw_b is None:
+                continue
+            found_any = True
+            r = raw_a.shape[0]
+            if r > rank:
+                raise ValueError(
+                    f"{proj} layer {i}: checkpoint rank {r} exceeds the "
+                    f"requested rank {rank}"
+                )
+            a[i, :, :r] = t(np.asarray(raw_a, np.float32))
+            b[i, :r, :] = t(np.asarray(raw_b, np.float32))
+        out[proj] = {"a": a, "b": b}
+    if not found_any:
+        raise ValueError(
+            f"no lora_A/lora_B tensors under {path}; found e.g. "
+            f"{sorted(raw)[:6]}"
+        )
+    return out
+
+
 def save_params_hf(params: Params, config: ModelConfig, path: str | Path) -> None:
     """Inverse mapping (ours → HF naming), for tests and for exporting
     fine-tuned weights back to the HF ecosystem."""

@@ -173,6 +173,55 @@ def cache_width(cache: KVCache) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Multi-LoRA: gathered grouped adapter matmul (ROADMAP item 4). The adapter
+# pool is a FIXED-shape stacked tree — per projection ``{"a": [L, R, din, r],
+# "b": [L, R, r, dout]}`` plus ``"scale": [R]`` — where row 0 is the all-zero
+# BASE row (public adapter id -1 maps there) and rows 1..R-1 are hot-swapped
+# by serving/adapters.py. Each batch row gathers ITS adapter's factors, so
+# one compiled program serves base + N adapters mixed in one dispatch: the
+# per-slot ``adapter_rows`` array is data, not a shape. The low-rank product
+# accumulates in fp32 (rank-r factors lose precision fast in bf16) and adds
+# onto the base projection — mathematically W_i = W + scale_i * A_i @ B_i
+# without ever materializing a merged weight per tenant (DeepServe's
+# many-logical-models-one-hot-engine multiplexing, PAPERS.md).
+# ---------------------------------------------------------------------------
+
+LORA_PROJS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _lora_delta(
+    x: jax.Array,  # [B, S, din]
+    entry: dict,  # {"a": [R, din, r], "b": [R, r, dout]} (one layer's slice)
+    scale: jax.Array,  # [R]
+    rows: jax.Array,  # [B] pool row per slot (0 = base/zero row)
+) -> jax.Array:
+    """Per-slot low-rank correction ``scale_i * (x @ A_i) @ B_i`` with the
+    factors gathered by each row's adapter id — the grouped adapter matmul.
+    Row 0 is all-zero, so base slots ride the same program at the cost of a
+    rank-r matmul against zeros (decode is weight-bandwidth-bound; the
+    [B, r] intermediate is noise next to the base projection's stream)."""
+    ag = jnp.take(entry["a"], rows, axis=0)  # [B, din, r]
+    bg = jnp.take(entry["b"], rows, axis=0)  # [B, r, dout]
+    t = jnp.einsum(
+        "bsd,bdr->bsr", x.astype(jnp.float32), ag.astype(jnp.float32)
+    )
+    out = jnp.einsum("bsr,bro->bso", t, bg.astype(jnp.float32))
+    sc = jnp.take(scale, rows, axis=0)  # [B]
+    return (out * sc[:, None, None]).astype(x.dtype)
+
+
+def _lora_proj(
+    x: jax.Array, proj: str, lora: Optional[dict], lora_scale, rows
+) -> jax.Array:
+    """Adapter delta for one projection, or a scalar zero when the pool has
+    no such projection (MoE layers carry attention-only adapters) or the
+    engine runs without adapters at all."""
+    if lora is None or proj not in lora:
+        return jnp.zeros((), x.dtype)
+    return _lora_delta(x, lora[proj], lora_scale, rows)
+
+
+# ---------------------------------------------------------------------------
 # Paged KV pool (ROADMAP item 1: ONE page-table-indexed device pool replaces
 # the per-slot dense caches, the prefix pool, and the kv_bound compile
 # ladder). Layout [L, P, Hkv, page_size, D] — the same head-major trailing
@@ -406,9 +455,22 @@ def _activation(x: jax.Array, kind: str) -> jax.Array:
     return jax.nn.silu(x)
 
 
-def dense_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
-    gate = _activation(quantized_matmul(x, lp["w_gate"]), config.activation)
-    return quantized_matmul(gate * quantized_matmul(x, lp["w_up"]), lp["w_down"])
+def dense_ffn(
+    x: jax.Array, lp: dict, config: ModelConfig,
+    lora: Optional[dict] = None, lora_scale=None, adapter_rows=None,
+) -> jax.Array:
+    gate = _activation(
+        quantized_matmul(x, lp["w_gate"])
+        + _lora_proj(x, "w_gate", lora, lora_scale, adapter_rows),
+        config.activation,
+    )
+    up = quantized_matmul(x, lp["w_up"]) + _lora_proj(
+        x, "w_up", lora, lora_scale, adapter_rows
+    )
+    h = gate * up
+    return quantized_matmul(h, lp["w_down"]) + _lora_proj(
+        h, "w_down", lora, lora_scale, adapter_rows
+    )
 
 
 def moe_ffn(x: jax.Array, lp: dict, config: ModelConfig) -> jax.Array:
@@ -494,6 +556,9 @@ def _layer(
     verify: bool = False,
     paged_table: Optional[jax.Array] = None,  # [B, Tp] physical pages
     page_size: int = 0,
+    lora: Optional[dict] = None,  # per-layer adapter slices {proj: {a, b}}
+    lora_scale: Optional[jax.Array] = None,  # [R] per-adapter scale
+    adapter_rows: Optional[jax.Array] = None,  # [B] pool row per slot
 ) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
     """One transformer block. If cache_kv given, k/v are written at
     cache_positions and attention runs over the full cache width. With
@@ -503,14 +568,26 @@ def _layer(
     ``paged_table`` set, cache_kv are per-layer PAGE-POOL entries
     ([P, Hkv, ps, D]): K/V scatter to the slot's pages and attention reads
     through the table (Pallas ragged-paged kernel on decode shapes when it
-    applies, else the gathered masked-jnp view — same math either way)."""
+    applies, else the gathered masked-jnp view — same math either way).
+    With ``lora`` set, every projection adds its slot-gathered low-rank
+    adapter term (``_lora_delta``) — K/V written to the cache INCLUDE the
+    wk/wv adapter deltas, which is why prefill must be adapter-aware too."""
     b, s, d = x.shape
     hd = config.resolved_head_dim
 
     attn_in = rms_norm(x, lp["attn_norm"], config.rms_norm_eps)
-    q = quantized_matmul(attn_in, lp["wq"]).reshape(b, s, config.n_heads, hd)
-    k = quantized_matmul(attn_in, lp["wk"]).reshape(b, s, config.n_kv_heads, hd)
-    v = quantized_matmul(attn_in, lp["wv"]).reshape(b, s, config.n_kv_heads, hd)
+    q = quantized_matmul(attn_in, lp["wq"]) + _lora_proj(
+        attn_in, "wq", lora, lora_scale, adapter_rows
+    )
+    k = quantized_matmul(attn_in, lp["wk"]) + _lora_proj(
+        attn_in, "wk", lora, lora_scale, adapter_rows
+    )
+    v = quantized_matmul(attn_in, lp["wv"]) + _lora_proj(
+        attn_in, "wv", lora, lora_scale, adapter_rows
+    )
+    q = q.reshape(b, s, config.n_heads, hd)
+    k = k.reshape(b, s, config.n_kv_heads, hd)
+    v = v.reshape(b, s, config.n_kv_heads, hd)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
 
@@ -546,12 +623,17 @@ def _layer(
             k_all = _paged_gather_entry(ck, paged_table, page_size)
             v_all = _paged_gather_entry(cv, paged_table, page_size)
             attn = attention(q, k_all, v_all, mask, config)
-        x = x + quantized_matmul(attn, lp["wo"])
+        x = x + quantized_matmul(attn, lp["wo"]) + _lora_proj(
+            attn, "wo", lora, lora_scale, adapter_rows
+        )
         ffn_in = rms_norm(x, lp["ffn_norm"], config.rms_norm_eps)
         ffn_out = (
             moe_ffn(ffn_in, lp, config)
             if config.is_moe
-            else dense_ffn(ffn_in, lp, config)
+            else dense_ffn(
+                ffn_in, lp, config, lora=lora, lora_scale=lora_scale,
+                adapter_rows=adapter_rows,
+            )
         )
         return x + ffn_out, new_cache
     if cache_kv is not None:
@@ -591,12 +673,12 @@ def _layer(
 
         attn_out = quantized_matmul(ring_attention(q, k, v, config), lp["wo"])
     else:
-        attn_out = quantized_matmul(
-            _dispatch_attention(
-                q, k_all, v_all, mask, config, cache_positions, causal,
-                kv_offset, kv_bound, verify,
-            ),
-            lp["wo"],
+        attn = _dispatch_attention(
+            q, k_all, v_all, mask, config, cache_positions, causal,
+            kv_offset, kv_bound, verify,
+        )
+        attn_out = quantized_matmul(attn, lp["wo"]) + _lora_proj(
+            attn, "wo", lora, lora_scale, adapter_rows
         )
     x = x + attn_out
 
@@ -604,7 +686,10 @@ def _layer(
     if config.is_moe:
         ffn_out = moe_ffn(ffn_in, lp, config)
     else:
-        ffn_out = dense_ffn(ffn_in, lp, config)
+        ffn_out = dense_ffn(
+            ffn_in, lp, config, lora=lora, lora_scale=lora_scale,
+            adapter_rows=adapter_rows,
+        )
     return x + ffn_out, new_cache
 
 
@@ -634,14 +719,28 @@ def _unembed(params: Params, x: jax.Array, config: ModelConfig) -> jax.Array:
     return _softcap(logits, config.final_logit_softcap)
 
 
+def _split_lora(lora: Optional[dict]):
+    """Split the stacked adapter pool into its scannable per-layer arrays
+    (leading L axis — ride the layer scan's xs) and the layer-independent
+    ``scale`` vector (closed over by the scan body)."""
+    if lora is None:
+        return None, None
+    layers = {k: v for k, v in lora.items() if k != "scale"}
+    return (layers or None), lora.get("scale")
+
+
 def _scan_layers(
     params, x, sin, cos, mask, config, cache=None, cache_positions=None, causal=True,
     kv_offset=None, kv_bound=None, collect_kv=False,
+    lora=None, adapter_rows=None,
 ):
     """lax.scan over stacked layer params; carries (x, cache). With
     ``collect_kv`` (cache-less) the scan stacks each layer's roped K/V into
-    [L, B, Hkv, S, D] arrays — the makings of a serving cache."""
+    [L, B, Hkv, S, D] arrays — the makings of a serving cache. ``lora``
+    (the stacked adapter pool) joins the scan xs so each layer body sees
+    its own [R, din, r] slices."""
     layers = params["layers"]
+    lora_layers, lora_scale = _split_lora(lora)
 
     if cache is None:
 
@@ -656,21 +755,25 @@ def _scan_layers(
         return x, kvs
 
     def body_cached(carry, inputs):
-        lp, (ck, cv) = inputs
+        lp, (ck, cv), ll = inputs
         y, new_kv = _layer(
             carry, lp, sin, cos, mask, config, cache_kv=(ck, cv),
             cache_positions=cache_positions, kv_offset=kv_offset,
-            kv_bound=kv_bound,
+            kv_bound=kv_bound, lora=ll, lora_scale=lora_scale,
+            adapter_rows=adapter_rows,
         )
         return y, new_kv
 
-    x, new_kv = lax.scan(body_cached, x, (layers, (cache["k"], cache["v"])))
+    x, new_kv = lax.scan(
+        body_cached, x, (layers, (cache["k"], cache["v"]), lora_layers)
+    )
     return x, {"k": new_kv[0], "v": new_kv[1]}
 
 
 def _scan_layers_inplace(
     params, x, sin, cos, mask, config, cache, cache_positions, kv_bound=None,
     kv_offset=None, verify=False, paged_table=None, page_size=0,
+    lora=None, adapter_rows=None,
 ):
     """Layer loop with the cache updated IN PLACE via a scan carry +
     dynamic-update-slice at the layer index, instead of consuming the cache
@@ -695,23 +798,26 @@ def _scan_layers_inplace(
             lambda a, n: lax.dynamic_update_index_in_dim(a, n, l, 0), full, new
         )
 
+    lora_layers, lora_scale = _split_lora(lora)
+
     def body(carry, inputs):
         x, cache = carry
-        lp, l = inputs
+        lp, l, ll = inputs
         ck = read(cache["k"], l)
         cv = read(cache["v"], l)
         y, new_kv = _layer(
             x, lp, sin, cos, mask, config, cache_kv=(ck, cv),
             cache_positions=cache_positions, kv_offset=kv_offset,
             kv_bound=kv_bound, verify=verify, paged_table=paged_table,
-            page_size=page_size,
+            page_size=page_size, lora=ll, lora_scale=lora_scale,
+            adapter_rows=adapter_rows,
         )
         nck, ncv = new_kv
         cache = {"k": write(cache["k"], nck, l), "v": write(cache["v"], ncv, l)}
         return (y, cache), None
 
     (x, cache), _ = lax.scan(
-        body, (x, cache), (layers, jnp.arange(config.n_layers))
+        body, (x, cache), (layers, jnp.arange(config.n_layers), lora_layers)
     )
     return x, cache
 
@@ -792,9 +898,12 @@ def prefill(
     lengths: jax.Array,  # [B] true prompt lengths
     cache: KVCache,
     config: ModelConfig,
+    lora: Optional[dict] = None,  # stacked adapter pool (serving/adapters.py)
+    adapter_rows: Optional[jax.Array] = None,  # [B] pool row per prompt
 ) -> tuple[jax.Array, KVCache]:
     """Process prompts, fill cache slots 0..len, return logits at the last
-    real token of each prompt ([B, V])."""
+    real token of each prompt ([B, V]). With adapters, the prompt's K/V
+    carry the wk/wv deltas — a tenant's cache is its own from token 0."""
     b, s = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     sin, cos = _rope_freqs(positions, config)
@@ -806,7 +915,8 @@ def prefill(
     mask = mask & (kv_pos < s)
     x = _embed(params, tokens, config)
     x, cache = _scan_layers(
-        params, x, sin, cos, mask, config, cache=cache, cache_positions=positions
+        params, x, sin, cos, mask, config, cache=cache, cache_positions=positions,
+        lora=lora, adapter_rows=adapter_rows,
     )
     last = jnp.clip(lengths - 1, 0, s - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [B, D]
@@ -825,6 +935,8 @@ def prefill_segment(
     cache: KVCache,
     config: ModelConfig,
     kv_bound: Optional[int] = None,  # static pow2 cap ≥ offset+W (bandwidth)
+    lora: Optional[dict] = None,
+    adapter_rows: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Chunked prefill: process one segment of a longer prompt against a
     cache whose columns [0, offsets) were written by earlier segments.
@@ -850,6 +962,7 @@ def prefill_segment(
     x, cache = _scan_layers(
         params, x, sin, cos, mask, config, cache=cache,
         cache_positions=positions, kv_offset=offsets, kv_bound=kv_bound,
+        lora=lora, adapter_rows=adapter_rows,
     )
     last = jnp.clip(seg_lengths - 1, 0, s - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
@@ -886,6 +999,8 @@ def decode_step_inplace(
     cache: KVCache,
     config: ModelConfig,
     kv_bound: Optional[int] = None,  # static cap on readable cache columns
+    lora: Optional[dict] = None,
+    adapter_rows: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """decode_step with the in-place layer scan (_scan_layers_inplace) —
     NOT separately jitted: intended as the body of a fused multi-step chunk
@@ -905,7 +1020,7 @@ def decode_step_inplace(
     x = _embed(params, tokens[:, None], config)
     x, cache = _scan_layers_inplace(
         params, x, sin, cos, mask, config, cache=cache, cache_positions=pos2,
-        kv_bound=kv_bound,
+        kv_bound=kv_bound, lora=lora, adapter_rows=adapter_rows,
     )
     return _unembed(params, x, config)[:, 0], cache
 
@@ -916,6 +1031,8 @@ def verify_step_inplace(
     positions: jax.Array,  # [B] position of each row's FIRST token
     cache: KVCache,
     config: ModelConfig,
+    lora: Optional[dict] = None,
+    adapter_rows: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Multi-token speculative verify: score K drafts per slot in ONE
     forward — logits at EVERY position come back ([B, K+1, V], unlike
@@ -944,7 +1061,7 @@ def verify_step_inplace(
     x = _embed(params, tokens, config)
     x, cache = _scan_layers_inplace(
         params, x, sin, cos, mask, config, cache=cache, cache_positions=pos,
-        kv_offset=positions, verify=True,
+        kv_offset=positions, verify=True, lora=lora, adapter_rows=adapter_rows,
     )
     return _unembed(params, x, config), cache
 
@@ -976,17 +1093,22 @@ def paged_decode_step_inplace(
     table: jax.Array,  # [B, Tp] physical page per logical page
     config: ModelConfig,
     page_size: int,
+    lora: Optional[dict] = None,
+    adapter_rows: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """decode_step through the page table: ONE compiled program for every
     sequence-length mix (the dense path's (steps × kv_bound) ladder is
-    gone — a slot reads exactly its mapped pages)."""
+    gone — a slot reads exactly its mapped pages). With adapters, the
+    per-slot gathered low-rank terms keep it ONE program for every
+    base/adapter mix too — adapter_rows is data, never a shape."""
     pos2 = positions[:, None]
     sin, cos = _rope_freqs(pos2, config)
     mask = _paged_mask(table, page_size, pos2)
     x = _embed(params, tokens[:, None], config)
     x, pool = _scan_layers_inplace(
         params, x, sin, cos, mask, config, cache=pool, cache_positions=pos2,
-        paged_table=table, page_size=page_size,
+        paged_table=table, page_size=page_size, lora=lora,
+        adapter_rows=adapter_rows,
     )
     return _unembed(params, x, config)[:, 0], pool
 
@@ -999,6 +1121,8 @@ def paged_verify_step_inplace(
     table: jax.Array,
     config: ModelConfig,
     page_size: int,
+    lora: Optional[dict] = None,
+    adapter_rows: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """verify_step through the page table → logits [B, K+1, V]. Same
     stale-rejected-rows invariant as the dense verify: positions advance
@@ -1011,7 +1135,8 @@ def paged_verify_step_inplace(
     x = _embed(params, tokens, config)
     x, pool = _scan_layers_inplace(
         params, x, sin, cos, mask, config, cache=pool, cache_positions=pos,
-        verify=True, paged_table=table, page_size=page_size,
+        verify=True, paged_table=table, page_size=page_size, lora=lora,
+        adapter_rows=adapter_rows,
     )
     return _unembed(params, x, config), pool
 
@@ -1025,6 +1150,8 @@ def paged_prefill_segment_inplace(
     table: jax.Array,
     config: ModelConfig,
     page_size: int,
+    lora: Optional[dict] = None,
+    adapter_rows: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Chunked/suffix prefill straight into the slot's pages: K/V for the
     segment scatter at global positions [offsets, offsets+W) and attention
@@ -1040,7 +1167,8 @@ def paged_prefill_segment_inplace(
     x, pool = _scan_layers_inplace(
         params, x, sin, cos, mask, config, cache=pool,
         cache_positions=positions, kv_offset=offsets,
-        paged_table=table, page_size=page_size,
+        paged_table=table, page_size=page_size, lora=lora,
+        adapter_rows=adapter_rows,
     )
     last = jnp.clip(seg_lengths - 1, 0, s - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]

@@ -7,6 +7,16 @@ RecordSink.emit point — preserving the reference's StreamingChunksConsumer
 contract (ChatCompletionsStep.java:137) and its ordered-commit semantics.
 """
 
+from langstream_tpu.serving.adapters import (
+    AdapterPoolExhausted,
+    AdapterRegistry,
+    AdapterSpec,
+)
+from langstream_tpu.serving.constrain import (
+    GrammarError,
+    GrammarRegistry,
+    TokenDFA,
+)
 from langstream_tpu.serving.sampling import sample, speculative_verify
 from langstream_tpu.serving.speculation import NGramIndex
 from langstream_tpu.serving.engine import (
@@ -25,8 +35,14 @@ from langstream_tpu.serving.pagepool import (
 )
 
 __all__ = [
+    "AdapterPoolExhausted",
+    "AdapterRegistry",
+    "AdapterSpec",
     "DeadlineExceededError",
     "FaultInjector",
+    "GrammarError",
+    "GrammarRegistry",
+    "TokenDFA",
     "GenerationRequest",
     "GenerationResult",
     "InjectedFault",

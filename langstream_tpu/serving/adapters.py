@@ -1,0 +1,436 @@
+"""Multi-LoRA adapter registry: many logical models, one hot engine.
+
+ROADMAP item 4's serving half (grounded in PAPERS.md "DeepServe" — the
+multi-tenant win is multiplexing N logical models onto ONE engine — and
+"Software-Defined Agentic Serving" — the adapter is a per-request POLICY
+input, not a deployment): the engine keeps a FIXED-shape device pool of
+stacked low-rank factors, and every decode/verify/prefill dispatch gathers
+each slot's factors by row (`models/transformer.py _lora_delta`), so a
+mixed batch of base + N adapters is still ONE compiled program.
+
+This module is the HOST half:
+
+- ``AdapterSpec``: one logical adapter — name, rank, scale (alpha/rank),
+  and where its factors come from (a HF/peft safetensors dir via
+  ``models/loader.load_lora_params``, or a seed for random init in tests
+  and benches).
+- ``AdapterRegistry``: the device pool (row 0 = the all-zero BASE row the
+  public adapter id ``-1`` maps to; rows 1..R-1 hot-swapped) plus the
+  host bookkeeping that makes residency a CACHE, not a deployment:
+  refcounted rows (a row serving an active slot is pinned), LRU eviction
+  under pool pressure, and a jitted traced-row upload program so a swap is
+  ONE device dispatch that never recompiles (`adapter-load` is warmed with
+  an out-of-bounds row at engine startup, like every other program).
+
+Registration is a control-plane operation: ``register()`` loads/initializes
+the factors host-side (no device work), ``acquire()`` makes them resident
+on first use — so registering 100 adapters against an 8-row pool is legal,
+and the pool behaves like the prefix cache does for KV: hot tenants stay,
+cold tenants swap in on demand (``swaps_total`` is the gauge to watch).
+
+Adapters smaller than the pool rank are zero-padded (zero columns
+contribute exactly nothing to ``(x @ A) @ B``); adapters LARGER than the
+pool rank are rejected at registration with the sizing arithmetic.
+MoE configs carry attention-only adapters (expert FFN tensors are sharded
+over "expert" and a per-slot gathered expert-FFN delta has no cheap
+formulation); dense configs adapt all seven projections.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+import threading
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from langstream_tpu.models.configs import ModelConfig
+
+log = logging.getLogger(__name__)
+
+# public id -1 (base / no adapter) maps to device pool row 0, the all-zero
+# row — the zero/base row contract models/transformer.py documents
+BASE_ROW = 0
+
+
+class AdapterPoolExhausted(RuntimeError):
+    """Every pool row is pinned by an active request — a transient
+    saturation: the engine sheds the admission with a retry-after
+    (ShedError → HTTP 429), it never corrupts a resident tenant."""
+
+
+def _proj_dims(config: ModelConfig) -> dict[str, tuple[int, int]]:
+    """(din, dout) per adapted projection. MoE: attention-only."""
+    d, hd = config.d_model, config.resolved_head_dim
+    h, hkv, f = config.n_heads, config.n_kv_heads, config.d_ff
+    dims = {
+        "wq": (d, h * hd),
+        "wk": (d, hkv * hd),
+        "wv": (d, hkv * hd),
+        "wo": (h * hd, d),
+    }
+    if not config.is_moe:
+        dims.update({
+            "w_gate": (d, f),
+            "w_up": (d, f),
+            "w_down": (f, d),
+        })
+    return dims
+
+
+def make_lora_pool(
+    config: ModelConfig, rows: int, rank: int, dtype: Optional[Any] = None
+) -> dict:
+    """The device-resident stacked adapter pool: per projection
+    ``{"a": [L, rows, din, rank], "b": [L, rows, r, dout]}`` plus
+    ``"scale": [rows]`` — all zeros, so every row starts as the base row
+    until a swap loads it."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    L = config.n_layers
+    pool: dict[str, Any] = {}
+    for proj, (din, dout) in _proj_dims(config).items():
+        pool[proj] = {
+            "a": jnp.zeros((L, rows, din, rank), dtype),
+            "b": jnp.zeros((L, rows, rank, dout), dtype),
+        }
+    pool["scale"] = jnp.zeros((rows,), jnp.float32)
+    return pool
+
+
+def lora_pool_bytes(
+    config: ModelConfig, rows: int, rank: int, dtype: Optional[Any] = None
+) -> int:
+    """Plan-term arithmetic WITHOUT allocating (serving/memory.py)."""
+    if rows <= 0 or rank <= 0:
+        return 0
+    itemsize = jnp.dtype(dtype or config.dtype).itemsize
+    per_row = sum(
+        (din + dout) * rank * config.n_layers * itemsize
+        for din, dout in _proj_dims(config).values()
+    )
+    return rows * per_row + rows * 4  # + the fp32 scale vector
+
+
+def rows_for_fraction(
+    config: ModelConfig,
+    rank: int,
+    weights_bytes: int,
+    fraction: float,
+    n_registered: int = 0,
+) -> int:
+    """Pool rows from the ``adapter-pool-fraction`` HBM budget: enough rows
+    that ``rows × bytes_per_row ≤ fraction × weights_bytes``, floored at
+    2 (the base row + one live adapter — a 1-row pool could never serve an
+    adapter at all) and capped at 65 (64 tenants + base; past that the
+    gather index cost stops being noise). ``n_registered`` floors the
+    result so a config that LISTS more adapters than the fraction affords
+    still gets one row each — the operator asked for them by name, and the
+    plan term makes the cost visible."""
+    per_row = lora_pool_bytes(config, 1, rank)
+    if per_row <= 0:
+        return 0
+    by_budget = int(max(0.0, fraction) * weights_bytes // per_row)
+    return max(2, min(65, max(by_budget, n_registered + 1)))
+
+
+def init_random_lora(
+    config: ModelConfig, rank: int, seed: int
+) -> dict[str, dict[str, np.ndarray]]:
+    """Random adapter factors (tests, benches, `weights: random` parity).
+    Standard LoRA init puts zeros in B so the delta starts at zero — here
+    BOTH factors are random: a test adapter must CHANGE the output, or
+    token-exactness tests would pass vacuously."""
+    rng = np.random.default_rng(seed)
+    L = config.n_layers
+    out: dict[str, dict[str, np.ndarray]] = {}
+    for proj, (din, dout) in _proj_dims(config).items():
+        out[proj] = {
+            "a": (rng.standard_normal((L, din, rank)) / math.sqrt(din)).astype(
+                np.float32
+            ),
+            "b": (rng.standard_normal((L, rank, dout)) / math.sqrt(rank)).astype(
+                np.float32
+            ),
+        }
+    return out
+
+
+@dataclass
+class AdapterSpec:
+    """One logical adapter, as configured (`adapters:` on tpu-serving)."""
+
+    name: str
+    rank: int = 8
+    # the LoRA scaling alpha/rank; peft checkpoints carry alpha in their
+    # config — here the resolved multiplier is configured directly
+    scale: float = 1.0
+    path: Optional[str] = None  # HF/peft safetensors dir (models/loader)
+    seed: Optional[int] = None  # random init fallback (tests/benches)
+
+    @staticmethod
+    def from_dict(d: dict) -> "AdapterSpec":
+        return AdapterSpec(
+            name=str(d["name"]),
+            rank=int(d.get("rank", 8)),
+            scale=float(d.get("scale", 1.0)),
+            path=d.get("path"),
+            seed=int(d["seed"]) if d.get("seed") is not None else None,
+        )
+
+
+@dataclass
+class _AdapterState:
+    spec: AdapterSpec
+    host: dict  # per-proj {"a": [L, din, r], "b": [L, r, dout]} numpy
+    row: Optional[int] = None  # device pool row when resident
+    refs: int = 0  # active slots decoding with this adapter
+    last_used: int = 0
+    loads: int = 0  # times swapped onto the device
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def _load_row(pool, row, host_tree, scale):
+    """Upload one adapter's factors into pool row ``row`` — traced index,
+    so every swap is the SAME compiled program; an out-of-bounds row drops
+    every write (the warmup dispatch)."""
+
+    def put(p, h):
+        # p: [L, rows, ...], h: [L, ...] — row axis is 1
+        return p.at[:, row].set(h.astype(p.dtype), mode="drop")
+
+    out = {
+        k: jax.tree.map(put, pool[k], host_tree[k])
+        for k in host_tree
+    }
+    out["scale"] = pool["scale"].at[row].set(scale, mode="drop")
+    for k in pool:
+        if k not in out:
+            out[k] = pool[k]
+    return out
+
+
+class AdapterRegistry:
+    """Host bookkeeping + device pool for hot-swappable LoRA adapters.
+
+    All mutating methods run on the engine thread (acquire/release ride
+    admissions and completions); ``advertised()`` and ``stats()`` are read
+    from beacon/metrics threads, hence the one lock around the advertised
+    snapshot — the same crossing-threads pattern as PrefixPageIndex."""
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        rows: int,
+        rank: int,
+        dtype: Optional[Any] = None,
+    ) -> None:
+        if rows < 2 or rank < 1:
+            raise ValueError(
+                f"adapter pool needs >= 2 rows (base + 1) and rank >= 1; "
+                f"got rows={rows} rank={rank}"
+            )
+        self.config = config
+        self.rows = int(rows)
+        self.rank = int(rank)
+        self.pool = make_lora_pool(config, self.rows, self.rank, dtype)
+        self.pool_bytes = lora_pool_bytes(config, self.rows, self.rank, dtype)
+        self._by_name: dict[str, _AdapterState] = {}
+        self._row_owner: dict[int, _AdapterState] = {}
+        self._free_rows = list(range(self.rows - 1, BASE_ROW, -1))
+        self._tick = 0
+        self._ad_lock = threading.Lock()
+        self._advertised: tuple[str, ...] = ()
+        # cumulative stats (gauges)
+        self.swaps_total = 0
+        self.registered_total = 0
+        # callback the engine installs so row uploads are counted in its
+        # compiled-program set (the flat-programs guarantee has no blind
+        # spots) — None outside an engine (unit tests)
+        self.on_load_program: Optional[Any] = None
+
+    # -- registration (control plane) ----------------------------------------
+
+    def register(self, spec: AdapterSpec | dict) -> None:
+        """Load/init the adapter host-side and make it ACQUIRABLE. No
+        device work — residency happens at first acquire. Re-registering a
+        name replaces its factors (the next acquire re-uploads)."""
+        if isinstance(spec, dict):
+            spec = AdapterSpec.from_dict(spec)
+        if spec.rank > self.rank:
+            raise ValueError(
+                f"adapter {spec.name!r} rank {spec.rank} exceeds the pool "
+                f"rank {self.rank}; raise the pool rank (all adapters share "
+                "one padded rank — the pool shape is the compile surface)"
+            )
+        if spec.path:
+            from langstream_tpu.models.loader import load_lora_params
+
+            host = load_lora_params(spec.path, self.config, spec.rank)
+        else:
+            host = init_random_lora(
+                self.config, spec.rank, spec.seed if spec.seed is not None else 0
+            )
+        host = self._pad_rank(host, spec.rank)
+        old = self._by_name.get(spec.name)
+        if old is not None and old.row is not None:
+            # replaced while resident: drop the stale row (refs guard —
+            # replacing a PINNED adapter waits for its requests to finish)
+            if old.refs > 0:
+                raise ValueError(
+                    f"adapter {spec.name!r} is serving {old.refs} active "
+                    "request(s); drain before replacing its weights"
+                )
+            self._evict_state(old)
+        self._by_name[spec.name] = _AdapterState(spec=spec, host=host)
+        self.registered_total += 1
+
+    def unregister(self, name: str) -> None:
+        state = self._by_name.get(name)
+        if state is None:
+            return
+        if state.refs > 0:
+            raise ValueError(
+                f"adapter {name!r} is serving {state.refs} active request(s)"
+            )
+        if state.row is not None:
+            self._evict_state(state)
+        del self._by_name[name]
+
+    def _pad_rank(self, host: dict, rank: int) -> dict:
+        if rank == self.rank:
+            return host
+        pad = self.rank - rank
+        out = {}
+        for proj, ab in host.items():
+            out[proj] = {
+                "a": np.pad(ab["a"], ((0, 0), (0, 0), (0, pad))),
+                "b": np.pad(ab["b"], ((0, 0), (0, pad), (0, 0))),
+            }
+        return out
+
+    # -- residency (data plane) ----------------------------------------------
+
+    def names(self) -> list[str]:
+        return sorted(self._by_name)
+
+    def acquire(self, name: str) -> int:
+        """Resolve an adapter name to its device pool row, swapping it in
+        (LRU eviction of an unpinned row) when not resident. Refcounts the
+        row; the caller MUST release() once the request finishes. Raises
+        KeyError (unknown name — fail the request loudly) or
+        AdapterPoolExhausted (every row pinned — shed with retry-after)."""
+        state = self._by_name.get(name)
+        if state is None:
+            raise KeyError(
+                f"unknown adapter {name!r}; registered: {self.names()}"
+            )
+        self._tick += 1
+        state.last_used = self._tick
+        if state.row is None:
+            self._swap_in(state)
+        state.refs += 1
+        return state.row
+
+    def release(self, name: str) -> None:
+        state = self._by_name.get(name)
+        if state is None:
+            return  # unregistered while in flight — row already recycled
+        assert state.refs > 0, name
+        state.refs -= 1
+
+    def _swap_in(self, state: _AdapterState) -> None:
+        if not self._free_rows:
+            victims = [
+                s for s in self._row_owner.values() if s.refs == 0
+            ]
+            if not victims:
+                raise AdapterPoolExhausted(
+                    f"all {self.rows - 1} adapter rows are pinned by active "
+                    "requests; raise adapter-pool-fraction or retry"
+                )
+            self._evict_state(min(victims, key=lambda s: s.last_used))
+        row = self._free_rows.pop()
+        if self.on_load_program is not None:
+            self.on_load_program()
+        host_dev = {
+            proj: {k: jnp.asarray(v) for k, v in ab.items()}
+            for proj, ab in state.host.items()
+        }
+        self.pool = _load_row(
+            self.pool, jnp.asarray(row, jnp.int32), host_dev,
+            jnp.float32(state.spec.scale),
+        )
+        state.row = row
+        state.loads += 1
+        self._row_owner[row] = state
+        self.swaps_total += 1
+        self._refresh_advertised()
+
+    def _evict_state(self, state: _AdapterState) -> None:
+        assert state.refs == 0
+        row = state.row
+        state.row = None
+        if row is not None:
+            self._row_owner.pop(row, None)
+            self._free_rows.append(row)
+        self._refresh_advertised()
+        # the stale factors stay in the row until the next upload — rows
+        # are only reachable through adapter_rows, and nothing maps to an
+        # orphaned row, so no zeroing dispatch is needed (unlike KV pages,
+        # which later admissions ALIAS)
+
+    def warmup(self) -> None:
+        """Compile the row-upload program with an out-of-bounds row (every
+        write drops) so the first hot swap under traffic is never a
+        mid-traffic XLA compile."""
+        if self.on_load_program is not None:
+            self.on_load_program()
+        zero = init_random_lora(self.config, 1, 0)
+        zero = self._pad_rank(
+            {p: {"a": np.zeros_like(v["a"]), "b": np.zeros_like(v["b"])}
+             for p, v in zero.items()},
+            1,
+        )
+        host_dev = {
+            proj: {k: jnp.asarray(v) for k, v in ab.items()}
+            for proj, ab in zero.items()
+        }
+        self.pool = _load_row(
+            self.pool, jnp.asarray(self.rows, jnp.int32), host_dev,
+            jnp.float32(0.0),
+        )
+        jax.block_until_ready(self.pool["scale"])
+
+    # -- observability --------------------------------------------------------
+
+    def _refresh_advertised(self) -> None:
+        resident = tuple(
+            sorted(s.spec.name for s in self._row_owner.values())
+        )
+        with self._ad_lock:
+            self._advertised = resident
+
+    def advertised(self) -> tuple[str, ...]:
+        """Resident adapter names — the fleet beacon's adapter-affinity
+        payload (names, never weights; read from the HTTP thread)."""
+        with self._ad_lock:
+            return self._advertised
+
+    @property
+    def resident(self) -> int:
+        return len(self._row_owner)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "registered": len(self._by_name),
+            "resident": self.resident,
+            "rows": self.rows - 1,  # usable rows (base row excluded)
+            "rank": self.rank,
+            "swaps-total": self.swaps_total,
+            "pool-bytes": self.pool_bytes,
+        }

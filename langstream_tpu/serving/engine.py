@@ -122,6 +122,17 @@ class GenerationRequest:
     _done: threading.Event = field(default_factory=threading.Event)
     _result: Optional["GenerationResult"] = None
     _cancelled: threading.Event = field(default_factory=threading.Event)
+    # engine-installed teardown hook, run EXACTLY ONCE inside _finish
+    # BEFORE the waiter wakes (adapter/grammar refcount release — the one
+    # place every completion path, including queued deaths and crash
+    # recovery, funnels through)
+    _finalize: Optional[Callable[[], None]] = None
+    # compiled grammar (serving/constrain.TokenDFA), attached at submit()
+    # when options.response_format is set
+    _dfa: Optional[Any] = None
+    # adapter/grammar pool rows once resolved at admission (idempotence
+    # marker for the page-deferral retry path)
+    _agentic_rows: Optional[tuple[int, int]] = None
 
     def cancel(self) -> None:
         """Request cancellation from ANY thread. The engine honors it at
@@ -152,6 +163,12 @@ class GenerationRequest:
     def _finish(self, result: "GenerationResult") -> None:
         if self._done.is_set():
             return  # first resolution wins (sweep vs admission pop races)
+        if self._finalize is not None:
+            fin, self._finalize = self._finalize, None
+            try:
+                fin()
+            except Exception:  # noqa: BLE001 — teardown must not eat the result
+                log.exception("request finalize hook failed")
         self._result = result
         self._done.set()
         if self.on_done is not None:
@@ -201,12 +218,31 @@ class _Slot:
         self.verify_iters = 0
 
 
+def _dfa_mask(dfa, g, state):
+    """Per-slot grammar mask for ONE sampling step: the DFA pool row
+    gathered by (grammar row, current state). Legality IS the sign bit —
+    ``next[s, t] >= 0`` — so mask and advance are one int32 gather
+    (serving/constrain.py)."""
+    nrow = dfa[g, state]  # [B, V] int32
+    return nrow, nrow >= 0
+
+
+def _dfa_advance(nrow, tokens, state):
+    """Advance each slot's DFA state past its sampled token. The NaN
+    sentinel (-1) clamps to index 0 and dead targets clamp to state 0 —
+    both only reachable for slots the engine is about to quarantine or
+    that are not constrained at all (row 0 self-loops at 0)."""
+    tclip = jnp.clip(tokens, 0, nrow.shape[-1] - 1)
+    nxt = jnp.take_along_axis(nrow, tclip[:, None], axis=1)[:, 0]
+    return jnp.maximum(nxt, 0).astype(state.dtype)
+
+
 @functools.partial(
     jax.jit, static_argnames=("steps", "config", "kv_bound"), donate_argnames=("cache",)
 )
 def _decode_chunk(
     params, tokens, positions, cache, key, temp, top_k, top_p, steps, config,
-    kv_bound=None,
+    kv_bound=None, lora=None, arows=None, dfa=None, g=None, dstate=None,
 ):
     """``steps`` fused decode+sample iterations in ONE dispatch (lax.scan).
 
@@ -236,16 +272,27 @@ def _decode_chunk(
         cache = jax.tree.map(lambda a: a[:, :, :, :kv_bound], cache)
 
     def body(carry, _):
-        tokens, positions, cache, key = carry
+        tokens, positions, cache, key, dstate = carry
         logits, cache = decode_step_inplace(
-            params, tokens, positions, cache, config
+            params, tokens, positions, cache, config,
+            lora=lora, adapter_rows=arows,
         )
         key, sub = jax.random.split(key)
-        next_tokens = sample(logits, sub, temp, top_k, top_p)
-        return (next_tokens, positions + 1, cache, key), next_tokens
+        if dfa is not None:
+            # constrained decoding rides the FUSED chunk: mask this step's
+            # logits with each slot's current DFA row, then advance the
+            # state past the sampled token ON DEVICE — the host mirror
+            # replays the same table per delivered token, so a 16-step
+            # chunk stays one dispatch with both sides in lockstep
+            nrow, allowed = _dfa_mask(dfa, g, dstate)
+            next_tokens = sample(logits, sub, temp, top_k, top_p, allowed)
+            dstate = _dfa_advance(nrow, next_tokens, dstate)
+        else:
+            next_tokens = sample(logits, sub, temp, top_k, top_p)
+        return (next_tokens, positions + 1, cache, key, dstate), next_tokens
 
-    (tokens, positions, cache, key), chunk = lax.scan(
-        body, (tokens, positions, cache, key), None, length=steps
+    (tokens, positions, cache, key, dstate), chunk = lax.scan(
+        body, (tokens, positions, cache, key, dstate), None, length=steps
     )
     if full is not None:
         cache = jax.tree.map(
@@ -255,7 +302,7 @@ def _decode_chunk(
             full,
             cache,
         )
-    return chunk, tokens, positions, cache, key
+    return chunk, tokens, positions, cache, key, dstate
 
 
 @functools.partial(
@@ -263,7 +310,7 @@ def _decode_chunk(
 )
 def _verify_chunk(
     params, tokens, positions, cache, key, temp, top_k, top_p, drafts, config,
-    kv_bound=None,
+    kv_bound=None, lora=None, arows=None, dfa=None, g=None, vstates=None,
 ):
     """ONE self-speculative iteration in ONE dispatch: run the multi-token
     verify forward over [current token ++ drafts] (k+1 positions per slot),
@@ -288,13 +335,30 @@ def _verify_chunk(
         cache = jax.tree.map(lambda a: a[:, :, :, :kv_bound], cache)
     inputs = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, k+1]
     logits, cache = verify_step_inplace(
-        params, inputs, positions, cache, config
+        params, inputs, positions, cache, config,
+        lora=lora, adapter_rows=arows,
     )
     key, sub = jax.random.split(key)
-    out, accept = speculative_verify(logits, drafts, sub, temp, top_k, top_p)
+    allowed = None
+    if dfa is not None:
+        # ``vstates`` [B, K+1]: the host-computed DFA state at every verify
+        # position (state after consuming drafts 0..j-1 — the same mask
+        # plain masked decode would apply, the exactness invariant under
+        # constraints; serving/constrain.py verify_states)
+        allowed = dfa[g[:, None], vstates] >= 0  # [B, K+1, V]
+    out, accept = speculative_verify(
+        logits, drafts, sub, temp, top_k, top_p, allowed
+    )
     # the last emitted token (correction or bonus) is the next chunk's input
     tokens = jnp.take_along_axis(out, accept[:, None], axis=1)[:, 0]
     positions = positions + accept + 1
+    dstate = None
+    if dfa is not None:
+        # state after the LAST emitted token: gather the pre-state at the
+        # accept position, advance past the emitted correction/bonus
+        pre = jnp.take_along_axis(vstates, accept[:, None], axis=1)[:, 0]
+        nrow = dfa[g, pre]
+        dstate = _dfa_advance(nrow, tokens, pre)
     if full is not None:
         cache = jax.tree.map(
             lambda big, small: lax.dynamic_update_slice(
@@ -304,7 +368,7 @@ def _verify_chunk(
             cache,
         )
     packed = jnp.concatenate([out, accept[:, None]], axis=1)  # [B, k+2]
-    return packed, tokens, positions, cache, key
+    return packed, tokens, positions, cache, key, dstate
 
 
 @functools.partial(
@@ -352,18 +416,30 @@ def _reset_rows(cache, slots):
 )
 def _prefill_segment_and_sample(
     params, tokens, offsets, seg_lengths, local_cache, key, temp, top_k, top_p,
-    config, kv_bound,
+    config, kv_bound, lora=None, arows=None, dfa=None, g=None,
+    state_dev=None, state_slot=None,
 ):
     """One chunked-prefill segment + a sample of its last-token logits.
     Sampling every segment (vs only the last) keeps the compiled-shape count
     at O(log2 segments) (the pow2 kv_bound); non-final samples are simply
-    never fetched."""
+    never fetched. With a grammar, the first generated token is masked by
+    DFA state 0 and the advanced state scatters into ``state_dev`` at
+    ``state_slot`` (out-of-bounds on non-final segments — dropped), so the
+    decode chain the engine dispatches NEXT iteration already carries the
+    right state without a host round trip."""
     logits, local_cache = prefill_segment(
-        params, tokens, offsets, seg_lengths, local_cache, config, kv_bound
+        params, tokens, offsets, seg_lengths, local_cache, config, kv_bound,
+        lora=lora, adapter_rows=arows,
     )
     key, sub = jax.random.split(key)
-    first = sample(logits, sub, temp, top_k, top_p)
-    return first, local_cache, key
+    if dfa is not None:
+        nrow = dfa[g, jnp.zeros_like(g)]  # generation starts at state 0
+        first = sample(logits, sub, temp, top_k, top_p, nrow >= 0)
+        s1 = _dfa_advance(nrow, first, jnp.zeros_like(g))
+        state_dev = state_dev.at[state_slot].set(s1[0], mode="drop")
+    else:
+        first = sample(logits, sub, temp, top_k, top_p)
+    return first, local_cache, key, state_dev
 
 
 @functools.partial(
@@ -372,27 +448,36 @@ def _prefill_segment_and_sample(
 )
 def _paged_decode_chunk(
     params, tokens, positions, pool, table, key, temp, top_k, top_p, steps,
-    config, page_size,
+    config, page_size, lora=None, arows=None, dfa=None, g=None, dstate=None,
 ):
     """``steps`` fused decode+sample iterations against the PAGED pool in
     ONE dispatch — the paged twin of ``_decode_chunk`` with the kv_bound
     slice/splice dance deleted: each slot reads exactly its mapped pages,
     so this is ONE compiled program for every sequence-length mix (the
-    (steps × pow2-bound) ladder collapses; ROADMAP item 1)."""
+    (steps × pow2-bound) ladder collapses; ROADMAP item 1). Adapter rows
+    and grammar rows are DATA ([B] int32 gathers), so base + N adapters +
+    constrained slots mixed in one batch is STILL that one program — the
+    ISSUE-10 acceptance invariant."""
 
     def body(carry, _):
-        tokens, positions, pool, key = carry
+        tokens, positions, pool, key, dstate = carry
         logits, pool = paged_decode_step_inplace(
-            params, tokens, positions, pool, table, config, page_size
+            params, tokens, positions, pool, table, config, page_size,
+            lora=lora, adapter_rows=arows,
         )
         key, sub = jax.random.split(key)
-        next_tokens = sample(logits, sub, temp, top_k, top_p)
-        return (next_tokens, positions + 1, pool, key), next_tokens
+        if dfa is not None:
+            nrow, allowed = _dfa_mask(dfa, g, dstate)
+            next_tokens = sample(logits, sub, temp, top_k, top_p, allowed)
+            dstate = _dfa_advance(nrow, next_tokens, dstate)
+        else:
+            next_tokens = sample(logits, sub, temp, top_k, top_p)
+        return (next_tokens, positions + 1, pool, key, dstate), next_tokens
 
-    (tokens, positions, pool, key), chunk = lax.scan(
-        body, (tokens, positions, pool, key), None, length=steps
+    (tokens, positions, pool, key, dstate), chunk = lax.scan(
+        body, (tokens, positions, pool, key, dstate), None, length=steps
     )
-    return chunk, tokens, positions, pool, key
+    return chunk, tokens, positions, pool, key, dstate
 
 
 @functools.partial(
@@ -400,23 +485,36 @@ def _paged_decode_chunk(
 )
 def _paged_verify_chunk(
     params, tokens, positions, pool, table, key, temp, top_k, top_p, drafts,
-    config, page_size,
+    config, page_size, lora=None, arows=None, dfa=None, g=None, vstates=None,
 ):
     """ONE self-speculative verify iteration against the paged pool — the
     paged twin of ``_verify_chunk``, and like the decode chunk a SINGLE
     compiled program (no bound ladder). Same no-rewind invariant: positions
     advance only past accepted tokens, stale draft page columns are
-    overwritten before any causal mask can reach them."""
+    overwritten before any causal mask can reach them. Draft positions are
+    masked with the host-shipped per-position DFA states (``vstates``) so
+    speculative verify stays token-exact under constraints."""
     inputs = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, k+1]
     logits, pool = paged_verify_step_inplace(
-        params, inputs, positions, pool, table, config, page_size
+        params, inputs, positions, pool, table, config, page_size,
+        lora=lora, adapter_rows=arows,
     )
     key, sub = jax.random.split(key)
-    out, accept = speculative_verify(logits, drafts, sub, temp, top_k, top_p)
+    allowed = None
+    if dfa is not None:
+        allowed = dfa[g[:, None], vstates] >= 0  # [B, K+1, V]
+    out, accept = speculative_verify(
+        logits, drafts, sub, temp, top_k, top_p, allowed
+    )
     tokens = jnp.take_along_axis(out, accept[:, None], axis=1)[:, 0]
     positions = positions + accept + 1
+    dstate = None
+    if dfa is not None:
+        pre = jnp.take_along_axis(vstates, accept[:, None], axis=1)[:, 0]
+        nrow = dfa[g, pre]
+        dstate = _dfa_advance(nrow, tokens, pre)
     packed = jnp.concatenate([out, accept[:, None]], axis=1)  # [B, k+2]
-    return packed, tokens, positions, pool, key
+    return packed, tokens, positions, pool, key, dstate
 
 
 @functools.partial(
@@ -424,19 +522,28 @@ def _paged_verify_chunk(
 )
 def _paged_segment_and_sample(
     params, tokens, offsets, seg_lengths, pool, table, key, temp, top_k, top_p,
-    config, page_size,
+    config, page_size, lora=None, arows=None, dfa=None, g=None,
+    state_dev=None, state_slot=None,
 ):
     """One chunked/suffix prefill segment straight into the slot's pages +
     a sample of its last-token logits. Replaces the dense path's local
     cache + final insert + (on warm admissions) the prefix gather: aliased
     prefix pages are already visible through the table, so a warm admission
-    is ONE dispatch (plus at most one copy-on-write page copy)."""
+    is ONE dispatch (plus at most one copy-on-write page copy). Grammar
+    handling as in ``_prefill_segment_and_sample``."""
     logits, pool = paged_prefill_segment_inplace(
-        params, tokens, offsets, seg_lengths, pool, table, config, page_size
+        params, tokens, offsets, seg_lengths, pool, table, config, page_size,
+        lora=lora, adapter_rows=arows,
     )
     key, sub = jax.random.split(key)
-    first = sample(logits, sub, temp, top_k, top_p)
-    return first, pool, key
+    if dfa is not None:
+        nrow = dfa[g, jnp.zeros_like(g)]
+        first = sample(logits, sub, temp, top_k, top_p, nrow >= 0)
+        s1 = _dfa_advance(nrow, first, jnp.zeros_like(g))
+        state_dev = state_dev.at[state_slot].set(s1[0], mode="drop")
+    else:
+        first = sample(logits, sub, temp, top_k, top_p)
+    return first, pool, key, state_dev
 
 
 @functools.partial(jax.jit, donate_argnames=("pool",))
@@ -485,6 +592,7 @@ def _make_admit_group(mesh):
     def admit_group(
         params, cache, tokens_dev, positions_dev, temp_dev, top_k_dev,
         top_p_dev, key, tokens, meta, slots, config,
+        lora=None, arows=None, dfa=None, g_rows=None, state_dev=None,
     ):
         # tokens [P, W] int32; meta [4, P] f32 = lengths/temps/top_ks/top_ps
         lengths = meta[0].astype(jnp.int32)
@@ -501,9 +609,22 @@ def _make_admit_group(mesh):
             local_cache = constrain_serving_local_cache(
                 local_cache, config.n_kv_heads, mesh
             )
-        logits, local_cache = prefill(params, tokens, lengths, local_cache, config)
+        logits, local_cache = prefill(
+            params, tokens, lengths, local_cache, config,
+            lora=lora, adapter_rows=arows,
+        )
         key, sub = jax.random.split(key)
-        first = sample(logits, sub, temps, top_ks, top_ps)
+        if dfa is not None:
+            # constrained rows: first generated token masked by DFA state 0,
+            # advanced state scattered into the decode chain alongside the
+            # token — the NEXT decode chunk (often dispatched before this
+            # fetch even lands) reads a coherent state
+            nrow = dfa[g_rows, jnp.zeros_like(g_rows)]
+            first = sample(logits, sub, temps, top_ks, top_ps, nrow >= 0)
+            s1 = _dfa_advance(nrow, first, jnp.zeros_like(g_rows))
+            state_dev = state_dev.at[slots].set(s1, mode="drop")
+        else:
+            first = sample(logits, sub, temps, top_ks, top_ps)
 
         def put(big, small):
             w = small.shape[3]
@@ -515,7 +636,10 @@ def _make_admit_group(mesh):
         temp_dev = temp_dev.at[slots].set(temps, mode="drop")
         top_k_dev = top_k_dev.at[slots].set(top_ks, mode="drop")
         top_p_dev = top_p_dev.at[slots].set(top_ps, mode="drop")
-        return first, cache, tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev, key
+        return (
+            first, cache, tokens_dev, positions_dev, temp_dev, top_k_dev,
+            top_p_dev, key, state_dev,
+        )
 
     return admit_group
 
@@ -541,6 +665,7 @@ def _make_paged_admit_group(mesh=None):
     def admit_group(
         params, pool, tokens_dev, positions_dev, temp_dev, top_k_dev,
         top_p_dev, key, tokens, meta, slots, tables, config, page_size,
+        lora=None, arows=None, dfa=None, g_rows=None, state_dev=None,
     ):
         # tokens [P, W] int32; meta [4, P] f32; tables [P, Tp] int32
         lengths = meta[0].astype(jnp.int32)
@@ -557,16 +682,28 @@ def _make_paged_admit_group(mesh=None):
             local_cache = constrain_serving_local_cache(
                 local_cache, config.n_kv_heads, mesh
             )
-        logits, local_cache = prefill(params, tokens, lengths, local_cache, config)
+        logits, local_cache = prefill(
+            params, tokens, lengths, local_cache, config,
+            lora=lora, adapter_rows=arows,
+        )
         key, sub = jax.random.split(key)
-        first = sample(logits, sub, temps, top_ks, top_ps)
+        if dfa is not None:
+            nrow = dfa[g_rows, jnp.zeros_like(g_rows)]
+            first = sample(logits, sub, temps, top_ks, top_ps, nrow >= 0)
+            s1 = _dfa_advance(nrow, first, jnp.zeros_like(g_rows))
+            state_dev = state_dev.at[slots].set(s1, mode="drop")
+        else:
+            first = sample(logits, sub, temps, top_ks, top_ps)
         pool = paged_insert_cache(pool, local_cache, tables, page_size)
         tokens_dev = tokens_dev.at[slots].set(first, mode="drop")
         positions_dev = positions_dev.at[slots].set(lengths, mode="drop")
         temp_dev = temp_dev.at[slots].set(temps, mode="drop")
         top_k_dev = top_k_dev.at[slots].set(top_ks, mode="drop")
         top_p_dev = top_p_dev.at[slots].set(top_ps, mode="drop")
-        return first, pool, tokens_dev, positions_dev, temp_dev, top_k_dev, top_p_dev, key
+        return (
+            first, pool, tokens_dev, positions_dev, temp_dev, top_k_dev,
+            top_p_dev, key, state_dev,
+        )
 
     return admit_group
 
@@ -784,6 +921,14 @@ class ServingEngine:
         prefix_cache_entries: Optional[int] = None,
         speculation: Any = False,
         speculation_tokens: int = 4,
+        adapters: Optional[list] = None,
+        adapter_pool_fraction: float = 0.1,
+        adapter_rank: Optional[int] = None,
+        adapter_pool_rows: Optional[int] = None,
+        constrained_decoding: Any = "auto",
+        grammar_slots: int = 4,
+        grammar_states: int = 128,
+        grammar_tokenizer: Optional[Any] = None,
         queue_depth: Optional[int] = None,
         shed_policy: str = "block",
         restart_backoff_s: float = 0.1,
@@ -1006,6 +1151,108 @@ class ServingEngine:
         self.spec_slot_steps_total = 0
         self.spec_draft_lookups_total = 0
         self.spec_draft_hits_total = 0
+        # -- the agentic serving tier (ISSUE 10 / ROADMAP item 4) ------------
+        # Multi-LoRA multiplexing: a fixed-shape device pool of stacked
+        # low-rank factors (serving/adapters.py); every dispatch gathers
+        # each slot's factors by its adapter ROW (host-uploaded [B] int32 —
+        # data, not shape, so base + N adapters mix in ONE program).
+        # Constrained decoding: response_format grammars compile to token
+        # DFAs (serving/constrain.py); the [G+1, S, V] next-state pool
+        # lives on device, per-slot grammar rows ride each dispatch, and
+        # the DFA state advances ON DEVICE inside fused chunks while the
+        # host mirrors it per delivered token (completion detection + the
+        # speculative verify masks).
+        adapters_cfg = list(adapters or [])
+        constrain_on = (
+            constrained_decoding is True
+            or str(constrained_decoding).lower() in ("auto", "on", "true", "1")
+        )
+        if spmd is not None and (adapters_cfg or constrain_on):
+            # neither the adapter rows nor the grammar pool ride the
+            # leader→follower wire yet; a multi-host replica serves base
+            # free-form only (docs/SERVING.md §15). `constrained-decoding:
+            # auto` means "enable where supported", so the default degrades
+            # SILENTLY here — only an explicit ask (adapters configured, or
+            # constrained forced on) deserves the warning
+            explicit = bool(adapters_cfg) or (
+                constrained_decoding is True
+                or str(constrained_decoding).lower() in ("on", "true", "1")
+            )
+            log.log(
+                logging.WARNING if explicit else logging.INFO,
+                "adapters/constrained decoding are not on the SPMD wire "
+                "yet; off on this multi-host replica",
+            )
+            adapters_cfg = []
+            constrain_on = False
+        self._adapters = None
+        self._constrain_reg = None
+        # dispatch-facing + authoritative per-slot adapter rows: the pair
+        # exists so the `adapter` fault site (host corruption drill) is
+        # DETECTABLE — _adapter_integrity_check compares them before every
+        # decode/verify dispatch, same design as the page tables' _owned
+        self._adapter_rows = np.zeros(max_batch, np.int32)
+        self._adapter_rows_auth = np.zeros(max_batch, np.int32)
+        self._slot_adapter_name: dict[int, str] = {}
+        self._g_rows = np.zeros(max_batch, np.int32)
+        self._dfa_state_dev = None
+        self._slot_dfa: dict[int, Any] = {}
+        self._dfa_host_state: dict[int, int] = {}
+        self.constrained_requests_total = 0
+        self._constrain_host_ema_ms = 0.0
+        self._agentic = bool(adapters_cfg) or constrain_on
+        adapter_rows_cap, adapter_rank_eff = 0, 0
+        if adapters_cfg:
+            from langstream_tpu.serving.adapters import (
+                AdapterRegistry,
+                AdapterSpec,
+                rows_for_fraction,
+            )
+
+            specs = [
+                a if isinstance(a, AdapterSpec) else AdapterSpec.from_dict(a)
+                for a in adapters_cfg
+            ]
+            adapter_rank_eff = int(
+                adapter_rank or max((s.rank for s in specs), default=8)
+            )
+            weights_bytes = sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(params)
+            )
+            adapter_rows_cap = (
+                int(adapter_pool_rows)
+                if adapter_pool_rows is not None
+                else rows_for_fraction(
+                    config, adapter_rank_eff, weights_bytes,
+                    adapter_pool_fraction, n_registered=len(specs),
+                )
+            )
+            self._adapters = AdapterRegistry(
+                config, adapter_rows_cap, adapter_rank_eff
+            )
+            self._adapters.on_load_program = functools.partial(
+                self._record_program, "adapter-load"
+            )
+            for s in specs:
+                self._adapters.register(s)
+        if constrain_on:
+            from langstream_tpu.serving.constrain import GrammarRegistry
+
+            tok = grammar_tokenizer
+            if tok is None:
+                from langstream_tpu.serving.tokenizer import ByteTokenizer
+
+                tok = ByteTokenizer()
+            self._constrain_reg = GrammarRegistry(
+                tok, config.vocab_size, eos_token_id,
+                slots=max(1, int(grammar_slots)),
+                max_states=max(2, int(grammar_states)),
+            )
+            self._constrain_reg.on_load_program = functools.partial(
+                self._record_program, "grammar-load"
+            )
+            self._dfa_state_dev = jnp.zeros(max_batch, jnp.int32)
         self._prefix_pool = None
         pool_entries, pool_width = 0, 0
         if enabled and not self._paged:
@@ -1185,6 +1432,14 @@ class ServingEngine:
                 page_size=self.page_size,
                 kv_pages=self._kv_pages,
                 page_fraction=self._page_fraction,
+                adapter_pool_rows=adapter_rows_cap,
+                adapter_rank=adapter_rank_eff,
+                grammar_slots=(
+                    self._constrain_reg.slots if self._constrain_reg else 0
+                ),
+                grammar_states=(
+                    self._constrain_reg.max_states if self._constrain_reg else 0
+                ),
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -1303,6 +1558,30 @@ class ServingEngine:
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds the "
                 f"engine limit of {limit} (max_seq_len - 1)"
             )
+        opts = request.options
+        adapter_name = getattr(opts, "adapter", None)
+        if adapter_name and self._adapters is None:
+            raise ValueError(
+                f"request names adapter {adapter_name!r} but this engine has "
+                "no adapter registry (configure `adapters:` on tpu-serving)"
+            )
+        response_format = getattr(opts, "response_format", None)
+        if response_format and self._constrain_reg is None:
+            raise ValueError(
+                "request carries response_format but constrained decoding is "
+                "off on this engine"
+                + (
+                    " (not supported on multi-host SPMD replicas yet — "
+                    "docs/SERVING.md §15)"
+                    if self._spmd is not None
+                    else " (constrained-decoding: off was configured)"
+                )
+            )
+        if response_format and request._dfa is None:
+            # compile (or cache-hit) on the SUBMITTER's thread — grammar
+            # compilation is pure host work and must not stall the engine
+            # loop; an uncompilable schema fails HERE, loudly
+            request._dfa = self._constrain_reg.compile(dict(response_format))
         deadline_s = request.options.deadline_s
         if deadline_s is not None:
             est_wait = self._queue_wait_ema_s
@@ -1567,6 +1846,35 @@ class ServingEngine:
             "spec-draft-tokens-total": self.spec_draft_tokens_total,
             "spec-accepted-tokens-total": self.spec_accepted_tokens_total,
             "spec-verify-dispatches-total": self.spec_dispatches_total,
+            # multi-LoRA multiplexing + constrained decoding (zeros with
+            # the agentic tier off, so the metrics exporter sets its
+            # gauges unconditionally — the same contract every subsystem
+            # block above follows)
+            "adapters": self._adapters is not None,
+            "adapters-registered": (
+                self._adapters.stats()["registered"] if self._adapters else 0
+            ),
+            "adapters-resident": (
+                self._adapters.resident if self._adapters else 0
+            ),
+            "adapter-pool-rows": (
+                self._adapters.rows - 1 if self._adapters else 0
+            ),
+            "adapter-swaps-total": (
+                self._adapters.swaps_total if self._adapters else 0
+            ),
+            "adapter-pool-bytes": (
+                self._adapters.pool_bytes if self._adapters else 0
+            ),
+            "constrained-decoding": self._constrain_reg is not None,
+            "constrained-requests-total": self.constrained_requests_total,
+            "grammars-resident": (
+                self._constrain_reg.resident if self._constrain_reg else 0
+            ),
+            "grammar-swaps-total": (
+                self._constrain_reg.swaps_total if self._constrain_reg else 0
+            ),
+            "constrain-overhead-ms": round(self._constrain_host_ema_ms, 4),
             # request lifecycle / fault recovery (this PR's acceptance
             # surface: every degradation path is countable in production)
             "draining": self._draining,
@@ -1903,19 +2211,25 @@ class ServingEngine:
                 pool.dev, jnp.asarray(0, jnp.int32), self.config, pool.width
             )
             self._record_program("segment", ws, bound, pool.width)
-            first, throwaway, self._key = _prefill_segment_and_sample(
-                self.params,
-                jnp.zeros((1, ws), jnp.int32),
-                jnp.zeros(1, jnp.int32),
-                jnp.ones(1, jnp.int32),
-                throwaway,
-                self._key,
-                jnp.zeros(1, jnp.float32),
-                jnp.zeros(1, jnp.int32),
-                jnp.ones(1, jnp.float32),
-                self.config,
-                bound,
+            kw = self._segment_agentic_kwargs(None, self.max_batch)
+            first, throwaway, self._key, state_dev = (
+                _prefill_segment_and_sample(
+                    self.params,
+                    jnp.zeros((1, ws), jnp.int32),
+                    jnp.zeros(1, jnp.int32),
+                    jnp.ones(1, jnp.int32),
+                    throwaway,
+                    self._key,
+                    jnp.zeros(1, jnp.float32),
+                    jnp.zeros(1, jnp.int32),
+                    jnp.ones(1, jnp.float32),
+                    self.config,
+                    bound,
+                    **kw,
+                )
             )
+            if state_dev is not None:
+                self._dfa_state_dev = state_dev
             jax.block_until_ready(first)
         log.info(
             "prefix-cache programs precompiled: pool %d×%d, gather widths %s, "
@@ -2036,6 +2350,10 @@ class ServingEngine:
             if self._prefix_pool is not None:
                 announce_warmup(wire.WARMUP_PREFIX_PROGRAMS)
                 self._warmup_prefix_programs()
+            if self._agentic:
+                # no announce: the agentic tier is construction-disabled
+                # under SPMD, so this warmup never runs on a replica
+                self._warmup_agentic()
         while not self._stop.is_set():
             self._iterate(pending)
         while pending:
@@ -2052,19 +2370,30 @@ class ServingEngine:
         rebuilt from scratch — with buffer donation there is no safe way
         to keep using arrays a failed dispatch may have invalidated."""
         quarantined = 0
-        for slot in self._slots:
+        # teardown STRICTLY BEFORE _finish: the waiter wakes INSIDE _finish
+        # (on_done / result()), and anything it reads right away — active
+        # slots, stats(), the slot's token list — must already reflect the
+        # quarantine. Finishing first left a window where a woken waiter
+        # observed its own half-torn slot (the finish-waker race; the
+        # regression test loses it deterministically under the injector).
+        finished: list[tuple[GenerationRequest, GenerationResult]] = []
+        for i, slot in enumerate(self._slots):
             request = slot.request
             if request is not None:
                 quarantined += 1
-                request._finish(GenerationResult(
+                result = GenerationResult(
                     tokens=list(slot.generated), finish_reason="error",
                     prompt_tokens=len(request.prompt_tokens),
                     ttft_s=0, total_s=0, error=error,
-                ))
+                )
                 slot.request = None
                 slot.generated = []
                 slot.position = 0
-        for st in self._longs.values():
+                slot.last_token_at = 0.0
+                self._slot_clear_agentic(i)
+                finished.append((request, result))
+        for idx in list(self._longs):
+            st = self._longs.pop(idx)
             entry = st.pop("prefix", None)
             if entry is not None and self._prefix_pool is not None:
                 try:
@@ -2072,10 +2401,12 @@ class ServingEngine:
                 except Exception:  # noqa: BLE001 — pool resets below anyway
                     pass
             quarantined += 1
-            st["request"]._finish(GenerationResult(
+            self._reserved.discard(idx)
+            self._long_caches.pop(idx, None)
+            finished.append((st["request"], GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=error,
-            ))
+            )))
         with self._stats_lock:
             self.quarantined_slots_total += quarantined
         self._longs.clear()
@@ -2085,6 +2416,8 @@ class ServingEngine:
         self._freed_slots.clear()
         self._spec_index.clear()
         self._pending_row_resets.clear()
+        for request, result in finished:
+            request._finish(result)
         self._inflight_steps = 0
         self._step_time_ema_s = 0.0
         self._last_chunk_ready_t = 0.0
@@ -2117,6 +2450,8 @@ class ServingEngine:
         self._temp_dev = jnp.zeros(self.max_batch, jnp.float32)
         self._top_k_dev = jnp.zeros(self.max_batch, jnp.int32)
         self._top_p_dev = jnp.ones(self.max_batch, jnp.float32)
+        if self._dfa_state_dev is not None:
+            self._dfa_state_dev = jnp.zeros(self.max_batch, jnp.int32)
         if self._prefix_pool is not None:
             # pool rows may hold rows published from the poisoned cache (or
             # the pool buffer itself may be donation-invalidated mid-publish)
@@ -2522,6 +2857,207 @@ class ServingEngine:
             self._obs.record("engine_queue_wait_s", wait)
         return True
 
+    # -- multi-LoRA + constrained decoding (the agentic tier, ISSUE 10) ------
+
+    def _resolve_agentic(self, request: GenerationRequest) -> bool:
+        """Resolve a request's adapter name and grammar to their device
+        pool ROWS, refcounting both; idempotent (page-deferred admissions
+        retry through here). Failure — unknown adapter, pinned-full pool —
+        fails the REQUEST with the error, never the engine. Installs the
+        request's _finalize hook so the refcounts release exactly once, on
+        whatever path the request eventually finishes (completion, cancel,
+        deadline, quarantine, crash recovery — they all funnel through
+        _finish)."""
+        if request._agentic_rows is not None:
+            return True
+        from langstream_tpu.serving.adapters import AdapterPoolExhausted
+
+        opts = request.options
+        adapter_name = getattr(opts, "adapter", None)
+        arow, grow = 0, 0
+        try:
+            if adapter_name:
+                arow = self._adapters.acquire(adapter_name)
+            if request._dfa is not None:
+                t0 = time.monotonic()
+                try:
+                    grow = self._constrain_reg.acquire(request._dfa)
+                except Exception:
+                    if adapter_name:
+                        self._adapters.release(adapter_name)
+                    raise
+                self._note_constrain_host((time.monotonic() - t0) * 1e3)
+                with self._stats_lock:
+                    self.constrained_requests_total += 1
+        except Exception as e:  # noqa: BLE001 — fail the request, not the loop
+            log.warning("agentic resolution failed: %s", e)
+            if isinstance(e, AdapterPoolExhausted) or (
+                request._dfa is not None and "pinned" in str(e)
+            ):
+                # every row pinned by ACTIVE requests is a transient
+                # saturation, not a client error: shed with a retry-after
+                # (ShedError → HTTP 429; the front door's paced retries
+                # will land once an in-flight tenant finishes) — the
+                # contract the registries document
+                self._count_shed()
+                e = ShedError(
+                    str(e),
+                    retry_after_s=max(self._queue_wait_ema_s, 0.25),
+                )
+            request._finish(GenerationResult(
+                tokens=[], finish_reason="error",
+                prompt_tokens=len(request.prompt_tokens),
+                ttft_s=0, total_s=0, error=e,
+            ))
+            return False
+        request._agentic_rows = (arow, grow)
+
+        def _release() -> None:
+            if adapter_name:
+                self._adapters.release(adapter_name)
+            if request._dfa is not None:
+                self._constrain_reg.release(request._dfa)
+
+        request._finalize = _release
+        return True
+
+    def _slot_bind_agentic(self, idx: int, request: GenerationRequest) -> None:
+        """Copy the request's resolved rows into the per-slot dispatch
+        state at activation (the moment slot.request is set)."""
+        arow, grow = request._agentic_rows or (0, 0)
+        if self._adapters is not None:
+            self._adapter_rows[idx] = arow
+            self._adapter_rows_auth[idx] = arow
+            name = getattr(request.options, "adapter", None)
+            if name:
+                self._slot_adapter_name[idx] = name
+        if self._constrain_reg is not None:
+            self._g_rows[idx] = grow
+            if request._dfa is not None:
+                self._slot_dfa[idx] = request._dfa
+                self._dfa_host_state[idx] = 0
+
+    def _slot_clear_agentic(self, idx: int) -> None:
+        if self._adapters is not None:
+            self._adapter_rows[idx] = 0
+            self._adapter_rows_auth[idx] = 0
+            self._slot_adapter_name.pop(idx, None)
+        if self._constrain_reg is not None:
+            self._g_rows[idx] = 0
+            self._slot_dfa.pop(idx, None)
+            self._dfa_host_state.pop(idx, None)
+
+    def _note_constrain_host(self, ms: float) -> None:
+        """EMA of host-side constrained-decoding bookkeeping (grammar
+        residency swaps + per-verify state tables) — the `mask overhead`
+        gauge's host half; the device half is what bench_adapters measures
+        as the per-step on/off delta."""
+        self._constrain_host_ema_ms = (
+            ms
+            if self._constrain_host_ema_ms == 0
+            else 0.9 * self._constrain_host_ema_ms + 0.1 * ms
+        )
+
+    def _agentic_args(self) -> tuple:
+        """(lora, arows, dfa, g) dispatch inputs. The [B] row arrays are
+        host-uploaded per dispatch — tiny, and keeping them host-side is
+        what makes the `adapter` fault site's integrity check possible
+        (compare dispatch-facing vs authoritative before upload)."""
+        lora = self._adapters.pool if self._adapters is not None else None
+        arows = (
+            jnp.asarray(self._adapter_rows)
+            if self._adapters is not None
+            else None
+        )
+        dfa = (
+            self._constrain_reg.pool if self._constrain_reg is not None else None
+        )
+        g = (
+            jnp.asarray(self._g_rows)
+            if self._constrain_reg is not None
+            else None
+        )
+        return lora, arows, dfa, g
+
+    def _agentic_row_args(self, requests: list) -> tuple:
+        """Per-ROW (not per-slot) adapter/grammar row vectors for a batched
+        admission: entry j serves requests[j]; padding rows ride as base."""
+        if not self._agentic:
+            return None, None
+        n = self.prefill_batch
+        arows = np.zeros(n, np.int32)
+        g_rows = np.zeros(n, np.int32)
+        for j, request in enumerate(requests[:n]):
+            ar, gr = (request._agentic_rows or (0, 0)) if request else (0, 0)
+            arows[j] = ar
+            g_rows[j] = gr
+        return arows, g_rows
+
+    def _adapter_integrity_check(self) -> None:
+        """Validate every active slot's dispatch-facing adapter row against
+        the authoritative copy before a decode/verify dispatch — the
+        `adapter` fault site's detection path (host memory corruption or a
+        bookkeeping bug would otherwise serve slot X with tenant Y's
+        weights, the worst kind of silent wrong). A mismatch quarantines
+        ONLY that slot; every other slot's tokens stay exact (the chaos
+        suite asserts both)."""
+        if self._adapters is None:
+            return
+        if self._injector is not None:
+            snapshot = [
+                (i, s.request) for i, s in enumerate(self._slots) if s.active
+            ]
+            self._injector.corrupt_adapter_rows(self._adapter_rows, snapshot)
+        for i, slot in enumerate(self._slots):
+            if not slot.active:
+                continue
+            if self._adapter_rows[i] == self._adapter_rows_auth[i]:
+                continue
+            with self._stats_lock:
+                self.quarantined_slots_total += 1
+            # restore the dispatch-facing row before anything dispatches
+            self._adapter_rows[i] = self._adapter_rows_auth[i]
+            if self._paged:
+                self._quarantine_pages(i)
+            else:
+                self._pending_row_resets.append(i)
+            self._flight_dump("adapter-quarantine", extra={"slot": i})
+            self._finish_slot(
+                i, "error",
+                error=RuntimeError(
+                    f"adapter-row corruption detected for slot {i}; slot "
+                    "quarantined"
+                ),
+            )
+
+    def _warmup_agentic(self) -> None:
+        """Warm the adapter/grammar row-upload programs with out-of-bounds
+        rows (every write drops) so the first hot swap under traffic is
+        never a mid-traffic compile — the same front-load-the-compiles
+        policy as every other warmup."""
+        if self._adapters is not None:
+            self._adapters.warmup()
+        if self._constrain_reg is not None:
+            self._constrain_reg.warmup()
+
+    def adapter_advertisement(self) -> tuple[str, ...]:
+        """Resident adapter names for the fleet beacon (serving/fleet.py):
+        the router scores adapter affinity alongside prefix affinity —
+        routing a tenant's request to a replica already holding its
+        factors skips a swap dispatch. Names only, never weights."""
+        if self._adapters is None:
+            return ()
+        return self._adapters.advertised()
+
+    def register_adapter(self, spec) -> None:
+        """Hot-register an adapter through the control plane (no device
+        work until its first request). Thread-safety note: registration
+        mutates host bookkeeping the engine thread reads — call while the
+        engine serves only OTHER adapters' traffic, or quiesce first."""
+        if self._adapters is None:
+            raise RuntimeError("this engine has no adapter registry")
+        self._adapters.register(spec)
+
     def _admit(self, budget: Optional[int] = None) -> list[tuple]:
         """Move queued requests into free slots (prefill path); returns ALL
         the deferred first-token fetch entries. Nothing is fetched here —
@@ -2597,6 +3133,8 @@ class ServingEngine:
                         self._held_back = request
                         break
                     self._long_queue.append(request)
+                elif self._agentic and not self._resolve_agentic(request):
+                    continue  # unknown adapter / pinned-full pool: resolved
                 else:
                     pairs.append((idx, request))
                     admitted_tokens += self._bucket(len(request.prompt_tokens))
@@ -2622,7 +3160,14 @@ class ServingEngine:
         if self._prefix_pool is not None:
             cold: list[tuple[int, GenerationRequest]] = []
             for idx, request in pairs:
-                hit = self._prefix_lookup(request.prompt_tokens)
+                # an adapter tenant's prefix KV carries its wk/wv deltas —
+                # never publish it under the shared trie, never reuse the
+                # base trie for it (same rule on the paged alias path)
+                hit = (
+                    None
+                    if getattr(request.options, "adapter", None)
+                    else self._prefix_lookup(request.prompt_tokens)
+                )
                 if hit is not None:
                     entries.extend(self._prefill_prefix(idx, request, *hit))
                 else:
@@ -2699,7 +3244,11 @@ class ServingEngine:
                 lengths=lengths, slots=slots, temps=temps, top_ks=top_ks,
                 top_ps=top_ps,
             ))
-        first = self._dev_prefill(width, tokens, lengths, temps, top_ks, top_ps, slots)
+        arows, g_rows = self._agentic_row_args([r for _, r in group])
+        first = self._dev_prefill(
+            width, tokens, lengths, temps, top_ks, top_ps, slots,
+            arows=arows, g_rows=g_rows,
+        )
         if self._obs.on:
             self._obs.record(
                 "engine_prefill_dispatch_s", time.monotonic() - started
@@ -2713,27 +3262,53 @@ class ServingEngine:
             slot.started_at = started
             slot.first_token_at = 0.0  # stamped when the deferred fetch lands
             slot.reset_obs("cold", 1)
+            self._slot_bind_agentic(idx, request)
             with self._stats_lock:
                 self.total_requests += 1
             self._spec_admit(idx, request.prompt_tokens)
             self._maybe_publish(idx, request.prompt_tokens)
         return [("prefill", self._fetcher.submit(first), list(group))]
 
-    def _dev_prefill(self, width, tokens, lengths, temps, top_ks, top_ps, slots):
+    def _agentic_admit_kwargs(self, n: int, arows, g_rows) -> dict:
+        """Keyword args the admit-group programs take when the agentic
+        tier is on — zeros (base rows) for warmups and padding. Empty dict
+        when off, so legacy engines trace the exact pre-ISSUE-10 programs."""
+        kw: dict[str, Any] = {}
+        if self._adapters is not None:
+            kw["lora"] = self._adapters.pool
+            kw["arows"] = jnp.asarray(
+                arows if arows is not None else np.zeros(n, np.int32)
+            )
+        if self._constrain_reg is not None:
+            kw["dfa"] = self._constrain_reg.pool
+            kw["g_rows"] = jnp.asarray(
+                g_rows if g_rows is not None else np.zeros(n, np.int32)
+            )
+            kw["state_dev"] = self._dfa_state_dev
+        return kw
+
+    def _dev_prefill(
+        self, width, tokens, lengths, temps, top_ks, top_ps, slots,
+        arows=None, g_rows=None,
+    ):
         """Device layer of a batched prefill — runs IDENTICALLY on the
         leader and (via follower_loop) every SPMD follower, so the sharded
-        cache and decode chain evolve in lockstep from pure host inputs."""
+        cache and decode chain evolve in lockstep from pure host inputs.
+        (Agentic args never appear under SPMD — the tier is construction-
+        disabled on multi-host replicas, so the wire needs no new ops.)"""
         if self._injector is not None:
             self._injector.fire("prefill")  # before any state mutates
         n = len(tokens)
         assert all(len(a) == n for a in (lengths, temps, top_ks, top_ps, slots))
         if self._paged:
             return self._dev_paged_prefill(
-                tokens, lengths, temps, top_ks, top_ps, slots
+                tokens, lengths, temps, top_ks, top_ps, slots,
+                arows=arows, g_rows=g_rows,
             )
         self._record_program("prefill", tokens.shape[1], n)
         # pack the per-row scalars into one upload (per-op tunnel latency)
         meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
+        kw = self._agentic_admit_kwargs(n, arows, g_rows)
         (
             first,
             self._cache,
@@ -2743,6 +3318,7 @@ class ServingEngine:
             self._top_k_dev,
             self._top_p_dev,
             self._key,
+            state_dev,
         ) = self._admit_group(
             self.params,
             self._cache,
@@ -2756,10 +3332,16 @@ class ServingEngine:
             jnp.asarray(meta),
             jnp.asarray(slots),
             self.config,
+            **kw,
         )
+        if state_dev is not None:
+            self._dfa_state_dev = state_dev
         return first
 
-    def _dev_paged_prefill(self, tokens, lengths, temps, top_ks, top_ps, slots):
+    def _dev_paged_prefill(
+        self, tokens, lengths, temps, top_ks, top_ps, slots,
+        arows=None, g_rows=None,
+    ):
         """Paged device layer of a batched cold prefill: the SAME fused
         local-cache forward as the dense admit group (token-exactness), but
         the insert scatters into each row's reserved pages. Rows whose slot
@@ -2773,6 +3355,7 @@ class ServingEngine:
                 tables[j] = pool.tables[s]
         self._record_program("paged-prefill", tokens.shape[1], n)
         meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
+        kw = self._agentic_admit_kwargs(n, arows, g_rows)
         (
             first,
             pool.dev,
@@ -2782,6 +3365,7 @@ class ServingEngine:
             self._top_k_dev,
             self._top_p_dev,
             self._key,
+            state_dev,
         ) = self._paged_admit_group(
             self.params,
             pool.dev,
@@ -2797,7 +3381,10 @@ class ServingEngine:
             jnp.asarray(tables),
             self.config,
             self.page_size,
+            **kw,
         )
+        if state_dev is not None:
+            self._dfa_state_dev = state_dev
         return first
 
     # -- prefix KV reuse -----------------------------------------------------
@@ -2866,6 +3453,7 @@ class ServingEngine:
             first = self._dev_prefix_admit(
                 tokens, p, len(suffix), kv_bound, entry.row,
                 opts.temperature, opts.top_k, opts.top_p, idx,
+                agentic_rows=request._agentic_rows,
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
             if self._spmd is not None:
@@ -2890,6 +3478,7 @@ class ServingEngine:
         slot.started_at = started
         slot.first_token_at = 0.0
         slot.reset_obs("warm", 1)
+        self._slot_bind_agentic(idx, request)
         with self._stats_lock:
             self.total_requests += 1
         self._spec_admit(idx, prompt)
@@ -2898,9 +3487,25 @@ class ServingEngine:
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
 
+    def _segment_agentic_kwargs(self, agentic_rows, state_slot) -> dict:
+        """Agentic kwargs for the batch-1 segment programs (warm suffix /
+        long-prompt chunks). ``state_slot`` out of bounds (non-final
+        segments, warmups) drops the DFA state scatter."""
+        kw: dict[str, Any] = {}
+        arow, grow = agentic_rows or (0, 0)
+        if self._adapters is not None:
+            kw["lora"] = self._adapters.pool
+            kw["arows"] = jnp.asarray([arow], jnp.int32)
+        if self._constrain_reg is not None:
+            kw["dfa"] = self._constrain_reg.pool
+            kw["g"] = jnp.asarray([grow], jnp.int32)
+            kw["state_dev"] = self._dfa_state_dev
+            kw["state_slot"] = jnp.asarray(state_slot, jnp.int32)
+        return kw
+
     def _dev_prefix_admit(
         self, tokens, offset, seg_len, kv_bound, entry_row,
-        temperature, top_k, top_p, idx,
+        temperature, top_k, top_p, idx, agentic_rows=None,
     ):
         """Device layer of a warm admission: prefix gather + suffix segment
         + big-cache insert + decode-chain scatters. The segment and insert
@@ -2920,7 +3525,8 @@ class ServingEngine:
 
             local = shard_serving_cache(local, self.mesh)
         self._record_program("segment", tokens.shape[1], kv_bound, t_pool)
-        first, local, self._key = _prefill_segment_and_sample(
+        kw = self._segment_agentic_kwargs(agentic_rows, idx)
+        first, local, self._key, state_dev = _prefill_segment_and_sample(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray([offset], jnp.int32),
@@ -2932,7 +3538,10 @@ class ServingEngine:
             jnp.asarray([top_p], jnp.float32),
             self.config,
             kv_bound,
+            **kw,
         )
+        if state_dev is not None:
+            self._dfa_state_dev = state_dev
         self._record_program("insert", t_pool)
         self._cache = self._insert_group(
             self._cache, local, jnp.asarray(np.full(1, idx, np.int32))
@@ -2993,7 +3602,9 @@ class ServingEngine:
             ))
             return -1  # handled — nothing reserved
         hit = None
-        if index is not None:
+        if index is not None and not getattr(request.options, "adapter", None):
+            # adapter tenants never alias the shared base-prefix pages —
+            # their prompt KV includes the wk/wv adapter deltas
             for cand in index.candidates(prompt):
                 hit = cand  # ascending: the deepest usable prefix wins
         shared: tuple[int, ...] = ()
@@ -3097,6 +3708,7 @@ class ServingEngine:
                 tokens, p, len(suffix), idx,
                 opts.temperature, opts.top_k, opts.top_p,
                 final=True, prompt_len=len(prompt),
+                agentic_rows=request._agentic_rows,
             )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
             if self._spmd is not None:
@@ -3119,6 +3731,7 @@ class ServingEngine:
         slot.started_at = started
         slot.first_token_at = 0.0
         slot.reset_obs("warm", 1)
+        self._slot_bind_agentic(idx, request)
         with self._stats_lock:
             self.total_requests += 1
         self._spec_admit(idx, prompt)
@@ -3127,13 +3740,14 @@ class ServingEngine:
 
     def _dev_paged_segment(
         self, tokens, s0, seg_len, idx, temperature, top_k, top_p,
-        *, final: bool, prompt_len: int,
+        *, final: bool, prompt_len: int, agentic_rows=None,
     ):
         """Device layer of one paged prefill segment (warm suffix OR one
         chunk of a long prompt): K/V scatter straight into the slot's
         pages, attention reads the prefix through the table. On ``final``
         the decode chain scatters — there is no insert/splice: the pages
-        ARE the cache."""
+        ARE the cache. The DFA state scatter only lands on ``final`` (the
+        segment whose sampled first token actually seeds the chain)."""
         if self._injector is not None:
             self._injector.fire("segment")
         pool = self._pagepool
@@ -3141,7 +3755,10 @@ class ServingEngine:
         if 0 <= idx < self.max_batch:
             table[0] = pool.tables[idx]
         self._record_program("paged-segment", tokens.shape[1])
-        first, pool.dev, self._key = _paged_segment_and_sample(
+        kw = self._segment_agentic_kwargs(
+            agentic_rows, idx if final else self.max_batch
+        )
+        first, pool.dev, self._key, state_dev = _paged_segment_and_sample(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray([s0], jnp.int32),
@@ -3154,7 +3771,10 @@ class ServingEngine:
             jnp.asarray([top_p], jnp.float32),
             self.config,
             self.page_size,
+            **kw,
         )
+        if state_dev is not None:
+            self._dfa_state_dev = state_dev
         if final:
             self._record_program("chain-scatter")
             (
@@ -3347,7 +3967,14 @@ class ServingEngine:
 
         Paged layout: publish is pure HOST bookkeeping — the slot's leading
         pages join the index with a refcount bump, no device copy at all
-        (the dense path's copy-on-publish gather is gone)."""
+        (the dense path's copy-on-publish gather is gone).
+
+        Adapter invariant: a tenant slot's prefix KV embeds its wk/wv
+        adapter deltas — publishing it under the shared (base) trie would
+        poison every later base admission that aliased it. Tenant slots
+        never publish."""
+        if self._adapters is not None and self._adapter_rows_auth[idx] != 0:
+            return
         if self._paged:
             index = self._prefix_index
             if index is None:
@@ -3509,6 +4136,8 @@ class ServingEngine:
             request = self._long_queue.pop(0)
             if not self._prequalify(request):
                 continue  # resolved in the long backlog
+            if self._agentic and not self._resolve_agentic(request):
+                continue  # unknown adapter / pinned pool: request resolved
             if self._paged:
                 # paged: reserve the whole prompt's pages up front, aliasing
                 # ANY cached prefix boundary (segments write at global
@@ -3532,13 +4161,24 @@ class ServingEngine:
             # loop over the ring path — skipping a whole segment of prefill
             # saves more than the ring's single-dispatch latency win.
             prefix = None
-            if self._prefix_pool is not None:
+            if self._prefix_pool is not None and not getattr(
+                request.options, "adapter", None
+            ):
                 prefix = self._prefix_lookup(
                     request.prompt_tokens, full_width_only=True
                 )
-            if prefix is None and self._ring_admit is not None and self._ring_pad(
-                len(request.prompt_tokens)
-            ) is not None:
+            if (
+                prefix is None
+                and self._ring_admit is not None
+                # the ring admit's fused splice predates adapters/grammars
+                # (no lora threading, no first-token mask): AGENTIC
+                # requests take the segment loop — which threads both —
+                # instead of growing a third ring variant; plain requests
+                # keep the one-dispatch ring path unchanged
+                and request._dfa is None
+                and not getattr(request.options, "adapter", None)
+                and self._ring_pad(len(request.prompt_tokens)) is not None
+            ):
                 # ring path: the whole prompt in ONE sequence-sharded
                 # dispatch — it never becomes a stream, but its tokens
                 # count against this iteration's prefill budget
@@ -3668,6 +4308,7 @@ class ServingEngine:
                     tokens, s0, len(seg), idx,
                     opts.temperature, opts.top_k, opts.top_p,
                     final=final, prompt_len=len(prompt),
+                    agentic_rows=request._agentic_rows,
                 )
             else:
                 first = self._dev_long_segment(
@@ -3677,6 +4318,7 @@ class ServingEngine:
                     prefix_row=(
                         prefix_entry.row if prefix_entry is not None else None
                     ),
+                    agentic_rows=request._agentic_rows,
                 )
         except Exception as e:  # noqa: BLE001 — fail the request, not the engine
             if self._spmd is not None:
@@ -3715,6 +4357,7 @@ class ServingEngine:
         slot.started_at = time.monotonic()
         slot.first_token_at = 0.0
         slot.reset_obs("long", st["seg"])
+        self._slot_bind_agentic(idx, request)
         with self._stats_lock:
             self.total_requests += 1
         self._spec_admit(idx, prompt)
@@ -3842,7 +4485,7 @@ class ServingEngine:
     def _dev_long_segment(
         self, tokens, s0, seg_len, kv_bound, t_long, temperature, top_k, top_p,
         *, start: bool, final: bool, idx: int, prompt_len: int,
-        prefix_row: Optional[int] = None,
+        prefix_row: Optional[int] = None, agentic_rows=None,
     ):
         """Device layer of one chunked-prefill segment (leader + SPMD
         followers): fresh local cache on ``start`` (seeded from pool row
@@ -3870,19 +4513,27 @@ class ServingEngine:
                 local_cache = shard_serving_cache(local_cache, self.mesh)
             self._long_caches[idx] = local_cache
         self._record_program("segment", tokens.shape[1], kv_bound, t_long)
-        first, self._long_caches[idx], self._key = _prefill_segment_and_sample(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.asarray([s0], jnp.int32),
-            jnp.asarray([seg_len], jnp.int32),
-            self._long_caches[idx],
-            self._key,
-            jnp.asarray([temperature], jnp.float32),
-            jnp.asarray([top_k], jnp.int32),
-            jnp.asarray([top_p], jnp.float32),
-            self.config,
-            kv_bound,
+        kw = self._segment_agentic_kwargs(
+            agentic_rows, idx if final else self.max_batch
         )
+        first, self._long_caches[idx], self._key, state_dev = (
+            _prefill_segment_and_sample(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray([s0], jnp.int32),
+                jnp.asarray([seg_len], jnp.int32),
+                self._long_caches[idx],
+                self._key,
+                jnp.asarray([temperature], jnp.float32),
+                jnp.asarray([top_k], jnp.int32),
+                jnp.asarray([top_p], jnp.float32),
+                self.config,
+                kv_bound,
+                **kw,
+            )
+        )
+        if state_dev is not None:
+            self._dfa_state_dev = state_dev
         if final:
             slots_dev = jnp.asarray(np.full(1, idx, np.int32))
             self._record_program("insert", t_long)
@@ -3918,6 +4569,7 @@ class ServingEngine:
             # (announced as OP_PAGE_FREE) and deactivates the slot, and the
             # mask announced below must already reflect both
             self._page_integrity_check()
+        self._adapter_integrity_check()
         steps = self._chunk_steps()
         # shrunk (non-full) chunks run UNBOUNDED: pairing the occasional
         # short chunk with the kv_bound ladder would multiply the compiled-
@@ -4013,6 +4665,8 @@ class ServingEngine:
         pass the leader's wire-shipped mask."""
         if self._injector is not None:
             self._injector.fire("decode")  # crashes the loop → restart path
+        lora, arows, dfa, g = self._agentic_args()
+        dstate = self._dfa_state_dev
         if self._paged:
             self._record_program("paged-decode", steps)
             if len(stale):
@@ -4024,6 +4678,7 @@ class ServingEngine:
                 self._positions_dev,
                 pool.dev,
                 self._key,
+                dstate,
             ) = _paged_decode_chunk(
                 self.params,
                 self._tokens_dev,
@@ -4037,26 +4692,41 @@ class ServingEngine:
                 steps,
                 self.config,
                 self.page_size,
+                lora,
+                arows,
+                dfa,
+                g,
+                dstate,
             )
+            if dstate is not None:
+                self._dfa_state_dev = dstate
             return chunk
         self._record_program("decode", steps, kv_bound or 0)
         if len(stale):
             self._reset_stale_temps(stale)
-        chunk, self._tokens_dev, self._positions_dev, self._cache, self._key = (
-            _decode_chunk(
-                self.params,
-                self._tokens_dev,
-                self._positions_dev,
-                self._cache,
-                self._key,
-                self._temp_dev,
-                self._top_k_dev,
-                self._top_p_dev,
-                steps,
-                self.config,
-                kv_bound,
-            )
+        (
+            chunk, self._tokens_dev, self._positions_dev, self._cache,
+            self._key, dstate,
+        ) = _decode_chunk(
+            self.params,
+            self._tokens_dev,
+            self._positions_dev,
+            self._cache,
+            self._key,
+            self._temp_dev,
+            self._top_k_dev,
+            self._top_p_dev,
+            steps,
+            self.config,
+            kv_bound,
+            lora,
+            arows,
+            dfa,
+            g,
+            dstate,
         )
+        if dstate is not None:
+            self._dfa_state_dev = dstate
         return chunk
 
     def _dispatch_verify(self, clean: bool = True) -> tuple:
@@ -4069,6 +4739,7 @@ class ServingEngine:
         empty draft can never change what is emitted)."""
         if self._paged:
             self._page_integrity_check()  # before the announce (see chunk)
+        self._adapter_integrity_check()
         k = self.spec_tokens
         kv_bound = 0 if self._paged else self._decode_kv_bound(k + 1)
         stale = self._collect_stale()
@@ -4089,6 +4760,24 @@ class ServingEngine:
             if prop:
                 drafts[i, : len(prop)] = prop
                 proposed[i] = len(prop)
+        vstates = None
+        if self._constrain_reg is not None:
+            # per-position DFA states for the verify masks, from the HOST
+            # mirror — spec mode drains the pipeline before proposing, so
+            # the mirror is current at dispatch time (the invariant that
+            # makes host-computed states legal here)
+            t0 = time.monotonic()
+            vstates = np.zeros((self.max_batch, k + 1), np.int32)
+            from langstream_tpu.serving.constrain import verify_states
+
+            for i, slot in enumerate(self._slots):
+                dfa_i = self._slot_dfa.get(i)
+                if not slot.active or dfa_i is None:
+                    continue
+                vstates[i] = verify_states(
+                    dfa_i, self._dfa_host_state.get(i, 0), drafts[i]
+                )
+            self._note_constrain_host((time.monotonic() - t0) * 1e3)
         mask = self._active_mask()
         if self._spmd is not None:
             # speculation on the wire: ship the PROPOSALS (steps = k, the
@@ -4099,7 +4788,9 @@ class ServingEngine:
                 slots=np.asarray(stale, np.int32), kv_bound=kv_bound,
                 drafts=drafts, mask=mask,
             ))
-        packed = self._dev_verify(drafts, stale, kv_bound, mask=mask)
+        packed = self._dev_verify(
+            drafts, stale, kv_bound, mask=mask, vstates=vstates
+        )
         snapshot = [
             (i, slot.request) for i, slot in enumerate(self._slots) if slot.active
         ]
@@ -4115,14 +4806,24 @@ class ServingEngine:
     def _dev_verify(
         self, drafts: np.ndarray, stale, kv_bound: int,
         mask: Optional[np.ndarray] = None,
+        vstates: Optional[np.ndarray] = None,
     ) -> Any:
         """Device layer of one verify iteration — the speculative engine's
         only decode-phase dispatch, so the decode fault site fires here
         (crash/restart drills hold under speculation too; the corrupt-type
         ``verify`` site fires host-side at fetch processing instead, where
-        it can target ONE slot)."""
+        it can target ONE slot). ``vstates``: host-computed per-position
+        DFA states (None → all-zero table, what the warmups dispatch)."""
         if self._injector is not None:
             self._injector.fire("decode")
+        lora, arows, dfa, g = self._agentic_args()
+        vstates_dev = None
+        if dfa is not None:
+            if vstates is None:
+                vstates = np.zeros(
+                    (self.max_batch, drafts.shape[1] + 1), np.int32
+                )
+            vstates_dev = jnp.asarray(vstates)
         if self._paged:
             self._record_program("paged-verify", drafts.shape[1])
             if len(stale):
@@ -4134,6 +4835,7 @@ class ServingEngine:
                 self._positions_dev,
                 pool.dev,
                 self._key,
+                dstate,
             ) = _paged_verify_chunk(
                 self.params,
                 self._tokens_dev,
@@ -4147,7 +4849,14 @@ class ServingEngine:
                 jnp.asarray(drafts),
                 self.config,
                 self.page_size,
+                lora,
+                arows,
+                dfa,
+                g,
+                vstates_dev,
             )
+            if dstate is not None:
+                self._dfa_state_dev = dstate
             return packed
         self._record_program("verify", drafts.shape[1], kv_bound or 0)
         if len(stale):
@@ -4158,6 +4867,7 @@ class ServingEngine:
             self._positions_dev,
             self._cache,
             self._key,
+            dstate,
         ) = _verify_chunk(
             self.params,
             self._tokens_dev,
@@ -4170,7 +4880,14 @@ class ServingEngine:
             jnp.asarray(drafts),
             self.config,
             kv_bound,
+            lora,
+            arows,
+            dfa,
+            g,
+            vstates_dev,
         )
+        if dstate is not None:
+            self._dfa_state_dev = dstate
         return packed
 
     def _process_verify(self, entry: tuple) -> None:
@@ -4358,6 +5075,31 @@ class ServingEngine:
                 # the emitted token joins the slot's draft context — the
                 # next iteration's proposals continue from it
                 index.append(token)
+            dfa = self._slot_dfa.get(idx)
+            if dfa is not None:
+                # the HOST half of constrained decoding: mirror the device's
+                # DFA advance per delivered token (same table → lockstep),
+                # and finish with "stop" the moment the grammar COMPLETES —
+                # tokens the device's sink self-loop generates after this
+                # point are dropped by the snapshot identity check, so the
+                # delivered text is exactly one grammar derivation
+                s = dfa.advance(self._dfa_host_state.get(idx, 0), token)
+                if s < 0:
+                    # unreachable while host and device share the table;
+                    # reaching it means state corruption — off-grammar
+                    # output must fail loudly, never stream on
+                    self._finish_slot(
+                        idx, "error",
+                        error=RuntimeError(
+                            f"constrained decode diverged at slot {idx}: "
+                            f"token {token} is illegal in DFA state "
+                            f"{self._dfa_host_state.get(idx, 0)}"
+                        ),
+                    )
+                    return
+                self._dfa_host_state[idx] = s
+                if dfa.is_complete(s):
+                    finished_reason = "stop"
             with self._stats_lock:
                 self.total_generated += 1
             if request.on_token is not None:
@@ -4365,9 +5107,9 @@ class ServingEngine:
                     request.on_token(token)
                 except Exception:  # noqa: BLE001 — stream consumer must not kill the loop
                     log.exception("on_token callback failed")
-            if len(slot.generated) >= opts.max_new_tokens:
+            if finished_reason is None and len(slot.generated) >= opts.max_new_tokens:
                 finished_reason = "length"
-            elif slot.position >= self.max_seq_len - 1:
+            elif finished_reason is None and slot.position >= self.max_seq_len - 1:
                 # cache full — scattering past the buffer would silently drop
                 finished_reason = "length"
 
@@ -4425,6 +5167,7 @@ class ServingEngine:
         slot.position = 0
         slot.last_token_at = 0.0
         self._spec_index.pop(idx, None)
+        self._slot_clear_agentic(idx)
         self._freed_slots.append(idx)
         if self._paged:
             # slot reset = free its table (shared pages survive through the
@@ -4441,51 +5184,48 @@ class ServingEngine:
 
     def _fail_all(self, error: BaseException) -> None:
         self._dead = error
-        if self._held_back is not None:
-            self._held_back._finish(GenerationResult(
+
+        def dead_result() -> GenerationResult:
+            return GenerationResult(
                 tokens=[], finish_reason="error", prompt_tokens=0,
                 ttft_s=0, total_s=0, error=error,
-            ))
+            )
+
+        # collect every in-flight request, TEAR DOWN FIRST, resolve last:
+        # _finish wakes waiters immediately, and a waiter sampling engine
+        # state (active slots, stats(), long-stream dicts) must never see
+        # its own request still wired into a half-torn slot (the same
+        # ordering rule _finish_slot and _recover follow)
+        doomed: list[GenerationRequest] = []
+        if self._held_back is not None:
+            doomed.append(self._held_back)
             self._held_back = None
         for st in self._longs.values():
             entry = st.pop("prefix", None)
             if entry is not None and self._prefix_pool is not None:
                 self._prefix_pool.release(entry)
-            st["request"]._finish(GenerationResult(
-                tokens=[], finish_reason="error", prompt_tokens=0,
-                ttft_s=0, total_s=0, error=error,
-            ))
+            doomed.append(st["request"])
         self._longs.clear()
         self._long_caches.clear()
-        for request in self._long_queue:
-            request._finish(GenerationResult(
-                tokens=[], finish_reason="error", prompt_tokens=0,
-                ttft_s=0, total_s=0, error=error,
-            ))
+        doomed.extend(self._long_queue)
         self._long_queue.clear()
-        for request in self._page_deferred:
-            request._finish(GenerationResult(
-                tokens=[], finish_reason="error", prompt_tokens=0,
-                ttft_s=0, total_s=0, error=error,
-            ))
+        doomed.extend(self._page_deferred)
         self._page_deferred.clear()
         self._reserved.clear()
         self._spec_index.clear()
-        for slot in self._slots:
+        for i, slot in enumerate(self._slots):
             if slot.request is not None:
-                slot.request._finish(GenerationResult(
-                    tokens=[], finish_reason="error", prompt_tokens=0,
-                    ttft_s=0, total_s=0, error=error,
-                ))
+                doomed.append(slot.request)
                 slot.request = None
+                slot.generated = []
+                slot.position = 0
+                self._slot_clear_agentic(i)
         while True:
             try:
-                request = self._queue.get_nowait()
+                doomed.append(self._queue.get_nowait())
             except queue.Empty:
                 break
-            request._finish(GenerationResult(
-                tokens=[], finish_reason="error", prompt_tokens=0,
-                ttft_s=0, total_s=0, error=error,
-            ))
         with self._waiting_lock:
             self._waiting.clear()
+        for request in doomed:
+            request._finish(dead_result())

@@ -21,6 +21,12 @@ Sites (where the engine asks ``fires(site)``):
             host bookkeeping / memory corruption drill) — the engine's
             integrity check must quarantine ONLY that slot and free its
             pages back to the pool through the authoritative owned list
+  adapter   corrupt one active slot's dispatch-facing adapter row (the
+            multi-LoRA gather index, serving/adapters.py) — serving slot X
+            with tenant Y's factors is SILENT wrongness, so the engine
+            compares the row against its authoritative copy before every
+            decode/verify dispatch and must quarantine ONLY the victim
+            while every survivor stays token-exact
   fetch     stall the device→host fetch thread (slow-tunnel simulation)
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
@@ -53,7 +59,10 @@ from typing import Optional
 
 log = logging.getLogger(__name__)
 
-SITES = ("prefill", "segment", "decode", "nan", "verify", "page", "fetch", "client")
+SITES = (
+    "prefill", "segment", "decode", "nan", "verify", "page", "adapter",
+    "fetch", "client",
+)
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;
 # the injector writes the same value into fetched tokens so the engine's
@@ -224,6 +233,20 @@ class FaultInjector:
         packed[victim, 0] = NAN_SENTINEL  # first emitted token → sentinel
         packed[victim, -1] = 0  # accept 0 → the sentinel is delivered first
         return packed
+
+    def corrupt_adapter_rows(self, rows, snapshot):
+        """``adapter`` site: bump one active slot's entry in the engine's
+        dispatch-facing adapter-row array, leaving the authoritative copy
+        intact — the host-corruption drill for the multi-LoRA gather
+        index. The engine's pre-dispatch integrity check must catch the
+        mismatch and quarantine only that slot. Victim drawn from the
+        seeded RNG; returns the victim slot or None."""
+        if not snapshot or not self.fires("adapter"):
+            return None
+        with self._lock:
+            victim = snapshot[self._rng.randrange(len(snapshot))][0]
+            rows[victim] = rows[victim] + 1  # any mismatch will do
+        return victim
 
     def corrupt_page_table(self, pool, snapshot):
         """``page`` site: scramble one active slot's page-table entry in

@@ -146,6 +146,17 @@ def beacon_from_engine(
         "ttft_p99_ms": round(float(ttft.get("p99", 0.0)) * 1e3, 3),
         "boundaries": [int(b) for b in boundaries],
         "prefixes": [[d, int(n)] for d, n in prefixes],
+        # resident LoRA adapters (NAMES only, never factors): the router's
+        # adapter-affinity signal — landing a tenant's request on a replica
+        # already holding its adapter skips a hot-swap dispatch (§15)
+        "adapters": [
+            str(a)
+            for a in (
+                engine.adapter_advertisement()
+                if hasattr(engine, "adapter_advertisement")
+                else ()
+            )
+        ],
     }
 
 
@@ -171,6 +182,9 @@ def validate_beacon(doc: dict[str, Any]) -> bool:
             or not isinstance(pair[1], int)
         ):
             raise ValueError(f"prefix advertisement {j} is not [digest, length]")
+    for j, name in enumerate(doc.get("adapters") or []):
+        if not isinstance(name, str):
+            raise ValueError(f"adapter advertisement {j} is not a name string")
     for forbidden in ("tokens", "prompt", "text", "prompt_tokens"):
         if forbidden in doc:
             raise ValueError(f"beacon carries token-content key {forbidden!r}")
@@ -436,6 +450,7 @@ class _ReplicaState:
     beacon_at: float = -1e18  # monotonic of last SUCCESSFUL refresh
     failed_at: float = -1e18  # monotonic of last mark_failed
     digests: dict[str, int] = field(default_factory=dict)  # digest → length
+    adapters: frozenset = frozenset()  # resident LoRA adapter names
 
 
 @dataclass
@@ -470,6 +485,7 @@ class FleetRouter:
         sticky_ttl_s: float = 600.0,
         fail_cooldown_s: float = 5.0,
         shed_queue_wait_s: float = 30.0,
+        adapter_affinity_tokens: float = 512.0,
     ) -> None:
         if policy not in self.POLICIES:
             raise ValueError(
@@ -484,6 +500,11 @@ class FleetRouter:
         self.sticky_ttl_s = float(sticky_ttl_s)
         self.fail_cooldown_s = float(fail_cooldown_s)
         self.shed_queue_wait_s = float(shed_queue_wait_s)
+        # adapter affinity in PREFIX-TOKEN units: routing a tenant to a
+        # replica already holding its adapter is scored as worth this many
+        # warm prefix tokens (a hot-swap dispatch ≈ re-prefilling that
+        # much prompt on the engines measured; tune alongside λ — §15)
+        self.adapter_affinity_tokens = float(adapter_affinity_tokens)
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -499,6 +520,7 @@ class FleetRouter:
         self.routed_affinity_total = 0
         self.routed_sticky_total = 0
         self.routed_balanced_total = 0
+        self.routed_adapter_total = 0
         self.shed_total = 0
         self.failover_total = 0
         self._hist_lock = threading.Lock()
@@ -532,6 +554,9 @@ class FleetRouter:
                 state.digests = {
                     d: int(n) for d, n in (beacon.get("prefixes") or [])
                 }
+                state.adapters = frozenset(
+                    str(a) for a in (beacon.get("adapters") or [])
+                )
             ok += 1
         return ok
 
@@ -603,13 +628,19 @@ class FleetRouter:
         tokens,
         session_id: Optional[str] = None,
         exclude: Optional[set] = None,
+        adapter: Optional[str] = None,
     ) -> RouteDecision:
         """Pick the replica for one request. Raises FleetShedError when no
         replica is routable or every routable replica is saturated (full
-        admission queue, or queue-wait EMA past ``shed_queue_wait_s``)."""
+        admission queue, or queue-wait EMA past ``shed_queue_wait_s``).
+        ``adapter``: the request's LoRA adapter name — replicas advertising
+        it resident score an ``adapter_affinity_tokens`` bonus alongside
+        prefix affinity."""
         t0 = time.perf_counter()
         try:
-            return self._route(list(tokens), session_id, exclude or set())
+            return self._route(
+                list(tokens), session_id, exclude or set(), adapter
+            )
         finally:
             # Histogram.record is single-writer by contract (the engine's
             # histograms have exactly one writer thread); route() runs on
@@ -620,6 +651,7 @@ class FleetRouter:
 
     def _route(
         self, tokens: list, session_id: Optional[str], exclude: set,
+        adapter: Optional[str] = None,
     ) -> RouteDecision:
         now = time.monotonic()
         with self._lock:
@@ -697,16 +729,25 @@ class FleetRouter:
             )
             probe = {n: prefix_digest(tokens[:n]) for n in lengths}
             best, best_score, best_match = None, None, 0
+            best_adapter_hit = False
             for s in live:
                 match = 0
                 for n in lengths:
                     if s.digests.get(probe[n]) == n and n > match:
                         match = n
-                score = match - self.lam * self._load(s.beacon)
+                adapter_hit = bool(adapter) and adapter in s.adapters
+                score = (
+                    match
+                    + (self.adapter_affinity_tokens if adapter_hit else 0.0)
+                    - self.lam * self._load(s.beacon)
+                )
                 if best_score is None or score > best_score:
                     best, best_score, best_match = s, score, match
+                    best_adapter_hit = adapter_hit
             assert best is not None
-            if best_match > 0:
+            if best_adapter_hit:
+                self.routed_adapter_total += 1
+            if best_match > 0 or best_adapter_hit:
                 self.routed_affinity_total += 1
                 kind = "affinity"
             else:
@@ -842,6 +883,7 @@ class FleetRouter:
                 "fleet-routed-affinity-total": self.routed_affinity_total,
                 "fleet-routed-sticky-total": self.routed_sticky_total,
                 "fleet-routed-balanced-total": self.routed_balanced_total,
+                "fleet-routed-adapter-total": self.routed_adapter_total,
                 "fleet-shed-total": self.shed_total,
                 "fleet-failover-total": self.failover_total,
                 "fleet-sticky-sessions": len(self._sticky),

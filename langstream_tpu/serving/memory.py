@@ -73,6 +73,16 @@ class ServingMemoryPlan:
     # Sized by pages_for_fraction: dense-parity token capacity plus the
     # prefix-cache-fraction alias headroom.
     page_pool_bytes: int = 0
+    # multi-LoRA adapter pool (serving/adapters.py): the fixed-shape
+    # stacked low-rank factor tree — rows × per-row bytes, resident for
+    # the engine's lifetime. Sized by `adapter-pool-fraction`; 0 when no
+    # adapters are configured.
+    adapter_pool_bytes: int = 0
+    # grammar DFA pool (serving/constrain.py): the [G+1, S, V] int32
+    # next-state table constrained decoding gathers per step. V-linear —
+    # at a 256k vocab the defaults cost ~0.7GiB, which is exactly why it
+    # is a PLAN term and not a surprise (docs/SERVING.md §15 sizing).
+    grammar_pool_bytes: int = 0
     # self-speculative verify chunk (engine._verify_chunk): the multi-token
     # forward materializes fp32 logits for ALL k+1 positions of every slot
     # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
@@ -98,6 +108,8 @@ class ServingMemoryPlan:
             + self.prefix_pool_bytes
             + self.page_pool_bytes
             + self.verify_chunk_bytes
+            + self.adapter_pool_bytes
+            + self.grammar_pool_bytes
         )
 
     def fits(self, hbm_bytes: int) -> bool:
@@ -115,6 +127,15 @@ class ServingMemoryPlan:
         d = max(1, int(devices))
         return self.workspace_bytes + (self.total_bytes - self.workspace_bytes) // d
 
+    def _agentic_summary(self) -> str:
+        gib = 1024**3
+        parts = []
+        if self.adapter_pool_bytes:
+            parts.append(f"adapter-pool {self.adapter_pool_bytes / gib:.2f}GiB + ")
+        if self.grammar_pool_bytes:
+            parts.append(f"grammar-pool {self.grammar_pool_bytes / gib:.2f}GiB + ")
+        return "".join(parts)
+
     def summary(self) -> str:
         gib = 1024**3
         if self.page_pool_bytes:
@@ -124,6 +145,7 @@ class ServingMemoryPlan:
                 f"(+{self.scan_buffer_bytes / gib:.2f}GiB layer slices) + "
                 f"fused-prefill {self.fused_prefill_bytes / gib:.2f}GiB + "
                 f"verify-chunk {self.verify_chunk_bytes / gib:.2f}GiB + "
+                f"{self._agentic_summary()}"
                 f"workspace {self.workspace_bytes / gib:.2f}GiB = "
                 f"{self.total_bytes / gib:.2f}GiB"
             )
@@ -136,6 +158,7 @@ class ServingMemoryPlan:
             f"fused-prefill {self.fused_prefill_bytes / gib:.2f}GiB + "
             f"prefix-pool {self.prefix_pool_bytes / gib:.2f}GiB + "
             f"verify-chunk {self.verify_chunk_bytes / gib:.2f}GiB + "
+            f"{self._agentic_summary()}"
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
         )
@@ -172,6 +195,10 @@ def plan_serving_memory(
     page_size: int = 64,
     kv_pages: int = 0,
     page_fraction: float = 0.0,
+    adapter_pool_rows: int = 0,
+    adapter_rank: int = 0,
+    grammar_slots: int = 0,
+    grammar_states: int = 0,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -197,9 +224,26 @@ def plan_serving_memory(
     (serving/pagepool.py): ``kv_pages`` pages of ``page_size`` tokens, or
     ``pages_for_fraction(max_batch, max_seq_len, page_size,
     page_fraction)`` when kv_pages is 0.
+    ``adapter_pool_rows``/``adapter_rank``: shape of the multi-LoRA device
+    pool (serving/adapters.py) — 0 omits the term (no adapters).
+    ``grammar_slots``/``grammar_states``: shape of the constrained-decoding
+    DFA pool (serving/constrain.py) — 0 omits the term.
     """
     from langstream_tpu.models.quant import init_random_quantized_params
     from langstream_tpu.models.transformer import init_params, make_kv_cache
+
+    adapter_bytes = 0
+    if adapter_pool_rows > 0 and adapter_rank > 0:
+        from langstream_tpu.serving.adapters import lora_pool_bytes
+
+        adapter_bytes = lora_pool_bytes(config, adapter_pool_rows, adapter_rank)
+    grammar_bytes = 0
+    if grammar_slots > 0 and grammar_states > 0:
+        from langstream_tpu.serving.constrain import grammar_pool_bytes
+
+        grammar_bytes = grammar_pool_bytes(
+            grammar_slots, grammar_states, config.vocab_size
+        )
 
     paged = kv_layout == "paged"
     if paged:
@@ -245,6 +289,8 @@ def plan_serving_memory(
                 if speculation_tokens > 0
                 else 0
             ),
+            adapter_pool_bytes=adapter_bytes,
+            grammar_pool_bytes=grammar_bytes,
         )
 
     key = jax.ShapeDtypeStruct((2,), jax.numpy.uint32)
@@ -307,6 +353,8 @@ def plan_serving_memory(
             if speculation_tokens > 0
             else 0
         ),
+        adapter_pool_bytes=adapter_bytes,
+        grammar_pool_bytes=grammar_bytes,
     )
 
 

@@ -225,7 +225,8 @@ _FORBIDDEN_KEYS = frozenset(
 )
 
 DUMP_REASONS = (
-    "nan-quarantine", "page-quarantine", "engine-restart", "shed-burst",
+    "nan-quarantine", "page-quarantine", "adapter-quarantine",
+    "engine-restart", "shed-burst",
     "on-demand",
     # SPMD leader/follower disagreement (echo mismatch, sequence gap, or a
     # failed replay): dumped on the FOLLOWER, tagged with the ControlBlock

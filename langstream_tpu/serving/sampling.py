@@ -83,8 +83,18 @@ def sample(
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = disabled
     top_p: jax.Array,  # [B] fp32, 1.0 = disabled
+    allowed: jax.Array = None,  # [B, V] bool — grammar mask, True = legal
 ) -> jax.Array:
     """Returns sampled token ids [B]. temperature 0 → greedy for that slot.
+
+    ``allowed`` (constrained decoding, serving/constrain.py): illegal
+    tokens drop to -inf BEFORE the greedy argmax and the top-k/top-p
+    filters, so a constrained slot's output is guaranteed inside its
+    grammar on both the greedy and sampled paths. The mask lands AFTER the
+    NaN guard's finite check — a grammar's own -inf columns must not read
+    as a poisoned row (the guard exists for device faults, not masks), and
+    the DFA's no-dead-end invariant guarantees at least one True per row
+    so the masked softmax stays finite.
 
     NaN guard: a row whose logits contain any non-finite value (NaN/±inf
     overflow — a numerically-poisoned KV row or a device fault) returns the
@@ -98,6 +108,8 @@ def sample(
     unlike the sort this module already gates behind any_filter."""
     b, v = logits.shape
     finite = jnp.all(jnp.isfinite(logits), axis=-1)  # [B]
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, -jnp.inf)
     greedy = _greedy_argmax(logits)
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
@@ -125,8 +137,22 @@ def speculative_verify(
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = disabled
     top_p: jax.Array,  # [B] fp32, 1.0 = disabled
+    allowed: jax.Array = None,  # [B, K+1, V] bool — per-POSITION grammar mask
 ) -> tuple[jax.Array, jax.Array]:
     """Batched draft verification for self-speculative decoding.
+
+    ``allowed`` (constrained decoding): position j's mask is derived from
+    the DFA state AFTER consuming drafts 0..j-1 (the engine ships the
+    per-position state ids; serving/constrain.py). Masking the verify
+    logits with the SAME per-position masks non-speculative decode would
+    apply keeps the exactness invariants under constraints: greedy rows
+    accept the longest prefix matching the MASKED argmax chain (an illegal
+    draft's -inf logit can never equal the argmax, so it is rejected
+    exactly where plain masked decode would have emitted something else),
+    and sampled rows rejection-sample against the masked softmax (an
+    illegal draft has p(d)=0 → never accepted; corrections/bonus draws
+    come from the masked residual) — the emitted marginal is exactly the
+    masked p.
 
     Position j of ``logits`` is the model's next-token distribution after
     consuming verify input j (input 0 = the slot's current token, inputs
@@ -155,6 +181,8 @@ def speculative_verify(
     b, k1, v = logits.shape
     k = k1 - 1
     finite = jnp.all(jnp.isfinite(logits.reshape(b, -1)), axis=-1)  # [B]
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, -jnp.inf)
     greedy = _greedy_argmax(logits.reshape(b * k1, v)).reshape(b, k1)
     greedy_acc = drafts == greedy[:, :k]  # [B, K]
 

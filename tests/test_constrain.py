@@ -1,0 +1,459 @@
+"""Grammar-constrained decoding (serving/constrain.py + the mask path in
+serving/sampling.py + the engine's DFA plumbing) — ISSUE 10's grammar half.
+
+The layers under test, bottom-up:
+- regex → byte DFA: matching semantics vs Python `re` on accept/reject
+  sets (the compiler is hand-rolled; `re` is the oracle);
+- JSON schema → regex → token DFA: every schema-constrained completion
+  parses AND validates, and bounded primitives force termination;
+- the sampler fold: masked sample()/speculative_verify() behavior incl.
+  the NaN-guard ordering (a grammar's -inf must not read as a fault);
+- engine e2e: the device mask path is token-exact vs an INDEPENDENT
+  host-masked reference loop (transformer.prefill + decode_step with
+  numpy masking — no engine code on the reference side).
+
+Engine-heavy tests are `slow` (chaos CI runs them; tier-1 keeps the pure
+host units)."""
+
+import dataclasses
+import json
+import re as _re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+from langstream_tpu.models.transformer import (
+    decode_step_inplace,
+    init_params,
+    make_kv_cache,
+    prefill,
+)
+from langstream_tpu.serving.constrain import (
+    DEAD,
+    GrammarError,
+    GrammarRegistry,
+    TokenDFA,
+    compile_response_format,
+    compile_token_dfa,
+    grammar_pool_bytes,
+    schema_to_regex,
+    verify_states,
+    _nfa_to_byte_dfa,
+    _regex_to_nfa,
+)
+from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+from langstream_tpu.serving.sampling import sample
+from langstream_tpu.serving.tokenizer import ByteTokenizer
+
+CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+TOK = ByteTokenizer()
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 8},
+        "n": {"type": "integer"},
+    },
+}
+RF = {"type": "json_schema", "json_schema": {"schema": SCHEMA}}
+
+
+def make_engine(**kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("grammar_tokenizer", TOK)
+    kw.setdefault("eos_token_id", TOK.eos_token_id)
+    engine = ServingEngine(kw.pop("config", CFG), PARAMS, **kw)
+    engine.start()
+    return engine
+
+
+# ---------------------------------------------------------------------------
+# regex → byte DFA (oracle: python re)
+# ---------------------------------------------------------------------------
+
+
+def _dfa_accepts(pattern: str, text: str) -> bool:
+    byte_next, accepting = _nfa_to_byte_dfa(*_regex_to_nfa(pattern))
+    s = 0
+    for b in text.encode("utf-8"):
+        s = int(byte_next[s, b])
+        if s < 0:
+            return False
+    return s in accepting
+
+
+@pytest.mark.parametrize("pattern,accepts,rejects", [
+    ("abc", ["abc"], ["ab", "abcd", "abd", ""]),
+    ("a*b", ["b", "ab", "aaab"], ["a", "ba"]),
+    ("a+b?", ["a", "ab", "aaa"], ["b", "", "abb"]),
+    ("(ab|cd)+", ["ab", "cdab"], ["a", "abc", ""]),
+    ("[0-9]+", ["0", "42"], ["", "4x"]),
+    ("[^x]y", ["ay", "zy"], ["xy", "y"]),
+    (r"-?(0|[1-9][0-9]*)", ["0", "-7", "120"], ["01", "-", "+3"]),
+    ("a{2,4}", ["aa", "aaa", "aaaa"], ["a", "aaaaa"]),
+    ("(ab){0,2}c", ["c", "abc", "ababc"], ["abababc", "ab"]),
+    (r"\{x\}", ["{x}"], ["x", "{x"]),
+])
+def test_regex_dfa_matches_python_re(pattern, accepts, rejects):
+    # sanity: our accept/reject sets agree with python's re
+    for text in accepts:
+        assert _re.fullmatch(pattern, text), (pattern, text)
+        assert _dfa_accepts(pattern, text), (pattern, text)
+    for text in rejects:
+        assert not _re.fullmatch(pattern, text), (pattern, text)
+        assert not _dfa_accepts(pattern, text), (pattern, text)
+
+
+def test_regex_parser_rejects_malformed():
+    # non-ASCII inside a CLASS is a GrammarError (classes are byte sets;
+    # multi-byte UTF-8 can't join one) — never an IndexError escaping to
+    # the caller; non-ASCII LITERALS outside classes byte-chain fine
+    for bad in ("(", "a{", "a{3,1}", "[", "a)", "*a", "\\", "[€]", "[a-€]"):
+        with pytest.raises(GrammarError):
+            _regex_to_nfa(bad)
+    _regex_to_nfa("€")  # literal multi-byte char is legal
+
+
+# ---------------------------------------------------------------------------
+# JSON schema → regex
+# ---------------------------------------------------------------------------
+
+
+def test_schema_to_regex_samples_match():
+    pattern = schema_to_regex(SCHEMA)
+    assert _re.fullmatch(pattern, '{"name":"bob","n":42}')
+    assert _re.fullmatch(pattern, '{"name":"","n":-1}')
+    assert not _re.fullmatch(pattern, '{"name":"bob"}')  # all props required
+    assert not _re.fullmatch(pattern, '{"n":42,"name":"bob"}')  # fixed order
+    enum = schema_to_regex({"enum": ["red", "green", 3]})
+    assert _re.fullmatch(enum, '"red"') and _re.fullmatch(enum, "3")
+    arr = schema_to_regex({"type": "array", "items": {"type": "integer"},
+                           "maxItems": 2})
+    assert _re.fullmatch(arr, "[]") and _re.fullmatch(arr, "[1,2]")
+    assert not _re.fullmatch(arr, "[1,2,3]")
+    # maxItems: 1 emits the epsilon repetition {0,0} — must compile, and
+    # accept exactly zero or one element
+    one = compile_response_format(
+        {"type": "json_schema", "schema": {
+            "type": "array", "items": {"type": "integer"}, "maxItems": 1,
+        }},
+        TOK, CFG.vocab_size, None,
+    )
+    s = 0
+    for ch in "[7]":
+        s = one.advance(s, ord(ch))
+        assert s >= 0, ch
+    assert one.is_complete(s) or s in one.accepting
+    assert one.advance(one.advance(0, ord("[")), ord("]")) >= 0  # empty []
+
+
+def test_token_byte_table_cached_per_tokenizer():
+    from langstream_tpu.serving.constrain import _token_byte_table
+
+    tok = ByteTokenizer()
+    b1, l1 = _token_byte_table(tok, CFG.vocab_size)
+    b2, l2 = _token_byte_table(tok, CFG.vocab_size)
+    assert b1 is b2 and l1 is l2  # grammar-independent: built once
+
+
+def test_schema_to_regex_rejects_unsupported():
+    with pytest.raises(GrammarError):
+        schema_to_regex({"type": "object", "properties": {}})
+    with pytest.raises(GrammarError):
+        schema_to_regex({"oneOf": [{"type": "string"}]})
+
+
+# ---------------------------------------------------------------------------
+# token DFA
+# ---------------------------------------------------------------------------
+
+
+def test_token_dfa_legality_and_advance():
+    dfa = compile_token_dfa("(yes|no)", TOK, CFG.vocab_size, TOK.eos_token_id)
+    s0 = 0
+    legal0 = {t for t in range(CFG.vocab_size) if dfa.next[s0, t] >= 0}
+    assert legal0 == {ord("y"), ord("n")}
+    s1 = dfa.advance(s0, ord("n"))
+    s2 = dfa.advance(s1, ord("o"))
+    assert dfa.is_complete(s2) or s2 in dfa.accepting
+    # byte ids past the tokenizer vocab are never legal mid-grammar
+    assert dfa.next[s0, 300] == DEAD
+
+
+def test_token_dfa_complete_state_self_loops_not_dead():
+    """Sink-accept states self-loop on EVERY token (the no-all-masked-row
+    invariant that keeps the NaN guard quiet); the host finishes on entry
+    so the loop tokens are never delivered."""
+    dfa = compile_token_dfa("ab", TOK, CFG.vocab_size, None)
+    s = dfa.advance(dfa.advance(0, ord("a")), ord("b"))
+    assert dfa.is_complete(s)
+    assert np.all(dfa.next[s] == s)
+
+
+def test_token_dfa_eos_legal_only_at_accepting_states():
+    dfa = compile_token_dfa("[0-9]{1,3}", TOK, CFG.vocab_size, TOK.eos_token_id)
+    assert dfa.next[0, TOK.eos_token_id] == DEAD  # nothing matched yet
+    s1 = dfa.advance(0, ord("7"))
+    assert dfa.next[s1, TOK.eos_token_id] >= 0  # "7" is a full match
+
+
+def test_verify_states_carries_last_legal_past_illegal_draft():
+    dfa = compile_token_dfa("[0-9]+", TOK, CFG.vocab_size, None)
+    states = verify_states(dfa, 0, [ord("1"), ord("x"), ord("2")])
+    assert len(states) == 4
+    assert states[1] == dfa.advance(0, ord("1"))
+    assert states[2] == states[1]  # 'x' illegal → carry
+    assert all(s >= 0 for s in states)
+
+
+def test_response_format_spellings_and_errors():
+    flat = compile_response_format(
+        {"type": "json_schema", "schema": SCHEMA}, TOK, CFG.vocab_size, None
+    )
+    nested = compile_response_format(RF, TOK, CFG.vocab_size, None)
+    assert np.array_equal(flat.next, nested.next)
+    with pytest.raises(GrammarError):
+        compile_response_format({"type": "xml"}, TOK, CFG.vocab_size, None)
+    with pytest.raises(GrammarError):
+        compile_response_format({"type": "regex"}, TOK, CFG.vocab_size, None)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_grammar_registry_cache_residency_and_lru():
+    reg = GrammarRegistry(TOK, CFG.vocab_size, None, slots=2, max_states=64)
+    d1 = reg.compile({"type": "regex", "regex": "ab"})
+    assert reg.compile({"type": "regex", "regex": "ab"}) is d1  # cache hit
+    assert reg.compiled_total == 1
+    r1 = reg.acquire(d1)
+    d2 = reg.compile({"type": "regex", "regex": "cd"})
+    r2 = reg.acquire(d2)
+    assert r1 != r2 and reg.resident == 2
+    d3 = reg.compile({"type": "regex", "regex": "ef"})
+    with pytest.raises(GrammarError):
+        reg.acquire(d3)  # both rows pinned
+    reg.release(d1)
+    r3 = reg.acquire(d3)
+    assert r3 == r1 and reg.swaps_total == 3  # LRU row recycled
+
+
+def test_grammar_registry_rejects_oversized_grammar():
+    reg = GrammarRegistry(TOK, CFG.vocab_size, None, slots=1, max_states=4)
+    with pytest.raises(GrammarError):
+        reg.compile({"type": "regex", "regex": "abcdefghij"})
+
+
+def test_grammar_pool_bytes_arithmetic():
+    assert grammar_pool_bytes(4, 128, 512) == 5 * 128 * 512 * 4
+    assert grammar_pool_bytes(0, 128, 512) == 0
+
+
+# ---------------------------------------------------------------------------
+# sampler fold
+# ---------------------------------------------------------------------------
+
+
+def test_sample_mask_restricts_and_preserves_nan_guard():
+    logits = jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32)[None, :])
+    allowed = np.zeros((1, 16), bool)
+    allowed[0, 3] = True
+    out = sample(
+        logits, jax.random.PRNGKey(0), jnp.zeros(1), jnp.zeros(1, jnp.int32),
+        jnp.ones(1), jnp.asarray(allowed),
+    )
+    assert int(out[0]) == 3  # only legal token wins despite lower logit
+    # a genuinely non-finite row still trips the sentinel THROUGH the mask
+    poisoned = logits.at[0, 5].set(jnp.nan)
+    out = sample(
+        poisoned, jax.random.PRNGKey(0), jnp.zeros(1),
+        jnp.zeros(1, jnp.int32), jnp.ones(1), jnp.asarray(allowed),
+    )
+    assert int(out[0]) == -1
+
+
+def test_sampled_path_respects_mask_distribution():
+    """Masked sampled tokens land ONLY on legal ids and follow the masked
+    softmax (coarse chi-square-free check on frequencies)."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(1, 8)).astype(np.float32))
+    allowed = np.zeros((1, 8), bool)
+    allowed[0, [2, 5]] = True
+    counts = {2: 0, 5: 0}
+    n = 400
+    for i in range(n):
+        out = sample(
+            logits, jax.random.PRNGKey(i), jnp.ones(1) * 0.8,
+            jnp.zeros(1, jnp.int32), jnp.ones(1), jnp.asarray(allowed),
+        )
+        counts[int(out[0])] += 1
+    masked = np.where(allowed[0], np.asarray(logits[0]) / 0.8, -np.inf)
+    probs = np.exp(masked - masked.max())
+    probs /= probs.sum()
+    assert abs(counts[2] / n - probs[2]) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# engine e2e (slow)
+# ---------------------------------------------------------------------------
+
+
+def _host_masked_reference(prompt, dfa: TokenDFA, max_new: int,
+                           config=CFG) -> list[int]:
+    """INDEPENDENT reference: prefill + per-step decode through the raw
+    transformer entry points, masking fetched logits with numpy and taking
+    the argmax host-side — no engine, no device mask path."""
+    cache = make_kv_cache(config, 1, 256)
+    tokens = np.zeros((1, 64), np.int32)
+    tokens[0, : len(prompt)] = prompt
+    logits, cache = prefill(
+        PARAMS, jnp.asarray(tokens), jnp.asarray([len(prompt)]), cache, config
+    )
+    out: list[int] = []
+    state = 0
+    position = len(prompt)
+    current = None
+    while len(out) < max_new:
+        row = np.asarray(logits)[0] if current is None else np.asarray(
+            current
+        )[0]
+        legal = dfa.next[state] >= 0
+        row = np.where(legal[: row.shape[0]], row, -np.inf)
+        token = int(np.argmax(row))
+        if token == TOK.eos_token_id:
+            break
+        out.append(token)
+        state = dfa.advance(state, token)
+        if dfa.is_complete(state):
+            break
+        current, cache = decode_step_inplace(
+            PARAMS, jnp.asarray([token]), jnp.asarray([position]), cache,
+            config,
+        )
+        current = current[None, :] if current.ndim == 1 else current
+        position += 1
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv", ["float", "int8"])
+def test_constrained_greedy_token_exact_vs_host_masked_reference(kv):
+    config = CFG if kv == "float" else dataclasses.replace(
+        CFG, kv_cache_dtype="int8"
+    )
+    dfa = compile_response_format(RF, TOK, CFG.vocab_size, TOK.eos_token_id)
+    prompt = TOK.encode("Hi")
+    want = _host_masked_reference(prompt, dfa, 64, config=config)
+    engine = make_engine(config=config)
+    try:
+        got = engine.generate(list(prompt), GenerationOptions(
+            max_new_tokens=64, response_format=RF,
+        ), timeout=600)
+        assert got.tokens == want
+        assert got.finish_reason == "stop"
+        json.loads(TOK.decode(got.tokens))
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_constrained_completions_parse_and_validate_including_sampled():
+    engine = make_engine(max_batch=4)
+    try:
+        results = []
+        for temp in (0.0, 0.9, 1.3):
+            r = engine.generate(TOK.encode("Go"), GenerationOptions(
+                max_new_tokens=96, temperature=temp, response_format=RF,
+            ), timeout=600)
+            results.append(r)
+        for r in results:
+            assert r.finish_reason == "stop"
+            doc = json.loads(TOK.decode(r.tokens))
+            assert set(doc) == {"name", "n"}
+            assert isinstance(doc["name"], str) and len(doc["name"]) <= 8
+            assert isinstance(doc["n"], int)
+        assert engine.stats()["constrained-requests-total"] == 3
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_constrained_prefix_warm_admission_token_exact():
+    """Constraints compose with prefix reuse (grammar masks only the
+    GENERATED side): a warm admission's constrained output must equal the
+    cold one's."""
+    preamble = TOK.encode("x" * 80)
+    engine = make_engine(prefix_cache="auto", max_batch=2)
+    try:
+        opts = GenerationOptions(max_new_tokens=64, response_format=RF)
+        cold = engine.generate(list(preamble), opts, timeout=600)
+        saved0 = engine.stats()["prefill-tokens-saved-total"]
+        warm = engine.generate(list(preamble), opts, timeout=600)
+        assert engine.stats()["prefill-tokens-saved-total"] > saved0, (
+            "second admission did not hit the prefix cache"
+        )
+        assert warm.tokens == cold.tokens
+        json.loads(TOK.decode(warm.tokens))
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_constrained_mixed_with_free_slots_one_program():
+    """A constrained slot and a free-form slot decode concurrently; the
+    free slot's output is byte-identical to a grammar-free engine's, and
+    the program count stays flat across the mixed batch."""
+    free_engine = make_engine(constrained_decoding="off")
+    try:
+        want_free = free_engine.generate(
+            TOK.encode("Hello"), GenerationOptions(max_new_tokens=16),
+            timeout=600,
+        ).tokens
+    finally:
+        free_engine.stop()
+    engine = make_engine(max_batch=2, precompile=True)
+    try:
+        warm = engine.generate(
+            TOK.encode("warm"), GenerationOptions(max_new_tokens=8),
+            timeout=600,
+        )
+        assert warm.tokens
+        # also warm the constrained grammar (its row upload is a program)
+        engine.generate(TOK.encode("warm"), GenerationOptions(
+            max_new_tokens=32, response_format=RF,
+        ), timeout=600)
+        programs_before = engine.stats()["compiled_programs"]
+        con = engine.submit(GenerationRequest(
+            prompt_tokens=TOK.encode("Go"),
+            options=GenerationOptions(max_new_tokens=96, response_format=RF),
+        ))
+        free = engine.submit(GenerationRequest(
+            prompt_tokens=TOK.encode("Hello"),
+            options=GenerationOptions(max_new_tokens=16),
+        ))
+        assert free.result(timeout=600).tokens == want_free
+        json.loads(TOK.decode(con.result(timeout=600).tokens))
+        assert engine.stats()["compiled_programs"] == programs_before
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_response_format_rejected_when_constrain_off():
+    engine = make_engine(constrained_decoding="off")
+    try:
+        with pytest.raises(ValueError):
+            engine.submit(GenerationRequest(
+                prompt_tokens=TOK.encode("x"),
+                options=GenerationOptions(response_format=RF),
+            ))
+    finally:
+        engine.stop()

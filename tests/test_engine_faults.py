@@ -590,3 +590,99 @@ def test_fault_injector_env_activation(monkeypatch):
     assert inj is not None and inj.seed == 3 and inj.stall_s == 0.5
     with pytest.raises(ValueError):
         FaultInjector("warp@1")  # unknown site fails fast, not silently
+
+
+def test_finish_waker_never_observes_half_torn_slot():
+    """The finish-waker race (ISSUE 10 satellite): `_finish` wakes the
+    waiter IMMEDIATELY — on_done runs inside it, result() unblocks — so
+    every teardown (slot.request cleared, generated list detached, pages
+    freed) must land strictly BEFORE. This test loses the race
+    deterministically: an injected decode crash routes the in-flight
+    request through `_recover`, and the on_done callback (running inside
+    _finish, on the engine thread) snapshots whether any slot still wires
+    to the finishing request. Before the fix, _recover finished the
+    request and THEN cleared the slot — this assertion read the half-torn
+    state every time."""
+    observed = []
+
+    def on_done_factory(holder):
+        def on_done(result):
+            engine = holder["engine"]
+            req = holder["request"]
+            observed.append({
+                "slot_refs": sum(
+                    1 for s in engine._slots if s.request is req
+                ),
+                "long_refs": sum(
+                    1 for st in engine._longs.values()
+                    if st.get("request") is req
+                ),
+                # the result's token list must be detached from any slot's
+                # live list (a later slot reuse would mutate it under the
+                # waiter otherwise)
+                "aliased": any(
+                    result.tokens is s.generated for s in engine._slots
+                ),
+            })
+        return on_done
+
+    holder: dict = {}
+    engine = make_engine(
+        fault_injector=FaultInjector("decode@2", seed=0),
+        restart_backoff_s=0.01, max_restarts=2,
+    )
+    holder["engine"] = engine
+    try:
+        request = GenerationRequest(
+            prompt_tokens=[5, 6, 7],
+            options=GenerationOptions(max_new_tokens=32),
+            on_done=on_done_factory(holder),
+        )
+        holder["request"] = request
+        engine.submit(request)
+        with pytest.raises(InjectedFault):
+            request.result(timeout=120)
+        assert observed, "on_done never ran"
+        snap = observed[0]
+        assert snap["slot_refs"] == 0, "waker saw its request still slotted"
+        assert snap["long_refs"] == 0
+        assert not snap["aliased"], "result.tokens aliases a live slot list"
+        # the engine restarted and still serves
+        ok = engine.generate([5, 6, 7], GenerationOptions(max_new_tokens=4),
+                             timeout=120)
+        assert ok.tokens == solo_reference([5, 6, 7], 4)[:4]
+    finally:
+        engine.stop()
+
+
+def test_fail_all_waker_never_observes_half_torn_slot():
+    """Same ordering contract on the UNRECOVERABLE path (_fail_all): with
+    the restart budget at zero, the injected crash fails everything — and
+    the waker must still see its slot fully torn down."""
+    observed = []
+    holder: dict = {}
+
+    def on_done(result):
+        engine = holder["engine"]
+        req = holder["request"]
+        observed.append(sum(1 for s in engine._slots if s.request is req))
+
+    engine = make_engine(
+        fault_injector=FaultInjector("decode@2", seed=0), max_restarts=0,
+    )
+    holder["engine"] = engine
+    try:
+        request = GenerationRequest(
+            prompt_tokens=[5, 6, 7],
+            options=GenerationOptions(max_new_tokens=32),
+            on_done=on_done,
+        )
+        holder["request"] = request
+        engine.submit(request)
+        with pytest.raises(InjectedFault):
+            request.result(timeout=120)
+        assert observed and observed[0] == 0, (
+            "waker saw its request still slotted during _fail_all"
+        )
+    finally:
+        engine.stop()

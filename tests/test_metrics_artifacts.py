@@ -102,6 +102,10 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_spec_acceptance_rate",
         "engine_spec_accepted_tokens_per_step",
         "engine_spec_draft_hit_rate",
+        "engine_adapters_resident",
+        "engine_adapter_swaps_total",
+        "engine_constrained_requests_total",
+        "engine_constrain_overhead_ms",
         "engine_shed_total",
         "engine_deadline_exceeded_total",
         "engine_cancelled_total",
@@ -174,6 +178,32 @@ def test_fleet_panels_present():
     )
     assert replicas is not None, "fleet replica-count panel missing"
     assert "fleet_replica_count" in replicas
+
+
+def test_agentic_panels_present():
+    """The ISSUE-10 agentic-tier panels must survive dashboard edits:
+    adapter residency/swaps (the multi-LoRA pool-thrash signal,
+    serving/adapters.py) and the constrained-decoding volume + mask
+    overhead pair (serving/constrain.py; docs/SERVING.md §15)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    adapters = next(
+        (e for t, e in exprs_by_title.items() if "adapter" in t.lower()),
+        None,
+    )
+    assert adapters is not None, "adapter multiplexing panel missing"
+    assert "engine_adapters_resident" in adapters
+    assert "engine_adapter_swaps_total" in adapters
+    constrained = next(
+        (e for t, e in exprs_by_title.items() if "constrained" in t.lower()),
+        None,
+    )
+    assert constrained is not None, "constrained-decoding panel missing"
+    assert "engine_constrained_requests_total" in constrained
+    assert "engine_constrain_overhead_ms" in constrained
 
 
 def test_grafana_provisioning_parses():

@@ -325,3 +325,138 @@ def test_speculation_off_reports_zeroed_stats():
     assert stats["speculation-tokens"] == 0
     assert stats["spec-acceptance-rate"] == 0.0
     assert stats["spec-accepted-tokens-per-step"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# constrained + speculative exactness (ISSUE 10): the verify path must stay
+# token-exact when grammar masks apply per draft position — greedy output
+# equals the non-speculative constrained engine's on both KV dtypes and
+# both admission paths, and the sampled path's emitted marginal equals the
+# MASKED softmax (the round-9 exactness machinery, extended under masks)
+# ---------------------------------------------------------------------------
+
+from langstream_tpu.serving.tokenizer import ByteTokenizer  # noqa: E402
+
+_TOK = ByteTokenizer()
+_RF = {
+    "type": "json_schema",
+    "json_schema": {"schema": {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string", "maxLength": 8},
+            "n": {"type": "integer"},
+        },
+    }},
+}
+
+
+def _constrained_engine(config=CFG, spec=True, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("grammar_tokenizer", _TOK)
+    kw.setdefault("eos_token_id", _TOK.eos_token_id)
+    engine = ServingEngine(
+        config, PARAMS, speculation="auto" if spec else "off",
+        speculation_tokens=4, **kw,
+    )
+    engine.start()
+    return engine
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("config", [CFG, CFG_INT8], ids=["float", "int8kv"])
+def test_constrained_speculative_token_exact_cold(config):
+    import json as _json
+
+    opts = GenerationOptions(max_new_tokens=80, response_format=dict(_RF))
+    ref = _constrained_engine(config, spec=False)
+    try:
+        want = ref.generate(_TOK.encode("Hello"), opts, timeout=600)
+    finally:
+        ref.stop()
+    engine = _constrained_engine(config, spec=True)
+    try:
+        got = engine.generate(_TOK.encode("Hello"), opts, timeout=600)
+        stats = engine.stats()
+    finally:
+        engine.stop()
+    assert got.tokens == want.tokens
+    assert got.finish_reason == "stop"
+    _json.loads(_TOK.decode(got.tokens))  # the structured-output guarantee
+    assert stats["spec-verify-dispatches-total"] > 0  # spec actually ran
+
+
+@pytest.mark.slow
+def test_constrained_speculative_token_exact_prefix_warm():
+    """Prefix-warm constrained admission under speculation: warm output ==
+    cold output == the non-speculative engine's, with a real cache hit."""
+    import json as _json
+
+    preamble = _TOK.encode("y" * 80)
+    opts = GenerationOptions(max_new_tokens=80, response_format=dict(_RF))
+    ref = _constrained_engine(spec=False, prefix_cache="auto")
+    try:
+        want = ref.generate(list(preamble), opts, timeout=600).tokens
+    finally:
+        ref.stop()
+    engine = _constrained_engine(spec=True, prefix_cache="auto")
+    try:
+        cold = engine.generate(list(preamble), opts, timeout=600)
+        saved0 = engine.stats()["prefill-tokens-saved-total"]
+        warm = engine.generate(list(preamble), opts, timeout=600)
+        assert engine.stats()["prefill-tokens-saved-total"] > saved0
+    finally:
+        engine.stop()
+    assert cold.tokens == want
+    assert warm.tokens == want
+    _json.loads(_TOK.decode(warm.tokens))
+
+
+def test_verify_masked_rejection_sampling_preserves_masked_marginal():
+    """Distribution exactness UNDER MASKS: with per-position allowed sets,
+    the emitted first token's marginal equals the MASKED softmax — an
+    illegal draft (p=0 under the mask) is never accepted, and corrections
+    come from the masked residual."""
+    v = 8
+    rng = np.random.default_rng(9)
+    logits = jnp.asarray(rng.normal(size=(1, 3, v)).astype(np.float32) * 2.0)
+    allowed = np.zeros((1, 3, v), bool)
+    allowed[0, :, [1, 3, 5]] = True
+    drafts = jnp.asarray([[2, 5]])  # draft 2 is ILLEGAL at position 0
+    temp = jnp.asarray([0.7], jnp.float32)
+    top_k = jnp.zeros(1, jnp.int32)
+    top_p = jnp.ones(1, jnp.float32)
+
+    n = 6000
+    keys = jax.random.split(jax.random.PRNGKey(2), n)
+    out, accept = jax.vmap(
+        lambda k: speculative_verify(
+            logits, drafts, k, temp, top_k, top_p, jnp.asarray(allowed)
+        )
+    )(keys)
+    assert int(np.max(np.asarray(accept))) == 0  # illegal draft never accepted
+    first = np.asarray(out[:, 0, 0])
+    assert set(np.unique(first)).issubset({1, 3, 5})
+    masked = np.where(allowed[0, 0], np.asarray(logits[0, 0]) / 0.7, -np.inf)
+    target = np.exp(masked - masked.max())
+    target /= target.sum()
+    counts = np.bincount(first, minlength=v) / n
+    np.testing.assert_allclose(counts, target, atol=0.03)
+
+
+def test_verify_masked_greedy_accepts_only_legal_matching_drafts():
+    temp, top_k, top_p = _greedy_params()
+    logits = _logits_with_argmax_chain([3, 7, 2])
+    allowed = np.ones((1, 3, 16), bool)
+    allowed[0, 1, 7] = False  # the matching draft at position 1 is ILLEGAL
+    out, accept = speculative_verify(
+        logits, jnp.asarray([[3, 7]]), jax.random.PRNGKey(0), temp, top_k,
+        top_p, jnp.asarray(allowed),
+    )
+    # position 0's draft (3, legal, matches) accepted; position 1's draft
+    # matches the RAW argmax but is masked out → rejected, correction is
+    # the masked argmax at that position
+    assert int(accept[0]) == 1
+    assert int(out[0, 0]) == 3
+    assert int(out[0, 1]) != 7
