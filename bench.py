@@ -617,6 +617,120 @@ def bench_adapters(preset: str, quantize: bool, *, max_batch: int,
     return out
 
 
+def bench_tiered_kv(preset: str, quantize: bool, *, n_sessions: int = 8,
+                    rounds: int = 3, new_tokens: int = 16,
+                    page_size: int = 16, kv_int8: bool = False) -> dict:
+    """Tiered-KV phase (ISSUE 11 acceptance): the idle-session CHURN
+    workload the tier exists for — N chat sessions taking sequential
+    turns over a device pool deliberately sized to keep only ~2 of their
+    prefixes resident, so by the time a session's next turn arrives its
+    prefix has been evicted (spill off: gone, full re-prefill) or demoted
+    (spill on: hibernated host-side, DMA restore). Measured twice on
+    fresh engines over the same params: next-turn TTFT p50/p99 plus the
+    tier's own traffic accounting (spill/restore bytes, restored-hits vs
+    recompute-fallbacks). Prefix cache ON in both legs — the pair
+    isolates the HOST TIER, not the cache (PERF.md round 15)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+
+    config = MODEL_PRESETS[preset]
+    if kv_int8:
+        config = dataclasses.replace(config, kv_cache_dtype="int8")
+    if quantize:
+        from langstream_tpu.models.quant import init_random_quantized_params
+
+        params = init_random_quantized_params(config, jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+    else:
+        params = init_params(config, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(11)
+    # distinct 80-token session preambles: each publishes a 64-token
+    # (4-page at ps=16) prefix; the pool below holds ~2 of them resident
+    prompts = [
+        rng.integers(1, config.vocab_size, size=80).tolist()
+        for _ in range(n_sessions)
+    ]
+    opts = GenerationOptions(max_new_tokens=new_tokens, temperature=0.0)
+    prefix_pages = 64 // page_size
+    active_pages = -(-(80 + new_tokens) // page_size)  # ceil
+    kv_pages = active_pages + 2 * prefix_pages
+
+    out: dict = {
+        "tiered_sessions": n_sessions, "tiered_rounds": rounds,
+        "tiered_kv_pages": kv_pages,
+    }
+    for mode in ("on", "off"):
+        engine = ServingEngine(
+            config,
+            params,
+            max_batch=2,
+            max_seq_len=256,
+            prefill_buckets=(16, 32, 64),
+            decode_chunk=8,
+            kv_layout="paged",
+            page_size=page_size,
+            kv_pages=kv_pages,
+            prefix_cache="auto",
+            prefix_cache_entries=n_sessions * 2,
+            host_kv_fraction=float(n_sessions) if mode == "on" else 0.0,
+            spill_idle_s=0.0,
+            precompile=True,
+        )
+        engine.start()
+        try:
+            turn_ttfts: list[float] = []
+            for rnd in range(rounds):
+                for i, p in enumerate(prompts):
+                    r = engine.submit(GenerationRequest(
+                        prompt_tokens=list(p), options=opts,
+                    )).result(timeout=1200)
+                    if rnd > 0:  # next-turn TTFT: revisits only
+                        turn_ttfts.append(r.ttft_s)
+                    if mode == "on":
+                        # the inter-turn idle the sweep hibernates in;
+                        # sized for CPU jitter, not for the copy (one
+                        # 4-page spill is <1ms of memcpy)
+                        deadline = time.monotonic() + 2.0
+                        while (
+                            time.monotonic() < deadline
+                            and any(
+                                e.tier == "device"
+                                for e in engine._prefix_index._live
+                            )
+                        ):
+                            time.sleep(0.005)
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        tag = f"spill_{mode}"
+        arr = np.asarray(turn_ttfts)
+        out[f"{tag}_next_turn_p50_ttft_ms"] = round(
+            float(np.percentile(arr, 50)) * 1e3, 2)
+        out[f"{tag}_next_turn_p99_ttft_ms"] = round(
+            float(np.percentile(arr, 99)) * 1e3, 2)
+        if mode == "on":
+            out["tiered_restored_hits"] = stats["restored-hits-total"]
+            out["tiered_recompute_fallbacks"] = stats[
+                "recompute-fallbacks-total"]
+            out["tiered_spill_mib"] = round(
+                stats["spill-bytes-total"] / 2**20, 2)
+            out["tiered_restore_mib"] = round(
+                stats["restore-bytes-total"] / 2**20, 2)
+            out["tiered_host_demotions"] = stats["host-demotions-total"]
+        else:
+            out["spill_off_prefix_evictions"] = stats[
+                "prefix-cache-evictions-total"]
+        _reclaim()
+    return out
+
+
 def bench_degradation(preset: str, quantize: bool, max_batch: int,
                       new_tokens: int, n_requests: int, max_seq_len: int,
                       decode_chunk: int) -> dict:
@@ -1253,6 +1367,18 @@ def main() -> None:
         ))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] adapters phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # tiered-KV idle-session churn: next-turn TTFT with the host tier on
+    # vs off over a pool sized to thrash (ISSUE 11 acceptance; docs §16)
+    print("[bench] tiered-KV hibernation phase", file=sys.stderr, flush=True)
+    try:
+        extras.update(bench_tiered_kv(
+            preset, quantize,
+            n_sessions=8 if not on_tpu else 32, rounds=3,
+            new_tokens=16, kv_int8=on_tpu,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] tiered-KV phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # observability overhead pair: histograms + spans + flight recorder on
     # vs off over the same decode workload (§12; PERF.md round 11) — the

@@ -127,6 +127,35 @@ class _BaseCompletionsStep(Step):
             "bytes of KV copy eliminated by page aliasing vs the dense "
             "gather-per-hit design (cumulative)",
         )
+        # tiered KV: host-RAM spill + session hibernation (serving/
+        # pagepool.HostPageTier, docs/SERVING.md §16) — arena occupancy,
+        # spill/restore byte traffic, and the restore-vs-recompute split
+        self._m_host_pages_total = metrics.gauge(
+            "engine_host_pages_total",
+            "host-tier KV arena capacity in pages (0 with the tier off)",
+        )
+        self._m_host_pages = metrics.gauge(
+            "engine_host_pages_in_use",
+            "host-tier arena pages holding hibernated prefix KV",
+        )
+        self._m_spill_bytes = metrics.gauge(
+            "engine_spill_bytes_total",
+            "KV bytes spilled device→host (hibernation), cumulative",
+        )
+        self._m_restore_bytes = metrics.gauge(
+            "engine_restore_bytes_total",
+            "KV bytes restored host→device (session wake), cumulative",
+        )
+        self._m_restored_hits = metrics.gauge(
+            "engine_restored_hits_total",
+            "warm admissions served by a host-tier restore instead of a "
+            "re-prefill, cumulative",
+        )
+        self._m_recompute_fallbacks = metrics.gauge(
+            "engine_recompute_fallbacks_total",
+            "host-tier hits that fell back to recompute (failed/corrupt/"
+            "no-room restore), cumulative",
+        )
         # request lifecycle / fault recovery (serving/engine.py): sourced
         # from the engine's cumulative stats, gauges like the prefix set
         self._m_shed = metrics.gauge(
@@ -241,6 +270,12 @@ class _BaseCompletionsStep(Step):
         self._m_kv_pages.set(stats.get("kv-pages-in-use", 0))
         self._m_kv_alias.set(stats.get("kv-page-alias-rate", 0))
         self._m_prefix_copy_saved.set(stats.get("prefix-copy-bytes-saved-total", 0))
+        self._m_host_pages_total.set(stats.get("host-pages-total", 0))
+        self._m_host_pages.set(stats.get("host-pages-in-use", 0))
+        self._m_spill_bytes.set(stats.get("spill-bytes-total", 0))
+        self._m_restore_bytes.set(stats.get("restore-bytes-total", 0))
+        self._m_restored_hits.set(stats.get("restored-hits-total", 0))
+        self._m_recompute_fallbacks.set(stats.get("recompute-fallbacks-total", 0))
         self._m_shed.set(stats.get("shed-total", 0))
         self._m_deadline.set(stats.get("deadline-exceeded-total", 0))
         self._m_cancelled.set(stats.get("cancelled-total", 0))

@@ -41,6 +41,18 @@ Resource configuration:
     relative to the decode cache; `prefix-cache-entries` overrides the
     row count directly (0 disables the pool entirely). The memory plan
     accounts the pool before warmup.
+  host-kv-fraction: tiered KV (docs/SERVING.md §16; paged layout +
+    prefix-cache only) — sizes a pinned host-RAM page arena relative to
+    the device pool (e.g. 8.0 = 8× the pool in host RAM; 0, the default,
+    disables the tier). Idle published prefixes spill into it off the hot
+    loop (`spill-idle-s`, default 0 = as soon as published) and under HBM
+    pressure LRU eviction DEMOTES to the host copy instead of dropping —
+    a hibernated session's next turn restores its KV at DMA speed instead
+    of re-prefilling. `spill: auto|off` (default auto) is the escape
+    hatch; a restore blocking an admission past `restore-stall-dump-s`
+    (default 1.0) produces a `spill-stall` flight dump. Leader-side host
+    state: construction-disabled under SPMD (an explicit warning, like
+    adapters in round 14).
   speculation: auto | off (default off) → self-speculative decoding
     (serving/speculation.py + engine._verify_chunk): host-side n-gram
     prompt-lookup drafts verified k+1-at-a-time in one device dispatch —
@@ -272,6 +284,17 @@ class _EngineHolder:
             raise ValueError(
                 f"unknown prefix-cache {px!r}; supported: auto, off"
             )
+        spill = self.config.get("spill", "auto")
+        if not isinstance(spill, bool) and str(spill).lower() not in (
+            "auto", "off",
+        ):
+            raise ValueError(f"unknown spill {spill!r}; supported: auto, off")
+        host_kv_fraction = float(self.config.get("host-kv-fraction", 0.0))
+        if host_kv_fraction < 0:
+            raise ValueError(
+                f"host-kv-fraction must be >= 0, got {host_kv_fraction}"
+            )
+        spill_idle_s = float(self.config.get("spill-idle-s", 0.0))
         spec = self.config.get("speculation", "off")
         if not isinstance(spec, bool) and str(spec).lower() not in ("auto", "off"):
             raise ValueError(
@@ -353,6 +376,13 @@ class _EngineHolder:
                 int(self.config["kv-pages"])
                 if self.config.get("kv-pages") is not None
                 else None
+            ),
+            # tiered KV (docs/SERVING.md §16): host-RAM spill + hibernation
+            host_kv_fraction=host_kv_fraction,
+            spill=spill,
+            spill_idle_s=spill_idle_s,
+            restore_stall_dump_s=float(
+                self.config.get("restore-stall-dump-s", 1.0)
             ),
             prefix_cache=px,  # validated at the top of this method
             prefix_cache_fraction=float(

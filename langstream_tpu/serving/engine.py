@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import functools
 import logging
+import math
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -574,6 +576,34 @@ def _page_zero(pool, pages):
     return jax.tree.map(zero, pool)
 
 
+@jax.jit
+def _page_snapshot(pool, src):
+    """Slice ONE physical page (all layers/heads) out of the pool into
+    fresh device buffers — the spill path's decoupling trick: the engine
+    thread dispatches this (async, one traced-index program) and hands the
+    RESULT arrays to the spill worker, so the worker's device→host copy
+    can never race a later donating dispatch that rewrites (or a free that
+    recycles) the page. NOT donated: the pool stays live."""
+
+    def take(a):
+        return lax.dynamic_index_in_dim(a, src, 1, keepdims=False)
+
+    return jax.tree.map(take, pool)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def _page_restore(pool, block, dst):
+    """Upload ONE host-arena page back into physical page ``dst`` — the
+    hibernation restore. Traced index: ONE compiled program regardless of
+    destination; an out-of-bounds ``dst`` drops (warmup). int8 pools
+    upload int8 + scales — half the bytes of bf16, same as the pool."""
+
+    def put(a, b):
+        return a.at[:, dst].set(b.astype(a.dtype), mode="drop")
+
+    return jax.tree.map(put, pool, block)
+
+
 def _make_admit_group(mesh):
     """Factory for the FUSED admission step: local-cache zeros + prefill +
     first-token sample + big-cache insert + every decode-chain scatter in
@@ -867,6 +897,105 @@ class _TokenFetcher:
             handle._event.set()
 
 
+class _Spill:
+    """Handle for one in-flight entry spill (device pages → host arena).
+    Created on the engine thread with the page SNAPSHOTS already
+    dispatched (_page_snapshot — independent buffers, so the entry's
+    device pages may be freed immediately after); the spill worker copies
+    them into the arena slots and stamps checksums. ``cancelled`` is set
+    by the engine (entry dropped/quarantined mid-spill) — the worker
+    still completes its copy, and the completion drain frees the slots
+    instead of attaching them. ``gen`` fences crash recovery: handles
+    from before an engine restart are discarded at drain (the arena was
+    reset; their slots are not ours to free)."""
+
+    __slots__ = ("entry", "slots", "blocks", "gen", "cancelled", "error",
+                 "event")
+
+    def __init__(self, entry, slots: list, blocks: list, gen: int) -> None:
+        self.entry = entry
+        self.slots = slots
+        self.blocks = blocks
+        self.gen = gen
+        self.cancelled = False
+        self.error: Optional[BaseException] = None
+        self.event = threading.Event()
+
+
+class _SpillWorker:
+    """Dedicated spill thread (the round-7 _TokenFetcher pattern): the
+    engine thread only dispatches page snapshots and bookkeeping; the
+    actual device→host transfer + arena write + checksum — the slow,
+    bandwidth-bound part — happens here, strictly off the hot loop. One
+    FIFO queue + one worker; completions flow back through ``done`` and
+    are folded in by the engine at iteration top (_drain_spills)."""
+
+    def __init__(
+        self,
+        tier: Any,
+        done: "queue.SimpleQueue",
+        obs: Optional[EngineObservability] = None,
+    ) -> None:
+        self._tier = tier
+        self._done = done
+        self._obs = obs
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="serving-spill", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        """Quiesce: handles queued before the sentinel complete their
+        copies first, so after a True return no thread touches the arena
+        (crash recovery resets it right after). False — with the thread
+        left registered so ``alive()`` stays truthful — when the worker
+        failed to drain within ``timeout`` (wedged device fetch): the
+        caller must NOT reuse an arena this thread may still write into."""
+        t = self._thread
+        if t is None:
+            return True
+        self._queue.put(None)
+        t.join(timeout=timeout)
+        if t.is_alive():
+            return False
+        self._thread = None
+        return True
+
+    def submit(self, handle: _Spill) -> None:
+        self._queue.put(handle)
+
+    def _run(self) -> None:
+        while True:
+            handle = self._queue.get()
+            if handle is None:
+                return
+            try:
+                t0 = time.monotonic()
+                for block, slot in zip(handle.blocks, handle.slots):
+                    leaves = [
+                        np.asarray(jax.device_get(leaf))
+                        for leaf in jax.tree.leaves(block)
+                    ]
+                    self._tier.write(slot, leaves)
+                if self._obs is not None and self._obs.on:
+                    self._obs.record("engine_spill_s", time.monotonic() - t0)
+            except BaseException as e:  # noqa: BLE001 — surfaced at drain
+                handle.error = e
+            handle.blocks = None  # release the snapshot device buffers
+            self._done.put(handle)
+            handle.event.set()
+
+
 def _make_insert_group():
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def insert_group(cache, local_cache, slots):
@@ -916,6 +1045,10 @@ class ServingEngine:
         kv_layout: str = "paged",
         page_size: int = 64,
         kv_pages: Optional[int] = None,
+        host_kv_fraction: float = 0.0,
+        spill: Any = "auto",
+        spill_idle_s: float = 0.0,
+        restore_stall_dump_s: float = 1.0,
         prefix_cache: Any = False,
         prefix_cache_fraction: float = 0.25,
         prefix_cache_entries: Optional[int] = None,
@@ -995,6 +1128,54 @@ class ServingEngine:
         self._page_deferred: list[GenerationRequest] = []
         # physical pages to zero on the next iteration (quarantine)
         self._pending_page_zero: list[int] = []
+        # -- tiered KV: host-RAM spill + session hibernation (ROADMAP 3) -----
+        # host-kv-fraction sizes a pinned host arena RELATIVE to the device
+        # pool (2.0 = twice the pool's pages in host RAM; host RAM is ~10×
+        # HBM per host, so large values are the point). 0 disables the tier.
+        if str(spill).lower() not in ("auto", "on", "true", "1", "off",
+                                      "false", "0"):
+            raise ValueError(f"unknown spill {spill!r}; supported: auto, off")
+        spill_off = str(spill).lower() in ("off", "false", "0")
+        self.host_kv_fraction = max(0.0, float(host_kv_fraction))
+        self.spill_idle_s = max(0.0, float(spill_idle_s))
+        self._restore_stall_s = max(0.0, float(restore_stall_dump_s))
+        spill_on = (
+            self._paged and not spill_off and self.host_kv_fraction > 0
+        )
+        if spmd is not None and spill_on:
+            # spill/demote/restore decisions are leader-side host state
+            # (arena free list, checksums, idle clocks) and the restore
+            # upload is a device dispatch followers would need to replay —
+            # neither rides the wire yet. Explicit, LOUD disable (the
+            # round-14 adapters precedent): host-kv-fraction > 0 is an
+            # explicit ask, so this is a WARNING, not a silent downgrade.
+            log.warning(
+                "tiered KV host spill is not on the SPMD wire yet; off on "
+                "this multi-host replica (host-kv-fraction %.2f ignored)",
+                self.host_kv_fraction,
+            )
+            spill_on = False
+        self._spill_on = spill_on
+        self._host_tier = None
+        self._spill_worker: Optional[_SpillWorker] = None
+        self._spill_done: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._spill_gen = 0
+        # device-only entries awaiting hibernation, oldest first (engine
+        # thread only); entries join at publish/restore time
+        self._spill_candidates: deque = deque()
+        # cumulative tier accounting (engine thread writes, stats() reads)
+        self.spill_pages_total = 0
+        self.spill_bytes_total = 0
+        self.spill_failures_total = 0
+        self.restore_pages_total = 0
+        self.restore_bytes_total = 0
+        self.restored_hits_total = 0
+        self.restore_failures_total = 0
+        self.recompute_fallbacks_total = 0
+        # host-ms spent on spill/restore bookkeeping this iteration (flight
+        # recorder phase_ms; reset at iteration top)
+        self._spill_ms_iter = 0.0
+        self._restore_ms_iter = 0.0
         if not self._paged:
             self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
             if mesh is not None:
@@ -1420,6 +1601,16 @@ class ServingEngine:
             quantized = any(
                 leaf.dtype == jnp.int8 for leaf in jax.tree.leaves(params)
             )
+            if self._spill_on and prefix_index_entries <= 0:
+                # nothing to hibernate without the alias index: spilled
+                # pages are only reachable through prefix entries. Decided
+                # BEFORE the plan below so the startup log never claims
+                # host arena RAM that is never allocated
+                log.warning(
+                    "tiered KV host spill needs the prefix index "
+                    "(prefix-cache on, prefix-cache-entries > 0); off"
+                )
+                self._spill_on = False
             plan = plan_serving_memory(
                 config, max_batch, self.max_seq_len, quantized_weights=quantized,
                 prefill_batch=self.prefill_batch,
@@ -1432,6 +1623,9 @@ class ServingEngine:
                 page_size=self.page_size,
                 kv_pages=self._kv_pages,
                 page_fraction=self._page_fraction,
+                host_kv_fraction=(
+                    self.host_kv_fraction if self._spill_on else 0.0
+                ),
                 adapter_pool_rows=adapter_rows_cap,
                 adapter_rank=adapter_rank_eff,
                 grammar_slots=(
@@ -1483,6 +1677,28 @@ class ServingEngine:
                 self._prefix_index = PrefixPageIndex(
                     self.prefill_buckets, max_entries=prefix_index_entries
                 )
+            if self._spill_on:
+                from langstream_tpu.serving.pagepool import HostPageTier
+
+                host_pages = max(
+                    1, math.ceil(self._kv_pages * self.host_kv_fraction)
+                )
+                self._host_tier = HostPageTier(self._pagepool.dev, host_pages)
+                self._prefix_index.host_tier = self._host_tier
+                # hibernation capacity is governed by the arena alone: the
+                # index's entry cap counts (and cap-evicts) only
+                # DEVICE-resident entries, so idle hibernated sessions are
+                # never dropped to make room for a publish
+                self._spill_worker = _SpillWorker(
+                    self._host_tier, self._spill_done, self._obs
+                )
+                log.info(
+                    "tiered KV host arena: %d host pages (%.2f GiB RAM, "
+                    "%.2fx the device pool) — idle prefixes spill after "
+                    "%.1fs, LRU eviction demotes before dropping",
+                    host_pages, self._host_tier.bytes_total / 1024**3,
+                    self.host_kv_fraction, self.spill_idle_s,
+                )
 
     # -- public API ---------------------------------------------------------
 
@@ -1492,6 +1708,8 @@ class ServingEngine:
         self._dead = None
         self._stop.clear()
         self._fetcher.start()
+        if self._spill_worker is not None:
+            self._spill_worker.start()
         self._thread = threading.Thread(target=self._run, name="serving-engine", daemon=True)
         self._thread.start()
 
@@ -1501,6 +1719,8 @@ class ServingEngine:
             self._thread.join(timeout=30)
             self._thread = None
         self._fetcher.stop()
+        if self._spill_worker is not None:
+            self._spill_worker.stop()
         # resolve everything still in flight so blocked callers return now
         self._fail_all(RuntimeError("serving engine stopped"))
 
@@ -1680,12 +1900,15 @@ class ServingEngine:
 
     def prefix_advertisement(
         self, top_k: int = 32,
-    ) -> tuple[tuple[int, ...], list[tuple[str, int]]]:
+    ) -> tuple[tuple[int, ...], list[tuple[str, int, str]]]:
         """The fleet beacon's affinity payload: the prefix index's bucket
         boundaries plus its most-recently-used ``top_k`` prefixes as
-        ``(digest, length)`` pairs (serving/fleet.py). Non-mutating and
-        thread-safe — beacon building runs on the runtime HTTP thread and
-        must neither touch LRU recency nor leak token content."""
+        ``(digest, length, tier)`` triples (serving/fleet.py). ``tier``
+        splits device-resident from hibernated (host-tier) sessions so
+        sticky routing survives a spill — the router scores ``host`` at a
+        discount. Non-mutating and thread-safe — beacon building runs on
+        the runtime HTTP thread and must neither touch LRU recency nor
+        leak token content."""
         index = self._prefix_index if self._prefix_index is not None else self._prefix_pool
         if index is None:
             return (), []
@@ -1813,6 +2036,37 @@ class ServingEngine:
                 self._prefix_pool.live_entries
                 if self._prefix_pool
                 else self._prefix_index.live_entries if self._prefix_index else 0
+            ),
+            # tiered KV: host-RAM spill + session hibernation (zeros with
+            # the tier off, so the metrics exporter sets its gauges
+            # unconditionally — the standing contract of every block here)
+            "host-tier": self._host_tier is not None,
+            "host-pages-total": (
+                self._host_tier.num_pages if self._host_tier else 0
+            ),
+            "host-pages-in-use": (
+                self._host_tier.slots_in_use if self._host_tier else 0
+            ),
+            "host-tier-bytes-total": (
+                self._host_tier.bytes_total if self._host_tier else 0
+            ),
+            "spill-pages-total": self.spill_pages_total,
+            "spill-bytes-total": self.spill_bytes_total,
+            "spill-failures-total": self.spill_failures_total,
+            "restore-pages-total": self.restore_pages_total,
+            "restore-bytes-total": self.restore_bytes_total,
+            # the restore-vs-recompute hit split: a warm hit whose pages
+            # lived host-side either restored (DMA) or fell back to a
+            # re-prefill (fault/checksum/no-room) — the ratio is THE
+            # health gauge of the tier
+            "restored-hits-total": self.restored_hits_total,
+            "restore-failures-total": self.restore_failures_total,
+            "recompute-fallbacks-total": self.recompute_fallbacks_total,
+            "host-demotions-total": (
+                self._prefix_index.demotions if self._prefix_index else 0
+            ),
+            "host-evictions-total": (
+                self._prefix_index.host_evictions if self._prefix_index else 0
             ),
             # self-speculative decoding (zeros with speculation off, so the
             # metrics exporter sets its gauges unconditionally)
@@ -2085,6 +2339,17 @@ class ServingEngine:
         pool.dev = _page_zero(
             pool.dev, jnp.asarray(np.full(pool.table_len, pool.oob, np.int32))
         )
+        if self._spill_on:
+            # the tiered-KV pair: snapshot (spill's device-side slice) and
+            # restore (the ONE traced-index upload an admission dispatches
+            # per hibernated page) — warmed so the FIRST restore is DMA,
+            # not DMA + compile. Restore targets the OOB sentinel: drops.
+            self._record_program("page-snapshot")
+            snap = _page_snapshot(pool.dev, jnp.asarray(0, jnp.int32))
+            self._record_program("page-restore")
+            pool.dev = _page_restore(
+                pool.dev, snap, jnp.asarray(pool.oob, jnp.int32)
+            )
         jax.block_until_ready(jax.tree.leaves(pool.dev)[0])
         log.info(
             "paged programs precompiled: ONE %s program (chunk %d), %d "
@@ -2428,6 +2693,47 @@ class ServingEngine:
             # whose pages they tracked were just failed above). Queued and
             # page-deferred admissions keep their backlog spots.
             self._pending_page_zero.clear()
+            # tiered KV: quiesce the spill worker BEFORE resetting the
+            # arena (stop() completes in-flight copies first, so no thread
+            # writes a slot the fresh free list is about to re-issue);
+            # stale done-handles are fenced off by the generation bump
+            spill_quiesced = True
+            if self._spill_worker is not None:
+                spill_quiesced = self._spill_worker.stop()
+            self._spill_gen += 1
+            self._spill_candidates.clear()
+            while True:
+                try:
+                    self._spill_done.get_nowait()
+                except queue.Empty:
+                    break
+            if self._host_tier is not None:
+                if spill_quiesced:
+                    self._host_tier.reset()
+                else:
+                    # the worker is wedged past the join timeout (hung
+                    # device fetch — the very failure mode recovery
+                    # handles) and may still write into whatever arena it
+                    # holds a reference to. Resetting THAT arena would let
+                    # the late write land in a slot the fresh free list
+                    # re-issued, with a valid checksum: silent wrong KV at
+                    # a later restore. Abandon arena AND worker — the
+                    # straggler's writes land in orphaned memory
+                    log.error(
+                        "spill worker failed to quiesce — abandoning the "
+                        "host arena (%.2f GiB) and spawning a fresh one",
+                        self._host_tier.bytes_total / 1024**3,
+                    )
+                    from langstream_tpu.serving.pagepool import HostPageTier
+
+                    self._host_tier = HostPageTier(
+                        self._pagepool.dev, self._host_tier.num_pages
+                    )
+                    if self._prefix_index is not None:
+                        self._prefix_index.host_tier = self._host_tier
+                    self._spill_worker = _SpillWorker(
+                        self._host_tier, self._spill_done, self._obs
+                    )
             self._pagepool.reset()
             if self.mesh is not None:
                 from langstream_tpu.parallel.sharding import shard_page_pool
@@ -2437,6 +2743,8 @@ class ServingEngine:
                 )
             if self._prefix_index is not None:
                 self._prefix_index.reset()
+            if self._spill_worker is not None:
+                self._spill_worker.start()
         else:
             self._cache = make_kv_cache(
                 self.config, self.max_batch, self.max_seq_len
@@ -2474,6 +2782,13 @@ class ServingEngine:
             self._flush_row_resets()
         if self._pending_page_zero:
             self._flush_page_zeros()
+        # tiered KV: fold completed spills in and start hibernation spills
+        # for idle prefixes — bounded per iteration, O(1) when idle; the
+        # restore half runs inside admission (_paged_bind) where it gates
+        self._spill_ms_iter = 0.0
+        self._restore_ms_iter = 0.0
+        if self._spill_on:
+            self._spill_tick()
         self._sweep_waiting()
         t_sweep = time.monotonic() if obs_on else 0.0
         # chunks dispatched in previous iterations are still unfetched when
@@ -2603,6 +2918,11 @@ class ServingEngine:
                 "kv_pages": (
                     self._pagepool.pages_in_use if self._pagepool else 0
                 ),
+                # host-tier occupancy (tiered KV): arena slots holding
+                # hibernated prefix pages; 0 with the tier off
+                "host_pages": (
+                    self._host_tier.slots_in_use if self._host_tier else 0
+                ),
                 "programs": len(self._programs),
                 "injector": (
                     dict(self._injector.fired)
@@ -2614,6 +2934,11 @@ class ServingEngine:
                     "prefill": round((t_prefill - t_sweep) * 1e3, 3),
                     "dispatch": round((t_dispatch - t_prefill) * 1e3, 3),
                     "process": round((t_end - t_dispatch) * 1e3, 3),
+                    # spill = this iteration's hibernation bookkeeping
+                    # (snapshot dispatch + drain); restore = host→device
+                    # upload time inside admissions. Both host-wall ms.
+                    "spill": round(self._spill_ms_iter, 3),
+                    "restore": round(self._restore_ms_iter, 3),
                 },
             })
 
@@ -3604,9 +3929,39 @@ class ServingEngine:
         hit = None
         if index is not None and not getattr(request.options, "adapter", None):
             # adapter tenants never alias the shared base-prefix pages —
-            # their prompt KV includes the wk/wv adapter deltas
-            for cand in index.candidates(prompt):
-                hit = cand  # ascending: the deepest usable prefix wins
+            # their prompt KV includes the wk/wv adapter deltas. Deepest
+            # usable candidate wins; a HIBERNATED candidate (host tier,
+            # no device pages) is restored in place — the whole point of
+            # the tier: a radix hit on a spilled session is a DMA upload,
+            # not a miss. A failed restore (checksum/fault/no room) falls
+            # back to the next-shallower candidate, then to recompute.
+            failed_restores = 0
+            counted = getattr(request, "_tier_fallback_counted", False)
+            for p_cand, cand in reversed(index.candidates(prompt)):
+                if cand.dropped:
+                    # a deeper candidate's _restore_entry can evict_for a
+                    # SHALLOWER candidate out of this already-materialized
+                    # list — the dropped entry is stale, not a hit
+                    continue
+                if cand.pages:
+                    hit = (p_cand, cand)
+                    break
+                if self._restore_entry(
+                    cand, p_cand, count_failures=not counted
+                ):
+                    hit = (p_cand, cand)
+                    request._tier_restored = True
+                    break
+                failed_restores += 1
+            if failed_restores:
+                # failure gauges count once per REQUEST: a page-deferred
+                # request re-runs this loop every engine iteration, and a
+                # full-pool stall must not read as thousands of failed
+                # restores. The recompute-fallback side of the health
+                # gauge is decided at BIND time below — a deferral is not
+                # a cold ending (its retry may restore and must not land
+                # on both sides of the restore-vs-recompute split)
+                request._tier_fallback_counted = True
         shared: tuple[int, ...] = ()
         cow_src = None
         p, entry = 0, None
@@ -3620,10 +3975,38 @@ class ServingEngine:
         try:
             want_fresh = need - len(shared)
             if pool.free_pages < want_fresh and index is not None:
-                index.evict_for(pool, want_fresh)
+                # tiered KV: victims DEMOTE to their host copy when one is
+                # secured (spill_cb) — the device pool is a cache over the
+                # host tier, and eviction stops costing re-prefills
+                index.evict_for(
+                    pool, want_fresh,
+                    spill_cb=self._ensure_spilled if self._spill_on else None,
+                )
             cow_dst = pool.reserve(idx, need, shared)
             if cow_dst is None:
                 return None
+            # the restore-vs-recompute health gauge is decided HERE, at
+            # bind time, once per request and on exactly one side: a
+            # deferral is neither outcome (its retry decides), and a
+            # full-pool restore/demote cycle across retries must not
+            # count one admission as several restores
+            if (
+                hit is not None
+                and getattr(request, "_tier_restored", False)
+                and not getattr(request, "_tier_restored_counted", False)
+            ):
+                self.restored_hits_total += 1
+                request._tier_restored_counted = True
+            elif (
+                hit is None
+                and getattr(request, "_tier_fallback_counted", False)
+                and not getattr(request, "_tier_recompute_counted", False)
+            ):
+                # binds COLD after ≥1 failed restore: a recompute
+                # fallback — a shallower device-resident candidate
+                # serving the hit warm is not one
+                self.recompute_fallbacks_total += 1
+                request._tier_recompute_counted = True
             if self._spmd is not None:
                 # the reservation RESULT rides the wire: followers bind the
                 # same physical pages to the same slot table (aliased
@@ -3940,6 +4323,252 @@ class ServingEngine:
         self._record_program("page-zero")
         pool.dev = _page_zero(pool.dev, jnp.asarray(buf))
 
+    # -- tiered KV: host-RAM spill + hibernation restore ---------------------
+
+    def _drain_spills(self) -> None:
+        """Fold completed spills in (engine thread, iteration top): attach
+        the arena slots to their entry — or free them when the entry died
+        mid-copy (cancelled/quarantined), the copy failed, or the handle
+        predates a crash recovery (stale generation: the arena was already
+        reset; its free list owns those slots again)."""
+        tier = self._host_tier
+        if tier is None:
+            return
+        while True:
+            try:
+                handle = self._spill_done.get_nowait()
+            except queue.Empty:
+                return
+            if handle.gen != self._spill_gen:
+                continue
+            entry = handle.entry
+            if handle.cancelled or entry.dropped:
+                tier.free(handle.slots)
+                continue
+            entry.spilling = None
+            if handle.error is not None:
+                log.warning("page spill failed: %s", handle.error)
+                tier.free(handle.slots)
+                self.spill_failures_total += 1
+                if not entry.pages:
+                    # the entry was DEMOTED on the strength of this spill
+                    # (evict_for trusts an in-flight handle): with the copy
+                    # failed it holds neither device nor host pages — a
+                    # zombie a later radix hit would "restore" with zero
+                    # pages. Drop it; the session re-prefills next turn.
+                    self._prefix_index._drop(self._pagepool, entry)
+                continue
+            entry.host = tuple(handle.slots)
+            self._prefix_index._note_tier(entry)
+            self.spill_pages_total += len(handle.slots)
+            self.spill_bytes_total += len(handle.slots) * tier.bytes_per_page
+
+    def _ensure_spilled(self, entry) -> bool:
+        """Secure a host copy for ``entry`` (the demote-before-drop gate):
+        True when one exists, is in flight, or was enqueued just now. The
+        engine thread only dispatches the per-page snapshot program (async,
+        independent buffers — the entry's device pages may be freed the
+        moment this returns); the device→host bytes move on the spill
+        worker, off the hot loop."""
+        if not self._spill_on or self._spill_worker is None:
+            return False
+        if entry.host or entry.spilling is not None:
+            return True
+        if not entry.pages or entry.dropped:
+            return False
+        tier = self._host_tier
+        slots = tier.alloc(len(entry.pages))
+        if slots is None:
+            self._evict_host_for(len(entry.pages), keep=entry)
+            slots = tier.alloc(len(entry.pages))
+            if slots is None:
+                return False
+        pool = self._pagepool
+        self._record_program("page-snapshot")
+        blocks = [
+            _page_snapshot(pool.dev, jnp.asarray(p, jnp.int32))
+            for p in entry.pages
+        ]
+        handle = _Spill(entry, slots, blocks, self._spill_gen)
+        entry.spilling = handle
+        self._spill_worker.submit(handle)
+        return True
+
+    def _evict_host_for(self, need: int, keep=None) -> None:
+        """Make arena room: free host copies LRU-first (a ``both`` victim
+        just loses its spare; a ``host``-only victim is dropped outright —
+        its session will re-prefill). Never touches ``keep`` (the entry
+        we're making room FOR) or pinned entries."""
+        index, tier = self._prefix_index, self._host_tier
+        while tier.free_slots < need:
+            victims = [
+                e for e in index._live
+                if e.host and e.pins == 0 and e is not keep
+            ]
+            if not victims:
+                return
+            victim = min(victims, key=lambda e: e.last_used)
+            if victim.pages:
+                tier.free(victim.host)
+                victim.host = ()
+                index._note_tier(victim)
+                # the entry reverted to device-only: make it a spill
+                # candidate again so the idle sweep can re-hibernate it
+                # once the arena has room (duplicates in the deque are
+                # benign — the sweep's host/spilling checks skip them)
+                self._spill_candidates.append(victim)
+            else:
+                index._drop(self._pagepool, victim)
+            index.host_evictions += 1
+
+    def _spill_tick(self) -> None:
+        """Hibernation sweep, once per engine iteration: drain completed
+        spills, then start at most a couple of new ones for entries idle
+        past ``spill_idle_s`` (oldest first). O(1) when there is nothing
+        to do — the hot loop's cost is one deque truthiness check."""
+        if not self._spill_on:
+            return
+        t0 = time.monotonic()
+        self._drain_spills()
+        started = 0
+        now = time.monotonic()
+        # the deque is PUBLISH-ordered, not idle-ordered (last_used_t is
+        # refreshed on every hit): a hot entry at the front must not starve
+        # idle entries behind it, so not-yet-idle candidates ROTATE to the
+        # back and the scan is bounded per tick — the hot loop does at
+        # most 8 deque hops
+        scanned, limit = 0, min(len(self._spill_candidates), 8)
+        while self._spill_candidates and started < 2 and scanned < limit:
+            scanned += 1
+            entry = self._spill_candidates.popleft()
+            if entry.dropped or entry.host or entry.spilling is not None:
+                continue
+            if now - entry.last_used_t < self.spill_idle_s:
+                self._spill_candidates.append(entry)  # not idle: revisit
+                continue
+            if self._ensure_spilled(entry):
+                started += 1
+            else:
+                # arena full and unevictable THIS tick: rotate to the
+                # back and retry on a later sweep — a live session's
+                # prefix never re-publishes, so forgetting the candidate
+                # would leave it pinning HBM through its whole idle
+                # period. Stop the sweep: every further candidate hits
+                # the same full arena this tick
+                self._spill_candidates.append(entry)
+                break
+        self._spill_ms_iter += (time.monotonic() - t0) * 1e3
+
+    def _restore_entry(
+        self, entry, p: int, count_failures: bool = True,
+    ) -> bool:
+        """Hibernation restore (the admission's warm-hit path when the
+        radix hit lives host-side): allocate device pages, upload the
+        arena copy with the ONE warmed traced-index program, and re-attach
+        them to the entry. False — with the entry either intact (no device
+        room: caller falls back) or dropped (checksum mismatch / injected
+        ``spill`` fault / spill never completed: poison must not be
+        retried) — when the restore cannot serve the hit; the caller
+        recomputes. Synchronous on the engine thread: the admission needs
+        the pages before its suffix prefill, and the upload IS the win
+        (DMA speed vs re-prefill FLOPs). ``count_failures=False`` keeps a
+        page-deferred request's per-iteration retries off the failure
+        gauges (each request counts its failures once)."""
+        pool, index, tier = self._pagepool, self._prefix_index, self._host_tier
+        if entry.dropped:
+            return False
+        fail = 1 if count_failures else 0
+        t0 = time.monotonic()
+        handle = entry.spilling
+        if handle is not None:
+            # hit raced the copy: give it a short grace (the common case
+            # is a near-drained handle) bounded by the SAME threshold the
+            # feature treats as a stall incident — this wait blocks every
+            # active session's decode. On expiry fall back WITHOUT
+            # dropping: the copy is healthy, merely queued behind other
+            # handles; it completes off-thread and the next turn restores
+            if not handle.event.wait(self._restore_stall_s):
+                self.restore_failures_total += fail
+                self._flight_dump("spill-stall", extra={
+                    "restore-wait-ms": round((time.monotonic() - t0) * 1e3, 3),
+                    "reuse-tokens": p,
+                })
+                return False
+            self._drain_spills()
+            if not entry.host or entry.dropped:
+                self.restore_failures_total += fail
+                if not entry.dropped:
+                    index._drop(pool, entry)
+                return False
+        n = len(entry.host)
+        if n == 0:
+            # belt to _drain_spills' braces: an entry with neither device
+            # nor host pages can't serve anything — a zero-page "restore"
+            # would count a warm hit whose prefix KV was never written
+            self.restore_failures_total += fail
+            index._drop(pool, entry)
+            return False
+        # PIN across the eviction window below: evict_for's spill_cb can
+        # cascade into _evict_host_for, whose LRU victim scan would
+        # otherwise pick THIS entry (host-only and idle — the natural
+        # minimum) and drop it out from under the restore
+        index.acquire(entry)
+        try:
+            if pool.free_pages < n:
+                index.evict_for(pool, n, spill_cb=self._ensure_spilled)
+            pages = pool.alloc_pages(n)
+        finally:
+            index.release(entry)
+        if pages is None:
+            # no device room even after demotions — entry stays hibernated,
+            # the admission recomputes (or defers on its own reservation)
+            self.restore_failures_total += fail
+            return False
+        if entry.dropped or len(entry.host) != n:
+            # paranoia (python -O strips the attach assertion): the entry
+            # must still own exactly the arena slots we sized against
+            pool.decref(pages)
+            self.restore_failures_total += fail
+            if not entry.dropped:
+                index._drop(pool, entry)
+            return False
+        if self._injector is not None:
+            self._injector.corrupt_host_page(tier, entry.host)
+        ok = True
+        self._record_program("page-restore")
+        for slot, dst in zip(entry.host, pages):
+            block = tier.read(slot)
+            if block is None:
+                ok = False  # checksum mismatch: host copy is poison
+                break
+            pool.dev = _page_restore(pool.dev, block, jnp.asarray(dst, jnp.int32))
+        if not ok:
+            pool.decref(pages)
+            index._drop(pool, entry)  # frees the arena slots too
+            self.restore_failures_total += fail
+            log.warning(
+                "host-tier restore failed checksum (%d pages) — falling "
+                "back to re-prefill", n,
+            )
+            return False
+        index.attach_device_pages(pool, entry, pages)
+        self.restore_pages_total += n
+        self.restore_bytes_total += n * tier.bytes_per_page
+        took = time.monotonic() - t0
+        self._restore_ms_iter += took * 1e3
+        if self._obs.on:
+            self._obs.record("engine_restore_s", took)
+        if took > self._restore_stall_s:
+            # a restore that stalls an admission past the bound is an
+            # incident worth a postmortem ring (slow host RAM? checksum
+            # thrash? arena contention?) — same debounce as every reason
+            self._flight_dump("spill-stall", extra={
+                "restore-ms": round(took * 1e3, 3),
+                "restore-pages": n,
+                "reuse-tokens": p,
+            })
+        return True
+
     def _spec_admit(self, idx: int, prompt: list[int]) -> None:
         """Create the slot's draft index at admission, seeded with the
         prompt (prompt-lookup: the prompt is where repeated spans live).
@@ -3990,7 +4619,13 @@ class ServingEngine:
             if len(owned) < n:
                 return  # reservation narrower than the boundary (can't
                 # happen for a prompt that reached p; guard anyway)
-            index.insert(pool, prompt, p, tuple(owned[:n]))
+            entry = index.insert(pool, prompt, p, tuple(owned[:n]))
+            if entry is not None and self._spill_on:
+                # hibernation candidate: once idle past spill-idle-s the
+                # sweep spills its pages host-side (published prefix pages
+                # are stable — positions only grow — so the copy is valid
+                # even while the publisher keeps decoding)
+                self._spill_candidates.append(entry)
             return
         pool = self._prefix_pool
         if pool is None:

@@ -27,6 +27,11 @@ Sites (where the engine asks ``fires(site)``):
             compares the row against its authoritative copy before every
             decode/verify dispatch and must quarantine ONLY the victim
             while every survivor stays token-exact
+  spill     corrupt one host-arena page of the entry a hibernation restore
+            is about to upload (tiered KV, serving/pagepool.HostPageTier:
+            host-RAM-rot drill) — the arena checksum must catch it and the
+            victim admission must fall back to a cold re-prefill, token-
+            exact, while survivors and the free lists stay untouched
   fetch     stall the device→host fetch thread (slow-tunnel simulation)
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
@@ -61,7 +66,7 @@ log = logging.getLogger(__name__)
 
 SITES = (
     "prefill", "segment", "decode", "nan", "verify", "page", "adapter",
-    "fetch", "client",
+    "spill", "fetch", "client",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;
@@ -261,6 +266,20 @@ class FaultInjector:
             victim = snapshot[self._rng.randrange(len(snapshot))][0]
             # point the slot's first mapped entry somewhere else entirely
             pool.tables[victim, 0] = (pool.tables[victim, 0] + 1) % pool.num_pages
+        return victim
+
+    def corrupt_host_page(self, tier, slots):
+        """``spill`` site: flip one byte of one arena slot the restore is
+        about to read (drawn from the seeded RNG over the entry's slots) —
+        the host-memory-rot drill for the tiered-KV path. The tier's
+        checksum verification must catch it and the engine must degrade
+        the hit to a cold re-prefill, never serve the poisoned KV.
+        Returns the corrupted slot or None."""
+        if tier is None or not slots or not self.fires("spill"):
+            return None
+        with self._lock:
+            victim = slots[self._rng.randrange(len(slots))]
+        tier.corrupt(victim)
         return victim
 
     def events_snapshot(self) -> list[dict]:

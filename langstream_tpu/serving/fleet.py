@@ -145,7 +145,17 @@ def beacon_from_engine(
         "ttft_p50_ms": round(float(ttft.get("p50", 0.0)) * 1e3, 3),
         "ttft_p99_ms": round(float(ttft.get("p99", 0.0)) * 1e3, 3),
         "boundaries": [int(b) for b in boundaries],
-        "prefixes": [[d, int(n)] for d, n in prefixes],
+        # device-resident prefixes vs hibernated ones (tiered KV, §16):
+        # a spilled session's digest keeps advertising so sticky routing
+        # survives hibernation — the router scores it at a discount (the
+        # restore is cheap but not free). Advertisement triples may come
+        # from the dense pool too, where everything is device-resident.
+        "prefixes": [
+            [d, int(n)] for d, n, tier in prefixes if tier != "host"
+        ],
+        "spilled_prefixes": [
+            [d, int(n)] for d, n, tier in prefixes if tier == "host"
+        ],
         # resident LoRA adapters (NAMES only, never factors): the router's
         # adapter-affinity signal — landing a tenant's request on a replica
         # already holding its adapter skips a hot-swap dispatch (§15)
@@ -174,14 +184,17 @@ def validate_beacon(doc: dict[str, Any]) -> bool:
     ):
         if key not in doc:
             raise ValueError(f"beacon missing field {key!r}")
-    for j, pair in enumerate(doc["prefixes"]):
-        if (
-            not isinstance(pair, (list, tuple))
-            or len(pair) != 2
-            or not isinstance(pair[0], str)
-            or not isinstance(pair[1], int)
-        ):
-            raise ValueError(f"prefix advertisement {j} is not [digest, length]")
+    for key in ("prefixes", "spilled_prefixes"):
+        for j, pair in enumerate(doc.get(key) or []):
+            if (
+                not isinstance(pair, (list, tuple))
+                or len(pair) != 2
+                or not isinstance(pair[0], str)
+                or not isinstance(pair[1], int)
+            ):
+                raise ValueError(
+                    f"{key} advertisement {j} is not [digest, length]"
+                )
     for j, name in enumerate(doc.get("adapters") or []):
         if not isinstance(name, str):
             raise ValueError(f"adapter advertisement {j} is not a name string")
@@ -450,6 +463,9 @@ class _ReplicaState:
     beacon_at: float = -1e18  # monotonic of last SUCCESSFUL refresh
     failed_at: float = -1e18  # monotonic of last mark_failed
     digests: dict[str, int] = field(default_factory=dict)  # digest → length
+    # hibernated (host-tier) prefix digests: the session's KV survives on
+    # the replica but needs a restore — scored at spill_discount
+    spilled_digests: dict[str, int] = field(default_factory=dict)
     adapters: frozenset = frozenset()  # resident LoRA adapter names
 
 
@@ -486,6 +502,7 @@ class FleetRouter:
         fail_cooldown_s: float = 5.0,
         shed_queue_wait_s: float = 30.0,
         adapter_affinity_tokens: float = 512.0,
+        spill_discount: float = 0.5,
     ) -> None:
         if policy not in self.POLICIES:
             raise ValueError(
@@ -505,6 +522,12 @@ class FleetRouter:
         # warm prefix tokens (a hot-swap dispatch ≈ re-prefilling that
         # much prompt on the engines measured; tune alongside λ — §15)
         self.adapter_affinity_tokens = float(adapter_affinity_tokens)
+        # a HIBERNATED prefix match (the owner spilled the session's pages
+        # to host RAM) is worth this fraction of a device-resident match:
+        # the restore is a DMA upload, cheaper than re-prefilling but not
+        # free — and it says nothing about the replica being otherwise
+        # idle. 0 ignores spilled advertisements; 1 scores them at par.
+        self.spill_discount = min(1.0, max(0.0, float(spill_discount)))
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -553,6 +576,10 @@ class FleetRouter:
                 state.beacon_at = time.monotonic()
                 state.digests = {
                     d: int(n) for d, n in (beacon.get("prefixes") or [])
+                }
+                state.spilled_digests = {
+                    d: int(n)
+                    for d, n in (beacon.get("spilled_prefixes") or [])
                 }
                 state.adapters = frozenset(
                     str(a) for a in (beacon.get("adapters") or [])
@@ -719,11 +746,13 @@ class FleetRouter:
                 self.routed_balanced_total += 1
                 return self._decide(state, "balanced", 0, session_id, now)
             # affinity scoring: hash the prompt once per advertised length
+            # (device-resident AND hibernated advertisements both probe)
             lengths = sorted(
                 {
                     n
                     for s in live
-                    for n in s.digests.values()
+                    for src in (s.digests, s.spilled_digests)
+                    for n in src.values()
                     if n <= len(tokens) - 1
                 }
             )
@@ -731,18 +760,29 @@ class FleetRouter:
             best, best_score, best_match = None, None, 0
             best_adapter_hit = False
             for s in live:
-                match = 0
+                match, spilled_match = 0, 0
                 for n in lengths:
                     if s.digests.get(probe[n]) == n and n > match:
                         match = n
+                    if (
+                        s.spilled_digests.get(probe[n]) == n
+                        and n > spilled_match
+                    ):
+                        spilled_match = n
+                # a hibernated session's KV still lives on its owner — a
+                # restore beats a cold re-prefill anywhere else, so the
+                # spilled match competes, discounted (tiered KV, §16)
+                effective = max(
+                    match, int(spilled_match * self.spill_discount)
+                )
                 adapter_hit = bool(adapter) and adapter in s.adapters
                 score = (
-                    match
+                    effective
                     + (self.adapter_affinity_tokens if adapter_hit else 0.0)
                     - self.lam * self._load(s.beacon)
                 )
                 if best_score is None or score > best_score:
-                    best, best_score, best_match = s, score, match
+                    best, best_score, best_match = s, score, effective
                     best_adapter_hit = adapter_hit
             assert best is not None
             if best_adapter_hit:

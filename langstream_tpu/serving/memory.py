@@ -83,6 +83,13 @@ class ServingMemoryPlan:
     # at a 256k vocab the defaults cost ~0.7GiB, which is exactly why it
     # is a PLAN term and not a surprise (docs/SERVING.md §15 sizing).
     grammar_pool_bytes: int = 0
+    # tiered KV host arena (serving/pagepool.HostPageTier): pinned HOST
+    # RAM, not HBM — deliberately excluded from total_bytes (which is the
+    # HBM number an over-committed config dies on). Sized by the
+    # `host-kv-fraction` knob relative to the device pool; it appears in
+    # the plan so the startup log is honest about the process RSS a
+    # million-hibernated-sessions config will claim (docs/SERVING.md §16).
+    host_spill_bytes: int = 0
     # self-speculative verify chunk (engine._verify_chunk): the multi-token
     # forward materializes fp32 logits for ALL k+1 positions of every slot
     # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
@@ -139,6 +146,11 @@ class ServingMemoryPlan:
     def summary(self) -> str:
         gib = 1024**3
         if self.page_pool_bytes:
+            host = (
+                f" [+ host KV tier {self.host_spill_bytes / gib:.2f}GiB RAM]"
+                if self.host_spill_bytes
+                else ""
+            )
             return (
                 f"weights {self.weights_bytes / gib:.2f}GiB + "
                 f"page-pool {self.page_pool_bytes / gib:.2f}GiB "
@@ -147,7 +159,7 @@ class ServingMemoryPlan:
                 f"verify-chunk {self.verify_chunk_bytes / gib:.2f}GiB + "
                 f"{self._agentic_summary()}"
                 f"workspace {self.workspace_bytes / gib:.2f}GiB = "
-                f"{self.total_bytes / gib:.2f}GiB"
+                f"{self.total_bytes / gib:.2f}GiB{host}"
             )
         return (
             f"weights {self.weights_bytes / gib:.2f}GiB + "
@@ -195,6 +207,7 @@ def plan_serving_memory(
     page_size: int = 64,
     kv_pages: int = 0,
     page_fraction: float = 0.0,
+    host_kv_fraction: float = 0.0,
     adapter_pool_rows: int = 0,
     adapter_rank: int = 0,
     grammar_slots: int = 0,
@@ -224,6 +237,10 @@ def plan_serving_memory(
     (serving/pagepool.py): ``kv_pages`` pages of ``page_size`` tokens, or
     ``pages_for_fraction(max_batch, max_seq_len, page_size,
     page_fraction)`` when kv_pages is 0.
+    ``host_kv_fraction``: tiered-KV host arena pages relative to the
+    device pool (``ceil(pages × fraction)``, same per-page bytes) — the
+    ``host_spill_bytes`` term is HOST RAM, reported but excluded from the
+    HBM total; 0 omits it (tier off, and always 0 under the dense layout).
     ``adapter_pool_rows``/``adapter_rank``: shape of the multi-LoRA device
     pool (serving/adapters.py) — 0 omits the term (no adapters).
     ``grammar_slots``/``grammar_states``: shape of the constrained-decoding
@@ -257,6 +274,14 @@ def plan_serving_memory(
             lambda: make_page_pool(config, num_pages, page_size)
         )
         pool_bytes = _tree_bytes(pool_shape)
+        host_spill_bytes = 0
+        if host_kv_fraction > 0:
+            import math
+
+            host_spill_bytes = (
+                math.ceil(num_pages * host_kv_fraction)
+                * (pool_bytes // max(1, num_pages))
+            )
         fused_shape = (
             jax.eval_shape(
                 lambda: make_kv_cache(
@@ -284,6 +309,7 @@ def plan_serving_memory(
             fused_prefill_bytes=_tree_bytes(fused_shape) if fused_shape else 0,
             prefix_pool_bytes=0,  # aliasing shares the one pool
             page_pool_bytes=pool_bytes,
+            host_spill_bytes=host_spill_bytes,
             verify_chunk_bytes=(
                 5 * max_batch * (speculation_tokens + 1) * config.vocab_size * 4
                 if speculation_tokens > 0

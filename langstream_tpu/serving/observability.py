@@ -83,6 +83,20 @@ ENGINE_HISTOGRAMS: dict[str, dict[str, Any]] = {
         "help": "device-to-host token fetch latency per chunk (s)",
         "buckets": log_buckets(1e-4, 10.0, 4),
     },
+    # tiered KV (docs/SERVING.md §16): spill runs on its dedicated worker
+    # thread (device→host copy + arena write + checksum, per entry);
+    # restore runs ON the admission path (host→device upload of a
+    # hibernated prefix) — its tail is literally added TTFT, which is why
+    # it gets its own histogram instead of folding into prefill dispatch
+    "engine_spill_s": {
+        "help": "host-tier spill (device→host copy + checksum) per entry (s)",
+        "buckets": log_buckets(1e-4, 60.0, 4),
+    },
+    "engine_restore_s": {
+        "help": "host-tier restore (host→device page upload) per warm "
+                "admission (s)",
+        "buckets": log_buckets(1e-4, 60.0, 4),
+    },
 }
 
 
@@ -214,8 +228,10 @@ ITERATION_FIELDS = (
     "dispatch", # "decode" | "verify" | "" (nothing dispatched)
     "steps",    # decode steps (or k+1 verify width) dispatched
     "kv_pages", # physical pages in use (0 under the dense layout)
+    "host_pages", # host-tier arena slots in use (0 with the tier off)
     "programs", # distinct compiled device programs so far
-    "phase_ms", # {"sweep","prefill","dispatch","process"} host-wall ms
+    "phase_ms", # {"sweep","prefill","dispatch","process","spill","restore"}
+                # host-wall ms (spill/restore are 0 with the tier off)
 )
 
 # token content must never reach a dump: dumps travel to incident channels
@@ -228,6 +244,10 @@ DUMP_REASONS = (
     "nan-quarantine", "page-quarantine", "adapter-quarantine",
     "engine-restart", "shed-burst",
     "on-demand",
+    # a host-tier restore blocked an admission past the bound (slow host
+    # RAM, checksum thrash, or a spill the hit had to wait out) — dumped
+    # by the engine's restore path, token-content-free like every reason
+    "spill-stall",
     # SPMD leader/follower disagreement (echo mismatch, sequence gap, or a
     # failed replay): dumped on the FOLLOWER, tagged with the ControlBlock
     # seq, before the replica crashes — docs/SERVING.md §14

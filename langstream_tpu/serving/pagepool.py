@@ -31,6 +31,20 @@ bookkeeping corruption drill); ``_owned`` is the AUTHORITATIVE per-slot
 page list kept apart from the table array, so ``validate`` detects the
 corruption and ``free_slot`` still returns every page to the free list —
 the no-leak property the chaos suite asserts.
+
+Tiered KV (ROADMAP item 3): ``HostPageTier`` is a host-RAM page arena
+UNDER the device pool — idle published prefixes (hibernated chat/agent
+sessions) spill their pages into it asynchronously, and under HBM
+pressure the LRU eviction DEMOTES an entry's device pages to the host
+copy instead of dropping the prefix, so the device pool behaves as a
+cache over host RAM (~10× larger per host). ``PrefixPages`` tracks the
+tier per entry (``device`` | ``both`` | ``host``); a radix hit on a
+host-resident entry triggers a device restore (engine._restore_entry —
+one warmed traced-index upload program, DMA speed) instead of a miss.
+Every arena slot carries a blake2b checksum written at spill time and
+verified at restore time, so a corrupted host page (the ``spill`` fault
+site, or real RAM rot) degrades to a cold re-prefill — never to silently
+wrong KV.
 """
 
 from __future__ import annotations
@@ -38,6 +52,7 @@ from __future__ import annotations
 import hashlib
 import math
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -169,6 +184,13 @@ class PagePool:
             self._refs[p] = 1
         return pages
 
+    def alloc_pages(self, n: int) -> Optional[list[int]]:
+        """Allocate ``n`` pages with refcount 1 held by the CALLER (the
+        restore path: the prefix index adopts them via
+        ``attach_device_pages``, mirroring how ``insert`` holds one ref).
+        None — nothing allocated — when the free list cannot cover it."""
+        return self._alloc(n)
+
     # -- slot binding ---------------------------------------------------------
 
     def reserve(
@@ -235,6 +257,132 @@ class PagePool:
         self._owned.clear()
 
 
+# -- host-RAM page tier (spill / hibernation arena) ---------------------------
+
+
+class HostPageTier:
+    """Host-RAM page arena mirroring the device pool's leaf structure:
+    one numpy array per pool leaf with the page axis (axis 1) sized to
+    ``num_pages`` host pages. int8 KV pools spill int8 + scales — half the
+    bytes of a bf16 pool, exactly like the device side.
+
+    Thread contract: the free list, checksum map and all alloc/free calls
+    are ENGINE-THREAD-ONLY; ``write`` runs on the dedicated spill worker
+    thread, but only ever against slots the engine allocated to an
+    in-flight spill and will not read or reuse until the worker's done
+    handle drains — so no two threads ever touch the same arena slot
+    concurrently (the checksum map takes a small lock because the engine
+    reads entries the worker wrote)."""
+
+    def __init__(self, dev_pool: Any, num_pages: int) -> None:
+        if num_pages < 1:
+            raise ValueError("host page tier needs >= 1 page")
+        self.num_pages = int(num_pages)
+        leaves = jax.tree.leaves(dev_pool)
+        self._treedef = jax.tree.structure(dev_pool)
+        # device leaf [L, P, Hkv, ps(, D)] → host arena [L, HP, Hkv, ps(, D)]
+        self._arrays = [
+            np.zeros((leaf.shape[0], self.num_pages) + tuple(leaf.shape[2:]),
+                     leaf.dtype)
+            for leaf in leaves
+        ]
+        self.bytes_per_page = sum(
+            int(np.prod((a.shape[0],) + a.shape[2:])) * a.dtype.itemsize
+            for a in self._arrays
+        )
+        self.bytes_total = self.bytes_per_page * self.num_pages
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._sums: dict[int, bytes] = {}
+        self._sum_lock = threading.Lock()
+
+    # -- allocator (engine thread) -------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def slots_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, slots) -> None:
+        for s in slots:
+            self._free.append(int(s))
+        with self._sum_lock:
+            for s in slots:
+                self._sums.pop(int(s), None)
+
+    # -- page data ------------------------------------------------------------
+
+    @staticmethod
+    def _digest_blocks(blocks: list) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        for b in blocks:
+            h.update(b)  # C-contiguous ndarray: buffer protocol, no copy
+        return h.digest()
+
+    def _slot_blocks(self, slot: int) -> list:
+        return [np.ascontiguousarray(a[:, slot]) for a in self._arrays]
+
+    def write(self, slot: int, blocks: list) -> None:
+        """Store one device page's leaf blocks ([L, Hkv, ps(, D)] each, in
+        ``jax.tree.leaves`` order) into arena slot ``slot`` and stamp its
+        checksum. Spill-worker-thread."""
+        # hash the INCOMING blocks (already contiguous off device_get,
+        # byte-identical to what lands in the arena once coerced to the
+        # leaf dtype) — re-materializing the strided arena slot just to
+        # feed the hash would double the worker's memory traffic per page
+        blocks = [
+            np.ascontiguousarray(b, dtype=a.dtype)
+            for a, b in zip(self._arrays, blocks)
+        ]
+        for a, b in zip(self._arrays, blocks):
+            a[:, slot] = b
+        d = self._digest_blocks(blocks)
+        with self._sum_lock:
+            self._sums[slot] = d
+
+    def read(self, slot: int) -> Optional[Any]:
+        """Return arena slot ``slot`` as a pytree shaped like one device
+        page (the restore program's upload operand), or None when the
+        stored checksum no longer matches the bytes — a corrupted host
+        page must degrade to a re-prefill, never to silently wrong KV."""
+        with self._sum_lock:
+            want = self._sums.get(slot)
+        if want is None:
+            return None
+        # ONE contiguous materialization per leaf: the same buffers are
+        # hashed AND returned — this runs inside the admission stall
+        # window the engine_restore_s histogram polices, so the bytes
+        # must not be copied twice
+        blocks = self._slot_blocks(slot)
+        if self._digest_blocks(blocks) != want:
+            return None
+        return jax.tree.unflatten(self._treedef, blocks)
+
+    def corrupt(self, slot: int) -> None:
+        """Flip one byte of the slot's first leaf — the ``spill`` fault
+        site's host-RAM-rot drill. The checksum verification in ``read``
+        must catch it."""
+        a = self._arrays[0]
+        idx = (0, slot) + (0,) * (a.ndim - 2)
+        one = np.array([a[idx]], a.dtype)
+        one.view(np.uint8)[0] ^= 0xFF
+        a[idx] = one[0]
+
+    def reset(self) -> None:
+        """Crash recovery: every arena slot is forgotten (the entries that
+        referenced them are gone with the index reset)."""
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        with self._sum_lock:
+            self._sums.clear()
+
+
 # -- prefix alias index -------------------------------------------------------
 
 
@@ -256,7 +404,17 @@ class _Node:
 class PrefixPages:
     """One cached prefix: ``length`` tokens whose KV lives in ``pages``
     (refcounted in the pool; the LAST page is partial when length % ps).
-    ``pins`` guards in-flight admissions reading the entry."""
+    ``pins`` guards in-flight admissions reading the entry.
+
+    Tiered KV: ``host`` holds the entry's arena slots once a spill
+    completed (one per original device page, same order). The entry's
+    tier is derived — device pages only = ``device``, both = ``both``,
+    arena only (device half demoted under HBM pressure) = ``host``; a
+    host-tier entry survives in the trie with ``pages == ()`` so a radix
+    hit restores it instead of missing. ``spilling`` carries the
+    in-flight spill handle (engine._Spill); ``dropped`` lets the spill
+    completion drain detect an entry that was evicted/quarantined while
+    its copy was in flight."""
 
     pages: tuple[int, ...]
     length: int
@@ -264,6 +422,17 @@ class PrefixPages:
     last_used: int = 0
     node: Any = field(default=None, repr=False)
     digest: str = ""  # prefix_digest(tokens[:length]) — beacon advertisement
+    host: tuple[int, ...] = ()
+    spilling: Any = field(default=None, repr=False)
+    dropped: bool = False
+    # wall clock of publish/last hit — the spill-idle-s hibernation gate
+    last_used_t: float = 0.0
+
+    @property
+    def tier(self) -> str:
+        if self.pages:
+            return "both" if self.host else "device"
+        return "host"
 
 
 class PrefixPageIndex:
@@ -289,6 +458,15 @@ class PrefixPageIndex:
         # len() read — stats() runs on metrics threads, which must never
         # iterate _live mid-mutation
         self._page_holds: dict[int, int] = {}
+        # device-RESIDENT live entries (pages != ()): the insert cap's
+        # denominator AND the victim-scan universe for device eviction /
+        # quarantine, maintained incrementally — _live grows to arena
+        # scale under hibernation and must not be walked per publish or
+        # per admission-path eviction. (Host-side victim selection in
+        # engine._evict_host_for still scans host-holding entries: that
+        # cost is amortized against an actual arena eviction and bounded
+        # to one failed attempt per idle-sweep tick.)
+        self._dev_live: list[PrefixPages] = []
         self._tick = 0
         # beacon advertisement: digest → [length, recency tick], mutated on
         # the engine thread (insert/drop/hit) but READ from the runtime
@@ -296,12 +474,21 @@ class PrefixPageIndex:
         # threads, hence the one lock in this module
         self._ads: dict[str, list] = {}
         self._ad_lock = threading.Lock()
+        # host tier (set by the engine when spill is enabled): _drop frees
+        # an entry's arena slots through this, so drop/evict/quarantine
+        # paths can never leak host pages
+        self.host_tier: Optional[HostPageTier] = None
         # stats (cumulative since engine start)
         self.lookups = 0
         self.hits = 0
         self.tokens_saved = 0
         self.evictions = 0
         self.copy_bytes_saved = 0
+        # tiered-KV stats: demotions = device half dropped in favour of the
+        # host copy (the entry stays restorable); host_evictions = a host
+        # copy freed to make arena room (a host-only victim is gone for good)
+        self.demotions = 0
+        self.host_evictions = 0
 
     # -- trie (mirrors prefix_cache.PrefixCachePool) --------------------------
 
@@ -355,6 +542,7 @@ class PrefixPageIndex:
             self.hits += 1
             self._tick += 1
             used.last_used = self._tick
+            used.last_used_t = time.monotonic()
             if used.digest:
                 with self._ad_lock:
                     ad = self._ads.get(used.digest)
@@ -371,15 +559,20 @@ class PrefixPageIndex:
         cands = self.candidates(tokens)
         return cands[-1][0] if cands else 0
 
-    def advertised(self, top_k: int = 32) -> list[tuple[str, int]]:
+    def advertised(self, top_k: int = 32) -> list[tuple[str, int, str]]:
         """Most-recently-used ``top_k`` prefix digests as ``(digest,
-        length)`` pairs — the beacon's affinity advertisement. Thread-safe
-        (the /state endpoint serves this from the HTTP thread)."""
+        length, tier)`` triples — the beacon's affinity advertisement.
+        ``tier`` is ``device`` | ``both`` | ``host``: the fleet beacon
+        advertises hibernated (host-tier) sessions alongside resident
+        ones so sticky routing survives a spill, and the router scores
+        them at a discount (a restore is cheaper than a re-prefill but
+        not free). Thread-safe (the /state endpoint serves this from the
+        HTTP thread)."""
         with self._ad_lock:
             items = sorted(
                 self._ads.items(), key=lambda kv: kv[1][1], reverse=True
             )[: max(0, top_k)]
-        return [(digest, ad[0]) for digest, ad in items]
+        return [(digest, ad[0], ad[2]) for digest, ad in items]
 
     def has(self, tokens, length: int) -> bool:
         path = self._walk(tokens, limit=length)
@@ -408,11 +601,15 @@ class PrefixPageIndex:
     ) -> Optional[PrefixPages]:
         """Publish ``tokens[:length]`` as an alias of ``pages`` (the
         publishing slot's leading table entries): refcount bump only, no
-        device copy. Over the entry cap, the LRU unpinned entry makes room
-        (or the publish is skipped — never blocks)."""
+        device copy. Over the entry cap, the LRU unpinned DEVICE-holding
+        entry makes room (or the publish is skipped — never blocks). The
+        cap bounds the device-resident working set only: hibernated
+        entries each hold ≥1 exclusive arena slot, so the host tier's own
+        free list is their ceiling — cap eviction must not drop a
+        restorable session the arena was sized to keep."""
         assert length in self.boundaries, (length, self.boundaries)
-        if len(self._live) >= self.max_entries:
-            if not self.evict_lru(pool):
+        if len(self._dev_live) >= self.max_entries:
+            if not self.evict_device_lru(pool):
                 return None
         pool.incref(pages)
         node = self._walk(tokens, limit=length, create=True)[-1]
@@ -425,14 +622,27 @@ class PrefixPageIndex:
             # re-publish of the same prefix raced an eviction: keep newest
             self._drop(pool, node.entry)
         node.entry = entry
+        entry.last_used_t = time.monotonic()
         self._live.append(entry)
+        if entry.pages:
+            self._dev_live.append(entry)
         for p in entry.pages:
             self._page_holds[p] = self._page_holds.get(p, 0) + 1
         # advertise AFTER the re-publish _drop above, which removed the
         # same digest (same tokens, same length)
         with self._ad_lock:
-            self._ads[entry.digest] = [entry.length, entry.last_used]
+            self._ads[entry.digest] = [entry.length, entry.last_used, "device"]
         return entry
+
+    def _note_tier(self, entry: PrefixPages) -> None:
+        """Refresh the entry's advertised tier (spill completed, demotion,
+        restore) so the fleet beacon's resident-vs-hibernated split tracks
+        reality."""
+        if entry.digest:
+            with self._ad_lock:
+                ad = self._ads.get(entry.digest)
+                if ad is not None:
+                    ad[2] = entry.tier
 
     def _drop(self, pool: PagePool, entry: PrefixPages) -> None:
         node = entry.node
@@ -448,6 +658,8 @@ class PrefixPageIndex:
                 del parent.children[node.edge]
                 node = parent
         self._live.remove(entry)
+        if entry.pages:
+            self._dev_live.remove(entry)
         for p in entry.pages:
             left = self._page_holds.get(p, 0) - 1
             if left > 0:
@@ -457,7 +669,21 @@ class PrefixPageIndex:
         if entry.digest:
             with self._ad_lock:
                 self._ads.pop(entry.digest, None)
+        entry.dropped = True
+        if entry.spilling is not None:
+            # copy in flight: the worker owns the arena slots until its
+            # done handle drains — the engine frees them there (freeing
+            # now would let a new spill write the same slots concurrently)
+            entry.spilling.cancelled = True
+            entry.spilling = None
+        elif entry.host and self.host_tier is not None:
+            self.host_tier.free(entry.host)
+        entry.host = ()
         pool.decref(entry.pages)
+        # a dropped entry can survive in an admission's already-materialized
+        # candidate list (evict_for mid-loop); stale .pages there would
+        # alias pages the free list has re-issued to another slot
+        entry.pages = ()
 
     def evict_lru(self, pool: PagePool) -> bool:
         """Evict the least-recently-used UNPINNED entry. False when every
@@ -469,12 +695,84 @@ class PrefixPageIndex:
         self.evictions += 1
         return True
 
-    def evict_for(self, pool: PagePool, need_pages: int) -> bool:
-        """Free pool pages by evicting LRU entries until ``need_pages`` fit
-        (or nothing evictable remains). Eviction only helps when it drops a
-        page's LAST reference, so progress is re-checked per eviction."""
+    def release_device_pages(
+        self, pool: PagePool, entry: PrefixPages,
+    ) -> list[int]:
+        """Demote: drop the entry's DEVICE half only (decref + bytes-gauge
+        bookkeeping), leaving the trie node, advertisement and host copy
+        intact — the entry hibernates as ``host`` tier and a later radix
+        hit restores it. Returns the pages whose refcount hit zero."""
+        pages = entry.pages
+        entry.pages = ()
+        if pages:
+            self._dev_live.remove(entry)
+        for p in pages:
+            left = self._page_holds.get(p, 0) - 1
+            if left > 0:
+                self._page_holds[p] = left
+            else:
+                self._page_holds.pop(p, None)
+        self._note_tier(entry)
+        return pool.decref(pages)
+
+    def attach_device_pages(
+        self, pool: PagePool, entry: PrefixPages, pages,
+    ) -> None:
+        """Restore: adopt freshly allocated (refcount-1) pages as the
+        entry's device half — the inverse of ``release_device_pages``; the
+        index now holds the one reference, exactly like ``insert``. The
+        restore counts as a USE: without the recency bump a restored entry
+        whose admission then page-defers (record_lookup never runs) would
+        sit at the LRU minimum and be re-demoted by the next competing
+        bind's evict_for — a restore/demote upload loop every engine
+        iteration for as long as the pool stays full."""
+        assert not entry.pages and not entry.dropped
+        entry.pages = tuple(int(p) for p in pages)
+        self._dev_live.append(entry)
+        for p in entry.pages:
+            self._page_holds[p] = self._page_holds.get(p, 0) + 1
+        self._tick += 1
+        entry.last_used = self._tick
+        entry.last_used_t = time.monotonic()
+        self._note_tier(entry)
+
+    def evict_device_lru(
+        self, pool: PagePool, spill_cb=None,
+    ) -> bool:
+        """Free DEVICE pages by victimizing the LRU unpinned entry that
+        holds any: when ``spill_cb(entry)`` secures a host copy (already
+        spilled, spill in flight, or one enqueued now) the entry DEMOTES —
+        device half dropped, prefix still restorable — else it is dropped
+        outright (the pre-tier behaviour). False when nothing holding
+        device pages is evictable."""
+        victims = [e for e in self._dev_live if e.pins == 0]
+        if not victims:
+            return False
+        victim = min(victims, key=lambda e: e.last_used)
+        # a victim whose host copy already exists (or is in flight) is
+        # ALWAYS demoted, spill_cb or not: the publish-cap path used to
+        # drop it outright, destroying a restorable hibernated session
+        # the arena had already paid for on a mere cap event
+        secured = bool(victim.host) or victim.spilling is not None
+        if secured or (spill_cb is not None and spill_cb(victim)):
+            self.release_device_pages(pool, victim)
+            self.demotions += 1
+        else:
+            self._drop(pool, victim)
+            self.evictions += 1
+        return True
+
+    def evict_for(
+        self, pool: PagePool, need_pages: int, spill_cb=None,
+    ) -> bool:
+        """Free pool pages by demoting/evicting LRU entries until
+        ``need_pages`` fit (or nothing evictable remains). Eviction only
+        helps when it drops a page's LAST reference, so progress is
+        re-checked per victim. With ``spill_cb`` set (tiered KV), victims
+        demote to the host tier before dropping — the device pool becomes
+        a cache over host RAM."""
         while pool.free_pages < need_pages:
-            if not self.evict_lru(pool):
+            if not self.evict_device_lru(pool, spill_cb):
                 return False
         return True
 
@@ -482,7 +780,8 @@ class PrefixPageIndex:
         """Evict every entry referencing any of ``pages`` — the quarantine
         path: a poisoned slot's published prefixes must not outlive it."""
         touched = set(pages)
-        victims = [e for e in self._live if touched.intersection(e.pages)]
+        # only device-holding entries can reference device pages
+        victims = [e for e in self._dev_live if touched.intersection(e.pages)]
         for e in victims:
             self._drop(pool, e)
             self.evictions += 1
@@ -490,10 +789,20 @@ class PrefixPageIndex:
 
     def reset(self) -> None:
         """Crash recovery (the pool itself was rebuilt — page refs are gone
-        with it, so entries just vanish; counters are cumulative)."""
+        with it, so entries just vanish; counters are cumulative). Host
+        copies vanish with their entries: the engine resets the arena
+        right after (its spill worker is quiesced first), and marking the
+        entries dropped here makes any straggler spill handle discard."""
+        for e in self._live:
+            e.dropped = True
+            if e.spilling is not None:
+                e.spilling.cancelled = True
+                e.spilling = None
+            e.host = ()
         self._root = _Node()
         self._live = []
         self._page_holds = {}
+        self._dev_live = []
         with self._ad_lock:
             self._ads = {}
         self._tick = 0

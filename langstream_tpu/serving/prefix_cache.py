@@ -231,14 +231,16 @@ class PrefixCachePool:
         cands = self.candidates(tokens)
         return cands[-1][0] if cands else 0
 
-    def advertised(self, top_k: int = 32) -> list[tuple[str, int]]:
-        """Most-recently-used ``top_k`` ``(digest, length)`` pairs for the
-        fleet beacon; thread-safe."""
+    def advertised(self, top_k: int = 32) -> list[tuple[str, int, str]]:
+        """Most-recently-used ``top_k`` ``(digest, length, tier)`` triples
+        for the fleet beacon; thread-safe. The dense pool has no host
+        tier, so every entry advertises ``device`` (the paged index is
+        where ``host`` hibernation appears — pagepool.advertised)."""
         with self._ad_lock:
             items = sorted(
                 self._ads.items(), key=lambda kv: kv[1][1], reverse=True
             )[: max(0, top_k)]
-        return [(digest, ad[0]) for digest, ad in items]
+        return [(digest, ad[0], "device") for digest, ad in items]
 
     def has(self, tokens, length: int) -> bool:
         path = self._walk(tokens, limit=length)

@@ -125,7 +125,7 @@ def test_advertised_digests_track_insert_and_evict():
     tok = [3 + i % 40 for i in range(40)]
     index.insert(pool, tok, 32, tuple(pool._alloc(2)))
     ads = index.advertised(8)
-    assert (prefix_digest(tok[:32]), 32) in ads
+    assert (prefix_digest(tok[:32]), 32, "device") in ads
     assert index.evict_lru(pool)
     assert index.advertised(8) == []
 
@@ -491,6 +491,98 @@ def test_beacon_schema_rejects_token_content():
         validate_beacon({**doc, "prefixes": [["abc", "32"]]})  # length not int
     with pytest.raises(ValueError):
         validate_beacon({**doc, "schema": "nope"})
+    # hibernated advertisements (tiered KV, §16) validate under the same
+    # [digest, length] shape — and the same token-content redaction
+    assert validate_beacon(
+        {**doc, "spilled_prefixes": [[prefix_digest(PROMPT[:64]), 64]]}
+    )
+    with pytest.raises(ValueError):
+        validate_beacon({**doc, "spilled_prefixes": [["abc", "64", "x"]]})
+
+
+# ---------------------------------------------------------------------------
+# hibernated-session routing (tiered KV, docs/SERVING.md §16)
+# ---------------------------------------------------------------------------
+
+
+def test_hibernated_session_routes_to_owner():
+    """ISSUE-11 satellite: a session whose KV was spilled to the owner's
+    host tier must STILL route to that owner — a discounted restore beats
+    a cold re-prefill anywhere else — so sticky routing survives
+    hibernation."""
+    owner = _FakeReplica(
+        "owner", load=0.0,
+        spilled_prefixes=[[prefix_digest(PROMPT[:64]), 64]],
+    )
+    cold = _FakeReplica("cold", load=0.0)
+    router = _router([cold, owner])
+    decision = router.route(PROMPT)
+    assert decision.replica_id == "owner"
+    assert decision.kind == "affinity"
+    # the discounted match is what the decision carries: a restore is
+    # cheaper than a re-prefill but not free
+    assert decision.expected_match == int(64 * router.spill_discount)
+
+
+def test_spill_discount_trades_hibernated_against_resident():
+    """The discount knob: a device-resident 32-token match beats a
+    hibernated 64-token one at discount 0.25 (16 effective), loses at
+    par (1.0), and a discount of 0 ignores hibernated advertisements
+    entirely."""
+    resident = _FakeReplica(
+        "resident", load=0.0, prefixes=[(prefix_digest(PROMPT[:32]), 32)],
+    )
+    hibernated = _FakeReplica(
+        "hibernated", load=0.0,
+        spilled_prefixes=[[prefix_digest(PROMPT[:64]), 64]],
+    )
+    assert _router(
+        [resident, hibernated], spill_discount=0.25
+    ).route(PROMPT).replica_id == "resident"
+    assert _router(
+        [resident, hibernated], spill_discount=1.0
+    ).route(PROMPT).replica_id == "hibernated"
+    only_spilled = _router([hibernated], spill_discount=0.0)
+    decision = only_spilled.route(PROMPT)
+    assert decision.kind == "balanced" and decision.expected_match == 0
+
+
+def test_beacon_splits_resident_and_hibernated_digests():
+    """beacon_from_engine must advertise a hibernated prefix under
+    `spilled_prefixes` (and move it back to `prefixes` after a restore):
+    the fleet's view of the tier tracks the engine's."""
+    import time as _time
+
+    engine = make_engine(
+        kv_layout="paged", page_size=16, kv_pages=5,
+        prefix_cache_entries=8, host_kv_fraction=2.0, spill_idle_s=0.0,
+    )
+    try:
+        prompt_a = [(7 + 3 * i) % CFG.vocab_size for i in range(45)]
+        prompt_b = [(5 + 11 * i) % CFG.vocab_size for i in range(45)]
+        engine.generate(prompt_a, GREEDY, timeout=120)
+        deadline = _time.monotonic() + 30
+        while (
+            _time.monotonic() < deadline
+            and engine.stats()["spill-pages-total"] < 2
+        ):
+            _time.sleep(0.02)
+        # B's admission demotes A's hibernated prefix off the device pool
+        engine.generate(prompt_b, GREEDY, timeout=120)
+        doc = beacon_from_engine("r0", engine)
+        assert validate_beacon(doc)
+        dig_a = prefix_digest(prompt_a[:32])
+        assert [dig_a, 32] in doc["spilled_prefixes"], doc
+        assert [dig_a, 32] not in doc["prefixes"]
+        assert any(n == 32 for _, n in doc["prefixes"])  # B stays resident
+        # next turn restores A: the digest moves back to the resident list
+        engine.generate(prompt_a, GREEDY, timeout=120)
+        assert engine.stats()["restored-hits-total"] == 1
+        doc = beacon_from_engine("r0", engine)
+        assert [dig_a, 32] in doc["prefixes"]
+        assert [dig_a, 32] not in doc["spilled_prefixes"]
+    finally:
+        engine.stop()
 
 
 # ---------------------------------------------------------------------------

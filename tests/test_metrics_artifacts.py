@@ -106,6 +106,12 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_adapter_swaps_total",
         "engine_constrained_requests_total",
         "engine_constrain_overhead_ms",
+        "engine_host_pages_total",
+        "engine_host_pages_in_use",
+        "engine_spill_bytes_total",
+        "engine_restore_bytes_total",
+        "engine_restored_hits_total",
+        "engine_recompute_fallbacks_total",
         "engine_shed_total",
         "engine_deadline_exceeded_total",
         "engine_cancelled_total",
@@ -204,6 +210,37 @@ def test_agentic_panels_present():
     assert constrained is not None, "constrained-decoding panel missing"
     assert "engine_constrained_requests_total" in constrained
     assert "engine_constrain_overhead_ms" in constrained
+
+
+def test_tiered_kv_panels_present():
+    """The ISSUE-11 tiered-KV panels must survive dashboard edits: the
+    host-tier occupancy panel (arena pages + spill/restore byte traffic,
+    serving/pagepool.HostPageTier) and the restore-vs-recompute split —
+    THE health gauge of the hibernation wake path (docs/SERVING.md §16)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    occupancy = next(
+        (e for t, e in exprs_by_title.items() if "host kv tier" in t.lower()),
+        None,
+    )
+    assert occupancy is not None, "host-tier occupancy panel missing"
+    assert "engine_host_pages_in_use" in occupancy
+    assert "engine_host_pages_total" in occupancy
+    assert "engine_spill_bytes_total" in occupancy
+    assert "engine_restore_bytes_total" in occupancy
+    wake = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "restore vs recompute" in t.lower()
+        ),
+        None,
+    )
+    assert wake is not None, "restore-vs-recompute panel missing"
+    assert "engine_restored_hits_total" in wake
+    assert "engine_recompute_fallbacks_total" in wake
 
 
 def test_grafana_provisioning_parses():

@@ -370,7 +370,8 @@ def test_flight_dump_redaction_and_schema_rejects_token_content():
         "schema": FLIGHT_SCHEMA, "reason": "on-demand", "at": 1.0, "seq": 1,
         "iterations": [{
             "i": 1, "t": 1.0, "active": 1, "queued": 0, "dispatch": "decode",
-            "steps": 4, "kv_pages": 0, "programs": 3, "phase_ms": {},
+            "steps": 4, "kv_pages": 0, "host_pages": 0, "programs": 3,
+            "phase_ms": {},
         }],
         "counters": {},
         "extra": {},
@@ -479,7 +480,8 @@ def test_hot_loop_overhead_within_one_percent_of_decode_step():
         frame = {
             "i": 1, "t": 1.0, "active": active, "queued": 0, "longs": 0,
             "admitted": 0, "prefill_tokens": 0, "dispatch": "decode",
-            "steps": 8, "kv_pages": 12, "programs": 9, "injector": {},
+            "steps": 8, "kv_pages": 12, "host_pages": 0, "programs": 9,
+            "injector": {},
             "phase_ms": {"sweep": 0.01, "prefill": 0.0, "dispatch": 0.2,
                          "process": 0.1},
         }
@@ -668,7 +670,8 @@ def test_flight_endpoint_serves_recent_dumps(run):
             rec.record({
                 "i": 1, "t": 1.0, "active": 1, "queued": 0, "longs": 0,
                 "admitted": 0, "prefill_tokens": 0, "dispatch": "decode",
-                "steps": 4, "kv_pages": 0, "programs": 2, "injector": {},
+                "steps": 4, "kv_pages": 0, "host_pages": 0, "programs": 2,
+                "injector": {},
                 "phase_ms": {"sweep": 0.0, "prefill": 0.0, "dispatch": 0.1,
                              "process": 0.1},
             })
