@@ -899,7 +899,11 @@ def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
     import threading
 
     from langstream_tpu.serving.engine import ShedError
-    from langstream_tpu.serving.fleet import FleetRouter, FleetShedError
+    from langstream_tpu.serving.fleet import (
+        FleetRouter,
+        FleetShedError,
+        ReplicaError,
+    )
 
     router = FleetRouter(
         replicas, policy=policy, lam=lam, refresh_interval_s=0.2,
@@ -913,6 +917,7 @@ def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
         r.reset_histograms()  # the pair is WARM p50, not compile time
     ttfts: list = []
     sheds = [0]
+    fails = [0]
     lock = threading.Lock()
     prompts = [
         preambles[i % len(preambles)] + [2 + i]
@@ -935,6 +940,12 @@ def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
         except (ShedError, FleetShedError):
             with lock:
                 sheds[0] += 1
+        except ReplicaError:
+            # every replica died for this request (distinct from a shed
+            # since round 16): counted, not a silent thread death — the
+            # arm's sample size must stay honest
+            with lock:
+                fails[0] += 1
 
     threads = [
         threading.Thread(target=one, args=(i,)) for i in range(n_requests)
@@ -960,6 +971,7 @@ def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
         ),
         "hit_rates": [b["prefix_hit_rate"] for b in beacons],
         "shed_rate": round(sheds[0] / max(1, n_requests), 3),
+        "failed": fails[0],
         "completed": len(ttfts),
         "wall_s": round(wall, 2),
         "routed_affinity": stats["fleet-routed-affinity-total"]
@@ -967,6 +979,13 @@ def _fleet_arm(policy: str, replicas: list, preambles: list, burst_mult: int,
         "routed_balanced": stats["fleet-routed-balanced-total"],
         "dispatch_p50_ms": stats["fleet-dispatch-p50-ms"],
         "dispatch_p99_ms": stats["fleet-dispatch-p99-ms"],
+        # the streaming wire (docs/SERVING.md §17): remote-hop latency is
+        # end-of-stream wall time (TTFT above is the streaming number —
+        # first frame, not last), plus the failover/breaker health counters
+        "hop_p50_ms": stats["fleet-hop-p50-ms"],
+        "hop_p99_ms": stats["fleet-hop-p99-ms"],
+        "stream_failovers": stats["fleet-stream-failovers-total"],
+        "beacon_failures": stats["fleet-beacon-failures-total"],
     }
 
 

@@ -235,11 +235,38 @@ class _BaseCompletionsStep(Step):
             "fleet_replica_count",
             "replicas the fleet router fronts (routable or not)",
         )
-        from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
+        # fleet wire hardening (docs/SERVING.md §17): mid-stream warm
+        # failovers, the per-replica circuit breaker, beacon probe health,
+        # and the remote-hop latency histogram (mirrored from the router
+        # the same way the engine histograms are)
+        self._m_fleet_stream_failovers = metrics.gauge(
+            "fleet_stream_failovers_total",
+            "mid-STREAM warm failovers — a replica died after delivering "
+            "tokens and the router resumed on a survivor, cumulative",
+        )
+        self._m_fleet_circuit_open = metrics.gauge(
+            "fleet_circuit_open_total",
+            "per-replica circuit-breaker OPEN transitions (consecutive "
+            "beacon/dispatch failures past the threshold), cumulative",
+        )
+        self._m_fleet_beacon_failures = metrics.gauge(
+            "fleet_beacon_failures_total",
+            "beacon (/state) fetch failures across the fleet — sustained "
+            "growth on one replica means its probe is in backoff, "
+            "cumulative",
+        )
+        from langstream_tpu.serving.observability import (
+            ENGINE_HISTOGRAMS,
+            FLEET_HISTOGRAMS,
+        )
 
         self._m_hists = {
             name: metrics.histogram(name, spec["help"], spec["buckets"])
             for name, spec in ENGINE_HISTOGRAMS.items()
+        }
+        self._m_fleet_hists = {
+            name: metrics.histogram(name, spec["help"], spec["buckets"])
+            for name, spec in FLEET_HISTOGRAMS.items()
         }
 
     def _record_metrics(self, result: Any) -> None:
@@ -294,8 +321,22 @@ class _BaseCompletionsStep(Step):
         )
         self._m_fleet_balanced.set(fleet.get("fleet-routed-balanced-total", 0))
         self._m_fleet_replicas.set(fleet.get("fleet-replica-count", 0))
+        self._m_fleet_stream_failovers.set(
+            fleet.get("fleet-stream-failovers-total", 0)
+        )
+        self._m_fleet_circuit_open.set(fleet.get("fleet-circuit-open-total", 0))
+        self._m_fleet_beacon_failures.set(
+            fleet.get("fleet-beacon-failures-total", 0)
+        )
         for name, snap in (stats.get("histograms") or {}).items():
             mirror = self._m_hists.get(name)
+            if mirror is not None:
+                try:
+                    mirror.load(snap)
+                except ValueError:  # bucket-spec drift — skip, don't crash
+                    pass
+        for name, snap in (fleet.get("histograms") or {}).items():
+            mirror = self._m_fleet_hists.get(name)
             if mirror is not None:
                 try:
                     mirror.load(snap)

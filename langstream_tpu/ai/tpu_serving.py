@@ -459,6 +459,14 @@ class _EngineHolder:
                 generate_fn=lambda payload: fleet_mod.engine_generate(
                     engine, payload
                 ),
+                # streaming remote dispatch (docs/SERVING.md §17): frames
+                # flow to the dispatching router as the engine delivers
+                # tokens, so a remote route keeps local TTFT semantics
+                generate_stream_fn=(
+                    lambda payload: fleet_mod.engine_generate_stream(
+                        engine, payload
+                    )
+                ),
                 reset_fn=engine.reset_histograms,
             )
         return engine
@@ -717,82 +725,142 @@ class TpuCompletionsService(CompletionsService):
         chunks_consumer: Optional[StreamingChunksConsumer],
     ) -> Optional[ChatCompletionsResult]:
         """Resolve one request through the fleet router. Returns None when
-        the route lands on THIS replica (the caller runs the normal local
-        streaming path — no HTTP hop, per-token chunks) and the completed
-        result when it was dispatched to a peer. A peer that dies
-        mid-dispatch is quarantined and the request fails over COLD
-        (docs/SERVING.md §13); fleet sheds surface as the engine's
-        ShedError so the pipeline's 429 handling is one code path."""
+        the FIRST route lands on THIS replica (the caller runs the native
+        zero-hop streaming path) and the completed result when it was
+        dispatched over the wire.
+
+        The hop STREAMS (docs/SERVING.md §17): router.stream_generate
+        frames pipe straight into the gateway chunk writers as the peer
+        delivers tokens, so a remote route keeps local TTFT semantics —
+        the first chunk reaches the client long before the completion
+        finishes. A peer dying mid-stream fails over WARM inside the
+        router (prompt + delivered tokens re-dispatched to a survivor;
+        prefix reuse makes the resume cheap) — this layer only keeps the
+        cross-process cancel registration pointed at whichever replica
+        currently owns the stream. The hop budget derives from the
+        request's own deadline, never the flat default. Fleet sheds
+        surface as the engine's ShedError so the pipeline's 429 handling
+        is one code path."""
         import asyncio
 
         from langstream_tpu.serving import lifecycle
         from langstream_tpu.serving.engine import ShedError
-        from langstream_tpu.serving.fleet import FleetShedError, ReplicaError
+        from langstream_tpu.serving.fleet import (
+            FleetShedError,
+            ReplicaError,
+            close_frames,
+            hop_timeout_s,
+        )
 
         session_id = str(options.get("cancel-key") or "") or None
         # cross-process cancel (ROADMAP 3b): the cancel-key RIDES to the
-        # peer — engine_generate registers the request in the peer's
-        # process-local lifecycle registry — and the owning replica is
-        # recorded here, so lifecycle.cancel() on a client disconnect
-        # forwards POST /fleet/cancel and the remote decode dies at the
-        # next chunk boundary instead of at its deadline
+        # peer — engine_generate_stream registers the request in the
+        # peer's process-local lifecycle registry — and the owning replica
+        # is recorded here per hop, so lifecycle.cancel() on a client
+        # disconnect forwards POST /fleet/cancel and the remote decode
+        # dies at the next chunk boundary instead of at its deadline
         remote_options = dict(options)
         loop = asyncio.get_running_loop()
-        excluded: set = set()
-        last_shed: Optional[FleetShedError] = None
-        for _ in range(max(2, router.replica_count)):
+        frames = router.stream_generate(
+            prompt_tokens, remote_options, session_id=session_id,
+            timeout_s=hop_timeout_s(options),
+        )
+
+        def _next():
             try:
-                decision = router.route(
-                    prompt_tokens, session_id=session_id, exclude=excluded,
-                    adapter=(str(options.get("adapter") or "") or None),
-                )
+                return next(frames)
+            except StopIteration:
+                return None
+
+        delivered: list[int] = []
+        end: Optional[dict] = None
+        owner_url: Optional[str] = None
+        stream_state = None
+
+        def _point_cancel_at(url: str, is_local: bool) -> None:
+            # keep exactly one remote-owner registration live, following
+            # the stream across failovers
+            nonlocal owner_url
+            if owner_url is not None and session_id:
+                lifecycle.unregister_remote(session_id, owner_url)
+            owner_url = None
+            if (
+                session_id and url and not is_local
+                and not url.startswith("local:")
+            ):
+                lifecycle.register_remote(session_id, url)
+                owner_url = url
+
+        # ONE try/finally owns the stream from here: a cancellation at ANY
+        # await below (including the first fetch) must close the router
+        # generator so the serving replica cancels its in-flight request
+        try:
+            try:
+                first = await loop.run_in_executor(None, _next)
             except FleetShedError as e:
                 raise ShedError(str(e), retry_after_s=e.retry_after_s) from e
-            if decision.handle.is_local:
+            if first is None:
+                return None  # defensive: empty stream means nothing routed
+            if first.get("kind") == "route" and first.get("local"):
+                # the route landed HERE: hand back to the native streaming
+                # path before any dispatch happened (the route decision and
+                # its counters/stickiness stand — this replica serves it)
                 return None
-            owner_url = str(getattr(decision.handle, "url", "") or "")
-            remote = not owner_url.startswith("local:")
-            if session_id and remote:
-                lifecycle.register_remote(session_id, owner_url)
-            try:
-                out = await loop.run_in_executor(
-                    None,
-                    lambda d=decision: d.handle.generate(
-                        prompt_tokens, remote_options, 600.0
-                    ),
-                )
-            except FleetShedError as e:
-                last_shed = e
-                excluded.add(decision.replica_id)
-                continue
-            except ReplicaError:
-                router.note_failover(decision.replica_id)
-                excluded.add(decision.replica_id)
-                continue
-            finally:
-                if session_id and remote:
-                    lifecycle.unregister_remote(session_id, owner_url)
-            stream_state = None
             if chunks_consumer is not None:
                 stream_state = _StreamState(
                     self.holder.tokenizer(),
                     chunks_consumer,
                     int(options.get("min-chunks-per-message", 20)),
                 )
-                for t in out["tokens"]:
-                    stream_state.on_token(int(t))
-            return self._finish_result(
-                [int(t) for t in out["tokens"]],
-                str(out.get("finish_reason", "stop")),
-                int(out.get("prompt_tokens", len(prompt_tokens))),
-                float(out.get("ttft_s", 0.0)),
-                float(out.get("total_s", 0.0)),
-                options,
-                stream_state,
+            frame: Optional[dict] = first
+            while frame is not None:
+                kind = frame.get("kind")
+                if kind == "route":
+                    _point_cancel_at(
+                        str(frame.get("url") or ""),
+                        bool(frame.get("local")),
+                    )
+                elif kind == "tokens":
+                    for t in frame.get("tokens") or []:
+                        delivered.append(int(t))
+                        if stream_state is not None:
+                            stream_state.on_token(int(t))
+                elif kind == "end":
+                    end = frame
+                    break
+                try:
+                    frame = await loop.run_in_executor(None, _next)
+                except FleetShedError as e:
+                    raise ShedError(
+                        str(e), retry_after_s=e.retry_after_s
+                    ) from e
+        except ReplicaError:
+            if delivered:
+                raise  # tokens already streamed: a local restart would dup
+            # every replica DIED before the first token (sheds raise
+            # FleetShedError→ShedError above, never this): serve locally
+            # (cold) rather than fail — the engine in this process may be
+            # healthy even when the router has it quarantined
+            return None
+        finally:
+            if owner_url is not None and session_id:
+                lifecycle.unregister_remote(session_id, owner_url)
+            # race-safe: an executor thread may still be inside next()
+            # when this coroutine is cancelled
+            close_frames(frames)
+        if end is None:
+            raise ReplicaError(
+                "fleet stream ended without a terminal frame"
             )
-        if last_shed is not None:
-            raise ShedError(str(last_shed), retry_after_s=last_shed.retry_after_s)
-        return None  # every peer died: serve locally (cold) rather than fail
+        return self._finish_result(
+            delivered,
+            str(end.get("finish_reason", "stop")),
+            int(end.get("prompt_tokens", len(prompt_tokens))),
+            float(end.get("ttft_s", 0.0)),
+            float(end.get("total_s", 0.0)),
+            options,
+            stream_state,
+        )
 
     async def _generate(
         self,

@@ -59,13 +59,23 @@ class RuntimeHttpServer:
         """Fleet-internal dispatch: the router forwards a tokenized request
         to the replica it chose. Blocking engine work runs off-loop; engine
         sheds map to 429 + Retry-After (the same contract the in-process
-        completions path gets from ShedError)."""
+        completions path gets from ShedError).
+
+        With ``stream: true`` in the payload the response is a CHUNKED
+        newline-delimited-JSON frame stream (``lstpu-frames-v1``,
+        docs/SERVING.md §17): token chunks flow as the engine delivers
+        them, heartbeats keep the wire provably alive between chunks, and
+        one terminal frame carries finish_reason + usage. Pre-stream
+        failures (shed / bad request / dead engine) still answer with
+        real status codes — the submit happens BEFORE the response
+        commits to chunked encoding."""
         import asyncio
 
         from langstream_tpu.serving.fleet import (
             FleetShedError,
             ReplicaError,
             local_generate,
+            local_generate_stream,
         )
 
         try:
@@ -74,6 +84,11 @@ class RuntimeHttpServer:
             raise web.HTTPBadRequest(reason="body must be JSON") from None
         loop = asyncio.get_running_loop()
         try:
+            if payload.get("stream"):
+                frames = await loop.run_in_executor(
+                    None, local_generate_stream, payload
+                )
+                return await self._stream_frames(request, frames)
             result = await loop.run_in_executor(None, local_generate, payload)
         except FleetShedError as e:
             return web.json_response(
@@ -86,6 +101,78 @@ class RuntimeHttpServer:
         except ValueError as e:
             raise web.HTTPBadRequest(reason=str(e)) from None
         return web.json_response(result)
+
+    async def _stream_frames(
+        self, request: web.Request, frames
+    ) -> web.StreamResponse:
+        """Write one frame iterator as the chunked NDJSON hop body, with
+        the wire fault sites applied per frame (serving/faultinject.py,
+        docs/SERVING.md §17): ``net-stall`` goes silent mid-token,
+        ``net-cut`` aborts the transport in a frame's place (connection
+        reset, no terminal frame), ``net-corrupt`` writes a malformed
+        line. Closing the frame iterator on ANY exit cancels the engine
+        request when the stream never finished — a vanished client must
+        not burn the slot."""
+        import asyncio
+        import json as _json
+
+        from langstream_tpu.serving.fleet import close_frames, wire_injector
+
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        resp.enable_chunked_encoding()
+        loop = asyncio.get_running_loop()
+        injector = wire_injector()
+
+        def _next():
+            try:
+                return next(frames)
+            except StopIteration:
+                return None
+
+        try:
+            # prepare INSIDE the try: a client gone before the headers
+            # commit must still close the (eagerly-submitted) stream so
+            # the engine request is cancelled, not decoded to the budget
+            await resp.prepare(request)
+            while True:
+                frame = await loop.run_in_executor(None, _next)
+                if frame is None:
+                    break
+                if injector is not None:
+                    if injector.fires("net-stall"):
+                        # the wire goes quiet: no frame, no heartbeat —
+                        # the client's idle timeout must call this a dead
+                        # peer, not a slow decode
+                        await asyncio.sleep(injector.stall_s)
+                    if injector.fires("net-cut"):
+                        transport = request.transport
+                        if transport is not None:
+                            transport.abort()  # RST, mid-stream death
+                        return resp
+                    if injector.fires("net-corrupt"):
+                        # a malformed line in the frame's place: the
+                        # client's frame validation must fail the hop
+                        await resp.write(b'{"seq": "corrupt", "kind"\n')
+                        continue
+                await resp.write(
+                    _json.dumps(frame).encode("utf-8") + b"\n"
+                )
+        except (ConnectionResetError, ConnectionError, OSError) as e:
+            # client went away mid-stream: the finally closes the frame
+            # iterator, which cancels the engine request
+            log.debug("fleet stream client disconnected: %s", e)
+            return resp
+        finally:
+            # race-safe: an executor thread may still be inside next()
+            # when the handler is cancelled — close_frames retires the
+            # iterator once that step returns
+            close_frames(frames)
+        try:
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, OSError):
+            pass
+        return resp
 
     async def _fleet_cancel(self, request: web.Request) -> web.Response:
         """Cross-process session cancellation (ROADMAP 3b, docs/SERVING.md

@@ -36,6 +36,23 @@ Sites (where the engine asks ``fires(site)``):
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
 
+Network sites (the fleet wire, serving/fleet.py + runtime/http_server.py —
+docs/SERVING.md §17; these drive the replica-to-replica streaming
+transport, not the engine, and are consulted by the process-wide WIRE
+injector ``fleet.set_wire_injector`` / LSTPU_FAULTS):
+  net-connect  refuse the hop before it connects (client-side: HttpReplica
+               raises ReplicaError as if the peer's socket was refused)
+  net-stall    the stream goes silent mid-token (server-side: the handler
+               sleeps ``stall_s`` before the next frame — no tokens, no
+               heartbeats; the client's idle timeout must distinguish this
+               dead-peer signature from ordinary slow decode)
+  net-cut      connection reset after N frames (server-side: the handler
+               aborts the transport instead of writing the frame — the
+               mid-stream death the warm-failover path exists for)
+  net-corrupt  malformed frame (server-side: the handler writes a
+               non-JSON line in the frame's place — the client's frame
+               validation must fail the hop, never deliver garbage)
+
 Spec grammar (comma-separated, e.g. ``"decode@3,nan@5:4,fetch~0.1"``):
   site@N      fire exactly once, on the Nth call to that site (1-based)
   site@N+     fire on every call from the Nth on
@@ -67,6 +84,9 @@ log = logging.getLogger(__name__)
 SITES = (
     "prefill", "segment", "decode", "nan", "verify", "page", "adapter",
     "spill", "fetch", "client",
+    # fleet-wire sites (docs/SERVING.md §17): applied by the streaming
+    # transport and the /fleet/generate handler, not the engine
+    "net-connect", "net-stall", "net-cut", "net-corrupt",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;

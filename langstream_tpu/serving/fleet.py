@@ -56,23 +56,162 @@ drills are built on this.
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import math
+import os
+import queue
 import threading
 import time
 import urllib.error
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Iterator, Optional
 
 from langstream_tpu.api.metrics import Histogram, log_buckets
+from langstream_tpu.serving.observability import (
+    FLEET_HISTOGRAMS,
+    FlightRecorder,
+)
 from langstream_tpu.serving.pagepool import prefix_digest
 
 log = logging.getLogger(__name__)
 
 BEACON_SCHEMA = "lstpu-beacon-v1"
 STATE_SCHEMA = "lstpu-state-v1"
+
+# the fleet hop's streaming frame protocol (docs/SERVING.md §17):
+# newline-delimited JSON frames over chunked transfer-encoding, one
+# monotone per-request ``seq`` per frame starting at 0. Frame kinds:
+#   tokens     {"seq", "kind": "tokens", "tokens": [ids]} — a token chunk
+#   heartbeat  {"seq", "kind": "heartbeat"} — idle keepalive, so the
+#              client can tell slow-decode (heartbeats flow) from a dead
+#              peer (the wire goes silent past its idle timeout)
+#   end        terminal: finish_reason + usage + ttft_s/total_s — a stream
+#              that closes WITHOUT one is a failed hop, never a success
+#   error      terminal: the engine failed after streaming began (token
+#              content already delivered stays valid for failover resume)
+FRAME_SCHEMA = "lstpu-frames-v1"
+
+# hop budget when the request carries no deadline of its own; with one,
+# the hop is bounded by the REMAINING deadline + slack (hop_timeout_s) —
+# a 10s-deadline request must never hold a connection for 10 minutes
+DEFAULT_HOP_TIMEOUT_S = 600.0
+HOP_DEADLINE_SLACK_S = 5.0
+
+
+def hop_timeout_s(
+    options: Optional[dict], default: float = DEFAULT_HOP_TIMEOUT_S,
+) -> float:
+    """Total wall budget for one fleet hop, derived from the request's own
+    ``deadline`` option (plus transport/queue slack) when it has one. The
+    deadline ALSO rides the hop payload, so the peer's engine enforces it
+    server-side; this bound is the client's backstop for a wedged peer."""
+    from langstream_tpu.models.configs import GenerationOptions
+
+    # GenerationOptions.from_dict owns the option-key spellings: parsing
+    # them here again would let the engine enforce a deadline the hop
+    # doesn't see. A malformed options dict falls back to the default —
+    # the peer's own parse will reject it properly.
+    try:
+        d = GenerationOptions.from_dict(options or {}).deadline_s
+    except (TypeError, ValueError, KeyError):
+        return float(default)
+    if d is None or d <= 0:
+        return float(default)
+    return min(float(default), d + HOP_DEADLINE_SLACK_S)
+
+
+# ---------------------------------------------------------------------------
+# Wire fault injector (docs/SERVING.md §17): ONE process-wide injector for
+# the net-* sites, consulted by the HttpReplica transport (net-connect) and
+# the /fleet/generate streaming handler (net-stall / net-cut / net-corrupt).
+# Separate from the engine's injector — the wire is a different failure
+# domain — but activated the same two ways: set_wire_injector() in tests /
+# the replica worker config, or the LSTPU_FAULTS env spec.
+# ---------------------------------------------------------------------------
+
+_WIRE_LOCK = threading.Lock()
+_WIRE_INJECTOR: Optional[Any] = None
+_WIRE_ENV_CHECKED = False
+
+
+def set_wire_injector(injector: Optional[Any]) -> None:
+    """Install (or, with None, clear) the process-wide wire injector."""
+    global _WIRE_INJECTOR, _WIRE_ENV_CHECKED
+    with _WIRE_LOCK:
+        _WIRE_INJECTOR = injector
+        _WIRE_ENV_CHECKED = True
+
+
+def wire_injector() -> Optional[Any]:
+    global _WIRE_INJECTOR, _WIRE_ENV_CHECKED
+    with _WIRE_LOCK:
+        if not _WIRE_ENV_CHECKED:
+            from langstream_tpu.serving.faultinject import FaultInjector
+
+            _WIRE_INJECTOR = FaultInjector.from_env()
+            _WIRE_ENV_CHECKED = True
+        return _WIRE_INJECTOR
+
+
+def result_frames(out: dict[str, Any], prompt_len: int = 0) -> Iterator[dict]:
+    """Wrap an already-computed one-shot ``generate()`` result dict into
+    the §17 frame shapes — the single adapter behind every transport /
+    registration / legacy peer that doesn't stream natively."""
+    toks = [int(t) for t in out.get("tokens") or []]
+    seq = 0
+    if toks:
+        yield {
+            "v": FRAME_SCHEMA, "seq": 0, "kind": "tokens",
+            "tokens": toks,
+        }
+        seq = 1
+    yield {
+        "seq": seq, "kind": "end",
+        "finish_reason": str(out.get("finish_reason", "stop")),
+        "prompt_tokens": int(out.get("prompt_tokens", prompt_len)),
+        "ttft_s": float(out.get("ttft_s", 0.0)),
+        "total_s": float(out.get("total_s", 0.0)),
+        "usage": {
+            "prompt_tokens": int(out.get("prompt_tokens", prompt_len)),
+            "completion_tokens": len(toks),
+        },
+    }
+
+
+def close_frames(frames: Any) -> None:
+    """Close a frame iterator that may STILL be executing a ``next()`` on
+    an executor thread (the async consumer was cancelled mid-fetch): try
+    now, and if the generator is mid-step, retire it from a daemon thread
+    once the in-flight step returns. Closing is what cancels the
+    underlying engine request / hop socket, so best-effort-now is not
+    enough."""
+    close = getattr(frames, "close", None)
+    if close is None:
+        return
+    try:
+        close()
+        return
+    except ValueError:  # "generator already executing" — executor race
+        pass
+
+    def _later() -> None:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            try:
+                close()
+                return
+            except ValueError:
+                continue
+        log.warning("frame stream still executing after 30s; leaking it")
+
+    threading.Thread(
+        target=_later, name="fleet-frame-close", daemon=True
+    ).start()
 
 # λ default: tokens of expected prefix match one unit of load score is
 # worth. load_score ≈ queue-wait p90 seconds + occupancy (0..1) + page
@@ -220,14 +359,18 @@ def register_local(
     beacon_fn: Callable[[], dict],
     generate_fn: Optional[Callable[[dict], dict]] = None,
     reset_fn: Optional[Callable[[], None]] = None,
+    generate_stream_fn: Optional[Callable[[dict], Iterator[dict]]] = None,
 ) -> None:
     """Expose this process's engine on the runtime HTTP server: ``GET
     /state`` serves ``beacon_fn``, ``POST /fleet/generate`` runs
-    ``generate_fn`` (fleet-internal dispatch), ``POST /fleet/reset`` runs
-    ``reset_fn`` (bench warmup hygiene)."""
+    ``generate_fn`` (fleet-internal dispatch; with ``stream: true`` in the
+    payload it prefers ``generate_stream_fn`` — frames per §17 — and falls
+    back to wrapping ``generate_fn``'s one-shot result), ``POST
+    /fleet/reset`` runs ``reset_fn`` (bench warmup hygiene)."""
     with _LOCAL_LOCK:
         _LOCAL[str(replica_id)] = {
             "beacon": beacon_fn, "generate": generate_fn, "reset": reset_fn,
+            "generate_stream": generate_stream_fn,
         }
 
 
@@ -273,6 +416,27 @@ def local_generate(payload: dict[str, Any]) -> dict[str, Any]:
     return gen(payload)
 
 
+def local_generate_stream(payload: dict[str, Any]) -> Iterator[dict]:
+    """Streaming fleet-internal dispatch into this process's engine (the
+    POST /fleet/generate ``stream: true`` body). Returns the frame
+    iterator EAGERLY-submitted (docs/SERVING.md §17): pre-stream failures
+    — shed, bad request, dead engine — raise HERE, before the HTTP layer
+    has committed to a chunked response, so they still map to real status
+    codes. Registrations without a stream fn degrade to one final tokens
+    frame wrapped around the blocking ``generate`` result."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            raise ReplicaError("no serving engine registered in this process")
+        fns = next(iter(_LOCAL.values()))
+    stream = fns.get("generate_stream")
+    if stream is not None:
+        return stream(payload)
+    gen = fns.get("generate")
+    if gen is None:
+        raise ReplicaError("registered engine does not accept fleet dispatch")
+    return result_frames(gen(payload))
+
+
 def local_reset() -> None:
     with _LOCAL_LOCK:
         entries = list(_LOCAL.values())
@@ -283,7 +447,8 @@ def local_reset() -> None:
 
 
 def engine_generate(
-    engine: Any, payload: dict[str, Any], timeout_s: float = 600.0,
+    engine: Any, payload: dict[str, Any],
+    timeout_s: float = DEFAULT_HOP_TIMEOUT_S,
 ) -> dict[str, Any]:
     """The canonical ``generate_fn`` for ``register_local``: run one
     completion on the local engine from a fleet-dispatch payload
@@ -305,6 +470,10 @@ def engine_generate(
         raise ValueError("fleet dispatch payload carries no prompt_tokens")
     options = payload.get("options") or {}
     opts = GenerationOptions.from_dict(options)
+    # deadline discipline (§17): the forwarded deadline bounds the server-
+    # side wait too — a 10s-deadline request must not park an executor
+    # thread here for the full default hop budget on a wedged engine
+    timeout_s = min(timeout_s, hop_timeout_s(options, timeout_s))
     cancel_key = str(options.get("cancel-key") or "")
     # pre-built so it can register for cross-process cancel BEFORE the
     # submit; engine.generate keeps the submit/wait/cancel-on-timeout
@@ -327,6 +496,167 @@ def engine_generate(
         "ttft_s": round(result.ttft_s, 6),
         "total_s": round(result.total_s, 6),
     }
+
+
+class _EngineFrameStream:
+    """Frame iterator whose ``close()`` is safe BEFORE the first
+    ``next()``: the consumer may abandon the hop between the eager submit
+    and iteration (response prepare failed, handler cancelled), and the
+    engine request must still be cancelled + unregistered — a generator's
+    ``finally`` only runs once its body has started."""
+
+    def __init__(self, request: Any, cancel_key: str, gen: Iterator[dict]):
+        self._request = request
+        self._cancel_key = cancel_key
+        self._gen = gen
+
+    def __iter__(self) -> "_EngineFrameStream":
+        return self
+
+    def __next__(self) -> dict:
+        return next(self._gen)
+
+    def close(self) -> None:
+        try:
+            self._gen.close()
+        finally:
+            # idempotent with the generator's own finally (cancel() and
+            # unregister() both tolerate repeats): this leg covers the
+            # pre-start abandon, where the generator body never ran
+            if not self._request._done.is_set():  # noqa: SLF001
+                self._request.cancel()
+            if self._cancel_key:
+                from langstream_tpu.serving import lifecycle
+
+                lifecycle.unregister(self._cancel_key, self._request)
+
+
+def engine_generate_stream(
+    engine: Any,
+    payload: dict[str, Any],
+    timeout_s: float = DEFAULT_HOP_TIMEOUT_S,
+    heartbeat_s: Optional[float] = None,
+) -> Iterator[dict]:
+    """The streaming twin of ``engine_generate`` (docs/SERVING.md §17):
+    submit one completion on the local engine and return an iterator of
+    ``lstpu-frames-v1`` frames — token chunks as the engine delivers them
+    (so a remote route keeps local TTFT semantics), heartbeats while the
+    stream idles, ONE terminal ``end``/``error`` frame.
+
+    The SUBMIT happens eagerly, before the iterator is returned: shed /
+    bad-request / dead-engine failures raise here, while the HTTP layer
+    can still answer with a status code instead of a broken stream.
+    Closing the iterator mid-stream (client disconnected, net-cut drill)
+    cancels the in-flight request — a vanished consumer must not burn the
+    slot to max_new_tokens.
+
+    Token-delivery contract: every generated token rides a ``tokens``
+    frame (the engine calls on_token exactly once per kept token), so the
+    client-accumulated list IS result.tokens — what makes failover resume
+    (prompt + delivered) token-exact. The ``end`` frame carries counts and
+    usage, never token content the client doesn't already have."""
+    from langstream_tpu.models.configs import GenerationOptions
+    from langstream_tpu.serving import lifecycle
+    from langstream_tpu.serving.engine import GenerationRequest, ShedError
+
+    tokens = [int(t) for t in payload.get("prompt_tokens") or []]
+    if not tokens:
+        raise ValueError("fleet dispatch payload carries no prompt_tokens")
+    options = payload.get("options") or {}
+    opts = GenerationOptions.from_dict(options)
+    timeout_s = min(timeout_s, hop_timeout_s(options, timeout_s))
+    hb = float(payload.get("heartbeat-s") or heartbeat_s or 2.0)
+    hb = max(0.05, hb)
+    cancel_key = str(options.get("cancel-key") or "")
+    q: "queue.Queue[tuple[str, Any]]" = queue.Queue()
+    request = GenerationRequest(
+        prompt_tokens=tokens,
+        options=opts,
+        on_token=lambda t: q.put(("tok", int(t))),
+        on_done=lambda res: q.put(("done", res)),
+    )
+    if cancel_key:
+        lifecycle.register(cancel_key, request)
+    try:
+        try:
+            engine.submit(request)
+        except ShedError as e:
+            raise FleetShedError(str(e), retry_after_s=e.retry_after_s) from e
+    except BaseException:
+        if cancel_key:
+            lifecycle.unregister(cancel_key, request)
+        raise
+
+    def frames() -> Iterator[dict]:
+        seq = 0
+        result = None
+        hard_stop = time.monotonic() + timeout_s
+        try:
+            while result is None:
+                try:
+                    item = q.get(timeout=hb)
+                except queue.Empty:
+                    if time.monotonic() >= hard_stop:
+                        # wedged engine / blown hop budget: cancel and fail
+                        # the hop — the deadline already rode the options,
+                        # so this fires only when the engine ignores it
+                        request.cancel()
+                        yield {
+                            "seq": seq, "kind": "error",
+                            "error": f"hop budget ({timeout_s:.1f}s) "
+                                     "exhausted mid-stream",
+                        }
+                        return
+                    beat = {"seq": seq, "kind": "heartbeat"}
+                    if seq == 0:
+                        beat["v"] = FRAME_SCHEMA
+                    yield beat
+                    seq += 1
+                    continue
+                batch = [item]
+                while True:
+                    try:
+                        batch.append(q.get_nowait())
+                    except queue.Empty:
+                        break
+                toks = [t for kind, t in batch if kind == "tok"]
+                for kind, value in batch:
+                    if kind == "done":
+                        result = value
+                if toks:
+                    frame = {"seq": seq, "kind": "tokens", "tokens": toks}
+                    if seq == 0:
+                        frame["v"] = FRAME_SCHEMA
+                    yield frame
+                    seq += 1
+            if result.error is not None:
+                yield {
+                    "seq": seq, "kind": "error", "error": str(result.error),
+                }
+                return
+            end = {
+                "seq": seq, "kind": "end",
+                "finish_reason": result.finish_reason,
+                "prompt_tokens": result.prompt_tokens,
+                "ttft_s": round(result.ttft_s, 6),
+                "total_s": round(result.total_s, 6),
+                "usage": {
+                    "prompt_tokens": result.prompt_tokens,
+                    "completion_tokens": len(result.tokens),
+                },
+            }
+            if seq == 0:
+                end["v"] = FRAME_SCHEMA
+            yield end
+        finally:
+            if result is None:
+                # consumer walked away mid-stream (disconnect, failover
+                # cut): free the slot at the next chunk boundary
+                request.cancel()
+            if cancel_key:
+                lifecycle.unregister(cancel_key, request)
+
+    return _EngineFrameStream(request, cancel_key, frames())
 
 
 # ---------------------------------------------------------------------------
@@ -366,6 +696,49 @@ class InProcessReplica:
         except Exception as e:  # noqa: BLE001 — stopped/crashed engine
             raise ReplicaError(f"replica {self.replica_id}: {e}") from e
 
+    def generate_stream(
+        self, tokens, options: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Iterator[dict]:
+        """Streaming dispatch into the in-process engine: the same §17
+        frame iterator the HTTP transport yields, so the router's warm-
+        failover path treats local and remote replicas identically."""
+        options = dict(options or {})
+        try:
+            frames = engine_generate_stream(
+                self.engine,
+                {"prompt_tokens": list(tokens), "options": options},
+                timeout_s=(
+                    timeout_s if timeout_s is not None
+                    else hop_timeout_s(options)
+                ),
+            )
+        except (FleetShedError, ValueError):
+            raise  # sheds re-route; a bad REQUEST never quarantines
+        except Exception as e:  # noqa: BLE001 — stopped/crashed engine
+            raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+        return self._guard_frames(frames)
+
+    def _guard_frames(self, frames: Iterator[dict]) -> Iterator[dict]:
+        # mid-stream engine failures surface as ReplicaError so failover
+        # handling is one code path across transports; error frames are
+        # consumed here (the router never sees transport-internal kinds)
+        try:
+            for frame in frames:
+                if frame.get("kind") == "error":
+                    raise ReplicaError(
+                        f"replica {self.replica_id}: {frame.get('error')}"
+                    )
+                yield frame
+        except (FleetShedError, ReplicaError, ValueError):
+            raise
+        except Exception as e:  # noqa: BLE001 — engine died mid-stream
+            raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+        finally:
+            close = getattr(frames, "close", None)
+            if close is not None:
+                close()  # cancels the engine request if the consumer left
+
     def reset_histograms(self) -> None:
         self.engine.reset_histograms()
 
@@ -379,16 +752,39 @@ class HttpReplica:
 
     def __init__(
         self, replica_id: str, base_url: str,
-        beacon_timeout_s: float = 2.0, generate_timeout_s: float = 600.0,
+        beacon_timeout_s: float = 2.0,
+        generate_timeout_s: float = DEFAULT_HOP_TIMEOUT_S,
+        stream_idle_timeout_s: float = 20.0,
     ) -> None:
         self.replica_id = str(replica_id)
         self.url = base_url.rstrip("/")
         self.beacon_timeout_s = beacon_timeout_s
         self.generate_timeout_s = generate_timeout_s
+        # dead-peer detection on an OPEN stream (§17): the peer heartbeats
+        # every ~idle/4 while decoding slowly, so a wire silent past this
+        # bound is a dead/stalled peer, not a slow one — the hop fails and
+        # the router's warm failover takes over. The request's deadline
+        # (when tighter) bounds the whole hop regardless.
+        self.stream_idle_timeout_s = float(stream_idle_timeout_s)
 
     def _get(self, path: str, timeout_s: float) -> dict[str, Any]:
         with urllib.request.urlopen(self.url + path, timeout=timeout_s) as r:
             return json.loads(r.read().decode("utf-8"))
+
+    @staticmethod
+    def _tighten_read_timeout(resp: Any, timeout_s: float) -> None:
+        """Once the response HEADERS have arrived, drop the socket timeout
+        from the hop budget to the idle bound: from here on, silence
+        between frames longer than the heartbeat cadence means a dead
+        peer. Best-effort over stdlib internals (no public accessor for
+        the response's socket) — on failure the hop budget remains the
+        only bound, i.e. the pre-§17 behavior."""
+        try:
+            resp.fp.raw._sock.settimeout(  # noqa: SLF001
+                max(0.1, float(timeout_s))
+            )
+        except (AttributeError, OSError):
+            pass
 
     def fetch_beacon(self) -> dict[str, Any]:
         try:
@@ -407,18 +803,82 @@ class HttpReplica:
         self, tokens, options: Optional[dict] = None,
         timeout_s: Optional[float] = None,
     ) -> dict[str, Any]:
-        body = json.dumps(
-            {"prompt_tokens": list(map(int, tokens)), "options": options or {}}
-        ).encode("utf-8")
+        """Blocking dispatch: drain the streaming hop into the one-shot
+        result shape (back-compat surface for callers that want the whole
+        completion — the wire underneath always streams, §17)."""
+        out_tokens: list[int] = []
+        end: Optional[dict] = None
+        for frame in self.generate_stream(tokens, options, timeout_s=timeout_s):
+            kind = frame.get("kind")
+            if kind == "tokens":
+                out_tokens.extend(int(t) for t in frame.get("tokens") or [])
+            elif kind == "end":
+                end = frame
+        if end is None:  # generate_stream raises first; belt and braces
+            raise ReplicaError(
+                f"replica {self.replica_id}: stream ended without a "
+                "terminal frame"
+            )
+        return {
+            "tokens": out_tokens,
+            "finish_reason": str(end.get("finish_reason", "stop")),
+            "prompt_tokens": int(end.get("prompt_tokens", 0)),
+            "ttft_s": float(end.get("ttft_s", 0.0)),
+            "total_s": float(end.get("total_s", 0.0)),
+        }
+
+    def generate_stream(
+        self, tokens, options: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+        idle_timeout_s: Optional[float] = None,
+    ) -> Iterator[dict]:
+        """One streaming fleet hop (docs/SERVING.md §17): POST the request
+        with ``stream: true`` and yield validated frames as they arrive.
+        The request's deadline bounds CONNECT and every READ (hop budget =
+        remaining deadline + slack, never the flat default); the idle
+        timeout catches a silent peer between heartbeats. Frame validation
+        — contiguous seq, parseable JSON, terminal frame present — fails
+        the hop as ReplicaError, which is the router's failover signal;
+        tokens already yielded stay valid for a warm resume."""
+        options = dict(options or {})
+        injector = wire_injector()
+        if injector is not None and injector.fires("net-connect"):
+            raise ReplicaError(
+                f"replica {self.replica_id}: injected net-connect fault"
+            )
+        total_s = (
+            float(timeout_s) if timeout_s is not None
+            else hop_timeout_s(options, self.generate_timeout_s)
+        )
+        idle_s = float(
+            idle_timeout_s if idle_timeout_s is not None
+            else self.stream_idle_timeout_s
+        )
+        # urlopen's timeout is the SOCKET timeout: it bounds the connect
+        # and then every individual recv — exactly the per-read bound we
+        # want between frames
+        read_timeout = max(0.1, min(total_s, idle_s))
+        body = json.dumps({
+            "prompt_tokens": list(map(int, tokens)),
+            "options": options,
+            "stream": True,
+            # ask the peer to heartbeat well inside our idle timeout
+            "heartbeat-s": round(max(0.05, read_timeout / 4.0), 3),
+        }).encode("utf-8")
         req = urllib.request.Request(
             self.url + "/fleet/generate", data=body,
             headers={"Content-Type": "application/json"}, method="POST",
         )
+        hard_stop = time.monotonic() + total_s
         try:
-            with urllib.request.urlopen(
-                req, timeout=timeout_s or self.generate_timeout_s
-            ) as r:
-                return json.loads(r.read().decode("utf-8"))
+            # the HOP BUDGET (not the idle bound) governs connect + time-
+            # to-headers: the peer's eager submit may legitimately block
+            # on admission backpressure (shed-policy "block") with no
+            # bytes flowing yet — quarantining a merely-busy replica
+            # after idle_s would flap the whole fleet under load. Once
+            # the stream opens, the socket timeout tightens to the idle
+            # bound below.
+            resp = urllib.request.urlopen(req, timeout=max(0.1, total_s))
         except urllib.error.HTTPError as e:
             if e.code == 429:
                 retry = float(e.headers.get("Retry-After") or 1.0)
@@ -437,6 +897,105 @@ class HttpReplica:
             ) from e
         except (urllib.error.URLError, OSError, ValueError) as e:
             raise ReplicaError(f"replica {self.replica_id}: {e}") from e
+        self._tighten_read_timeout(resp, read_timeout)
+        expected_seq = 0
+        try:
+            with resp:
+                while True:
+                    if time.monotonic() >= hard_stop:
+                        raise ReplicaError(
+                            f"replica {self.replica_id}: hop budget "
+                            f"({total_s:.1f}s) exhausted mid-stream"
+                        )
+                    try:
+                        line = resp.readline()
+                    except (OSError, http.client.HTTPException, ValueError) as e:
+                        # socket timeout (idle peer), connection reset
+                        # (net-cut), chunked-decode garbage — all one
+                        # verdict: this hop is dead
+                        raise ReplicaError(
+                            f"replica {self.replica_id}: stream read failed "
+                            f"({e or type(e).__name__})"
+                        ) from e
+                    if not line:
+                        raise ReplicaError(
+                            f"replica {self.replica_id}: stream closed "
+                            "before terminal frame"
+                        )
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        frame = json.loads(line.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError) as e:
+                        raise ReplicaError(
+                            f"replica {self.replica_id}: corrupt stream "
+                            f"frame ({e})"
+                        ) from e
+                    if (
+                        expected_seq == 0
+                        and isinstance(frame, dict)
+                        and "seq" not in frame
+                        and ("tokens" in frame or "finish_reason" in frame)
+                    ):
+                        # a NOT-YET-UPGRADED peer ignored `stream: true`
+                        # and answered the legacy one-shot JSON body:
+                        # adapt it instead of quarantining a healthy
+                        # replica mid-rolling-upgrade
+                        try:
+                            adapted = list(result_frames(
+                                frame, prompt_len=len(list(tokens))
+                            ))
+                        except (TypeError, ValueError) as e:
+                            raise ReplicaError(
+                                f"replica {self.replica_id}: corrupt "
+                                f"legacy response body ({e})"
+                            ) from e
+                        for a in adapted:
+                            yield a
+                        return
+                    if (
+                        not isinstance(frame, dict)
+                        or frame.get("seq") != expected_seq
+                    ):
+                        got = (
+                            frame.get("seq") if isinstance(frame, dict)
+                            else None
+                        )
+                        raise ReplicaError(
+                            f"replica {self.replica_id}: stream sequence "
+                            f"broken (got {got!r}, want {expected_seq})"
+                        )
+                    expected_seq += 1
+                    kind = frame.get("kind")
+                    if kind == "error":
+                        raise ReplicaError(
+                            f"replica {self.replica_id}: "
+                            f"{frame.get('error')}"
+                        )
+                    if kind == "tokens":
+                        # the wire is untrusted: a parseable frame whose
+                        # token VALUES are garbage must fail the hop (the
+                        # failover signal), never leak a ValueError the
+                        # router would misread as a bad client request
+                        try:
+                            frame["tokens"] = [
+                                int(t) for t in frame.get("tokens") or []
+                            ]
+                        except (TypeError, ValueError) as e:
+                            raise ReplicaError(
+                                f"replica {self.replica_id}: corrupt "
+                                f"tokens frame ({e})"
+                            ) from e
+                    yield frame
+                    if kind == "end":
+                        return
+        except GeneratorExit:
+            # consumer abandoned the stream (local shortcut, failover of
+            # ANOTHER hop): close the socket so the peer's handler sees
+            # the disconnect and cancels its engine request
+            resp.close()
+            raise
 
     def reset_histograms(self) -> None:
         try:
@@ -467,6 +1026,14 @@ class _ReplicaState:
     # the replica but needs a restore — scored at spill_discount
     spilled_digests: dict[str, int] = field(default_factory=dict)
     adapters: frozenset = frozenset()  # resident LoRA adapter names
+    # circuit breaker (docs/SERVING.md §17): consecutive beacon-fetch +
+    # dispatch failures drive an exponential probe backoff — the refresh
+    # loop stops hammering a dead peer's /state every interval, and the
+    # backoff expiry IS the half-open probe slot (one beacon fetch; a
+    # fresh beacon closes the circuit, a failure doubles the backoff)
+    fails: int = 0
+    backoff_until: float = -1e18
+    circuit_open: bool = False
 
 
 @dataclass
@@ -503,6 +1070,8 @@ class FleetRouter:
         shed_queue_wait_s: float = 30.0,
         adapter_affinity_tokens: float = 512.0,
         spill_discount: float = 0.5,
+        beacon_backoff_max_s: float = 30.0,
+        circuit_failures: int = 3,
     ) -> None:
         if policy not in self.POLICIES:
             raise ValueError(
@@ -528,6 +1097,13 @@ class FleetRouter:
         # free — and it says nothing about the replica being otherwise
         # idle. 0 ignores spilled advertisements; 1 scores them at par.
         self.spill_discount = min(1.0, max(0.0, float(spill_discount)))
+        # probe backoff cap + the consecutive-failure count at which the
+        # breaker is DECLARED open (routability is already gated by beacon
+        # freshness from the first failure; the threshold only decides
+        # when the state — and the circuit_open_total transition counter —
+        # reads "open" rather than "blip")
+        self.beacon_backoff_max_s = float(beacon_backoff_max_s)
+        self.circuit_failures = max(1, int(circuit_failures))
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -546,30 +1122,63 @@ class FleetRouter:
         self.routed_adapter_total = 0
         self.shed_total = 0
         self.failover_total = 0
+        # wire hardening (docs/SERVING.md §17): mid-STREAM warm failovers
+        # (a cold failover before the first frame counts only in
+        # failover_total), beacon-fetch failures, and circuit-open
+        # transitions
+        self.stream_failover_total = 0
+        self.beacon_failures_total = 0
+        self.circuit_open_total = 0
         self._hist_lock = threading.Lock()
         self.dispatch_hist = Histogram(
             "fleet_dispatch_s",
             "router route() host wall time per dispatch (s)",
             log_buckets(1e-7, 1.0, 4),
         )
+        self.hop_hist = Histogram(
+            "fleet_hop_s",
+            FLEET_HISTOGRAMS["fleet_hop_s"]["help"],
+            FLEET_HISTOGRAMS["fleet_hop_s"]["buckets"],
+        )
+        # the router's own flight recorder: its ring stays empty (no
+        # engine loop here) — fleet-failover dumps carry the hop's frame
+        # TRACE in extra instead, token-content-free like every dump
+        self._flight = FlightRecorder(
+            capacity=8,
+            dump_dir=os.environ.get("LSTPU_FLIGHT_DIR") or None,
+        )
 
     # -- beacon refresh -----------------------------------------------------
 
-    def refresh_all(self) -> int:
+    def refresh_all(self, force: bool = True) -> int:
         """Fetch every replica's beacon once (synchronously). Returns how
         many refreshed successfully. Failures just leave the old beacon to
-        age out — route() treats stale as unroutable."""
+        age out — route() treats stale as unroutable — and feed the
+        per-replica circuit breaker (§17): consecutive failures back the
+        probe off exponentially (capped at ``beacon_backoff_max_s``), so
+        the refresh loop stops hitting a dead peer's /state every interval
+        forever. ``force=False`` (the background loop) honors the backoff
+        — a skipped replica is simply not yet due for its half-open probe;
+        the default probes everything (manual refresh, tests, start())."""
         ok = 0
         for state in list(self._replicas.values()):
+            if not force:
+                with self._lock:
+                    if time.monotonic() < state.backoff_until:
+                        continue  # circuit open: not due for the probe
             try:
                 beacon = state.handle.fetch_beacon()
             except ReplicaError as e:
                 log.debug("beacon refresh failed: %s", e)
+                with self._lock:
+                    self._note_failure(state, beacon_fetch=True)
                 continue
             except Exception:  # noqa: BLE001 — refresher must never die
                 log.exception(
                     "beacon refresh crashed for %s", state.handle.replica_id
                 )
+                with self._lock:
+                    self._note_failure(state, beacon_fetch=True)
                 continue
             with self._lock:
                 state.beacon = beacon
@@ -584,8 +1193,39 @@ class FleetRouter:
                 state.adapters = frozenset(
                     str(a) for a in (beacon.get("adapters") or [])
                 )
+                # a fresh beacon is the half-open probe SUCCEEDING: close
+                # the circuit and forget the backoff
+                if state.circuit_open:
+                    log.info(
+                        "circuit closed for replica %s (fresh beacon after "
+                        "%d failure(s))", state.handle.replica_id, state.fails,
+                    )
+                state.fails = 0
+                state.backoff_until = -1e18
+                state.circuit_open = False
             ok += 1
         return ok
+
+    def _note_failure(self, state: _ReplicaState, beacon_fetch: bool) -> None:
+        """One beacon-fetch or dispatch failure (caller holds ``_lock``):
+        advance the breaker — exponential probe backoff from the first
+        failure, the OPEN transition (counted once) at the threshold."""
+        state.fails += 1
+        if beacon_fetch:
+            self.beacon_failures_total += 1
+        base = max(self.refresh_interval_s, 0.1)
+        state.backoff_until = time.monotonic() + min(
+            base * (2 ** min(state.fails - 1, 16)), self.beacon_backoff_max_s
+        )
+        if state.fails >= self.circuit_failures and not state.circuit_open:
+            state.circuit_open = True
+            self.circuit_open_total += 1
+            log.warning(
+                "circuit OPEN for replica %s after %d consecutive "
+                "failure(s); half-open probe in <= %.1fs",
+                state.handle.replica_id, state.fails,
+                max(0.0, state.backoff_until - time.monotonic()),
+            )
 
     def start(self, initial_refresh: bool = True) -> None:
         if initial_refresh:
@@ -606,7 +1246,9 @@ class FleetRouter:
 
     def _refresh_loop(self) -> None:
         while not self._stop.wait(self.refresh_interval_s):
-            self.refresh_all()
+            # the loop honors per-replica backoff: a dead peer is probed
+            # on the circuit's half-open schedule, not every interval
+            self.refresh_all(force=False)
 
     # -- health -------------------------------------------------------------
 
@@ -625,7 +1267,9 @@ class FleetRouter:
     def mark_failed(self, replica_id: str) -> None:
         """A dispatch to this replica failed: quarantine it for
         ``fail_cooldown_s`` (and until a FRESH beacon proves it back). Its
-        sticky sessions fail over cold at their next request."""
+        sticky sessions fail over cold at their next request. Dispatch
+        failures feed the same circuit breaker as beacon-fetch failures —
+        readmission is always through the half-open beacon probe."""
         with self._lock:
             state = self._replicas.get(replica_id)
             if state is None:
@@ -635,6 +1279,7 @@ class FleetRouter:
             # the beacon that routed us here predates the failure — drop it
             # so recovery requires a refresh newer than the incident
             state.beacon_at = -1e18
+            self._note_failure(state, beacon_fetch=False)
 
     def _routable(self, state: _ReplicaState, now: float) -> bool:
         if now - state.failed_at < self.fail_cooldown_s:
@@ -828,45 +1473,322 @@ class FleetRouter:
 
     # -- dispatch with failover ----------------------------------------------
 
+    @staticmethod
+    def _oneshot_frames(
+        handle: Any, prompt: list, opts: dict, timeout_s: float,
+    ) -> Iterator[dict]:
+        """Frame adapter for transports without ``generate_stream`` (test
+        fakes, older peers): ONE blocking dispatch wrapped into the frame
+        shapes. The blocking call runs EAGERLY so its shed/failure raises
+        inside the caller's dispatch try-block."""
+        return result_frames(
+            handle.generate(prompt, opts, timeout_s), prompt_len=len(prompt)
+        )
+
+    def stream_generate(
+        self,
+        tokens,
+        options: Optional[dict] = None,
+        session_id: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Iterator[dict]:
+        """Route + STREAM one request with mid-stream warm failover
+        (docs/SERVING.md §17). Yields router-sequenced frames (one
+        contiguous ``seq`` across failovers — the client-facing
+        no-dup/no-drop/no-reorder guarantee):
+
+          route    before every hop: replica_id / url / local flag /
+                   tokens-resumed count, plus the RouteDecision object
+                   (in-process consumers only; never serialized)
+          tokens   token chunks, piped through from the serving replica
+          heartbeat  forwarded transport liveness (consumers may ignore
+                   them; forwarding keeps this generator closeable
+                   between tokens)
+          end      exactly once on success: finish_reason, usage against
+                   the ORIGINAL prompt, router-observed ttft_s/total_s,
+                   the serving replica and the failover count
+
+        A replica dying mid-stream (ReplicaError) is quarantined and the
+        request re-dispatches to a survivor with ``prompt + delivered
+        tokens`` as the new prompt — prefix reuse (and the host tier's
+        spilled prefixes) makes the resume warm, and greedy resumed
+        streams are token-exact vs an uninterrupted run. Each failover
+        dumps a ``fleet-failover`` flight record carrying the hop's frame
+        trace. Sheds exclude-and-retry as before; a bad request
+        (ValueError) propagates untouched."""
+        from langstream_tpu.models.configs import GenerationOptions
+
+        tokens = list(tokens)
+        options = dict(options or {})
+        # the canonical parse — NOT a re-implementation of the key chains
+        # and defaults, which would silently diverge from what the
+        # serving engine actually enforces
+        parsed = GenerationOptions.from_dict(options)
+        budget = int(parsed.max_new_tokens)
+        total_s = (
+            float(timeout_s) if timeout_s is not None
+            else hop_timeout_s(options)
+        )
+        started = time.monotonic()
+        first_token_at: Optional[float] = None
+        delivered: list[int] = []
+        out_seq = 0
+        excluded: set = set()
+        last_shed: Optional[FleetShedError] = None
+        trace: deque = deque(maxlen=64)
+        failovers = 0
+        # set on a mid-stream death; counted + dumped only once route()
+        # actually finds a survivor — a terminal failure is not a
+        # "failover" (the metric means RESUMED, §17)
+        pending_failover: Optional[dict] = None
+        adapter = str(options.get("adapter") or "") or None
+        for _ in range(self.replica_count):
+            prompt = tokens + delivered
+            opts = dict(options)
+            if delivered:
+                # the resumed stream finishes the ORIGINAL budget: tokens
+                # already delivered never re-generate (and never re-bill)
+                opts["max-tokens"] = max(1, budget - len(delivered))
+            try:
+                decision = self.route(
+                    prompt, session_id=session_id, exclude=excluded,
+                    adapter=adapter,
+                )
+            except FleetShedError as e:
+                if delivered:
+                    raise ReplicaError(
+                        f"stream lost its replica after {len(delivered)} "
+                        f"token(s) and no survivor is routable: {e}"
+                    ) from e
+                raise
+            if pending_failover is not None:
+                # the resume has a survivor: NOW it is a warm failover
+                failovers += 1
+                with self._lock:
+                    self.stream_failover_total += 1
+                    stream_failovers = self.stream_failover_total
+                self._flight.dump(
+                    "fleet-failover",
+                    counters={
+                        "delivered": pending_failover["delivered"],
+                        "stream_failovers_total": stream_failovers,
+                        "failover_total": self.failover_total,
+                    },
+                    extra={
+                        **pending_failover,
+                        "resumed_on": decision.replica_id,
+                    },
+                    force=True,  # every mid-stream resume is an incident
+                )
+                pending_failover = None
+            yield {
+                "v": FRAME_SCHEMA, "seq": out_seq, "kind": "route",
+                "replica": decision.replica_id,
+                "url": str(getattr(decision.handle, "url", "") or ""),
+                "local": bool(getattr(decision.handle, "is_local", False)),
+                "resumed": len(delivered),
+                "decision": decision,
+            }
+            out_seq += 1
+            remaining = total_s - (time.monotonic() - started)
+            if remaining <= 0:
+                raise ReplicaError(
+                    f"hop budget ({total_s:.1f}s) exhausted after "
+                    f"{len(delivered)} token(s)"
+                )
+            stream_fn = getattr(decision.handle, "generate_stream", None)
+            hop_t0 = time.perf_counter()
+            try:
+                frames = (
+                    stream_fn(prompt, opts, timeout_s=remaining)
+                    if stream_fn is not None
+                    else self._oneshot_frames(
+                        decision.handle, prompt, opts, remaining
+                    )
+                )
+                for frame in frames:
+                    kind = frame.get("kind")
+                    trace.append({
+                        "seq": frame.get("seq"), "kind": kind,
+                        "n": (
+                            len(frame.get("tokens") or [])
+                            if kind == "tokens" else 0
+                        ),
+                        "t": round(time.monotonic() - started, 4),
+                        "replica": decision.replica_id,
+                    })
+                    if kind == "tokens":
+                        try:
+                            toks = [
+                                int(t) for t in frame.get("tokens") or []
+                            ]
+                        except (TypeError, ValueError) as bad:
+                            # frame CONTENT from the replica, not the
+                            # caller's request: this must read as a dead
+                            # hop (failover), never as a bad request
+                            raise ReplicaError(
+                                f"replica {decision.replica_id}: corrupt "
+                                f"tokens frame ({bad})"
+                            ) from bad
+                        if not toks:
+                            continue
+                        if first_token_at is None:
+                            first_token_at = time.monotonic()
+                        delivered.extend(toks)
+                        yield {
+                            "seq": out_seq, "kind": "tokens",
+                            "tokens": toks, "replica": decision.replica_id,
+                        }
+                        out_seq += 1
+                    elif kind == "end":
+                        with self._hist_lock:
+                            self.hop_hist.record(
+                                time.perf_counter() - hop_t0
+                            )
+                        now = time.monotonic()
+                        yield {
+                            "seq": out_seq, "kind": "end",
+                            "finish_reason": str(
+                                frame.get("finish_reason", "stop")
+                            ),
+                            "prompt_tokens": len(tokens),
+                            "completion_tokens": len(delivered),
+                            "ttft_s": round(
+                                (first_token_at or now) - started, 6
+                            ),
+                            "total_s": round(now - started, 6),
+                            "engine_ttft_s": float(frame.get("ttft_s", 0.0)),
+                            "failovers": failovers,
+                            "replica": decision.replica_id,
+                        }
+                        return
+                    elif kind == "heartbeat":
+                        # forward (re-sequenced): the consumer may ignore
+                        # them, but YIELDING here parks this generator at
+                        # a resumable point between tokens — an abandoned
+                        # stream's close() lands at the next heartbeat
+                        # instead of waiting out an inter-token gap
+                        yield {
+                            "seq": out_seq, "kind": "heartbeat",
+                            "replica": decision.replica_id,
+                        }
+                        out_seq += 1
+                raise ReplicaError(
+                    f"replica {decision.replica_id}: stream ended without "
+                    "terminal frame"
+                )
+            except GeneratorExit:
+                # the CONSUMER abandoned this stream (disconnect, local
+                # shortcut): close the hop so the serving replica cancels
+                # its in-flight request instead of decoding to the budget
+                close = getattr(frames, "close", None)
+                if close is not None:
+                    close()
+                raise
+            except FleetShedError as e:
+                last_shed = e
+                excluded.add(decision.replica_id)
+                continue
+            except ValueError:
+                raise  # the REQUEST is bad — never retried across the fleet
+            except ReplicaError as e:
+                log.warning(
+                    "replica %s failed mid-dispatch (%s); failing over "
+                    "(%d token(s) delivered)",
+                    decision.replica_id, e, len(delivered),
+                )
+                # failed/wedged hops land in the histogram too — an
+                # incident is exactly when the hop-latency panel must move
+                with self._hist_lock:
+                    self.hop_hist.record(time.perf_counter() - hop_t0)
+                self.note_failover(decision.replica_id)
+                excluded.add(decision.replica_id)
+                if delivered and parsed.response_format:
+                    # a grammar-constrained stream cannot resume
+                    # mid-derivation: the survivor's DFA would restart at
+                    # state 0 and append a SECOND derivation after the
+                    # partial one — invalid output dressed as valid. Fail
+                    # loudly; the §15 parse/validate guarantee outranks
+                    # availability until DFA state rides the resume.
+                    raise ReplicaError(
+                        f"constrained stream lost its replica after "
+                        f"{len(delivered)} token(s); mid-derivation "
+                        "resume would break the grammar guarantee"
+                    ) from e
+                if delivered and len(delivered) >= budget:
+                    # the replica died BETWEEN its final tokens frame and
+                    # the terminal frame: the budget is fully delivered —
+                    # synthesize the end instead of re-dispatching for
+                    # tokens an uninterrupted run would never generate
+                    now = time.monotonic()
+                    yield {
+                        "seq": out_seq, "kind": "end",
+                        "finish_reason": "length",
+                        "prompt_tokens": len(tokens),
+                        "completion_tokens": len(delivered),
+                        "ttft_s": round((first_token_at or now) - started, 6),
+                        "total_s": round(now - started, 6),
+                        "engine_ttft_s": 0.0,
+                        "failovers": failovers,
+                        "replica": decision.replica_id,
+                    }
+                    return
+                if delivered:
+                    pending_failover = {
+                        "victim": decision.replica_id,
+                        "delivered": len(delivered),
+                        "resumed_prompt_len": len(tokens) + len(delivered),
+                        "error": str(e),
+                        "frames": list(trace),
+                    }
+                continue
+        if last_shed is not None and not delivered:
+            with self._lock:
+                self.shed_total += 1
+            raise last_shed
+        # nobody shed — every attempt DIED. ReplicaError (not a shed) so
+        # callers can tell "fleet is saturated, back off" from "fleet is
+        # broken, serve locally if you can" (the completions fallback)
+        raise ReplicaError(
+            f"every replica failed this stream "
+            f"({len(delivered)} token(s) delivered)"
+        )
+
     def generate(
         self,
         tokens,
         options: Optional[dict] = None,
         session_id: Optional[str] = None,
-        timeout_s: float = 600.0,
+        timeout_s: Optional[float] = None,
     ) -> tuple[dict[str, Any], RouteDecision]:
-        """Route + dispatch one request, failing over COLD to a surviving
-        replica when the chosen one dies mid-flight (ReplicaError). A
-        replica that merely sheds is excluded and the rest get a chance;
-        when everyone sheds, the fleet-level FleetShedError propagates with
-        the smallest retry-after observed."""
-        tokens = list(tokens)
-        excluded: set = set()
-        last_shed: Optional[FleetShedError] = None
-        for _ in range(self.replica_count):
-            decision = self.route(tokens, session_id, exclude=excluded)
-            try:
-                out = decision.handle.generate(
-                    tokens, options or {}, timeout_s
-                )
-                return out, decision
-            except FleetShedError as e:
-                last_shed = e
-                excluded.add(decision.replica_id)
-            except ReplicaError as e:
-                log.warning(
-                    "replica %s failed mid-dispatch (%s); failing over",
-                    decision.replica_id, e,
-                )
-                self.note_failover(decision.replica_id)
-                excluded.add(decision.replica_id)
-        if last_shed is not None:
-            with self._lock:
-                self.shed_total += 1
-            raise last_shed
-        raise FleetShedError(
-            "every replica failed or shed this request", retry_after_s=1.0
-        )
+        """Blocking route + dispatch: drain ``stream_generate`` (same
+        failover semantics, now WARM mid-stream instead of restart-cold)
+        into the one-shot result shape. The decision returned is the
+        replica that actually FINISHED the stream. ``timeout_s`` defaults
+        to None so the deadline-derived hop budget applies here too —
+        a non-None default would quietly reinstate the flat 600s."""
+        delivered: list[int] = []
+        decision: Optional[RouteDecision] = None
+        end: Optional[dict] = None
+        for frame in self.stream_generate(
+            tokens, options, session_id=session_id, timeout_s=timeout_s
+        ):
+            kind = frame.get("kind")
+            if kind == "route":
+                decision = frame["decision"]
+            elif kind == "tokens":
+                delivered.extend(frame["tokens"])
+            elif kind == "end":
+                end = frame
+        assert end is not None and decision is not None
+        out = {
+            "tokens": delivered,
+            "finish_reason": end["finish_reason"],
+            "prompt_tokens": end["prompt_tokens"],
+            "ttft_s": end["ttft_s"],
+            "total_s": end["total_s"],
+        }
+        return out, decision
 
     # -- autoscale hint -------------------------------------------------------
 
@@ -926,6 +1848,12 @@ class FleetRouter:
                 "fleet-routed-adapter-total": self.routed_adapter_total,
                 "fleet-shed-total": self.shed_total,
                 "fleet-failover-total": self.failover_total,
+                "fleet-stream-failovers-total": self.stream_failover_total,
+                "fleet-beacon-failures-total": self.beacon_failures_total,
+                "fleet-circuit-open-total": self.circuit_open_total,
+                "fleet-circuit-open-replicas": sum(
+                    1 for s in self._replicas.values() if s.circuit_open
+                ),
                 "fleet-sticky-sessions": len(self._sticky),
             }
         out["fleet-dispatch-p50-ms"] = round(
@@ -934,6 +1862,15 @@ class FleetRouter:
         out["fleet-dispatch-p99-ms"] = round(
             self.dispatch_hist.percentile(0.99) * 1e3, 4
         )
+        out["fleet-hop-p50-ms"] = round(
+            self.hop_hist.percentile(0.50) * 1e3, 4
+        )
+        out["fleet-hop-p99-ms"] = round(
+            self.hop_hist.percentile(0.99) * 1e3, 4
+        )
+        # mirrored into /metrics by the genai exporter (same load() path
+        # as the engine histograms — docs/SERVING.md §12/§17)
+        out["histograms"] = {"fleet_hop_s": self.hop_hist.snapshot()}
         out["fleet-desired-replicas"] = self.desired_replicas()
         return out
 
@@ -953,6 +1890,19 @@ async def _serve(config: dict[str, Any], host: str, port: int) -> None:
     from langstream_tpu.ai.tpu_serving import _EngineHolder
     from langstream_tpu.runtime.http_server import RuntimeHttpServer
 
+    # wire-level fault drills (docs/SERVING.md §17): the worker's config
+    # may carry a net-* spec for THIS process's transport/handler sites —
+    # separate keys from the engine's fault-injection so a drill can cut
+    # the wire of a perfectly healthy engine
+    wire_spec = str(config.get("wire-fault-injection") or "").strip()
+    if wire_spec:
+        from langstream_tpu.serving.faultinject import FaultInjector
+
+        set_wire_injector(FaultInjector(
+            wire_spec,
+            seed=int(config.get("wire-fault-seed", 0)),
+            stall_s=float(config.get("wire-fault-stall-s", 0.05)),
+        ))
     holder = _EngineHolder(config)
     engine = holder.engine()  # builds + starts + registers the beacon
     replica_id = str(config.get("fleet-replica-id") or "replica-0")

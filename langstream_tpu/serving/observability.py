@@ -100,6 +100,20 @@ ENGINE_HISTOGRAMS: dict[str, dict[str, Any]] = {
 }
 
 
+# fleet-wire distributions (serving/fleet.py, docs/SERVING.md §17): owned
+# by the ROUTER, not the engine — kept here so the genai exporter and the
+# metrics-artifact guards share one bucket spec without importing fleet.py
+# (which pulls jax via pagepool)
+FLEET_HISTOGRAMS: dict[str, dict[str, Any]] = {
+    "fleet_hop_s": {
+        "help": "remote fleet hop wall time, dispatch to terminal frame "
+                "OR hop failure (s) — failed/wedged hops count, so the "
+                "tail moves during incidents",
+        "buckets": log_buckets(1e-3, 600.0, 4),
+    },
+}
+
+
 def build_histograms() -> dict[str, Histogram]:
     return {
         name: Histogram(name, spec["help"], spec["buckets"])
@@ -252,6 +266,12 @@ DUMP_REASONS = (
     # failed replay): dumped on the FOLLOWER, tagged with the ControlBlock
     # seq, before the replica crashes — docs/SERVING.md §14
     "spmd-divergence",
+    # a replica died mid-STREAM on the fleet wire and the router re-
+    # dispatched prompt + delivered tokens to a survivor (docs/SERVING.md
+    # §17): dumped by the ROUTER's recorder with the hop's frame TRACE
+    # (seq/kind/count metadata, never token content) in extra — its
+    # iteration ring is empty because the router runs no engine loop
+    "fleet-failover",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

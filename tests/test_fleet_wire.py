@@ -1,0 +1,945 @@
+"""Fleet wire hardening tests (ISSUE 12, docs/SERVING.md §17).
+
+Four tiers:
+1. Frame-protocol units over a real engine: contiguous seq numbers,
+   token-exactness of the streamed chunks vs the blocking result,
+   heartbeats while decode is slow, and the deadline-derived hop budget
+   (a 10s-deadline request must never hold a hop for the flat default).
+2. The HTTP transport under network chaos: all four ``net-*`` fault
+   sites — connect refused, mid-token stall (idle-timeout detection),
+   connection cut (reset before the terminal frame), corrupt frame
+   (validation fails the hop) — deterministic under the pinned seed the
+   CI chaos step exports.
+3. The mid-stream kill drill (the acceptance criterion): ``net-cut``
+   after ≥8 streamed tokens on a 2-replica CPU fleet — the client
+   receives ONE contiguous, seq-verified stream with no duplicated /
+   missing tokens, the greedy resumed output is token-exact vs an
+   uninterrupted run, the survivor's resume is WARM (prefix reuse,
+   prefill_tokens_saved > 0), a ``fleet-failover`` flight dump is
+   produced, and neither engine restarts.
+4. Robustness satellites: /fleet/cancel error paths (dead peer URL,
+   unknown session, cancel racing completion) and the per-replica
+   circuit breaker (beacon-probe exponential backoff, half-open
+   readmission).
+
+A REAL process kill variant lives at the bottom, marked slow (one
+subprocess engine build); the chaos CI step runs it.
+"""
+
+import asyncio
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+from langstream_tpu.models.transformer import init_params
+from langstream_tpu.runtime.http_server import RuntimeHttpServer
+from langstream_tpu.serving import fleet as fleet_mod
+from langstream_tpu.serving import lifecycle
+from langstream_tpu.serving.engine import ServingEngine
+from langstream_tpu.serving.faultinject import FaultInjector
+from langstream_tpu.serving.fleet import (
+    FRAME_SCHEMA,
+    FleetRouter,
+    FleetShedError,
+    HttpReplica,
+    InProcessReplica,
+    ReplicaError,
+    beacon_from_engine,
+    engine_generate,
+    engine_generate_stream,
+    hop_timeout_s,
+    set_wire_injector,
+)
+from langstream_tpu.serving.observability import validate_flight_dump
+
+CFG = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+PROMPT = [9 + (3 * i) % 50 for i in range(40)]
+
+
+def make_engine(prefix=True, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("prefill_buckets", (16, 32, 64))
+    engine = ServingEngine(
+        CFG, PARAMS, prefix_cache="auto" if prefix else "off", **kw,
+    )
+    engine.start()
+    return engine
+
+
+@pytest.fixture(autouse=True)
+def _clean_wire_injector():
+    """Every test starts and ends with NO wire injector: the module-global
+    injector must never leak chaos into a neighbouring test."""
+    set_wire_injector(None)
+    yield
+    set_wire_injector(None)
+
+
+# ---------------------------------------------------------------------------
+# Shared engines + HTTP ring (module-scoped: engine builds compile XLA)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def eng_plain():
+    engine = make_engine()
+    engine.generate(PROMPT[:20], GenerationOptions(max_new_tokens=2, temperature=0.0))
+    yield engine
+    engine.stop()
+
+
+@pytest.fixture(scope="module")
+def eng_slow():
+    """Tokens trickle one at a time (the ``client`` stall site), so streams
+    have a real duration — what makes TTFT-vs-total and mid-stream cuts
+    observable on CPU."""
+    engine = make_engine(
+        fault_injector=FaultInjector("client@1+", seed=0, stall_s=0.05),
+    )
+    engine.generate(PROMPT[:20], GenerationOptions(max_new_tokens=2, temperature=0.0))
+    yield engine
+    engine.stop()
+
+
+@pytest.fixture(scope="module")
+def http_ring():
+    """One event loop + RuntimeHttpServer for the module; tests register
+    the engine they need via ``serve()`` (the process-local fleet registry
+    serves ONE engine at a time, like a real replica pod)."""
+    loop = asyncio.new_event_loop()
+    server = RuntimeHttpServer(metrics_text=lambda: "", agents_info=lambda: [], port=0)
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(10)
+
+    class Ring:
+        url = server.url
+
+        @staticmethod
+        def serve(engine, rid="pod-wire"):
+            class _Ctx:
+                def __enter__(self):
+                    fleet_mod.register_local(
+                        rid,
+                        beacon_fn=lambda: beacon_from_engine(rid, engine),
+                        generate_fn=lambda p: engine_generate(engine, p),
+                        generate_stream_fn=lambda p: engine_generate_stream(
+                            engine, p
+                        ),
+                        reset_fn=engine.reset_histograms,
+                    )
+                    return HttpReplica(rid, server.url)
+
+                def __exit__(self, *exc):
+                    fleet_mod.unregister_local(rid)
+
+            return _Ctx()
+
+    yield Ring
+    asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+    loop.call_soon_threadsafe(loop.stop)
+    thread.join(timeout=10)
+    loop.close()
+
+
+def _drain(frames):
+    """Collect (frames, tokens) with client-side seq verification."""
+    out, tokens = [], []
+    expected = 0
+    for frame in frames:
+        assert frame.get("seq") == expected, (
+            f"seq broken: got {frame.get('seq')}, want {expected} "
+            f"(frames so far: {[f.get('kind') for f in out]})"
+        )
+        expected += 1
+        out.append(frame)
+        if frame.get("kind") == "tokens":
+            tokens.extend(int(t) for t in frame["tokens"])
+    return out, tokens
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: frame protocol units
+# ---------------------------------------------------------------------------
+
+
+def test_engine_stream_frames_token_exact(eng_plain):
+    ref = eng_plain.generate(
+        list(PROMPT), GenerationOptions(max_new_tokens=12, temperature=0.0),
+        timeout=120,
+    )
+    frames, tokens = _drain(engine_generate_stream(
+        eng_plain,
+        {
+            "prompt_tokens": list(PROMPT),
+            "options": {"max-tokens": 12, "temperature": 0.0},
+        },
+    ))
+    assert tokens == list(ref.tokens), "streamed tokens diverge from blocking result"
+    # the schema stamp rides the stream's first frame, whatever its kind
+    # (a pre-first-token compile can put a heartbeat at seq 0)
+    assert frames[0].get("v") == FRAME_SCHEMA
+    end = frames[-1]
+    assert end["kind"] == "end"
+    assert end["finish_reason"] in ("length", "stop")
+    assert end["usage"] == {
+        "prompt_tokens": len(PROMPT), "completion_tokens": len(tokens),
+    }
+    assert end["prompt_tokens"] == len(PROMPT)
+    # token content never rides the terminal frame — the client already
+    # holds every token from the stream itself
+    assert "tokens" not in end
+
+
+def test_engine_stream_rejects_empty_prompt(eng_plain):
+    with pytest.raises(ValueError):
+        engine_generate_stream(eng_plain, {"prompt_tokens": [], "options": {}})
+
+
+def test_heartbeats_flow_while_decode_is_slow(eng_slow):
+    """Idle-stream heartbeats are what let a client distinguish slow
+    decode (heartbeats flow) from a dead peer (silence): with 50ms
+    inter-token stalls and a 10ms heartbeat interval, heartbeat frames
+    must appear between token frames — all on one contiguous seq."""
+    frames, tokens = _drain(engine_generate_stream(
+        eng_slow,
+        {
+            "prompt_tokens": list(PROMPT),
+            "options": {"max-tokens": 6, "temperature": 0.0},
+            "heartbeat-s": 0.01,
+        },
+    ))
+    kinds = [f["kind"] for f in frames]
+    assert kinds.count("heartbeat") >= 3, kinds
+    assert len(tokens) == 6
+    assert kinds[-1] == "end"
+
+
+def test_hop_timeout_derives_from_deadline():
+    """The deadline-propagation satellite, unit half: the hop budget is
+    the request's remaining deadline + slack, never the flat default —
+    and garbage deadlines fall back to the default instead of crashing."""
+    assert hop_timeout_s({}) == 600.0
+    assert hop_timeout_s(None) == 600.0
+    assert hop_timeout_s({"deadline": 10}) == 15.0
+    assert hop_timeout_s({"deadline-s": 2.0}) == 7.0
+    assert hop_timeout_s({"deadline": 1e9}) == 600.0
+    assert hop_timeout_s({"deadline": 0}) == 600.0
+    assert hop_timeout_s({"deadline": "soon"}) == 600.0
+    assert hop_timeout_s({"deadline": 20}, default=8.0) == 8.0
+
+
+def test_deadline_rides_the_hop_and_bounds_it(eng_slow, http_ring):
+    """The deadline-propagation satellite, e2e half: a 0.4s-deadline
+    request dispatched over the wire finishes as ``deadline`` (partial
+    tokens kept) in about that long — the peer's ENGINE enforces the
+    forwarded deadline; nothing waits on the flat 600s default."""
+    with http_ring.serve(eng_slow) as replica:
+        t0 = time.monotonic()
+        frames, tokens = _drain(replica.generate_stream(
+            PROMPT, {"max-tokens": 80, "temperature": 0.0, "deadline": 0.4},
+        ))
+        took = time.monotonic() - t0
+    assert frames[-1]["kind"] == "end"
+    assert frames[-1]["finish_reason"] == "deadline"
+    assert 0 < len(tokens) < 80
+    assert took < 10.0, f"deadline-bounded hop took {took:.1f}s"
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: HTTP streaming parity + network chaos
+# ---------------------------------------------------------------------------
+
+
+def test_remote_streaming_ttft_parity(eng_slow, http_ring):
+    """The acceptance criterion: a remote dispatch delivers its first
+    chunk long before the completion finishes (vs the old single-final-
+    chunk hop, where first == last by construction)."""
+    with http_ring.serve(eng_slow) as replica:
+        t0 = time.monotonic()
+        t_first = None
+        tokens = []
+        for frame in replica.generate_stream(
+            PROMPT, {"max-tokens": 12, "temperature": 0.0}
+        ):
+            if frame.get("kind") == "tokens":
+                if t_first is None:
+                    t_first = time.monotonic() - t0
+                tokens.extend(frame["tokens"])
+        total = time.monotonic() - t0
+    assert len(tokens) == 12
+    # 12 tokens × 50ms stall ≈ 600ms of decode; the first chunk must land
+    # well inside that window, not at the end
+    assert t_first is not None and t_first < 0.5 * total, (
+        f"first chunk at {t_first:.3f}s of {total:.3f}s — not streaming"
+    )
+
+
+def test_net_connect_refuses_deterministically(http_ring, eng_plain):
+    set_wire_injector(FaultInjector("net-connect@1", seed=0))
+    with http_ring.serve(eng_plain) as replica:
+        with pytest.raises(ReplicaError, match="net-connect"):
+            list(replica.generate_stream(PROMPT, {"max-tokens": 4}))
+        # @1 fires exactly once: the retry connects and completes
+        _frames, tokens = _drain(replica.generate_stream(
+            PROMPT, {"max-tokens": 4, "temperature": 0.0}
+        ))
+    assert len(tokens) == 4
+    assert fleet_mod.wire_injector().fired["net-connect"] == 1
+
+
+def test_net_corrupt_frame_fails_the_hop(eng_slow, http_ring):
+    """A malformed frame must fail the hop loudly (ReplicaError — the
+    router's failover signal), never deliver garbage; the peer's engine
+    request is cancelled when the client hangs up."""
+    set_wire_injector(FaultInjector("net-corrupt@3", seed=0))
+    with http_ring.serve(eng_slow) as replica:
+        with pytest.raises(ReplicaError, match="corrupt|sequence"):
+            list(replica.generate_stream(
+                PROMPT, {"max-tokens": 50, "temperature": 0.0}
+            ))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if eng_slow.stats()["active-slots"] == 0:
+                break
+            time.sleep(0.05)
+        assert eng_slow.stats()["active-slots"] == 0, (
+            "abandoned stream kept burning its slot"
+        )
+
+
+def test_net_cut_resets_mid_stream(eng_slow, http_ring):
+    set_wire_injector(FaultInjector("net-cut@4", seed=0))
+    with http_ring.serve(eng_slow) as replica:
+        tokens = []
+        with pytest.raises(ReplicaError):
+            for frame in replica.generate_stream(
+                PROMPT, {"max-tokens": 50, "temperature": 0.0}
+            ):
+                if frame.get("kind") == "tokens":
+                    tokens.extend(frame["tokens"])
+    # the cut landed AFTER frames flowed and BEFORE the stream finished
+    assert 0 < len(tokens) < 50
+    assert fleet_mod.wire_injector().fired["net-cut"] == 1
+
+
+def test_net_stall_trips_idle_timeout_not_hop_budget(eng_slow, http_ring):
+    """A silent peer (no tokens, no heartbeats) must be declared dead by
+    the IDLE timeout in seconds — not ride out the whole hop budget."""
+    set_wire_injector(FaultInjector("net-stall@2", seed=0, stall_s=3.0))
+    with http_ring.serve(eng_slow) as replica:
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaError, match="read failed|timed out"):
+            list(replica.generate_stream(
+                PROMPT, {"max-tokens": 50, "temperature": 0.0},
+                idle_timeout_s=0.5,
+            ))
+        took = time.monotonic() - t0
+    # detected by the 0.5s idle timeout, well before the stall resolves
+    assert took < 2.5, f"stalled stream took {took:.1f}s to fail"
+
+
+def test_net_sites_deterministic_under_pinned_seed():
+    """Two injectors with the same spec + seed fire on identical calls —
+    the property that makes the CI chaos step a regression test rather
+    than noise."""
+    a = FaultInjector("net-cut@3,net-corrupt@5:2,net-stall~0.3", seed=7)
+    b = FaultInjector("net-cut@3,net-corrupt@5:2,net-stall~0.3", seed=7)
+    seq_a = [(s, a.fires(s)) for _ in range(20) for s in ("net-cut", "net-corrupt", "net-stall")]
+    seq_b = [(s, b.fires(s)) for _ in range(20) for s in ("net-cut", "net-corrupt", "net-stall")]
+    assert seq_a == seq_b
+    assert a.fired == b.fired
+    assert a.fired["net-cut"] == 1
+    assert a.fired["net-corrupt"] == 8  # @5:2 → calls 5,7,9,…,19
+
+
+# ---------------------------------------------------------------------------
+# Tier 3: the mid-stream kill drill (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_mid_stream_net_cut_warm_failover_drill(eng_slow, eng_plain, http_ring):
+    """Kill the wire after ≥8 streamed tokens on a 2-replica fleet: the
+    client must receive one complete, seq-verified stream — no duplicated,
+    missing or out-of-order tokens — token-exact vs an uninterrupted
+    single-engine run; the survivor's resume must be WARM (prefix reuse,
+    prefill_tokens_saved > 0); a ``fleet-failover`` flight dump must be
+    produced; zero hangs, zero engine restarts."""
+    budget = 24
+    # the uninterrupted greedy reference — run on the survivor, which also
+    # publishes the prompt's prefix (what makes the resume warm)
+    ref = eng_plain.generate(
+        list(PROMPT), GenerationOptions(max_new_tokens=budget, temperature=0.0),
+        timeout=120,
+    )
+    assert len(ref.tokens) == budget or ref.finish_reason == "stop"
+    # the victim holds the same warm prefix, so affinity routes there
+    # first (listed first: ties break by registration order)
+    eng_slow.generate(
+        list(PROMPT), GenerationOptions(max_new_tokens=2, temperature=0.0),
+        timeout=120,
+    )
+    saved_before = eng_plain.stats()["prefill-tokens-saved-total"]
+    restarts_before = (
+        eng_slow.stats()["engine-restarts-total"],
+        eng_plain.stats()["engine-restarts-total"],
+    )
+    set_wire_injector(FaultInjector("net-cut@12", seed=0))
+    with http_ring.serve(eng_slow, rid="victim") as victim:
+        router = FleetRouter(
+            [victim, InProcessReplica("survivor", eng_plain)],
+            refresh_interval_s=3600.0, lam=16.0,
+            fail_cooldown_s=3600.0,  # no readmission during the drill
+        )
+        router.refresh_all()
+        # pin the FIRST route on the victim deterministically: both
+        # replicas advertise the same 32-token match, so bias the
+        # survivor's load — after the cut it is the only routable one
+        router._replicas["survivor"].beacon["load_score"] = 5.0
+        frames, tokens = _drain(router.stream_generate(
+            PROMPT, {"max-tokens": budget, "temperature": 0.0},
+        ))
+    by_replica: dict = {}
+    for f in frames:
+        if f.get("kind") == "tokens":
+            by_replica.setdefault(f["replica"], []).extend(f["tokens"])
+    assert len(by_replica.get("victim", [])) >= 8, (
+        f"cut landed before 8 streamed tokens: {by_replica}"
+    )
+    assert by_replica.get("survivor"), "no failover happened"
+    # the client-facing stream is exactly the uninterrupted run
+    assert tokens == list(ref.tokens), (
+        "resumed stream diverged from the uninterrupted reference"
+    )
+    end = frames[-1]
+    assert end["kind"] == "end"
+    assert end["failovers"] == 1
+    assert end["replica"] == "survivor"
+    assert end["completion_tokens"] == len(tokens)
+    # warm resume: the survivor reused the published prefix instead of
+    # re-prefilling prompt + delivered tokens from scratch
+    assert eng_plain.stats()["prefill-tokens-saved-total"] > saved_before
+    # failover accounting + the flight dump with the hop's frame trace
+    assert router.stream_failover_total == 1
+    assert router.failover_total == 1
+    dump = router._flight.last_dump
+    assert dump is not None and dump["reason"] == "fleet-failover"
+    assert validate_flight_dump(dump)
+    assert dump["extra"]["victim"] == "victim"
+    assert dump["extra"]["delivered"] >= 8
+    assert dump["extra"]["frames"], "dump carries no frame trace"
+    assert all("tokens" not in f for f in dump["extra"]["frames"])
+    # zero restarts anywhere; the victim frees its slot (cancel-on-cut)
+    assert (
+        eng_slow.stats()["engine-restarts-total"],
+        eng_plain.stats()["engine-restarts-total"],
+    ) == restarts_before
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if eng_slow.stats()["active-slots"] == 0:
+            break
+        time.sleep(0.05)
+    assert eng_slow.stats()["active-slots"] == 0
+    # the hop histogram saw the surviving hop
+    assert router.stats()["fleet-hop-p50-ms"] > 0
+
+
+def _canned_http_server(body: bytes):
+    """Micro HTTP server answering every POST with a fixed body — stands
+    in for peers the real RuntimeHttpServer can no longer emulate (old
+    versions, corrupt wires)."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0) or 0))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: ARG002 — quiet test output
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    return srv, thread
+
+
+def test_legacy_one_shot_peer_body_is_adapted_not_quarantined():
+    """Rolling-upgrade safety: a NOT-yet-upgraded peer ignores
+    `stream: true` and answers the old one-shot JSON body — the client
+    adapts it into frames instead of failing the hop and quarantining a
+    healthy replica."""
+    body = json.dumps({
+        "tokens": [1, 2, 3], "finish_reason": "length",
+        "prompt_tokens": 5, "ttft_s": 0.01, "total_s": 0.02,
+    }).encode()
+    srv, thread = _canned_http_server(body)
+    try:
+        replica = HttpReplica("legacy", f"http://127.0.0.1:{srv.server_port}")
+        frames, tokens = _drain(
+            replica.generate_stream([9, 9, 9, 9, 9], {"max-tokens": 3})
+        )
+        assert tokens == [1, 2, 3]
+        end = frames[-1]
+        assert end["kind"] == "end" and end["finish_reason"] == "length"
+        # and the blocking drain keeps working against the old peer too
+        out = replica.generate([9, 9, 9, 9, 9], {"max-tokens": 3})
+        assert out["tokens"] == [1, 2, 3]
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)
+
+
+def test_garbage_token_values_fail_hop_as_replica_error():
+    """A parseable frame whose token VALUES are garbage (the corrupt wire
+    net-corrupt models, one layer deeper) must read as a dead hop —
+    ReplicaError, the failover signal — never as the caller's bad
+    request, and never leak a TypeError."""
+    for garbage in (b'{"seq": 0, "kind": "tokens", "tokens": ["x"]}\n',
+                    b'{"seq": 0, "kind": "tokens", "tokens": [null]}\n'):
+        srv, thread = _canned_http_server(garbage)
+        try:
+            replica = HttpReplica(
+                "corrupt", f"http://127.0.0.1:{srv.server_port}"
+            )
+            with pytest.raises(ReplicaError, match="corrupt tokens"):
+                list(replica.generate_stream([5, 5, 5], {"max-tokens": 4}))
+        finally:
+            srv.shutdown()
+            thread.join(timeout=5)
+
+
+class _RecordingReplica:
+    """Fake with a streaming transport: yields scripted frames, optionally
+    dying after them; records dispatch calls."""
+
+    is_local = False
+
+    def __init__(self, rid, tokens=(), die_after=False, load=0.0):
+        self.replica_id = rid
+        self.url = f"fake:{rid}"
+        self.tokens = list(tokens)
+        self.die_after = die_after
+        self.load = load
+        self.dispatches = []
+
+    def fetch_beacon(self):
+        return {
+            "schema": "lstpu-beacon-v1", "id": self.replica_id,
+            "url": self.url, "at": time.time(), "load_score": self.load,
+            "queue_wait_ema_s": 0.0, "draining": False,
+            "quarantined": False, "prefixes": [],
+        }
+
+    def generate_stream(self, prompt, opts, timeout_s=None):
+        self.dispatches.append((list(prompt), dict(opts)))
+        budget = int(opts.get("max-tokens", 256))
+
+        def frames():
+            seq = 0
+            for t in self.tokens[:budget]:
+                yield {"seq": seq, "kind": "tokens", "tokens": [t]}
+                seq += 1
+            if self.die_after:
+                raise ReplicaError(f"replica {self.replica_id}: died")
+            yield {
+                "seq": seq, "kind": "end", "finish_reason": "length",
+                "prompt_tokens": len(prompt), "ttft_s": 0.01, "total_s": 0.02,
+            }
+
+        return frames()
+
+
+def test_cut_after_full_budget_synthesizes_end_not_extra_tokens():
+    """A replica dying BETWEEN its final tokens frame and the terminal
+    frame must not trigger a resume for tokens an uninterrupted run would
+    never generate: the router synthesizes the end (finish_reason length,
+    exactly the budget) and never dispatches the survivor."""
+    victim = _RecordingReplica("victim", tokens=range(100, 106), die_after=True)
+    other = _RecordingReplica("other", tokens=range(50))
+    router = FleetRouter([victim, other], refresh_interval_s=3600.0)
+    router.refresh_all()
+    frames, tokens = _drain(router.stream_generate(
+        PROMPT, {"max-tokens": 6, "temperature": 0.0},
+    ))
+    assert tokens == list(range(100, 106)), "budget violated or tokens lost"
+    end = frames[-1]
+    assert end["kind"] == "end" and end["finish_reason"] == "length"
+    assert end["completion_tokens"] == 6
+    assert other.dispatches == [], "re-dispatched a fully-delivered stream"
+    assert router.stream_failover_total == 0, "no resume happened"
+    assert router.failover_total == 1  # the death itself still counts
+
+
+def test_constrained_stream_refuses_mid_derivation_resume():
+    """A grammar-constrained stream that loses its replica mid-derivation
+    must FAIL, not resume: the survivor's DFA would restart at state 0
+    and emit a second derivation after the partial one — invalid output
+    dressed as valid (§15's parse/validate guarantee outranks
+    availability)."""
+    victim = _RecordingReplica("victim", tokens=range(100, 104), die_after=True)
+    other = _RecordingReplica("other", tokens=range(50))
+    router = FleetRouter([victim, other], refresh_interval_s=3600.0)
+    router.refresh_all()
+    with pytest.raises(ReplicaError, match="constrained"):
+        list(router.stream_generate(
+            PROMPT,
+            {
+                "max-tokens": 16, "temperature": 0.0,
+                "response-format": {"type": "regex", "regex": "[0-9]{1,8}"},
+            },
+        ))
+    assert other.dispatches == [], "constrained stream was resumed anyway"
+    assert router.stream_failover_total == 0
+
+
+def test_slow_headers_do_not_trip_the_idle_timeout():
+    """A peer whose submit blocks on admission backpressure sends no
+    bytes for a while: the hop BUDGET (not the idle bound) governs
+    time-to-headers, so a merely-busy replica is not quarantined — the
+    idle bound kicks in only once the stream is open."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0) or 0))
+            time.sleep(1.2)  # "submit blocked": silence before headers
+            body = json.dumps({
+                "tokens": [7], "finish_reason": "length",
+                "prompt_tokens": 3, "ttft_s": 0.01, "total_s": 0.02,
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: ARG002
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        replica = HttpReplica("busy", f"http://127.0.0.1:{srv.server_port}")
+        _frames, tokens = _drain(replica.generate_stream(
+            [3, 3, 3], {"max-tokens": 1}, timeout_s=10.0, idle_timeout_s=0.5,
+        ))
+        assert tokens == [7]
+    finally:
+        srv.shutdown()
+        thread.join(timeout=5)
+
+
+def test_terminal_stream_death_counts_only_real_resumes():
+    """stream_failovers means RESUMED on a survivor: when every replica
+    dies mid-stream, only the failover that actually found a survivor
+    counts — a total outage must not read as two successful warm
+    failovers on the panel."""
+    r1 = _RecordingReplica("r1", tokens=[1, 2], die_after=True)
+    r2 = _RecordingReplica("r2", tokens=[3, 4], die_after=True)
+    router = FleetRouter([r1, r2], refresh_interval_s=3600.0)
+    router.refresh_all()
+    with pytest.raises(ReplicaError):
+        list(router.stream_generate(PROMPT, {"max-tokens": 16}))
+    assert router.stream_failover_total == 1  # r1→r2 resumed; r2's death is terminal
+    assert router.failover_total == 2  # both deaths quarantined
+    dump = router._flight.last_dump
+    assert dump is not None and dump["extra"]["resumed_on"] == "r2"
+
+
+def test_every_replica_dead_raises_replica_error_not_shed():
+    """All-attempts-DIED is ReplicaError, not FleetShedError — callers
+    must be able to tell 'fleet saturated, back off' from 'fleet broken,
+    serve locally if you can'."""
+    r1 = _RecordingReplica("r1", die_after=True)
+    r2 = _RecordingReplica("r2", die_after=True)
+    router = FleetRouter([r1, r2], refresh_interval_s=3600.0)
+    router.refresh_all()
+    with pytest.raises(ReplicaError):
+        router.generate(PROMPT, {"max-tokens": 4})
+
+
+def test_fleet_dispatch_serves_locally_when_every_replica_dead():
+    """The completions backstop: when every replica (incl. this one, as
+    the router sees it) dies before the first token, _fleet_dispatch
+    returns None so the caller serves on the LOCAL engine — which may be
+    healthy even while the router has it quarantined."""
+    from langstream_tpu.ai.tpu_serving import TpuCompletionsService
+
+    class _DeadFleetRouter:
+        def stream_generate(self, *a, **k):
+            raise ReplicaError("every replica failed this stream")
+            yield  # pragma: no cover — makes this a generator function
+
+    svc = TpuCompletionsService(holder=None, step_config={})
+    out = asyncio.run(
+        svc._fleet_dispatch(_DeadFleetRouter(), [1, 2, 3], {}, None)
+    )
+    assert out is None
+
+
+# ---------------------------------------------------------------------------
+# Tier 4a: /fleet/cancel error paths (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_cancel_dead_peer_url_is_best_effort():
+    """A dead owner URL must not stall or crash the gateway's disconnect
+    path: the forward runs on a background thread, cancel() returns the
+    LOCAL count immediately."""
+    key = "sess-dead-peer"
+    lifecycle.register_remote(key, "http://127.0.0.1:9")  # discard port
+    try:
+        t0 = time.monotonic()
+        assert lifecycle.cancel(key) == 0
+        assert time.monotonic() - t0 < 1.0, "cancel blocked on a dead peer"
+    finally:
+        lifecycle.unregister_remote(key, "http://127.0.0.1:9")
+
+
+def test_fleet_cancel_unknown_and_missing_session(http_ring, eng_plain):
+    with http_ring.serve(eng_plain):
+        req = urllib.request.Request(
+            http_ring.url + "/fleet/cancel",
+            data=json.dumps({"session": "never-registered"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["cancelled"] == 0
+        bad = urllib.request.Request(
+            http_ring.url + "/fleet/cancel", data=b"{}",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=5)
+        assert err.value.code == 400
+        not_json = urllib.request.Request(
+            http_ring.url + "/fleet/cancel", data=b"not json",
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(not_json, timeout=5)
+        assert err.value.code == 400
+
+
+def test_fleet_cancel_racing_stream_completion(eng_plain, http_ring):
+    """A cancel that lands AFTER the stream finished is a no-op: the
+    peer's registry entry is gone (engine_generate_stream unregisters in
+    its finally), the endpoint reports 0 cancelled, the engine stays
+    healthy."""
+    key = "sess-race"
+    with http_ring.serve(eng_plain) as replica:
+        _frames, tokens = _drain(replica.generate_stream(
+            PROMPT,
+            {"max-tokens": 4, "temperature": 0.0, "cancel-key": key},
+        ))
+        assert len(tokens) == 4
+        req = urllib.request.Request(
+            http_ring.url + "/fleet/cancel",
+            data=json.dumps({"session": key}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.loads(r.read())["cancelled"] == 0
+        # engine unaffected: the next dispatch completes normally
+        _frames, tokens = _drain(replica.generate_stream(
+            PROMPT, {"max-tokens": 4, "temperature": 0.0},
+        ))
+        assert len(tokens) == 4
+
+
+# ---------------------------------------------------------------------------
+# Tier 4b: circuit breaker + beacon backoff (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FlakyReplica:
+    is_local = False
+    url = "fake:flaky"
+
+    def __init__(self, rid="flaky"):
+        self.replica_id = rid
+        self.fetch_calls = 0
+        self.dead = True
+
+    def fetch_beacon(self):
+        self.fetch_calls += 1
+        if self.dead:
+            raise ReplicaError("connection refused")
+        return {
+            "schema": "lstpu-beacon-v1", "id": self.replica_id,
+            "url": self.url, "at": time.time(), "load_score": 0.0,
+            "queue_wait_ema_s": 0.0, "draining": False,
+            "quarantined": False, "prefixes": [],
+        }
+
+
+def test_beacon_backoff_skips_dead_replica():
+    """The refresh-loop satellite: a dead replica's /state is NOT hit
+    every interval forever — consecutive failures back the probe off
+    exponentially (capped), and the backoff expiry is the half-open
+    probe that readmits it."""
+    replica = _FlakyReplica()
+    router = FleetRouter(
+        [replica], refresh_interval_s=0.05, beacon_backoff_max_s=0.4,
+        circuit_failures=2,
+    )
+    assert router.refresh_all(force=False) == 0
+    assert replica.fetch_calls == 1
+    assert router.beacon_failures_total == 1
+    # inside the backoff window: the loop's refresh SKIPS the replica
+    for _ in range(5):
+        router.refresh_all(force=False)
+    assert replica.fetch_calls == 1, "backoff did not pace the probe"
+    # past the backoff (base = max(interval, 0.1)): exactly one half-open
+    # probe fires (and fails → circuit opens at the threshold, backoff
+    # doubles)
+    time.sleep(0.12)
+    router.refresh_all(force=False)
+    assert replica.fetch_calls == 2
+    assert router.circuit_open_total == 1
+    assert router.stats()["fleet-circuit-open-replicas"] == 1
+    # recovery: the replica comes back; the next due probe closes the
+    # circuit and the replica is routable again off the fresh beacon
+    replica.dead = False
+    time.sleep(0.45)  # past the capped backoff
+    router.refresh_all(force=False)
+    assert replica.fetch_calls == 3
+    assert router.stats()["fleet-circuit-open-replicas"] == 0
+    assert router.route(PROMPT).replica_id == "flaky"
+    # counters are cumulative — recovery does not rewrite history
+    assert router.beacon_failures_total == 2
+    assert router.circuit_open_total == 1
+
+
+def test_dispatch_failures_feed_the_circuit():
+    replica = _FlakyReplica("r0")
+    replica.dead = False
+    router = FleetRouter(
+        [replica], refresh_interval_s=3600.0, circuit_failures=2,
+    )
+    router.refresh_all()
+    router.mark_failed("r0")
+    assert router.circuit_open_total == 0  # one blip ≠ open
+    router.mark_failed("r0")
+    assert router.circuit_open_total == 1
+    # a fresh beacon (manual/half-open probe) closes it
+    router.refresh_all()
+    assert router.stats()["fleet-circuit-open-replicas"] == 0
+
+
+def test_forced_refresh_ignores_backoff():
+    """Manual refresh_all() (tests, start(), operators) probes everything
+    regardless of backoff — only the background loop paces itself."""
+    replica = _FlakyReplica()
+    router = FleetRouter([replica], refresh_interval_s=3600.0)
+    router.refresh_all(force=False)
+    router.refresh_all(force=True)
+    router.refresh_all(force=True)
+    assert replica.fetch_calls == 3
+
+
+# ---------------------------------------------------------------------------
+# Tier 5 (slow): REAL process kill mid-stream
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_multiprocess_kill_mid_stream_fails_over_warm():
+    """The drill with a REAL process boundary: a subprocess replica is
+    SIGKILLed mid-stream (≥8 tokens delivered over real HTTP chunks); the
+    router resumes on an in-process survivor with no hang, no duplicate
+    or dropped tokens (seq-verified), and a fleet-failover dump."""
+    import os
+    import subprocess
+    import sys
+
+    config = {
+        "model": "tiny-test",
+        "max-batch": 2,
+        "max-seq-len": 128,
+        "prefill-buckets": (16, 32, 64),
+        "decode-chunk": 4,
+        "prefix-cache": "auto",
+        "fault-injection": "client@1+",  # tokens trickle → kill mid-stream
+        "fault-seed": 0,
+        "fault-stall-s": 0.05,
+        "fleet-replica-id": "peer-kill",
+    }
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("LSTPU_FAULTS", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "langstream_tpu.serving.fleet",
+            "--config", json.dumps(config),
+        ],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, text=True,
+    )
+    survivor = make_engine()
+    try:
+        line = proc.stdout.readline()
+        assert line, "replica died before serving"
+        url = json.loads(line)["url"]
+        victim = HttpReplica("peer-kill", url, stream_idle_timeout_s=5.0)
+        # warm BOTH sides so the route prefers the victim (listed first)
+        # and the survivor's resume is warm
+        budget = 24
+        survivor.generate(
+            list(PROMPT), GenerationOptions(max_new_tokens=2, temperature=0.0),
+            timeout=120,
+        )
+        victim.generate(PROMPT, {"max-tokens": 2, "temperature": 0.0})
+        router = FleetRouter(
+            [victim, InProcessReplica("survivor", survivor)],
+            refresh_interval_s=3600.0, lam=16.0, fail_cooldown_s=3600.0,
+        )
+        router.refresh_all()
+        # pin the first route on the subprocess victim (see the in-process
+        # drill): after the kill, the survivor is the only routable one
+        router._replicas["survivor"].beacon["load_score"] = 5.0
+        tokens = []
+        expected_seq = 0
+        killed = [False]
+        for frame in router.stream_generate(
+            PROMPT, {"max-tokens": budget, "temperature": 0.0},
+            timeout_s=120.0,
+        ):
+            assert frame["seq"] == expected_seq
+            expected_seq += 1
+            if frame.get("kind") == "tokens":
+                tokens.extend(frame["tokens"])
+                if len(tokens) >= 8 and not killed[0]:
+                    proc.kill()  # SIGKILL: no goodbye, just a dead wire
+                    killed[0] = True
+        assert killed[0], "stream finished before the kill could land"
+        assert len(tokens) == budget, (
+            f"resumed stream delivered {len(tokens)}/{budget} tokens"
+        )
+        assert router.stream_failover_total == 1
+        dump = router._flight.last_dump
+        assert dump is not None and dump["reason"] == "fleet-failover"
+        assert validate_flight_dump(dump)
+        assert survivor.stats()["engine-restarts-total"] == 0
+    finally:
+        survivor.stop()
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        proc.wait(timeout=30)

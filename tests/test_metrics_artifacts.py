@@ -19,15 +19,19 @@ def registered_metric_suffixes() -> set[str]:
     """Every name passed to .counter()/.gauge()/.histogram() anywhere in
     the source, plus the engine histogram taxonomy (registered via the
     ENGINE_HISTOGRAMS spec rather than string literals)."""
-    from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
+    from langstream_tpu.serving.observability import (
+        ENGINE_HISTOGRAMS,
+        FLEET_HISTOGRAMS,
+    )
 
     pat = re.compile(r"\.(?:counter|gauge|histogram)\(\s*\"([a-z0-9_]+)\"")
     names: set[str] = set()
     for py in SRC_DIR.rglob("*.py"):
         names.update(pat.findall(py.read_text()))
     names.update(ENGINE_HISTOGRAMS)
+    names.update(FLEET_HISTOGRAMS)
     # a histogram name X exposes X_bucket / X_sum / X_count series
-    for h in ENGINE_HISTOGRAMS:
+    for h in (*ENGINE_HISTOGRAMS, *FLEET_HISTOGRAMS):
         names.update({f"{h}_bucket", f"{h}_sum", f"{h}_count"})
     assert names, "no metric registrations found in source"
     return names
@@ -71,7 +75,10 @@ def test_dashboard_regexes_match_live_exposition():
     """Register the real serving + runner metric names the way the agents do
     and verify each dashboard __name__ regex matches at least one line of the
     rendered Prometheus exposition."""
-    from langstream_tpu.serving.observability import ENGINE_HISTOGRAMS
+    from langstream_tpu.serving.observability import (
+        ENGINE_HISTOGRAMS,
+        FLEET_HISTOGRAMS,
+    )
 
     reporter = MetricsReporter()
     runner_scope = reporter.with_prefix("agent_step1")
@@ -80,7 +87,7 @@ def test_dashboard_regexes_match_live_exposition():
     serving = reporter.with_prefix("agent_chat_completions")
     for n in ("num_calls_total", "completion_tokens_total", "prompt_tokens_total"):
         serving.counter(n)
-    for name, spec in ENGINE_HISTOGRAMS.items():
+    for name, spec in (*ENGINE_HISTOGRAMS.items(), *FLEET_HISTOGRAMS.items()):
         serving.histogram(name, spec["help"], spec["buckets"])
     for n in (
         "engine_load_score",
@@ -120,6 +127,9 @@ def test_dashboard_regexes_match_live_exposition():
         "fleet_routed_affinity_total",
         "fleet_routed_balanced_total",
         "fleet_replica_count",
+        "fleet_stream_failovers_total",
+        "fleet_circuit_open_total",
+        "fleet_beacon_failures_total",
     ):
         serving.gauge(n)
     exposed = {
@@ -184,6 +194,33 @@ def test_fleet_panels_present():
     )
     assert replicas is not None, "fleet replica-count panel missing"
     assert "fleet_replica_count" in replicas
+
+
+def test_fleet_wire_panels_present():
+    """The ISSUE-12 fleet-wire panels must survive dashboard edits: the
+    wire-health panel (mid-stream warm failovers + circuit-breaker opens +
+    beacon probe failures — serving/fleet.py, docs/SERVING.md §17) and the
+    remote-hop latency panel reading the fleet_hop_s histogram buckets."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    wire = next(
+        (e for t, e in exprs_by_title.items() if "fleet wire" in t.lower()),
+        None,
+    )
+    assert wire is not None, "fleet wire-health panel missing"
+    assert "fleet_stream_failovers_total" in wire
+    assert "fleet_circuit_open_total" in wire
+    assert "fleet_beacon_failures_total" in wire
+    hop = next(
+        (e for t, e in exprs_by_title.items() if "fleet hop" in t.lower()),
+        None,
+    )
+    assert hop is not None, "fleet hop-latency panel missing"
+    assert "fleet_hop_s_bucket" in hop
+    assert "histogram_quantile" in hop
 
 
 def test_agentic_panels_present():
