@@ -1078,6 +1078,155 @@ def bench_spmd_wire(*, preset: str = "tiny-test", new_tokens: int = 48,
     }
 
 
+def bench_disagg(*, n_steady: int = 12, steady_tokens: int = 16,
+                 n_bursts: int = 3, burst_prompt: int = 192,
+                 steady_prompt: int = 24, threshold: int = 64) -> dict:
+    """Disaggregated prefill/decode phase (ISSUE 13 acceptance, docs
+    §18): a 2-replica fleet serving ``n_steady`` steady decode streams
+    while ``n_bursts`` long-prompt bursts arrive mid-flight, measured
+    twice on FRESH engine pairs — roles ON (prefill + decode replicas,
+    long prompts prefill on one replica and their KV migrates to the
+    other) vs roles OFF (both mixed: long prompts compete with steady
+    decode wherever affinity lands them). Recorded: the steady streams'
+    TTFT and inter-token p50/p99 (the number disaggregation exists to
+    protect), the bursts' TTFT, and the migration ledger (count,
+    p50/p99, pages, fallbacks). On this CPU smoke the engines are tiny
+    and prefill is cheap — the chip run is where the burst actually
+    stalls a mixed batch; the phase records the machinery's overhead
+    honestly either way."""
+    import dataclasses
+    import threading as _threading
+
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import ServingEngine
+    from langstream_tpu.serving.fleet import FleetRouter, InProcessReplica
+
+    config = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(13)
+    steady_prompts = [
+        rng.integers(1, 200, size=steady_prompt).tolist()
+        for _ in range(n_steady)
+    ]
+    burst_prompts = [
+        rng.integers(1, 200, size=burst_prompt).tolist()
+        for _ in range(n_bursts)
+    ]
+    out: dict = {
+        "disagg_steady_streams": n_steady,
+        "disagg_bursts": n_bursts,
+        "disagg_burst_prompt": burst_prompt,
+        "disagg_threshold": threshold,
+    }
+
+    def _engine():
+        e = ServingEngine(
+            config, params, max_batch=8, max_seq_len=512,
+            prefill_buckets=(16, 32, 64, 128, 256), decode_chunk=4,
+            prefix_cache="auto", precompile=True,
+        )
+        e.start()
+        return e
+
+    warm_prompt = rng.integers(1, 200, size=burst_prompt).tolist()
+    for mode in ("roles", "mixed"):
+        a, b = _engine(), _engine()
+        roles = ("prefill", "decode") if mode == "roles" else ("mixed",) * 2
+        # wait out the precompile ladder on BOTH engines before the clock
+        # starts (the phase measures steady-state tails, not warmup), and
+        # reset the histograms the TTFT gauges would otherwise inherit
+        from langstream_tpu.models.configs import GenerationOptions
+
+        for e in (a, b):
+            e.generate(
+                list(warm_prompt),
+                GenerationOptions(max_new_tokens=4, temperature=0.0),
+            )
+            e.reset_histograms()
+        router = FleetRouter(
+            [InProcessReplica("r0", a, role=roles[0]),
+             InProcessReplica("r1", b, role=roles[1])],
+            prefill_route_threshold=threshold, refresh_interval_s=0.2,
+        )
+        router.start()
+        ttfts, gaps, burst_ttfts = [], [], []
+        lock = _threading.Lock()
+
+        def _stream(prompt, tokens, sink):
+            t0 = time.monotonic()
+            last = None
+            got = 0
+            for frame in router.stream_generate(
+                prompt, {"max-tokens": tokens, "temperature": 0.0}
+            ):
+                if frame["kind"] != "tokens":
+                    continue
+                now = time.monotonic()
+                for _ in frame["tokens"]:
+                    if got == 0:
+                        with lock:
+                            sink.append(now - t0)
+                    elif last is not None:
+                        with lock:
+                            gaps.append((now - last) / len(frame["tokens"]))
+                    got += 1
+                last = now
+
+        threads = [
+            _threading.Thread(
+                target=_stream, args=(p, steady_tokens, ttfts), daemon=True,
+            )
+            for p in steady_prompts
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # bursts land mid-steady-state, not first
+        bursts = [
+            _threading.Thread(
+                target=_stream, args=(p, 8, burst_ttfts), daemon=True,
+            )
+            for p in burst_prompts
+        ]
+        for t in bursts:
+            t.start()
+        for t in threads + bursts:
+            t.join(timeout=600)
+        st = router.stats()
+        key = mode
+        out.update({
+            f"disagg_{key}_steady_p50_ttft_ms": round(
+                float(np.percentile(ttfts, 50)) * 1e3, 1
+            ),
+            f"disagg_{key}_steady_p99_ttft_ms": round(
+                float(np.percentile(ttfts, 99)) * 1e3, 1
+            ),
+            f"disagg_{key}_steady_p99_intertoken_ms": round(
+                float(np.percentile(gaps, 99)) * 1e3, 2
+            ) if gaps else 0.0,
+            f"disagg_{key}_burst_p50_ttft_ms": round(
+                float(np.percentile(burst_ttfts, 50)) * 1e3, 1
+            ) if burst_ttfts else 0.0,
+            f"disagg_{key}_migrations": st["fleet-migrations-total"],
+            f"disagg_{key}_migrate_pages": st["fleet-migrate-pages-total"],
+            f"disagg_{key}_migrate_fallbacks": st[
+                "fleet-migrate-fallbacks-total"
+            ],
+            f"disagg_{key}_migrate_p50_ms": st["fleet-migrate-p50-ms"],
+            f"disagg_{key}_migrate_p99_ms": st["fleet-migrate-p99-ms"],
+        })
+        print(f"[bench] disagg {mode}: "
+              f"{ {k: v for k, v in out.items() if key in k} }",
+              file=sys.stderr, flush=True)
+        router.stop()
+        a.stop()
+        b.stop()
+    return out
+
+
 def bench_fleet(*, n_replicas: int = 3, n_groups: int = 4,
                 preamble_len: int = 256, burst_mult: int = 10,
                 new_tokens: int = 16, lam: float = 128.0) -> dict:
@@ -1435,6 +1584,18 @@ def main() -> None:
         extras.update(bench_fleet())
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] fleet phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # disaggregated prefill/decode (ISSUE 13 acceptance, docs §18): the
+    # mixed workload — steady decode streams + long-prompt bursts — with
+    # prefill/decode roles + KV-page migration ON vs a mixed 2-replica
+    # fleet; records steady-stream TTFT/inter-token tails and the
+    # migration ledger (count, p50/p99, fallbacks)
+    print("[bench] disaggregated prefill/decode phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_disagg())
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] disagg phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # SPMD fast-path wire (ISSUE 9 acceptance): loopback leader+follower
     # on a TP mesh over all local devices with prefix + speculation +

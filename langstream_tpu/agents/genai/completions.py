@@ -255,6 +255,30 @@ class _BaseCompletionsStep(Step):
             "growth on one replica means its probe is in backoff, "
             "cumulative",
         )
+        # disaggregated prefill/decode (serving/migrate.py + fleet.py,
+        # docs/SERVING.md §18): KV-page migration traffic and the
+        # decode-in-place fallback counter — a rising fallback share
+        # means the migration wire (or the decode pool) is unhealthy
+        self._m_fleet_migrations = metrics.gauge(
+            "fleet_migrations_total",
+            "completed KV-page migrations (receiver-ACKed, sender "
+            "released), cumulative",
+        )
+        self._m_fleet_migrate_pages = metrics.gauge(
+            "fleet_pages_migrated_total",
+            "KV pages moved between replicas by completed migrations, "
+            "cumulative",
+        )
+        self._m_fleet_migrate_bytes = metrics.gauge(
+            "fleet_migrate_bytes_total",
+            "bytes moved between replicas by completed migrations "
+            "(int8 pools ship half the bf16 bytes), cumulative",
+        )
+        self._m_fleet_migrate_fallbacks = metrics.gauge(
+            "fleet_migrate_fallbacks_total",
+            "migrations that failed (checksum, cut, deadline, exhaustion) "
+            "and fell back to decode-in-place, cumulative",
+        )
         from langstream_tpu.serving.observability import (
             ENGINE_HISTOGRAMS,
             FLEET_HISTOGRAMS,
@@ -327,6 +351,16 @@ class _BaseCompletionsStep(Step):
         self._m_fleet_circuit_open.set(fleet.get("fleet-circuit-open-total", 0))
         self._m_fleet_beacon_failures.set(
             fleet.get("fleet-beacon-failures-total", 0)
+        )
+        self._m_fleet_migrations.set(fleet.get("fleet-migrations-total", 0))
+        self._m_fleet_migrate_pages.set(
+            fleet.get("fleet-migrate-pages-total", 0)
+        )
+        self._m_fleet_migrate_bytes.set(
+            fleet.get("fleet-migrate-bytes-total", 0)
+        )
+        self._m_fleet_migrate_fallbacks.set(
+            fleet.get("fleet-migrate-fallbacks-total", 0)
         )
         for name, snap in (stats.get("histograms") or {}).items():
             mirror = self._m_hists.get(name)

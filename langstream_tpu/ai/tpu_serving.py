@@ -109,6 +109,18 @@ Resource configuration:
     (docs/SERVING.md §13). The /state beacon and /fleet/generate endpoint
     are served regardless of this knob — fleet: off only means THIS
     process routes nothing.
+  fleet-role: prefill | decode | mixed (default mixed) → disaggregated
+    prefill/decode (docs/SERVING.md §18): the role rides this replica's
+    beacon; routers steer prefill-heavy admissions (estimated prefill ≥
+    `fleet-prefill-threshold`, default 2048 tokens) at prefill-tagged
+    replicas, run prefill + the first token there, MIGRATE the KV pages
+    (`POST /fleet/migrate`, lstpu-kvmig-v1, per-page blake2b checksums)
+    to a decode replica, and finish the stream where the steady decode
+    pool lives. `fleet-migrate: auto|off` disables only the transfer
+    (roles still steer; streams decode in place);
+    `fleet-migrate-timeout-s` (default 30) bounds each transfer — on ANY
+    migration failure the stream decodes in place on the prefill
+    replica, token-exact, and the fallback is counted + flight-dumped.
   spmd-parity-echo: false (default) → on multi-host replicas, re-broadcast
     every processed decode/verify chunk's tokens so followers verify them
     against their own device results (one extra broadcast per chunk; a
@@ -343,6 +355,15 @@ class _EngineHolder:
                 echo=bool(self.config.get("spmd-parity-echo", False)),
                 decode_chunk=int(self.config.get("decode-chunk", 16)),
             )
+        # disaggregated serving (docs/SERVING.md §18): the replica's role —
+        # validated HERE so a bad knob fails before the engine builds, and
+        # passed down so role-tagged replicas budget migration staging RAM
+        fleet_role = str(self.config.get("fleet-role") or "mixed")
+        if fleet_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"unknown fleet-role {fleet_role!r}; supported: prefill, "
+                "decode, mixed"
+            )
         engine = ServingEngine(
             mc,
             self.params(),
@@ -427,6 +448,7 @@ class _EngineHolder:
             ),
             max_restarts=int(self.config.get("engine-max-restarts", 5)),
             fault_injector=self._fault_injector(),
+            migrate_staging=fleet_role != "mixed",
             # observability layer (docs/SERVING.md §12): histograms +
             # request spans + flight recorder; off is the escape hatch for
             # the measured (<1%) hot-loop overhead
@@ -450,11 +472,12 @@ class _EngineHolder:
 
             rid = str(self.config.get("fleet-replica-id") or "local")
             url = str(self.config.get("fleet-self-url") or "")
+            role = fleet_role  # validated before the engine build above
             self._fleet_replica_id = rid
             fleet_mod.register_local(
                 rid,
                 beacon_fn=lambda: fleet_mod.beacon_from_engine(
-                    rid, engine, url=url
+                    rid, engine, url=url, role=role
                 ),
                 generate_fn=lambda payload: fleet_mod.engine_generate(
                     engine, payload
@@ -466,6 +489,18 @@ class _EngineHolder:
                     lambda payload: fleet_mod.engine_generate_stream(
                         engine, payload
                     )
+                ),
+                # KV-page migration (docs/SERVING.md §18): inbound binds
+                # and outbound pushes for disaggregated prefill/decode —
+                # served regardless of the fleet knob, like /state
+                migrate_bind_fn=(
+                    lambda frames, timeout_s=30.0:
+                    fleet_mod.engine_migrate_bind(
+                        engine, frames, timeout_s
+                    )
+                ),
+                migrate_out_fn=lambda payload: fleet_mod.engine_migrate_out(
+                    engine, payload
                 ),
                 reset_fn=engine.reset_histograms,
             )
@@ -518,6 +553,7 @@ class _EngineHolder:
                     InProcessReplica(
                         rid, engine,
                         url=str(self.config.get("fleet-self-url") or ""),
+                        role=str(self.config.get("fleet-role") or "mixed"),
                     )
                 ]
                 for peer in self.config.get("fleet-replicas") or []:
@@ -542,6 +578,16 @@ class _EngineHolder:
                     ),
                     sticky_ttl_s=float(
                         self.config.get("fleet-sticky-ttl-s", 600.0)
+                    ),
+                    # disaggregated prefill/decode (docs/SERVING.md §18)
+                    prefill_route_threshold=int(
+                        self.config.get("fleet-prefill-threshold", 2048)
+                    ),
+                    migrate=str(
+                        self.config.get("fleet-migrate", "auto")
+                    ).lower() not in ("off", "false", "0", "none"),
+                    migrate_timeout_s=float(
+                        self.config.get("fleet-migrate-timeout-s", 30.0)
                     ),
                 )
                 router.start()
@@ -801,10 +847,18 @@ class TpuCompletionsService(CompletionsService):
                 raise ShedError(str(e), retry_after_s=e.retry_after_s) from e
             if first is None:
                 return None  # defensive: empty stream means nothing routed
-            if first.get("kind") == "route" and first.get("local"):
+            if (
+                first.get("kind") == "route"
+                and first.get("local")
+                and not first.get("disagg")
+            ):
                 # the route landed HERE: hand back to the native streaming
                 # path before any dispatch happened (the route decision and
-                # its counters/stickiness stand — this replica serves it)
+                # its counters/stickiness stand — this replica serves it).
+                # NOT for a disagg prefill-handoff route (§18): the router
+                # owns that orchestration (prefill here, migrate, decode
+                # elsewhere) — short-circuiting would decode in place and
+                # silently disable disaggregation on the local replica
                 return None
             if chunks_consumer is not None:
                 stream_state = _StreamState(

@@ -411,11 +411,19 @@ class FleetAutoscaleReconciler:
         name: str,
         kind: str = AgentCustomResource.KIND,
         interval_s: float = 15.0,
+        desired_roles_fn: Any = None,  # Callable[[], dict[str, int]] | None
     ) -> None:
         import threading
 
         self.kube = kube
         self.desired_fn = desired_fn
+        # disaggregated fleets (docs/SERVING.md §18): the per-role split
+        # (router.desired_replicas_by_role — prefill pool on queue-wait
+        # EMA, decode pool on occupancy/load) round-trips through
+        # ``status.fleet.desiredReplicasByRole`` alongside the scalar
+        # hint, so role-partitioned StatefulSets can each read their own
+        # count. Empty dict / None = homogeneous fleet, field omitted.
+        self.desired_roles_fn = desired_roles_fn
         self.namespace = namespace
         self.name = name
         self.kind = kind
@@ -450,11 +458,26 @@ class FleetAutoscaleReconciler:
                 self.namespace, self.name, desired,
             )
             return None
+        by_role: Optional[dict] = None
+        if self.desired_roles_fn is not None:
+            try:
+                raw = self.desired_roles_fn() or {}
+                by_role = {str(k): int(v) for k, v in raw.items()} or None
+            except Exception:  # noqa: BLE001 — advisory; scalar hint stands
+                log.exception("fleet role-split hint unavailable")
         fleet = dict((manifest.get("status") or {}).get("fleet") or {})
-        if fleet.get("desiredReplicas") == desired:
+        if (
+            fleet.get("desiredReplicas") == desired
+            and fleet.get("desiredReplicasByRole") == by_role
+        ):
             self.skipped_total += 1
             return None
         fleet["desiredReplicas"] = desired
+        if by_role is not None:
+            fleet["desiredReplicasByRole"] = by_role
+        elif self.desired_roles_fn is not None:
+            # the fleet stopped advertising roles: retire the stale split
+            fleet.pop("desiredReplicasByRole", None)
         try:
             # patch ONLY the fleet subtree: the real client's merge-patch
             # then cannot clobber status fields another controller wrote

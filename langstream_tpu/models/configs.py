@@ -228,6 +228,15 @@ class GenerationOptions:
     # {"type": "regex", "regex": "..."}. The engine compiles it to a
     # token DFA at submit and guarantees the completion stays inside it.
     response_format: Optional[dict] = None
+    # mid-derivation grammar resume (docs/SERVING.md §18): the DFA state
+    # the constrained stream had already reached when its replica died /
+    # its KV migrated. The prompt then carries the partial derivation and
+    # generation continues FROM this state instead of restarting the
+    # grammar at state 0 — what makes a constrained stream survivable on
+    # the fleet wire. Only meaningful alongside the SAME response_format
+    # (the state indexes that grammar's DFA); validated against the
+    # compiled DFA at submit.
+    grammar_resume_state: Optional[int] = None
 
     @staticmethod
     def from_dict(d: dict) -> "GenerationOptions":
@@ -237,6 +246,9 @@ class GenerationOptions:
             "max-queue-wait", d.get("max-queue-wait-s", d.get("max_queue_wait_s"))
         )
         response_format = d.get("response-format", d.get("response_format"))
+        resume = d.get(
+            "grammar-resume-state", d.get("grammar_resume_state")
+        )
         return GenerationOptions(
             max_new_tokens=int(d.get("max-tokens", d.get("max_new_tokens", 256))),
             temperature=float(d.get("temperature", 0.0)),
@@ -249,5 +261,8 @@ class GenerationOptions:
             adapter=(str(d["adapter"]) if d.get("adapter") else None),
             response_format=(
                 dict(response_format) if response_format else None
+            ),
+            grammar_resume_state=(
+                int(resume) if resume is not None else None
             ),
         )

@@ -39,6 +39,8 @@ class RuntimeHttpServer:
                 web.get("/state", self._state),
                 web.post("/fleet/generate", self._fleet_generate),
                 web.post("/fleet/cancel", self._fleet_cancel),
+                web.post("/fleet/migrate", self._fleet_migrate),
+                web.post("/fleet/migrate-out", self._fleet_migrate_out),
                 web.post("/fleet/reset", self._fleet_reset),
                 web.get("/healthz", self._healthz),
             ]
@@ -173,6 +175,97 @@ class RuntimeHttpServer:
         except (ConnectionResetError, ConnectionError, OSError):
             pass
         return resp
+
+    async def _fleet_migrate(self, request: web.Request) -> web.Response:
+        """Inbound KV-page migration (docs/SERVING.md §18): the body is a
+        chunked ``lstpu-kvmig-v1`` NDJSON frame stream; the local engine
+        verifies every page's checksum and binds the pages into its pool.
+        The response is the ACK the SENDER frees against, so protocol
+        failures (checksum mismatch, cut stream, pool exhaustion) answer
+        ``{"ok": false}`` with HTTP 200 — the transfer failed, the
+        transport worked — and the sender retains its copy. Nothing is
+        ever left allocated on a failed bind (receiver frees on abort)."""
+        import asyncio
+        import json as _json
+
+        from langstream_tpu.serving.fleet import (
+            ReplicaError,
+            local_migrate_bind,
+        )
+        from langstream_tpu.serving.migrate import MigrationError
+
+        # the frame stream is bounded (one prefix's pages): read it whole,
+        # parse line-by-line — binding runs on the engine thread anyway,
+        # so there is nothing to overlap with a streaming parse
+        try:
+            raw = await request.read()
+        except (ConnectionResetError, ConnectionError, OSError):
+            return web.json_response(
+                {"ok": False, "error": "body read failed (cut wire)"}
+            )
+        # the SENDER's budget governs the bind too (clamped so a rogue
+        # peer cannot park an executor thread for hours) — a raised
+        # fleet-migrate-timeout-s must bound the whole transfer, not just
+        # the push half
+        try:
+            timeout_s = float(request.query.get("timeout-s", 30.0))
+        except ValueError:
+            timeout_s = 30.0
+        timeout_s = min(max(timeout_s, 0.05), 600.0)
+
+        def _bind() -> dict:
+            def frames():
+                for line in raw.splitlines():
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield _json.loads(line)
+                    except ValueError as e:
+                        raise MigrationError(
+                            f"undecodable migration frame ({e})"
+                        ) from e
+
+            return local_migrate_bind(frames(), timeout_s)
+
+        loop = asyncio.get_running_loop()
+        try:
+            ack = await loop.run_in_executor(None, _bind)
+        except MigrationError as e:
+            return web.json_response({"ok": False, "error": str(e)})
+        except ReplicaError as e:
+            return web.json_response({"ok": False, "error": str(e)}, status=503)
+        return web.json_response(ack)
+
+    async def _fleet_migrate_out(self, request: web.Request) -> web.Response:
+        """Outbound migration command (§18): the router asks THIS replica
+        to push the prefix covering ``prompt_tokens`` to ``dest``'s
+        ``POST /fleet/migrate`` and relay the ACK. The local engine frees
+        its copy only on that ACK."""
+        import asyncio
+
+        from langstream_tpu.serving.fleet import (
+            ReplicaError,
+            local_migrate_out,
+        )
+        from langstream_tpu.serving.migrate import MigrationError
+
+        try:
+            payload = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="body must be JSON") from None
+        loop = asyncio.get_running_loop()
+        try:
+            ack = await loop.run_in_executor(
+                None, local_migrate_out, payload
+            )
+        except MigrationError as e:
+            return web.json_response({"ok": False, "error": str(e)})
+        except ReplicaError as e:
+            return web.json_response({"ok": False, "error": str(e)}, status=503)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from None
+        return web.json_response(ack)
 
     async def _fleet_cancel(self, request: web.Request) -> web.Response:
         """Cross-process session cancellation (ROADMAP 3b, docs/SERVING.md

@@ -132,9 +132,17 @@ class GenerationRequest:
     # compiled grammar (serving/constrain.TokenDFA), attached at submit()
     # when options.response_format is set
     _dfa: Optional[Any] = None
-    # adapter/grammar pool rows once resolved at admission (idempotence
-    # marker for the page-deferral retry path)
-    _agentic_rows: Optional[tuple[int, int]] = None
+    # the host-mirrored DFA state AFTER the latest delivered token —
+    # written on the engine thread strictly BEFORE on_token fires, so a
+    # callback reading it inside on_token sees the state matching that
+    # token. This is what rides the fleet wire's tokens frames: a
+    # survivor resumes a constrained stream mid-derivation from it
+    # (options.grammar_resume_state) instead of refusing (§18)
+    dfa_state: Optional[int] = None
+    # adapter/grammar pool rows + initial DFA state once resolved at
+    # admission (idempotence marker for the page-deferral retry path):
+    # (adapter_row, grammar_row, dfa_state0)
+    _agentic_rows: Optional[tuple[int, int, int]] = None
 
     def cancel(self) -> None:
         """Request cancellation from ANY thread. The engine honors it at
@@ -419,25 +427,28 @@ def _reset_rows(cache, slots):
 def _prefill_segment_and_sample(
     params, tokens, offsets, seg_lengths, local_cache, key, temp, top_k, top_p,
     config, kv_bound, lora=None, arows=None, dfa=None, g=None,
-    state_dev=None, state_slot=None,
+    state_dev=None, state_slot=None, state0=None,
 ):
     """One chunked-prefill segment + a sample of its last-token logits.
     Sampling every segment (vs only the last) keeps the compiled-shape count
     at O(log2 segments) (the pow2 kv_bound); non-final samples are simply
     never fetched. With a grammar, the first generated token is masked by
-    DFA state 0 and the advanced state scatters into ``state_dev`` at
-    ``state_slot`` (out-of-bounds on non-final segments — dropped), so the
-    decode chain the engine dispatches NEXT iteration already carries the
-    right state without a host round trip."""
+    the request's INITIAL DFA state ``state0`` ([1] int32 — 0 for a fresh
+    derivation, the carried state for a mid-derivation fleet resume, §18)
+    and the advanced state scatters into ``state_dev`` at ``state_slot``
+    (out-of-bounds on non-final segments — dropped), so the decode chain
+    the engine dispatches NEXT iteration already carries the right state
+    without a host round trip."""
     logits, local_cache = prefill_segment(
         params, tokens, offsets, seg_lengths, local_cache, config, kv_bound,
         lora=lora, adapter_rows=arows,
     )
     key, sub = jax.random.split(key)
     if dfa is not None:
-        nrow = dfa[g, jnp.zeros_like(g)]  # generation starts at state 0
+        s0 = state0 if state0 is not None else jnp.zeros_like(g)
+        nrow = dfa[g, s0]
         first = sample(logits, sub, temp, top_k, top_p, nrow >= 0)
-        s1 = _dfa_advance(nrow, first, jnp.zeros_like(g))
+        s1 = _dfa_advance(nrow, first, s0)
         state_dev = state_dev.at[state_slot].set(s1[0], mode="drop")
     else:
         first = sample(logits, sub, temp, top_k, top_p)
@@ -525,23 +536,25 @@ def _paged_verify_chunk(
 def _paged_segment_and_sample(
     params, tokens, offsets, seg_lengths, pool, table, key, temp, top_k, top_p,
     config, page_size, lora=None, arows=None, dfa=None, g=None,
-    state_dev=None, state_slot=None,
+    state_dev=None, state_slot=None, state0=None,
 ):
     """One chunked/suffix prefill segment straight into the slot's pages +
     a sample of its last-token logits. Replaces the dense path's local
     cache + final insert + (on warm admissions) the prefix gather: aliased
     prefix pages are already visible through the table, so a warm admission
     is ONE dispatch (plus at most one copy-on-write page copy). Grammar
-    handling as in ``_prefill_segment_and_sample``."""
+    handling as in ``_prefill_segment_and_sample`` (``state0`` seeds the
+    first-token mask — the mid-derivation resume hook)."""
     logits, pool = paged_prefill_segment_inplace(
         params, tokens, offsets, seg_lengths, pool, table, config, page_size,
         lora=lora, adapter_rows=arows,
     )
     key, sub = jax.random.split(key)
     if dfa is not None:
-        nrow = dfa[g, jnp.zeros_like(g)]
+        s0 = state0 if state0 is not None else jnp.zeros_like(g)
+        nrow = dfa[g, s0]
         first = sample(logits, sub, temp, top_k, top_p, nrow >= 0)
-        s1 = _dfa_advance(nrow, first, jnp.zeros_like(g))
+        s1 = _dfa_advance(nrow, first, s0)
         state_dev = state_dev.at[state_slot].set(s1[0], mode="drop")
     else:
         first = sample(logits, sub, temp, top_k, top_p)
@@ -623,6 +636,7 @@ def _make_admit_group(mesh):
         params, cache, tokens_dev, positions_dev, temp_dev, top_k_dev,
         top_p_dev, key, tokens, meta, slots, config,
         lora=None, arows=None, dfa=None, g_rows=None, state_dev=None,
+        g_state0=None,
     ):
         # tokens [P, W] int32; meta [4, P] f32 = lengths/temps/top_ks/top_ps
         lengths = meta[0].astype(jnp.int32)
@@ -645,13 +659,16 @@ def _make_admit_group(mesh):
         )
         key, sub = jax.random.split(key)
         if dfa is not None:
-            # constrained rows: first generated token masked by DFA state 0,
+            # constrained rows: first generated token masked by each row's
+            # INITIAL DFA state (g_state0 — 0 for fresh derivations, the
+            # carried state for a mid-derivation fleet resume, §18), the
             # advanced state scattered into the decode chain alongside the
             # token — the NEXT decode chunk (often dispatched before this
             # fetch even lands) reads a coherent state
-            nrow = dfa[g_rows, jnp.zeros_like(g_rows)]
+            s0 = g_state0 if g_state0 is not None else jnp.zeros_like(g_rows)
+            nrow = dfa[g_rows, s0]
             first = sample(logits, sub, temps, top_ks, top_ps, nrow >= 0)
-            s1 = _dfa_advance(nrow, first, jnp.zeros_like(g_rows))
+            s1 = _dfa_advance(nrow, first, s0)
             state_dev = state_dev.at[slots].set(s1, mode="drop")
         else:
             first = sample(logits, sub, temps, top_ks, top_ps)
@@ -696,6 +713,7 @@ def _make_paged_admit_group(mesh=None):
         params, pool, tokens_dev, positions_dev, temp_dev, top_k_dev,
         top_p_dev, key, tokens, meta, slots, tables, config, page_size,
         lora=None, arows=None, dfa=None, g_rows=None, state_dev=None,
+        g_state0=None,
     ):
         # tokens [P, W] int32; meta [4, P] f32; tables [P, Tp] int32
         lengths = meta[0].astype(jnp.int32)
@@ -718,9 +736,11 @@ def _make_paged_admit_group(mesh=None):
         )
         key, sub = jax.random.split(key)
         if dfa is not None:
-            nrow = dfa[g_rows, jnp.zeros_like(g_rows)]
+            # initial state per row (g_state0): 0 fresh, carried on resume
+            s0 = g_state0 if g_state0 is not None else jnp.zeros_like(g_rows)
+            nrow = dfa[g_rows, s0]
             first = sample(logits, sub, temps, top_ks, top_ps, nrow >= 0)
-            s1 = _dfa_advance(nrow, first, jnp.zeros_like(g_rows))
+            s1 = _dfa_advance(nrow, first, s0)
             state_dev = state_dev.at[slots].set(s1, mode="drop")
         else:
             first = sample(logits, sub, temps, top_ks, top_ps)
@@ -1067,6 +1087,7 @@ class ServingEngine:
         restart_backoff_s: float = 0.1,
         max_restarts: int = 5,
         fault_injector: Optional[FaultInjector] = None,
+        migrate_staging: bool = False,
         observability: bool = True,
         flight_iterations: int = 256,
         flight_dir: Optional[str] = None,
@@ -1176,6 +1197,20 @@ class ServingEngine:
         # recorder phase_ms; reset at iteration top)
         self._spill_ms_iter = 0.0
         self._restore_ms_iter = 0.0
+        # -- KV-page migration (disaggregated serving, docs/SERVING.md §18):
+        # commands from migration threads (HTTP handlers, the fleet
+        # router's dispatch executors) executed at iteration top on the
+        # engine thread — the pool/index are engine-thread-only, and the
+        # command queue is how a snapshot/bind crosses into that domain
+        # without a lock on the hot loop. Each command carries its own
+        # reply queue; callers time out (deadline-bounded migrate) rather
+        # than block forever on a dead engine.
+        self._migrate_cmds: "queue.SimpleQueue" = queue.SimpleQueue()
+        self.migrate_pages_out_total = 0
+        self.migrate_bytes_out_total = 0
+        self.migrate_pages_in_total = 0
+        self.migrate_bytes_in_total = 0
+        self.migrate_failures_total = 0
         if not self._paged:
             self._cache = make_kv_cache(config, max_batch, self.max_seq_len)
             if mesh is not None:
@@ -1634,6 +1669,9 @@ class ServingEngine:
                 grammar_states=(
                     self._constrain_reg.max_states if self._constrain_reg else 0
                 ),
+                # role-tagged replicas (§18): budget the host-RAM staging
+                # one in-flight KV migration claims on this end
+                migrate_staging=bool(migrate_staging) and self._paged,
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -1802,6 +1840,20 @@ class ServingEngine:
             # compilation is pure host work and must not stall the engine
             # loop; an uncompilable schema fails HERE, loudly
             request._dfa = self._constrain_reg.compile(dict(response_format))
+        resume = getattr(opts, "grammar_resume_state", None)
+        if request._dfa is not None and resume is not None:
+            if request._dfa.is_complete(int(resume)):
+                # the derivation already FINISHED when the original stream
+                # died (the cut ate only the terminal frame): there is
+                # nothing left to generate — resolve immediately instead
+                # of sampling a token the uninterrupted run never produced
+                request.dfa_state = int(resume)
+                request._finish(GenerationResult(
+                    tokens=[], finish_reason="stop",
+                    prompt_tokens=len(request.prompt_tokens),
+                    ttft_s=0.0, total_s=0.0,
+                ))
+                return request
         deadline_s = request.options.deadline_s
         if deadline_s is not None:
             est_wait = self._queue_wait_ema_s
@@ -2068,6 +2120,15 @@ class ServingEngine:
             "host-evictions-total": (
                 self._prefix_index.host_evictions if self._prefix_index else 0
             ),
+            # KV-page migration (disaggregated serving, §18): pages/bytes
+            # serialized OUT of this replica's pool and bound IN from a
+            # peer's — the sender side only counts after the receiver's
+            # ACK released the local copy
+            "migrate-pages-out-total": self.migrate_pages_out_total,
+            "migrate-bytes-out-total": self.migrate_bytes_out_total,
+            "migrate-pages-in-total": self.migrate_pages_in_total,
+            "migrate-bytes-in-total": self.migrate_bytes_in_total,
+            "migrate-failures-total": self.migrate_failures_total,
             # self-speculative decoding (zeros with speculation off, so the
             # metrics exporter sets its gauges unconditionally)
             "speculation": self._spec_enabled,
@@ -2339,17 +2400,18 @@ class ServingEngine:
         pool.dev = _page_zero(
             pool.dev, jnp.asarray(np.full(pool.table_len, pool.oob, np.int32))
         )
-        if self._spill_on:
-            # the tiered-KV pair: snapshot (spill's device-side slice) and
-            # restore (the ONE traced-index upload an admission dispatches
-            # per hibernated page) — warmed so the FIRST restore is DMA,
-            # not DMA + compile. Restore targets the OOB sentinel: drops.
-            self._record_program("page-snapshot")
-            snap = _page_snapshot(pool.dev, jnp.asarray(0, jnp.int32))
-            self._record_program("page-restore")
-            pool.dev = _page_restore(
-                pool.dev, snap, jnp.asarray(pool.oob, jnp.int32)
-            )
+        # the snapshot/restore pair serves BOTH the tiered-KV spill path
+        # and the §18 migration wire (every paged engine can send/receive
+        # a migration) — warmed so the first restore OR first migration is
+        # DMA, not DMA + compile (the unwarmed pair measured ~14s of a
+        # first HTTP migration's wall). Restore targets the OOB sentinel:
+        # drops.
+        self._record_program("page-snapshot")
+        snap = _page_snapshot(pool.dev, jnp.asarray(0, jnp.int32))
+        self._record_program("page-restore")
+        pool.dev = _page_restore(
+            pool.dev, snap, jnp.asarray(pool.oob, jnp.int32)
+        )
         jax.block_until_ready(jax.tree.leaves(pool.dev)[0])
         log.info(
             "paged programs precompiled: ONE %s program (chunk %d), %d "
@@ -2789,6 +2851,11 @@ class ServingEngine:
         self._restore_ms_iter = 0.0
         if self._spill_on:
             self._spill_tick()
+        # KV-page migration commands (snapshot/bind/release — §18) cross
+        # into the engine-thread domain here; O(1) when idle (one
+        # SimpleQueue emptiness check), and the idle loop spins at ~1ms so
+        # a migration never waits behind more than one iteration
+        self._drain_migrations()
         self._sweep_waiting()
         t_sweep = time.monotonic() if obs_on else 0.0
         # chunks dispatched in previous iterations are still unfetched when
@@ -3235,7 +3302,31 @@ class ServingEngine:
                 ttft_s=0, total_s=0, error=e,
             ))
             return False
-        request._agentic_rows = (arow, grow)
+        state0 = 0
+        if request._dfa is not None:
+            resume = getattr(opts, "grammar_resume_state", None)
+            if resume is not None:
+                state0 = int(resume)
+                if not (0 <= state0 < request._dfa.n_states):
+                    # an out-of-range resume state means the carried wire
+                    # state indexes a DIFFERENT grammar: continuing would
+                    # emit off-grammar output dressed as valid — refuse
+                    if adapter_name:
+                        self._adapters.release(adapter_name)
+                    self._constrain_reg.release(request._dfa)
+                    request._finish(GenerationResult(
+                        tokens=[], finish_reason="error",
+                        prompt_tokens=len(request.prompt_tokens),
+                        ttft_s=0, total_s=0,
+                        error=ValueError(
+                            f"grammar-resume-state {state0} is out of range "
+                            f"for this grammar ({request._dfa.n_states} "
+                            "states) — the resumed stream's grammar does "
+                            "not match"
+                        ),
+                    ))
+                    return False
+        request._agentic_rows = (arow, grow, state0)
 
         def _release() -> None:
             if adapter_name:
@@ -3249,7 +3340,7 @@ class ServingEngine:
     def _slot_bind_agentic(self, idx: int, request: GenerationRequest) -> None:
         """Copy the request's resolved rows into the per-slot dispatch
         state at activation (the moment slot.request is set)."""
-        arow, grow = request._agentic_rows or (0, 0)
+        arow, grow, state0 = request._agentic_rows or (0, 0, 0)
         if self._adapters is not None:
             self._adapter_rows[idx] = arow
             self._adapter_rows_auth[idx] = arow
@@ -3260,7 +3351,11 @@ class ServingEngine:
             self._g_rows[idx] = grow
             if request._dfa is not None:
                 self._slot_dfa[idx] = request._dfa
-                self._dfa_host_state[idx] = 0
+                # a mid-derivation fleet resume starts at the carried
+                # state, not 0 (§18) — host mirror and device state agree
+                # because the admit programs seed their mask from state0
+                self._dfa_host_state[idx] = state0
+                request.dfa_state = state0
 
     def _slot_clear_agentic(self, idx: int) -> None:
         if self._adapters is not None:
@@ -3305,18 +3400,23 @@ class ServingEngine:
         return lora, arows, dfa, g
 
     def _agentic_row_args(self, requests: list) -> tuple:
-        """Per-ROW (not per-slot) adapter/grammar row vectors for a batched
-        admission: entry j serves requests[j]; padding rows ride as base."""
+        """Per-ROW (not per-slot) adapter/grammar row + initial-DFA-state
+        vectors for a batched admission: entry j serves requests[j];
+        padding rows ride as base (state 0)."""
         if not self._agentic:
-            return None, None
+            return None, None, None
         n = self.prefill_batch
         arows = np.zeros(n, np.int32)
         g_rows = np.zeros(n, np.int32)
+        g_state0 = np.zeros(n, np.int32)
         for j, request in enumerate(requests[:n]):
-            ar, gr = (request._agentic_rows or (0, 0)) if request else (0, 0)
+            ar, gr, s0 = (
+                (request._agentic_rows or (0, 0, 0)) if request else (0, 0, 0)
+            )
             arows[j] = ar
             g_rows[j] = gr
-        return arows, g_rows
+            g_state0[j] = s0
+        return arows, g_rows, g_state0
 
     def _adapter_integrity_check(self) -> None:
         """Validate every active slot's dispatch-facing adapter row against
@@ -3569,10 +3669,12 @@ class ServingEngine:
                 lengths=lengths, slots=slots, temps=temps, top_ks=top_ks,
                 top_ps=top_ps,
             ))
-        arows, g_rows = self._agentic_row_args([r for _, r in group])
+        arows, g_rows, g_state0 = self._agentic_row_args(
+            [r for _, r in group]
+        )
         first = self._dev_prefill(
             width, tokens, lengths, temps, top_ks, top_ps, slots,
-            arows=arows, g_rows=g_rows,
+            arows=arows, g_rows=g_rows, g_state0=g_state0,
         )
         if self._obs.on:
             self._obs.record(
@@ -3594,10 +3696,14 @@ class ServingEngine:
             self._maybe_publish(idx, request.prompt_tokens)
         return [("prefill", self._fetcher.submit(first), list(group))]
 
-    def _agentic_admit_kwargs(self, n: int, arows, g_rows) -> dict:
+    def _agentic_admit_kwargs(
+        self, n: int, arows, g_rows, g_state0=None,
+    ) -> dict:
         """Keyword args the admit-group programs take when the agentic
         tier is on — zeros (base rows) for warmups and padding. Empty dict
-        when off, so legacy engines trace the exact pre-ISSUE-10 programs."""
+        when off, so legacy engines trace the exact pre-ISSUE-10 programs.
+        ``g_state0``: per-row initial DFA states (zeros except for
+        mid-derivation fleet resumes, §18)."""
         kw: dict[str, Any] = {}
         if self._adapters is not None:
             kw["lora"] = self._adapters.pool
@@ -3610,11 +3716,14 @@ class ServingEngine:
                 g_rows if g_rows is not None else np.zeros(n, np.int32)
             )
             kw["state_dev"] = self._dfa_state_dev
+            kw["g_state0"] = jnp.asarray(
+                g_state0 if g_state0 is not None else np.zeros(n, np.int32)
+            )
         return kw
 
     def _dev_prefill(
         self, width, tokens, lengths, temps, top_ks, top_ps, slots,
-        arows=None, g_rows=None,
+        arows=None, g_rows=None, g_state0=None,
     ):
         """Device layer of a batched prefill — runs IDENTICALLY on the
         leader and (via follower_loop) every SPMD follower, so the sharded
@@ -3628,12 +3737,12 @@ class ServingEngine:
         if self._paged:
             return self._dev_paged_prefill(
                 tokens, lengths, temps, top_ks, top_ps, slots,
-                arows=arows, g_rows=g_rows,
+                arows=arows, g_rows=g_rows, g_state0=g_state0,
             )
         self._record_program("prefill", tokens.shape[1], n)
         # pack the per-row scalars into one upload (per-op tunnel latency)
         meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
-        kw = self._agentic_admit_kwargs(n, arows, g_rows)
+        kw = self._agentic_admit_kwargs(n, arows, g_rows, g_state0)
         (
             first,
             self._cache,
@@ -3665,7 +3774,7 @@ class ServingEngine:
 
     def _dev_paged_prefill(
         self, tokens, lengths, temps, top_ks, top_ps, slots,
-        arows=None, g_rows=None,
+        arows=None, g_rows=None, g_state0=None,
     ):
         """Paged device layer of a batched cold prefill: the SAME fused
         local-cache forward as the dense admit group (token-exactness), but
@@ -3680,7 +3789,7 @@ class ServingEngine:
                 tables[j] = pool.tables[s]
         self._record_program("paged-prefill", tokens.shape[1], n)
         meta = np.stack([lengths, temps, top_ks, top_ps]).astype(np.float32)
-        kw = self._agentic_admit_kwargs(n, arows, g_rows)
+        kw = self._agentic_admit_kwargs(n, arows, g_rows, g_state0)
         (
             first,
             pool.dev,
@@ -3815,9 +3924,11 @@ class ServingEngine:
     def _segment_agentic_kwargs(self, agentic_rows, state_slot) -> dict:
         """Agentic kwargs for the batch-1 segment programs (warm suffix /
         long-prompt chunks). ``state_slot`` out of bounds (non-final
-        segments, warmups) drops the DFA state scatter."""
+        segments, warmups) drops the DFA state scatter. The request's
+        initial DFA state (the _agentic_rows triple) seeds the first-token
+        mask — nonzero only on a mid-derivation fleet resume (§18)."""
         kw: dict[str, Any] = {}
-        arow, grow = agentic_rows or (0, 0)
+        arow, grow, state0 = agentic_rows or (0, 0, 0)
         if self._adapters is not None:
             kw["lora"] = self._adapters.pool
             kw["arows"] = jnp.asarray([arow], jnp.int32)
@@ -3826,6 +3937,7 @@ class ServingEngine:
             kw["g"] = jnp.asarray([grow], jnp.int32)
             kw["state_dev"] = self._dfa_state_dev
             kw["state_slot"] = jnp.asarray(state_slot, jnp.int32)
+            kw["state0"] = jnp.asarray([state0], jnp.int32)
         return kw
 
     def _dev_prefix_admit(
@@ -4568,6 +4680,251 @@ class ServingEngine:
                 "reuse-tokens": p,
             })
         return True
+
+    # -- KV-page migration (disaggregated serving, docs/SERVING.md §18) ------
+
+    def _drain_migrations(self) -> None:
+        """Serve queued migration commands (engine thread, iteration top).
+        Each command replies on its own queue; a command that fails
+        replies the exception instead of killing the loop — a broken
+        migration degrades ONE transfer, never the engine."""
+        from langstream_tpu.serving.migrate import MigrationError
+
+        while True:
+            try:
+                kind, payload, reply = self._migrate_cmds.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                reply.put(("ok", self._migrate_cmd(kind, payload)))
+            except MigrationError as e:
+                self.migrate_failures_total += 1
+                reply.put(("err", e))
+            except Exception as e:  # noqa: BLE001 — degrade the transfer only
+                log.exception("migration command %s failed", kind)
+                self.migrate_failures_total += 1
+                reply.put(("err", MigrationError(f"{kind}: {e}")))
+
+    def _migrate_cmd(self, kind: str, payload: dict) -> dict:
+        from langstream_tpu.serving.migrate import MigrationError
+        from langstream_tpu.serving.pagepool import prefix_digest
+
+        pool, index = self._pagepool, self._prefix_index
+        if pool is None or index is None:
+            raise MigrationError(
+                "KV-page migration needs the paged layout with a prefix "
+                "index (kv-layout: paged, prefix-cache: auto)"
+            )
+        if kind == "snapshot":
+            hit = index.deepest_entry(payload["tokens"])
+            if hit is None:
+                raise MigrationError("no published prefix covers this prompt")
+            length, entry = hit
+            n = math.ceil(length / self.page_size)
+            tier = self._host_tier
+            # hibernated (and spilled-while-resident) sessions send
+            # STRAIGHT from the host arena — no device restore, and the
+            # stamped spill checksum ships as-is; a completed spill is
+            # required (an in-flight handle's slots are the worker's)
+            if entry.host and entry.spilling is None and tier is not None:
+                slots = list(entry.host[:n])
+                if len(slots) == n:
+                    blocks, sums = [], []
+                    for s in slots:
+                        block = tier.read(s)
+                        if block is None:
+                            blocks = None  # checksum rot: fall to device
+                            break
+                        blocks.append(jax.tree.leaves(block))
+                        sums.append(tier.checksum(s))
+                    if blocks is not None:
+                        return {
+                            "tier": "host", "length": length,
+                            "digest": prefix_digest(
+                                list(payload["tokens"])[:length]
+                            ),
+                            "blocks": blocks, "checksums": sums,
+                            "page_size": self.page_size,
+                            "bytes_per_page": pool.bytes_per_page,
+                        }
+            if not entry.pages or len(entry.pages) < n:
+                raise MigrationError(
+                    "prefix entry holds no readable pages (host copy "
+                    "failed verification and no device half exists)"
+                )
+            # device tier: slice each page into INDEPENDENT buffers (the
+            # spill path's decoupling trick) — the caller's device→host
+            # fetch can never race a later donating rewrite or a free
+            self._record_program("page-snapshot")
+            blocks = [
+                _page_snapshot(pool.dev, jnp.asarray(p, jnp.int32))
+                for p in entry.pages[:n]
+            ]
+            return {
+                "tier": "device", "length": length,
+                "digest": prefix_digest(list(payload["tokens"])[:length]),
+                "blocks": blocks, "checksums": None,
+                "page_size": self.page_size,
+                "bytes_per_page": pool.bytes_per_page,
+            }
+        if kind == "bind":
+            tokens, length = payload["tokens"], int(payload["length"])
+            blocks = payload["blocks"]
+            if length not in index.boundaries:
+                raise MigrationError(
+                    f"migrated length {length} is not a prefix boundary "
+                    f"here (boundaries {index.boundaries}) — sender and "
+                    "receiver disagree on bucket config"
+                )
+            if index.has(tokens, length):
+                # idempotent re-migration (retry after a lost ACK): the
+                # prefix is already resident — nothing to bind, ACK again
+                return {"pages": 0, "bytes": 0, "already": True}
+            n = math.ceil(length / self.page_size)
+            if len(blocks) != n:
+                raise MigrationError(
+                    f"migration carries {len(blocks)} pages for a "
+                    f"{length}-token prefix; expected {n}"
+                )
+            if pool.free_pages < n:
+                index.evict_for(
+                    pool, n,
+                    spill_cb=self._ensure_spilled if self._spill_on else None,
+                )
+            pages = pool.alloc_pages(n)
+            if pages is None:
+                raise MigrationError(
+                    f"receiver pool exhausted ({pool.free_pages} free, "
+                    f"{n} needed) — nothing was bound"
+                )
+            treedef = jax.tree.structure(pool.dev)
+            self._record_program("page-restore")
+            try:
+                for leaves, dst in zip(blocks, pages):
+                    block = jax.tree.unflatten(treedef, leaves)
+                    pool.dev = _page_restore(
+                        pool.dev, block, jnp.asarray(dst, jnp.int32)
+                    )
+                entry = index.insert(pool, tokens, length, tuple(pages))
+            except BaseException:
+                pool.decref(pages)  # receiver frees on ANY abort — no leak
+                raise
+            pool.decref(pages)  # the index holds the one reference now
+            if entry is None:
+                # cap full and nothing evictable: insert declined (the
+                # decref above already returned the pages — uploaded bytes
+                # are garbage in free pages, same as any freed slot)
+                raise MigrationError(
+                    "receiver prefix index is at capacity with every "
+                    "entry pinned — migration not bound"
+                )
+            if self._spill_on:
+                # a migrated-in session hibernates like a published one
+                self._spill_candidates.append(entry)
+            self.migrate_pages_in_total += n
+            self.migrate_bytes_in_total += n * pool.bytes_per_page
+            return {"pages": n, "bytes": n * pool.bytes_per_page}
+        if kind == "release":
+            tokens, length = payload["tokens"], int(payload["length"])
+            path = index._walk(tokens, limit=length)
+            entry = path[-1].entry if path else None
+            if entry is None or entry.length != length or entry.dropped:
+                return {"released": False, "pages": 0}
+            if entry.pins > 0:
+                # an in-flight admission is reading it: retain (refcounts
+                # keep the pages valid); LRU reclaims it once idle
+                return {"released": False, "pages": 0}
+            n = max(len(entry.pages), len(entry.host))
+            index._drop(pool, entry)
+            self.migrate_pages_out_total += n
+            self.migrate_bytes_out_total += n * pool.bytes_per_page
+            return {"released": True, "pages": n}
+        raise MigrationError(f"unknown migration command {kind!r}")
+
+    def _migrate_rpc(self, kind: str, payload: dict, timeout_s: float) -> dict:
+        """Caller-thread half of a migration command: enqueue, wait, bound
+        by ``timeout_s`` (the deadline-bounded-migrate contract — a wedged
+        engine fails the MIGRATION, and the router falls back, rather than
+        parking the hop forever)."""
+        from langstream_tpu.serving.migrate import MigrationError
+
+        if self._dead is not None:
+            raise MigrationError("engine is stopped") from self._dead
+        if not self._paged:
+            raise MigrationError(
+                "KV-page migration requires kv-layout: paged"
+            )
+        if self._spmd is not None:
+            raise MigrationError(
+                "KV-page migration is not on the SPMD wire yet (the bind/"
+                "restore dispatches would need follower replay)"
+            )
+        reply: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._migrate_cmds.put((kind, payload, reply))
+        try:
+            status, out = reply.get(timeout=max(0.05, float(timeout_s)))
+        except queue.Empty:
+            raise MigrationError(
+                f"engine did not serve the {kind} command within "
+                f"{timeout_s:.1f}s"
+            ) from None
+        if status == "err":
+            raise out
+        return out
+
+    def migrate_snapshot(self, tokens, timeout_s: float = 30.0) -> dict:
+        """Serialize the deepest published prefix covering ``tokens`` for
+        the migration wire (any thread): per-page host leaf blocks + the
+        blake2b checksum stamped the same way the host spill tier stamps
+        arena pages. Device-resident entries are sliced into independent
+        buffers on the engine thread and fetched HERE (off the engine
+        loop); hibernated entries ship their arena bytes + stored sums
+        with no device work at all. Raises MigrationError on any failure
+        (nothing is freed — the sender retains until ACK)."""
+        from langstream_tpu.serving.migrate import MigrationError
+        from langstream_tpu.serving.pagepool import page_checksum
+
+        out = self._migrate_rpc(
+            "snapshot", {"tokens": list(tokens)}, timeout_s
+        )
+        if out["tier"] == "device":
+            try:
+                fetched = [
+                    [np.asarray(jax.device_get(leaf)) for leaf in
+                     jax.tree.leaves(block)]
+                    for block in out["blocks"]
+                ]
+            except Exception as e:  # noqa: BLE001 — device fetch failed
+                raise MigrationError(f"page snapshot fetch failed: {e}") from e
+            out["blocks"] = fetched
+            out["checksums"] = [page_checksum(b) for b in fetched]
+        return out
+
+    def migrate_bind(
+        self, tokens, length: int, blocks: list, timeout_s: float = 30.0,
+    ) -> dict:
+        """Bind already-checksum-VERIFIED migrated pages into this
+        replica's pool + prefix index (any thread; the wire layer in
+        serving/migrate.py owns the verification — this method trusts its
+        caller exactly as far as one process boundary). On any failure
+        nothing stays bound: allocated pages return to the free list
+        before the error propagates (receiver frees on abort)."""
+        return self._migrate_rpc(
+            "bind",
+            {"tokens": list(tokens), "length": int(length), "blocks": blocks},
+            timeout_s,
+        )
+
+    def migrate_release(
+        self, tokens, length: int, timeout_s: float = 10.0,
+    ) -> dict:
+        """Drop the migrated-out prefix entry (sender side, ONLY after the
+        receiver's ACK): pages still aliased by active slots survive via
+        refcounts; a pinned entry is retained for LRU to reclaim."""
+        return self._migrate_rpc(
+            "release", {"tokens": list(tokens), "length": int(length)},
+            timeout_s,
+        )
 
     def _spec_admit(self, idx: int, prompt: list[int]) -> None:
         """Create the slot's draft index at admission, seeded with the
@@ -5733,6 +6090,11 @@ class ServingEngine:
                     )
                     return
                 self._dfa_host_state[idx] = s
+                # mirror onto the request BEFORE on_token below fires: a
+                # stream callback reading dfa_state inside on_token sees
+                # the state matching this token — what the fleet wire's
+                # tokens frames carry for mid-derivation resume (§18)
+                request.dfa_state = s
                 if dfa.is_complete(s):
                     finished_reason = "stop"
             with self._stats_lock:

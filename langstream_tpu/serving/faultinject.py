@@ -87,6 +87,12 @@ SITES = (
     # fleet-wire sites (docs/SERVING.md §17): applied by the streaming
     # transport and the /fleet/generate handler, not the engine
     "net-connect", "net-stall", "net-cut", "net-corrupt",
+    # KV-page migration site (docs/SERVING.md §18): corrupt one page
+    # payload of an in-flight replica-to-replica migration — the
+    # receiver's per-page checksum must catch it, discard the partial
+    # bind (no leaked pages), and the sender must RETAIN its copy so the
+    # router can fall back to decode-in-place, token-exact
+    "migrate",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;
@@ -287,6 +293,28 @@ class FaultInjector:
             # point the slot's first mapped entry somewhere else entirely
             pool.tables[victim, 0] = (pool.tables[victim, 0] + 1) % pool.num_pages
         return victim
+
+    def corrupt_migration_frame(self, frame):
+        """``migrate`` site: flip bytes of one page payload of an
+        in-flight KV migration (serving/migrate.py) — the wire-corruption
+        drill for the replica-to-replica transfer. The frame's stamped
+        checksum is left INTACT while the payload is damaged, so the
+        receiver's per-page verification must catch the mismatch and
+        abort the bind. Returns True when the site fired (the frame was
+        mutated in place)."""
+        if frame.get("kind") != "page" or not self.fires("migrate"):
+            return False
+        data = frame.get("data") or []
+        if not data or not data[0]:
+            return False
+        # flip the first base64 character to a DIFFERENT valid one: the
+        # payload still decodes (same length, same charset) but its bytes
+        # differ — exactly the bit-rot-in-flight class the per-page
+        # checksum exists to catch, exercised through the verify path
+        # rather than the cheaper undecodable-garbage path
+        first = data[0][0]
+        data[0] = ("A" if first != "A" else "B") + data[0][1:]
+        return True
 
     def corrupt_host_page(self, tier, slots):
         """``spill`` site: flip one byte of one arena slot the restore is

@@ -245,11 +245,19 @@ class ReplicaError(RuntimeError):
 
 def beacon_from_engine(
     replica_id: str, engine: Any, url: str = "", top_k: int = 32,
+    role: str = "mixed",
 ) -> dict[str, Any]:
     """Build the compact state beacon one replica advertises. Token content
     never appears — prefixes travel as (digest, length) pairs. Safe to call
     from any thread (engine.stats() and the advertisement registries take
-    their own locks)."""
+    their own locks). ``role`` is the disaggregated-serving tag
+    (``prefill`` | ``decode`` | ``mixed`` — the `fleet-role` knob, §18):
+    the router steers long-prompt admissions at prefill-tagged replicas
+    and migrates their KV to decode-tagged ones."""
+    if role not in ("prefill", "decode", "mixed"):
+        raise ValueError(
+            f"unknown fleet role {role!r}; supported: prefill, decode, mixed"
+        )
     stats = engine.stats()
     adv = getattr(engine, "prefix_advertisement", None)
     boundaries, prefixes = adv(top_k) if adv is not None else ((), [])
@@ -264,6 +272,7 @@ def beacon_from_engine(
         "schema": BEACON_SCHEMA,
         "id": str(replica_id),
         "url": url,
+        "role": role,
         "at": round(time.time(), 3),
         "load_score": stats.get("load-score", 0.0),
         "queue_wait_ema_s": stats.get("queue-wait-ema-s", 0.0),
@@ -306,6 +315,14 @@ def beacon_from_engine(
                 else ()
             )
         ],
+        # wire capabilities (§18): what this replica's VERSION understands.
+        # "kvmig" = binds inbound KV-page migrations; "dfa-resume" =
+        # honors grammar-resume-state. The router refuses to migrate to —
+        # or resume a constrained stream on — a peer that does not
+        # advertise the capability: a legacy peer would silently drop the
+        # option and restart the DFA at state 0 (invalid output dressed
+        # as valid), the exact class the §17 refusal existed to prevent.
+        "caps": ["kvmig", "dfa-resume"],
     }
 
 
@@ -337,6 +354,12 @@ def validate_beacon(doc: dict[str, Any]) -> bool:
     for j, name in enumerate(doc.get("adapters") or []):
         if not isinstance(name, str):
             raise ValueError(f"adapter advertisement {j} is not a name string")
+    role = doc.get("role", "mixed")
+    if role not in ("prefill", "decode", "mixed"):
+        raise ValueError(f"unknown beacon role {role!r}")
+    for j, cap in enumerate(doc.get("caps") or []):
+        if not isinstance(cap, str):
+            raise ValueError(f"capability advertisement {j} is not a string")
     for forbidden in ("tokens", "prompt", "text", "prompt_tokens"):
         if forbidden in doc:
             raise ValueError(f"beacon carries token-content key {forbidden!r}")
@@ -360,17 +383,24 @@ def register_local(
     generate_fn: Optional[Callable[[dict], dict]] = None,
     reset_fn: Optional[Callable[[], None]] = None,
     generate_stream_fn: Optional[Callable[[dict], Iterator[dict]]] = None,
+    migrate_bind_fn: Optional[Callable[..., dict]] = None,
+    migrate_out_fn: Optional[Callable[[dict], dict]] = None,
 ) -> None:
     """Expose this process's engine on the runtime HTTP server: ``GET
     /state`` serves ``beacon_fn``, ``POST /fleet/generate`` runs
     ``generate_fn`` (fleet-internal dispatch; with ``stream: true`` in the
     payload it prefers ``generate_stream_fn`` — frames per §17 — and falls
     back to wrapping ``generate_fn``'s one-shot result), ``POST
-    /fleet/reset`` runs ``reset_fn`` (bench warmup hygiene)."""
+    /fleet/reset`` runs ``reset_fn`` (bench warmup hygiene), ``POST
+    /fleet/migrate`` binds an inbound KV-page migration through
+    ``migrate_bind_fn`` and ``POST /fleet/migrate-out`` commands this
+    replica to push one through ``migrate_out_fn`` (docs/SERVING.md §18)."""
     with _LOCAL_LOCK:
         _LOCAL[str(replica_id)] = {
             "beacon": beacon_fn, "generate": generate_fn, "reset": reset_fn,
             "generate_stream": generate_stream_fn,
+            "migrate_bind": migrate_bind_fn,
+            "migrate_out": migrate_out_fn,
         }
 
 
@@ -435,6 +465,75 @@ def local_generate_stream(payload: dict[str, Any]) -> Iterator[dict]:
     if gen is None:
         raise ReplicaError("registered engine does not accept fleet dispatch")
     return result_frames(gen(payload))
+
+
+def local_migrate_bind(frames: Iterator[dict], timeout_s: float = 30.0) -> dict:
+    """Inbound KV-page migration into this process's engine (the POST
+    /fleet/migrate body, §18). Blocking — the HTTP server runs it in an
+    executor. Raises ReplicaError when no engine is registered."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            raise ReplicaError("no serving engine registered in this process")
+        fns = next(iter(_LOCAL.values()))
+    bind = fns.get("migrate_bind")
+    if bind is None:
+        raise ReplicaError(
+            "registered engine does not accept KV-page migrations"
+        )
+    return bind(frames, timeout_s)
+
+
+def local_migrate_out(payload: dict) -> dict:
+    """Outbound migration command (the POST /fleet/migrate-out body): this
+    process's engine exports the prefix and pushes it to ``dest``."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            raise ReplicaError("no serving engine registered in this process")
+        fns = next(iter(_LOCAL.values()))
+    out = fns.get("migrate_out")
+    if out is None:
+        raise ReplicaError(
+            "registered engine does not accept KV-page migrations"
+        )
+    return out(payload)
+
+
+def engine_migrate_bind(
+    engine: Any, frames: Iterator[dict], timeout_s: float = 30.0,
+) -> dict:
+    """The canonical ``migrate_bind_fn`` for ``register_local``: verify
+    and bind one inbound migration into the local engine."""
+    from langstream_tpu.serving import migrate as migrate_mod
+
+    return migrate_mod.bind_frames(engine, frames, timeout_s=timeout_s)
+
+
+def engine_migrate_out(engine: Any, payload: dict) -> dict:
+    """The canonical ``migrate_out_fn`` for ``register_local``: export the
+    prefix covering ``prompt_tokens`` from the local engine, push it to
+    the ``dest`` replica's ``POST /fleet/migrate``, and release the local
+    copy on its ACK (never before). Returns the receiver's ACK augmented
+    with sender-side phase timings."""
+    from langstream_tpu.serving import migrate as migrate_mod
+
+    tokens = [int(t) for t in payload.get("prompt_tokens") or []]
+    if not tokens:
+        raise ValueError("migrate-out payload carries no prompt_tokens")
+    dest = str(payload.get("dest") or "")
+    if not dest:
+        raise ValueError("migrate-out payload carries no dest url")
+    timeout_s = float(payload.get("timeout-s") or 30.0)
+    phases: dict[str, Any] = {}
+    frames = migrate_mod.export_frames(
+        engine, tokens, timeout_s=timeout_s,
+        state=payload.get("state") or {}, phases=phases,
+    )
+    t0 = time.monotonic()
+    ack = migrate_mod.push_migration(dest, frames, timeout_s)
+    phases["transfer_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+    migrate_mod._release_on_ack(engine, tokens, ack)  # noqa: SLF001
+    ack["phases"] = dict(phases, **(ack.get("phases") or {}))
+    return ack
 
 
 def local_reset() -> None:
@@ -572,9 +671,14 @@ def engine_generate_stream(
     request = GenerationRequest(
         prompt_tokens=tokens,
         options=opts,
-        on_token=lambda t: q.put(("tok", int(t))),
         on_done=lambda res: q.put(("done", res)),
     )
+    # on_token runs on the ENGINE thread, which writes request.dfa_state
+    # strictly before invoking it — pairing token and state here is what
+    # lets a constrained stream's tokens frames carry the host-mirrored
+    # DFA state, so a survivor can resume mid-derivation (§18) instead of
+    # refusing. None for unconstrained requests (and legacy peers).
+    request.on_token = lambda t: q.put(("tok", (int(t), request.dfa_state)))
     if cancel_key:
         lifecycle.register(cancel_key, request)
     try:
@@ -619,12 +723,19 @@ def engine_generate_stream(
                         batch.append(q.get_nowait())
                     except queue.Empty:
                         break
-                toks = [t for kind, t in batch if kind == "tok"]
+                toks = [v[0] for k, v in batch if k == "tok"]
+                dfa_state = None
                 for kind, value in batch:
                     if kind == "done":
                         result = value
+                    elif kind == "tok" and value[1] is not None:
+                        # the state matching the LAST token of this frame
+                        # (per-token states are monotone within a batch)
+                        dfa_state = int(value[1])
                 if toks:
                     frame = {"seq": seq, "kind": "tokens", "tokens": toks}
+                    if dfa_state is not None:
+                        frame["dfa_state"] = dfa_state
                     if seq == 0:
                         frame["v"] = FRAME_SCHEMA
                     yield frame
@@ -671,13 +782,19 @@ class InProcessReplica:
 
     is_local = True
 
-    def __init__(self, replica_id: str, engine: Any, url: str = "") -> None:
+    def __init__(
+        self, replica_id: str, engine: Any, url: str = "",
+        role: str = "mixed",
+    ) -> None:
         self.replica_id = str(replica_id)
         self.engine = engine
         self.url = url or f"local:{replica_id}"
+        self.role = str(role)
 
     def fetch_beacon(self) -> dict[str, Any]:
-        return beacon_from_engine(self.replica_id, self.engine, url=self.url)
+        return beacon_from_engine(
+            self.replica_id, self.engine, url=self.url, role=self.role
+        )
 
     def generate(
         self, tokens, options: Optional[dict] = None, timeout_s: float = 600.0,
@@ -997,6 +1114,43 @@ class HttpReplica:
             resp.close()
             raise
 
+    def migrate_out(
+        self, tokens, dest_url: str, state: Optional[dict],
+        timeout_s: float,
+    ) -> dict:
+        """Command this (remote) replica to push a KV-page migration to
+        ``dest_url``'s ``POST /fleet/migrate`` (§18). Returns the
+        receiver's ACK as relayed by the source. Failures raise
+        MigrationError — the source retains its pages (it frees only on
+        the ACK it relays here)."""
+        from langstream_tpu.serving.migrate import MigrationError
+
+        body = json.dumps({
+            "prompt_tokens": [int(t) for t in tokens],
+            "dest": str(dest_url),
+            "state": dict(state or {}),
+            "timeout-s": float(timeout_s),
+        }).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + "/fleet/migrate-out", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=max(0.1, float(timeout_s) + 2.0)
+            ) as r:
+                ack = json.loads(r.read().decode("utf-8"))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise MigrationError(
+                f"replica {self.replica_id} migrate-out failed: {e}"
+            ) from e
+        if not ack.get("ok"):
+            raise MigrationError(
+                f"replica {self.replica_id} migrate-out rejected: "
+                f"{ack.get('error')!r}"
+            )
+        return ack
+
     def reset_histograms(self) -> None:
         try:
             urllib.request.urlopen(
@@ -1026,6 +1180,14 @@ class _ReplicaState:
     # the replica but needs a restore — scored at spill_discount
     spilled_digests: dict[str, int] = field(default_factory=dict)
     adapters: frozenset = frozenset()  # resident LoRA adapter names
+    # disaggregated serving (§18): the replica's advertised phase role —
+    # prefill replicas absorb long-prompt bursts, decode replicas hold the
+    # steady state, mixed (the default) serves both
+    role: str = "mixed"
+    # advertised wire capabilities ("kvmig", "dfa-resume", ...): empty for
+    # legacy peers — the router only migrates to / resumes constrained
+    # streams on replicas that prove they understand the payload
+    caps: frozenset = frozenset()
     # circuit breaker (docs/SERVING.md §17): consecutive beacon-fetch +
     # dispatch failures drive an exponential probe backoff — the refresh
     # loop stops hammering a dead peer's /state every interval, and the
@@ -1040,9 +1202,15 @@ class _ReplicaState:
 class RouteDecision:
     replica_id: str
     handle: Any
-    kind: str  # affinity | sticky | balanced
+    kind: str  # affinity | sticky | balanced | prefill | migrated
     expected_match: int
     score: float
+    # disaggregated handoff (§18): True when this route lands the PREFILL
+    # phase on a prefill-tagged replica and the router intends to migrate
+    # the KV to a decode replica once the first token lands — the
+    # completions fast path must NOT short-circuit such a route even when
+    # it is local (the router owns the orchestration)
+    disagg: bool = False
 
 
 class FleetRouter:
@@ -1072,6 +1240,9 @@ class FleetRouter:
         spill_discount: float = 0.5,
         beacon_backoff_max_s: float = 30.0,
         circuit_failures: int = 3,
+        prefill_route_threshold: int = 2048,
+        migrate: bool = True,
+        migrate_timeout_s: float = 30.0,
     ) -> None:
         if policy not in self.POLICIES:
             raise ValueError(
@@ -1104,6 +1275,17 @@ class FleetRouter:
         # reads "open" rather than "blip")
         self.beacon_backoff_max_s = float(beacon_backoff_max_s)
         self.circuit_failures = max(1, int(circuit_failures))
+        # disaggregated prefill/decode (§18): an admission whose ESTIMATED
+        # prefill (prompt minus the best advertised prefix match) reaches
+        # the threshold routes to a prefill-tagged replica, prefills + its
+        # first token there, then its KV pages MIGRATE to a decode replica
+        # where the stream finishes — one 32k prompt never camps on the
+        # replicas holding 95 steady decode streams. Takes effect only
+        # when both roles are present and routable; `migrate=False` keeps
+        # role-aware routing but decodes in place (no transfer).
+        self.prefill_route_threshold = max(1, int(prefill_route_threshold))
+        self.migrate_enabled = bool(migrate)
+        self.migrate_timeout_s = float(migrate_timeout_s)
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -1129,6 +1311,14 @@ class FleetRouter:
         self.stream_failover_total = 0
         self.beacon_failures_total = 0
         self.circuit_open_total = 0
+        # disaggregated serving (§18): prefill-handoff routes, completed
+        # migrations (pages/bytes by receiver ACK), and fallbacks (the
+        # migration failed and the stream decoded in place / re-prefilled)
+        self.routed_prefill_total = 0
+        self.migrations_total = 0
+        self.migrate_pages_total = 0
+        self.migrate_bytes_total = 0
+        self.migrate_fallbacks_total = 0
         self._hist_lock = threading.Lock()
         self.dispatch_hist = Histogram(
             "fleet_dispatch_s",
@@ -1139,6 +1329,11 @@ class FleetRouter:
             "fleet_hop_s",
             FLEET_HISTOGRAMS["fleet_hop_s"]["help"],
             FLEET_HISTOGRAMS["fleet_hop_s"]["buckets"],
+        )
+        self.migrate_hist = Histogram(
+            "fleet_migrate_s",
+            FLEET_HISTOGRAMS["fleet_migrate_s"]["help"],
+            FLEET_HISTOGRAMS["fleet_migrate_s"]["buckets"],
         )
         # the router's own flight recorder: its ring stays empty (no
         # engine loop here) — fleet-failover dumps carry the hop's frame
@@ -1192,6 +1387,14 @@ class FleetRouter:
                 }
                 state.adapters = frozenset(
                     str(a) for a in (beacon.get("adapters") or [])
+                )
+                role = str(beacon.get("role") or "mixed")
+                state.role = (
+                    role if role in ("prefill", "decode", "mixed")
+                    else "mixed"
+                )
+                state.caps = frozenset(
+                    str(c) for c in (beacon.get("caps") or [])
                 )
                 # a fresh beacon is the half-open probe SUCCEEDING: close
                 # the circuit and forget the backoff
@@ -1402,8 +1605,7 @@ class FleetRouter:
                 }
             )
             probe = {n: prefix_digest(tokens[:n]) for n in lengths}
-            best, best_score, best_match = None, None, 0
-            best_adapter_hit = False
+            scored: list[tuple[_ReplicaState, int, bool]] = []
             for s in live:
                 match, spilled_match = 0, 0
                 for n in lengths:
@@ -1421,6 +1623,36 @@ class FleetRouter:
                     match, int(spilled_match * self.spill_discount)
                 )
                 adapter_hit = bool(adapter) and adapter in s.adapters
+                scored.append((s, effective, adapter_hit))
+            # role-aware candidate set (disaggregated serving, §18): with
+            # BOTH roles routable, a prefill-heavy admission (estimated
+            # prefill = prompt minus the best warm match anywhere) lands
+            # on a prefill-tagged replica — the handoff route the caller
+            # migrates away from once the first token lands — and
+            # everything else keeps the decode/mixed pool, so one 32k
+            # prompt never stalls the steady decode streams
+            disagg = False
+            kind_override = None
+            candidates = scored
+            prefill_pool = [t for t in scored if t[0].role == "prefill"]
+            decode_pool = [
+                t for t in scored if t[0].role in ("decode", "mixed")
+            ]
+            if prefill_pool and decode_pool:
+                best_anywhere = max(m for _, m, _ in scored)
+                est_prefill = len(tokens) - best_anywhere
+                if est_prefill >= self.prefill_route_threshold:
+                    candidates = prefill_pool
+                    kind_override = "prefill"
+                    disagg = self.migrate_enabled
+                    self.routed_prefill_total += 1
+                else:
+                    candidates = decode_pool
+            # no role split (prefill-only or decode/mixed-only fleets):
+            # candidates stays the full scored set
+            best, best_score, best_match = None, None, 0
+            best_adapter_hit = False
+            for s, effective, adapter_hit in candidates:
                 score = (
                     effective
                     + (self.adapter_affinity_tokens if adapter_hit else 0.0)
@@ -1432,7 +1664,9 @@ class FleetRouter:
             assert best is not None
             if best_adapter_hit:
                 self.routed_adapter_total += 1
-            if best_match > 0 or best_adapter_hit:
+            if kind_override is not None:
+                kind = kind_override
+            elif best_match > 0 or best_adapter_hit:
                 self.routed_affinity_total += 1
                 kind = "affinity"
             else:
@@ -1441,7 +1675,9 @@ class FleetRouter:
                 # everyone, since score reduces to −λ·load)
                 self.routed_balanced_total += 1
                 kind = "balanced"
-            return self._decide(best, kind, best_match, session_id, now)
+            return self._decide(
+                best, kind, best_match, session_id, now, disagg=disagg
+            )
 
     def _decide(
         self,
@@ -1450,6 +1686,7 @@ class FleetRouter:
         match: int,
         session_id: Optional[str],
         now: float,
+        disagg: bool = False,
     ) -> RouteDecision:
         rid = state.handle.replica_id
         if session_id:
@@ -1460,6 +1697,7 @@ class FleetRouter:
             kind=kind,
             expected_match=match,
             score=match - self.lam * self._load(state.beacon),
+            disagg=disagg,
         )
 
     def _prune_sticky(self, now: float) -> None:
@@ -1484,6 +1722,210 @@ class FleetRouter:
         return result_frames(
             handle.generate(prompt, opts, timeout_s), prompt_len=len(prompt)
         )
+
+    # -- disaggregated handoff (docs/SERVING.md §18) --------------------------
+
+    def _pick_decode_target(
+        self, exclude: set, require_caps: tuple = (),
+    ) -> Optional[RouteDecision]:
+        """The decode replica a just-prefilled stream migrates to:
+        least-loaded among decode-tagged routable replicas (mixed as the
+        fallback pool) that advertise every capability in
+        ``require_caps``. Prefix affinity is irrelevant here — the pages
+        travel WITH the stream. Returns None when no survivor can decode
+        (the caller decodes in place)."""
+        now = time.monotonic()
+        with self._lock:
+            live = [
+                s for rid, s in self._replicas.items()
+                if rid not in exclude and self._routable(s, now)
+                and all(c in s.caps for c in require_caps)
+            ]
+            pool = [s for s in live if s.role == "decode"] or [
+                s for s in live if s.role == "mixed"
+            ]
+            if not pool:
+                return None
+            best = min(pool, key=lambda s: self._load(s.beacon))
+            return self._decide(best, "migrated", 0, None, now)
+
+    def _handoff_target(
+        self,
+        decision: RouteDecision,
+        tokens: list,
+        delivered: list,
+        parsed: Any,
+        last_dfa_state: Optional[int],
+        session_id: Optional[str],
+        exclude: set,
+    ) -> RouteDecision:
+        """Prefill phase complete: migrate the stream's KV to a decode
+        replica and return the decision the resume hop MUST use. Every
+        failure path returns the PREFILL replica itself — decode-in-place,
+        the fallback that is always correct (the pages are there, the
+        resume is warm) — and counts/dumps the fallback."""
+        prompt = tokens + delivered
+        # the target must UNDERSTAND the transfer ("kvmig" — a legacy peer
+        # would 404/garble the bind) and, for a constrained stream, the
+        # carried DFA state ("dfa-resume" — a peer that silently dropped
+        # it would restart the grammar at 0: invalid output)
+        need = ("kvmig", "dfa-resume") if parsed.response_format else ("kvmig",)
+        target = self._pick_decode_target(
+            exclude | {decision.replica_id}, require_caps=need,
+        )
+        reason = None
+        if target is None:
+            reason = "no decode-capable replica routable"
+        elif parsed.response_format and last_dfa_state is None:
+            # the prefill hop's frames carried no DFA state (legacy peer):
+            # migrating would strand a derivation the decode replica
+            # cannot legally continue — decode where the grammar state is
+            reason = "constrained stream carried no DFA state"
+        if reason is None:
+            state = {"sampling": {
+                "temperature": parsed.temperature,
+                "top-k": parsed.top_k, "top-p": parsed.top_p,
+                "seed": parsed.seed,
+            }}
+            if parsed.response_format and last_dfa_state is not None:
+                state["grammar_key"] = json.dumps(
+                    parsed.response_format, sort_keys=True,
+                    separators=(",", ":"),
+                )
+                state["dfa_state"] = int(last_dfa_state)
+            ack = self._migrate(decision, target, prompt, state)
+            if ack is not None:
+                if session_id:
+                    # sticky repoint (§18): the session's KV now LIVES on
+                    # the decode replica — the next turn must route there,
+                    # not back to the prefill replica for a pointless
+                    # second migration
+                    with self._lock:
+                        self._sticky[session_id] = (
+                            target.replica_id, time.monotonic()
+                        )
+                return target
+            reason = "migration failed"
+        else:
+            with self._lock:
+                self.migrate_fallbacks_total += 1
+            self._flight.dump(
+                "migrate-failed",
+                counters={
+                    "migrate_fallbacks_total": self.migrate_fallbacks_total,
+                    "delivered": len(delivered),
+                },
+                extra={
+                    "error": reason, "src": decision.replica_id,
+                    "fallback": "decode-in-place",
+                },
+                force=True,
+            )
+        log.warning(
+            "disagg handoff falling back to decode-in-place on %s: %s",
+            decision.replica_id, reason,
+        )
+        # decode-in-place: same replica, full remaining budget, no disagg
+        return RouteDecision(
+            replica_id=decision.replica_id, handle=decision.handle,
+            kind="prefill", expected_match=len(prompt), score=decision.score,
+            disagg=False,
+        )
+
+    def _has_cap(self, replica_id: str, cap: str) -> bool:
+        with self._lock:
+            state = self._replicas.get(replica_id)
+            return state is not None and cap in state.caps
+
+    def _migrate(
+        self, src: RouteDecision, dst: RouteDecision, prompt: list,
+        state: dict,
+    ) -> Optional[dict]:
+        """Run one KV-page migration src → dst (§18). Returns the
+        receiver's ACK, or None after counting + dumping the failure —
+        the sender retains its pages on every failure path, so the caller
+        can always decode in place."""
+        t0 = time.perf_counter()
+        phases: dict[str, Any] = {}
+        try:
+            if getattr(src.handle, "is_local", False):
+                from langstream_tpu.serving import migrate as migrate_mod
+
+                frames = migrate_mod.export_frames(
+                    src.handle.engine, prompt,
+                    timeout_s=self.migrate_timeout_s,
+                    state=state, phases=phases,
+                )
+                if getattr(dst.handle, "is_local", False):
+                    ack = migrate_mod.bind_frames(
+                        dst.handle.engine, frames,
+                        timeout_s=self.migrate_timeout_s,
+                    )
+                else:
+                    t1 = time.perf_counter()
+                    ack = migrate_mod.push_migration(
+                        str(getattr(dst.handle, "url", "")), frames,
+                        self.migrate_timeout_s,
+                    )
+                    phases["transfer_ms"] = round(
+                        (time.perf_counter() - t1) * 1e3, 3
+                    )
+                migrate_mod._release_on_ack(  # noqa: SLF001
+                    src.handle.engine, prompt, ack
+                )
+            else:
+                migrate_out = getattr(src.handle, "migrate_out", None)
+                dst_url = str(getattr(dst.handle, "url", "") or "")
+                if migrate_out is None or not dst_url.startswith("http"):
+                    raise RuntimeError(
+                        "source replica cannot push a migration to this "
+                        "destination (no migrate-out transport / non-HTTP "
+                        "receiver)"
+                    )
+                ack = migrate_out(
+                    prompt, dst_url, state, self.migrate_timeout_s
+                )
+                phases.update(ack.get("phases") or {})
+            took = time.perf_counter() - t0
+            with self._hist_lock:
+                self.migrate_hist.record(took)
+            with self._lock:
+                self.migrations_total += 1
+                self.migrate_pages_total += int(ack.get("pages", 0))
+                self.migrate_bytes_total += int(ack.get("bytes", 0))
+            log.info(
+                "migrated %s pages (%s bytes) %s → %s in %.1f ms",
+                ack.get("pages"), ack.get("bytes"),
+                src.replica_id, dst.replica_id, took * 1e3,
+            )
+            return ack
+        except Exception as e:  # noqa: BLE001 — every failure falls back
+            took = time.perf_counter() - t0
+            with self._hist_lock:
+                # failed migrations land in the histogram too — the panel
+                # must move during incidents
+                self.migrate_hist.record(took)
+            with self._lock:
+                self.migrate_fallbacks_total += 1
+                fallbacks = self.migrate_fallbacks_total
+            self._flight.dump(
+                "migrate-failed",
+                counters={"migrate_fallbacks_total": fallbacks},
+                extra={
+                    "error": str(e), "src": src.replica_id,
+                    "dst": dst.replica_id,
+                    "phases": phases,
+                    "total_ms": round(took * 1e3, 3),
+                    "fallback": "decode-in-place",
+                },
+                force=True,
+            )
+            log.warning(
+                "KV migration %s → %s failed after %.1f ms (%s); sender "
+                "retains, stream decodes in place",
+                src.replica_id, dst.replica_id, took * 1e3, e,
+            )
+            return None
 
     def stream_generate(
         self,
@@ -1542,25 +1984,71 @@ class FleetRouter:
         # "failover" (the metric means RESUMED, §17)
         pending_failover: Optional[dict] = None
         adapter = str(options.get("adapter") or "") or None
-        for _ in range(self.replica_count):
+        # disaggregated handoff state (§18): ``forced`` short-circuits
+        # route() for the hop that must land on a SPECIFIC replica (the
+        # decode target the KV just migrated to, or the prefill replica
+        # decoding in place after a failed migration); ``last_dfa_state``
+        # is the constrained stream's host-mirrored grammar state as
+        # carried by the tokens frames — what makes a mid-derivation
+        # resume legal instead of refused
+        forced: Optional[RouteDecision] = None
+        last_dfa_state: Optional[int] = None
+        # attempt budget: one per replica, EXTENDED by one whenever a
+        # prefill handoff consumes a turn (its hop ends in a migration,
+        # not a failure) — a full fleet's worth of failovers still fits,
+        # and the all-replicas-died exit below keeps raising ReplicaError
+        # rather than letting an extra route() read as a shed
+        attempts, max_attempts = 0, self.replica_count
+        while attempts < max_attempts:
+            attempts += 1
             prompt = tokens + delivered
             opts = dict(options)
             if delivered:
                 # the resumed stream finishes the ORIGINAL budget: tokens
                 # already delivered never re-generate (and never re-bill)
                 opts["max-tokens"] = max(1, budget - len(delivered))
-            try:
-                decision = self.route(
-                    prompt, session_id=session_id, exclude=excluded,
-                    adapter=adapter,
-                )
-            except FleetShedError as e:
-                if delivered:
-                    raise ReplicaError(
-                        f"stream lost its replica after {len(delivered)} "
-                        f"token(s) and no survivor is routable: {e}"
-                    ) from e
-                raise
+                if parsed.response_format and last_dfa_state is not None:
+                    # resume the derivation FROM the carried state — the
+                    # survivor's DFA must not restart at 0 (§18)
+                    opts["grammar-resume-state"] = int(last_dfa_state)
+            if forced is not None:
+                decision, forced = forced, None
+            else:
+                try:
+                    decision = self.route(
+                        prompt, session_id=session_id, exclude=excluded,
+                        adapter=adapter,
+                    )
+                except FleetShedError as e:
+                    if delivered:
+                        raise ReplicaError(
+                            f"stream lost its replica after "
+                            f"{len(delivered)} token(s) and no survivor "
+                            f"is routable: {e}"
+                        ) from e
+                    raise
+                if (
+                    "grammar-resume-state" in opts
+                    and not self._has_cap(decision.replica_id, "dfa-resume")
+                ):
+                    # a legacy survivor would silently DROP the resume
+                    # state and restart the DFA at 0 — invalid output
+                    # dressed as valid. Exclude it; another survivor may
+                    # honor the state, and none at all is a loud failure
+                    # (the all-attempts exit below).
+                    excluded.add(decision.replica_id)
+                    continue
+            # prefill handoff (§18): run prefill + the FIRST token on the
+            # prefill-tagged replica (TTFT comes from there), then migrate
+            # the KV pages to a decode replica and finish the stream where
+            # the steady decode pool lives
+            handoff = (
+                decision.disagg
+                and budget - len(delivered) > 1
+                and self.migrate_enabled
+            )
+            if handoff:
+                opts["max-tokens"] = 1
             if pending_failover is not None:
                 # the resume has a survivor: NOW it is a warm failover
                 failovers += 1
@@ -1587,6 +2075,7 @@ class FleetRouter:
                 "url": str(getattr(decision.handle, "url", "") or ""),
                 "local": bool(getattr(decision.handle, "is_local", False)),
                 "resumed": len(delivered),
+                "disagg": bool(decision.disagg),
                 "decision": decision,
             }
             out_seq += 1
@@ -1598,6 +2087,7 @@ class FleetRouter:
                 )
             stream_fn = getattr(decision.handle, "generate_stream", None)
             hop_t0 = time.perf_counter()
+            handed_off = False
             try:
                 frames = (
                     stream_fn(prompt, opts, timeout_s=remaining)
@@ -1635,6 +2125,11 @@ class FleetRouter:
                         if first_token_at is None:
                             first_token_at = time.monotonic()
                         delivered.extend(toks)
+                        if frame.get("dfa_state") is not None:
+                            try:
+                                last_dfa_state = int(frame["dfa_state"])
+                            except (TypeError, ValueError):
+                                last_dfa_state = None
                         yield {
                             "seq": out_seq, "kind": "tokens",
                             "tokens": toks, "replica": decision.replica_id,
@@ -1645,6 +2140,25 @@ class FleetRouter:
                             self.hop_hist.record(
                                 time.perf_counter() - hop_t0
                             )
+                        if (
+                            handoff
+                            and str(frame.get("finish_reason")) == "length"
+                            and len(delivered) < budget
+                        ):
+                            # prefill phase done (our 1-token clamp, not a
+                            # real completion): migrate, then resume on
+                            # the decode target — or decode in place when
+                            # anything about the transfer fails
+                            forced = self._handoff_target(
+                                decision, tokens, delivered, parsed,
+                                last_dfa_state, session_id, excluded,
+                            )
+                            close = getattr(frames, "close", None)
+                            if close is not None:
+                                close()
+                            handed_off = True
+                            max_attempts += 1  # this turn was no failure
+                            break
                         now = time.monotonic()
                         yield {
                             "seq": out_seq, "kind": "end",
@@ -1673,6 +2187,8 @@ class FleetRouter:
                             "replica": decision.replica_id,
                         }
                         out_seq += 1
+                if handed_off:
+                    continue
                 raise ReplicaError(
                     f"replica {decision.replica_id}: stream ended without "
                     "terminal frame"
@@ -1703,17 +2219,22 @@ class FleetRouter:
                     self.hop_hist.record(time.perf_counter() - hop_t0)
                 self.note_failover(decision.replica_id)
                 excluded.add(decision.replica_id)
-                if delivered and parsed.response_format:
-                    # a grammar-constrained stream cannot resume
-                    # mid-derivation: the survivor's DFA would restart at
-                    # state 0 and append a SECOND derivation after the
-                    # partial one — invalid output dressed as valid. Fail
-                    # loudly; the §15 parse/validate guarantee outranks
-                    # availability until DFA state rides the resume.
+                if (
+                    delivered and parsed.response_format
+                    and last_dfa_state is None
+                ):
+                    # a grammar-constrained stream whose frames carried NO
+                    # DFA state (legacy peer / one-shot adapter) cannot
+                    # resume mid-derivation: the survivor's DFA would
+                    # restart at state 0 and append a SECOND derivation
+                    # after the partial one — invalid output dressed as
+                    # valid. With the state on the wire (tokens frames,
+                    # §18) the resume continues the derivation instead.
                     raise ReplicaError(
                         f"constrained stream lost its replica after "
-                        f"{len(delivered)} token(s); mid-derivation "
-                        "resume would break the grammar guarantee"
+                        f"{len(delivered)} token(s) and its frames carried "
+                        "no DFA state; mid-derivation resume would break "
+                        "the grammar guarantee"
                     ) from e
                 if delivered and len(delivered) >= budget:
                     # the replica died BETWEEN its final tokens frame and
@@ -1829,6 +2350,71 @@ class FleetRouter:
             want = n
         return max(min_replicas, min(want, max_replicas))
 
+    def desired_replicas_by_role(
+        self,
+        target_queue_wait_s: float = 0.5,
+        min_replicas: int = 1,
+        max_replicas: int = 64,
+    ) -> dict[str, int]:
+        """Role-split autoscale hint for disaggregated fleets (§18): the
+        PREFILL pool scales on its own queue-wait EMA (prefill-heavy
+        admissions queue there — wait is the burst-absorption signal),
+        the DECODE pool on occupancy/load-score (decode replicas run a
+        high-occupancy steady state by design; queue wait stays near zero
+        until they are genuinely full). Pools scale independently with
+        the same out-cap/in-conservatism as ``desired_replicas``; a role
+        with no routable beacon holds its current count. Empty dict when
+        the fleet advertises no roles (homogeneous fleets keep the scalar
+        hint)."""
+        now = time.monotonic()
+        with self._lock:
+            by_role: dict[str, list] = {}
+            totals: dict[str, int] = {}
+            for s in self._replicas.values():
+                role = s.role
+                totals[role] = totals.get(role, 0) + 1
+                if self._routable(s, now):
+                    by_role.setdefault(role, []).append(s.beacon)
+        if set(totals) <= {"mixed"}:
+            return {}
+        out: dict[str, int] = {}
+        for role, total in sorted(totals.items()):
+            live = by_role.get(role) or []
+            if not live:
+                out[role] = max(min_replicas, min(total, max_replicas))
+                continue
+            n = len(live)
+            ema = sum(
+                float(b.get("queue_wait_ema_s", 0.0)) for b in live
+            ) / n
+            occ = sum(
+                float(b.get("active_slots", 0))
+                / max(1, b.get("max_batch", 1))
+                for b in live
+            ) / n
+            load = sum(float(b.get("load_score", 0.0)) for b in live) / n
+            if role == "prefill":
+                if ema > target_queue_wait_s:
+                    want = math.ceil(
+                        n * min(ema / target_queue_wait_s, 4.0)
+                    )
+                elif ema < 0.1 * target_queue_wait_s and n > 1:
+                    want = n - 1
+                else:
+                    want = n
+            else:
+                # decode/mixed: occupancy-first — a pool running hot
+                # (≥85% slots or load past ~2, i.e. saturated occupancy +
+                # page pressure) grows; a cold one (<30%) shrinks by one
+                if occ >= 0.85 or load >= 2.0:
+                    want = math.ceil(n * min(max(occ / 0.85, 1.0), 4.0))
+                elif occ < 0.3 and ema < 0.1 * target_queue_wait_s and n > 1:
+                    want = n - 1
+                else:
+                    want = n
+            out[role] = max(min_replicas, min(want, max_replicas))
+        return out
+
     # -- stats ----------------------------------------------------------------
 
     def stats(self) -> dict[str, Any]:
@@ -1851,6 +2437,17 @@ class FleetRouter:
                 "fleet-stream-failovers-total": self.stream_failover_total,
                 "fleet-beacon-failures-total": self.beacon_failures_total,
                 "fleet-circuit-open-total": self.circuit_open_total,
+                "fleet-routed-prefill-total": self.routed_prefill_total,
+                "fleet-migrations-total": self.migrations_total,
+                "fleet-migrate-pages-total": self.migrate_pages_total,
+                "fleet-migrate-bytes-total": self.migrate_bytes_total,
+                "fleet-migrate-fallbacks-total": self.migrate_fallbacks_total,
+                "fleet-roles": {
+                    role: sum(
+                        1 for s in self._replicas.values() if s.role == role
+                    )
+                    for role in ("prefill", "decode", "mixed")
+                },
                 "fleet-circuit-open-replicas": sum(
                     1 for s in self._replicas.values() if s.circuit_open
                 ),
@@ -1868,10 +2465,22 @@ class FleetRouter:
         out["fleet-hop-p99-ms"] = round(
             self.hop_hist.percentile(0.99) * 1e3, 4
         )
+        out["fleet-migrate-p50-ms"] = round(
+            self.migrate_hist.percentile(0.50) * 1e3, 4
+        )
+        out["fleet-migrate-p99-ms"] = round(
+            self.migrate_hist.percentile(0.99) * 1e3, 4
+        )
         # mirrored into /metrics by the genai exporter (same load() path
         # as the engine histograms — docs/SERVING.md §12/§17)
-        out["histograms"] = {"fleet_hop_s": self.hop_hist.snapshot()}
+        out["histograms"] = {
+            "fleet_hop_s": self.hop_hist.snapshot(),
+            "fleet_migrate_s": self.migrate_hist.snapshot(),
+        }
         out["fleet-desired-replicas"] = self.desired_replicas()
+        out["fleet-desired-replicas-by-role"] = (
+            self.desired_replicas_by_role()
+        )
         return out
 
 

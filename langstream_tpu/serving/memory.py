@@ -90,6 +90,11 @@ class ServingMemoryPlan:
     # the plan so the startup log is honest about the process RSS a
     # million-hibernated-sessions config will claim (docs/SERVING.md §16).
     host_spill_bytes: int = 0
+    # disaggregated serving (docs/SERVING.md §18): worst-case HOST-RAM
+    # staging for one in-flight KV-page migration (one request's page set
+    # serialized end-to-end). Host RAM like host_spill_bytes — excluded
+    # from the HBM total; 0 on mixed-role replicas.
+    migrate_staging_bytes: int = 0
     # self-speculative verify chunk (engine._verify_chunk): the multi-token
     # forward materializes fp32 logits for ALL k+1 positions of every slot
     # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
@@ -151,6 +156,11 @@ class ServingMemoryPlan:
                 if self.host_spill_bytes
                 else ""
             )
+            if self.migrate_staging_bytes:
+                host += (
+                    f" [+ migrate staging "
+                    f"{self.migrate_staging_bytes / gib:.2f}GiB RAM]"
+                )
             return (
                 f"weights {self.weights_bytes / gib:.2f}GiB + "
                 f"page-pool {self.page_pool_bytes / gib:.2f}GiB "
@@ -212,6 +222,7 @@ def plan_serving_memory(
     adapter_rank: int = 0,
     grammar_slots: int = 0,
     grammar_states: int = 0,
+    migrate_staging: bool = False,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -265,7 +276,10 @@ def plan_serving_memory(
     paged = kv_layout == "paged"
     if paged:
         from langstream_tpu.models.transformer import make_page_pool
-        from langstream_tpu.serving.pagepool import pages_for_fraction
+        from langstream_tpu.serving.pagepool import (
+            pages_for_fraction,
+            table_len_for,
+        )
 
         num_pages = kv_pages or pages_for_fraction(
             max_batch, max_seq_len, page_size, page_fraction
@@ -280,6 +294,18 @@ def plan_serving_memory(
 
             host_spill_bytes = (
                 math.ceil(num_pages * host_kv_fraction)
+                * (pool_bytes // max(1, num_pages))
+            )
+        # disaggregated serving (§18): one in-flight KV migration stages a
+        # request's worst-case page set in host RAM on BOTH ends (sender
+        # snapshot fetch, receiver frame buffer + decode) — transient, but
+        # a plan that ignored it would bless hosts with no headroom for
+        # the transfer the role topology exists to make. HOST RAM, like
+        # host_spill_bytes; excluded from the HBM total.
+        migrate_staging_bytes = 0
+        if migrate_staging:
+            migrate_staging_bytes = (
+                table_len_for(max_seq_len, page_size)
                 * (pool_bytes // max(1, num_pages))
             )
         fused_shape = (
@@ -310,6 +336,7 @@ def plan_serving_memory(
             prefix_pool_bytes=0,  # aliasing shares the one pool
             page_pool_bytes=pool_bytes,
             host_spill_bytes=host_spill_bytes,
+            migrate_staging_bytes=migrate_staging_bytes,
             verify_chunk_bytes=(
                 5 * max_batch * (speculation_tokens + 1) * config.vocab_size * 4
                 if speculation_tokens > 0

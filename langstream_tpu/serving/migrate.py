@@ -1,0 +1,338 @@
+"""KV-page migration over the fleet wire (``lstpu-kvmig-v1``).
+
+Disaggregated prefill/decode (ROADMAP item 2, DeepServe — PAPERS.md
+arxiv 2501.14417) needs exactly one new data-plane op: move a published
+prefix's KV PAGES from the replica that prefilled them to the replica
+that will decode against them. This module is that op, engineered so the
+transfer can fail at ANY byte and the request still completes with
+correct tokens (STREAM's integrity-checked inter-tier transfer stance,
+arxiv 2606.13968, extended from the host-RAM tier to the wire):
+
+- **Frames** (newline-delimited JSON, one monotone ``seq`` per frame):
+
+  ``begin``   prefix length + digest + page count/geometry + the prefix
+              TOKENS (data plane, like the /fleet/generate payload — the
+              receiver's radix trie is keyed by tokens; beacons and
+              flight dumps stay digest-only as ever)
+  ``page``    one pool page: base64 leaf blocks (``jax.tree.leaves``
+              order — int8 pools ship int8 + scales, half the bytes of
+              bf16) + the blake2b-16 checksum ``pagepool.page_checksum``
+              stamps. Hibernated sessions ship their host-arena bytes
+              with the checksum STORED at spill time — recomputing would
+              launder rot the arena already caught.
+  ``commit``  terminal: pages_sent + the decode-resume state (sequence
+              position, sampling echo, grammar key + host-mirrored DFA
+              state when the session is mid-derivation)
+
+- **Discipline**: the receiver binds pages into its own pool only behind
+  the per-page checksum (a mismatch aborts with NOTHING allocated), the
+  sender frees its copy ONLY on the receiver's ACK, the receiver frees
+  ONLY on abort — both free lists are leak-asserted by the chaos suite.
+  The ``migrate`` fault site corrupts a page payload in flight; ``net-cut``
+  fired against the migration aborts it between frames. Either way the
+  router falls back (decode-in-place / re-prefill) and the request stays
+  token-exact for greedy sampling.
+
+Transport: in-process transfer is a plain generator handoff; the HTTP
+hop POSTs the frames chunked to the receiver's ``POST /fleet/migrate``
+(runtime/http_server.py) and reads the ACK JSON. docs/SERVING.md §18.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import time
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MIG_SCHEMA = "lstpu-kvmig-v1"
+
+
+class MigrationError(RuntimeError):
+    """A KV-page migration failed (checksum mismatch, cut wire, pool
+    exhaustion, deadline). Callers fall back — decode-in-place on the
+    sender or a cold re-prefill on the receiver — and the sender RETAINS
+    its pages; this error never implies lost KV."""
+
+
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode("ascii")
+
+
+def export_frames(
+    engine: Any,
+    tokens,
+    timeout_s: float = 30.0,
+    state: Optional[dict] = None,
+    phases: Optional[dict] = None,
+) -> Iterator[dict]:
+    """Serialize the deepest published prefix covering ``tokens`` into
+    migration frames. The snapshot happens EAGERLY (before the first
+    frame yields) so a no-prefix/dead-engine failure raises here, while
+    the caller can still choose a fallback instead of aborting a
+    half-sent stream. The wire injector's ``migrate`` site corrupts one
+    page payload in flight; ``net-cut`` aborts between frames —
+    both leave the sender's copy intact (release happens only on ACK,
+    outside this generator)."""
+    from langstream_tpu.serving.fleet import wire_injector
+
+    tokens = [int(t) for t in tokens]
+    t0 = time.monotonic()
+    snap = engine.migrate_snapshot(tokens, timeout_s=timeout_s)
+    if phases is not None:
+        phases["snapshot_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        phases["tier"] = snap["tier"]
+    injector = wire_injector()
+
+    def frames() -> Iterator[dict]:
+        n = len(snap["blocks"])
+        yield {
+            "v": MIG_SCHEMA, "seq": 0, "kind": "begin",
+            "length": int(snap["length"]),
+            "digest": snap["digest"],
+            "pages": n,
+            "page_size": int(snap["page_size"]),
+            "bytes_per_page": int(snap["bytes_per_page"]),
+            "tier": snap["tier"],
+            "prompt_tokens": tokens[: int(snap["length"])],
+        }
+        for i, (leaves, checksum) in enumerate(
+            zip(snap["blocks"], snap["checksums"])
+        ):
+            if injector is not None and injector.fires("net-cut"):
+                raise MigrationError(
+                    f"injected net-cut after {i} of {n} page frame(s)"
+                )
+            frame = {
+                "seq": i + 1, "kind": "page", "i": i,
+                "data": [_b64(leaf) for leaf in leaves],
+                "checksum": checksum.hex(),
+            }
+            if injector is not None:
+                injector.corrupt_migration_frame(frame)
+            yield frame
+        yield {
+            "seq": n + 1, "kind": "commit", "pages_sent": n,
+            "state": dict(state or {}, position=int(snap["length"])),
+        }
+
+    return frames()
+
+
+def _leaf_specs(engine: Any) -> list[tuple[tuple, Any]]:
+    """Per-leaf (page_shape, dtype) of the receiver's pool — what one
+    serialized page must decode to. Static attributes only: safe to read
+    off the engine thread."""
+    import jax
+
+    return [
+        ((leaf.shape[0],) + tuple(leaf.shape[2:]), leaf.dtype)
+        for leaf in jax.tree.leaves(engine._pagepool.dev)  # noqa: SLF001
+    ]
+
+
+def bind_frames(
+    engine: Any, frames: Iterator[dict], timeout_s: float = 30.0,
+) -> dict:
+    """Receiver side: validate + checksum every page frame, then bind the
+    pages into ``engine``'s pool and prefix index. ALL verification
+    happens before anything is allocated — a cut stream, a corrupt
+    payload, or a checksum mismatch aborts with the receiver's free list
+    untouched. Returns the ACK dict the sender frees against."""
+    from langstream_tpu.serving.pagepool import page_checksum
+
+    deadline = time.monotonic() + max(0.05, timeout_s)
+    t0 = time.monotonic()
+    begin: Optional[dict] = None
+    blocks: list[list[np.ndarray]] = []
+    specs = None
+    expected_seq = 0
+    try:
+        for frame in frames:
+            if time.monotonic() > deadline:
+                raise MigrationError(
+                    f"migration exceeded its {timeout_s:.1f}s budget "
+                    f"after {len(blocks)} page(s)"
+                )
+            if not isinstance(frame, dict) or frame.get("seq") != expected_seq:
+                got = frame.get("seq") if isinstance(frame, dict) else None
+                raise MigrationError(
+                    f"migration sequence broken (got {got!r}, want "
+                    f"{expected_seq})"
+                )
+            expected_seq += 1
+            kind = frame.get("kind")
+            if kind == "begin":
+                if frame.get("v") != MIG_SCHEMA:
+                    raise MigrationError(
+                        f"unknown migration schema {frame.get('v')!r}"
+                    )
+                begin = frame
+                specs = _leaf_specs(engine)
+            elif kind == "page":
+                if begin is None:
+                    raise MigrationError("page frame before begin")
+                page = []
+                try:
+                    for (shape, dtype), b64 in zip(
+                        specs, frame.get("data") or []
+                    ):
+                        raw = base64.b64decode(b64, validate=True)
+                        arr = np.frombuffer(raw, dtype=dtype)
+                        page.append(arr.reshape(shape))
+                    want = bytes.fromhex(str(frame.get("checksum") or ""))
+                except (ValueError, TypeError) as e:
+                    raise MigrationError(
+                        f"page {frame.get('i')} payload undecodable ({e})"
+                    ) from e
+                if len(page) != len(specs):
+                    raise MigrationError(
+                        f"page {frame.get('i')} carries {len(page)} leaf "
+                        f"blocks; this pool has {len(specs)}"
+                    )
+                if page_checksum(page) != want:
+                    raise MigrationError(
+                        f"page {frame.get('i')} failed its checksum — "
+                        "discarding the migration (sender retains)"
+                    )
+                blocks.append(page)
+            elif kind == "commit":
+                if begin is None:
+                    raise MigrationError("commit frame before begin")
+                if len(blocks) != int(begin.get("pages") or -1) or (
+                    len(blocks) != int(frame.get("pages_sent") or -1)
+                ):
+                    raise MigrationError(
+                        f"commit count mismatch: {len(blocks)} received, "
+                        f"begin said {begin.get('pages')}, commit said "
+                        f"{frame.get('pages_sent')}"
+                    )
+                remaining = max(0.05, deadline - time.monotonic())
+                ack = engine.migrate_bind(
+                    [int(t) for t in begin["prompt_tokens"]],
+                    int(begin["length"]),
+                    blocks,
+                    timeout_s=remaining,
+                )
+                return {
+                    "ok": True,
+                    "length": int(begin["length"]),
+                    "digest": str(begin.get("digest") or ""),
+                    "pages": int(ack.get("pages", 0)),
+                    "bytes": int(ack.get("bytes", 0)),
+                    "already": bool(ack.get("already", False)),
+                    "state": dict(frame.get("state") or {}),
+                    "bind_ms": round((time.monotonic() - t0) * 1e3, 3),
+                }
+            else:
+                raise MigrationError(f"unknown migration frame {kind!r}")
+    finally:
+        close = getattr(frames, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — abort path must not mask
+                log.exception("migration frame close failed")
+    raise MigrationError(
+        f"migration stream ended after {len(blocks)} page(s) without a "
+        "commit frame (cut wire) — nothing was bound"
+    )
+
+
+def transfer(
+    src_engine: Any,
+    dst_engine: Any,
+    tokens,
+    timeout_s: float = 30.0,
+    state: Optional[dict] = None,
+    phases: Optional[dict] = None,
+) -> dict:
+    """In-process migration: export from ``src_engine``, bind into
+    ``dst_engine``, release the source copy on ACK. Raises MigrationError
+    with the sender intact on any failure."""
+    phases = phases if phases is not None else {}
+    frames = export_frames(
+        src_engine, tokens, timeout_s=timeout_s, state=state, phases=phases,
+    )
+    ack = bind_frames(dst_engine, frames, timeout_s=timeout_s)
+    _release_on_ack(src_engine, tokens, ack)
+    return ack
+
+
+def _release_on_ack(src_engine: Any, tokens, ack: dict) -> None:
+    """Sender frees ONLY on ACK; a failed release is benign (the entry
+    stays for LRU) and must never fail a migration that already landed."""
+    try:
+        src_engine.migrate_release(tokens, int(ack["length"]))
+    except Exception as e:  # noqa: BLE001 — ack'd migration stands
+        log.warning("post-ACK migration release failed (retained): %s", e)
+
+
+def push_migration(url: str, frames: Iterator[dict], timeout_s: float) -> dict:
+    """HTTP sender: POST the frame stream chunked to the receiver's
+    ``POST /fleet/migrate`` and return its ACK. Any transport failure —
+    refused connect, reset mid-body, non-JSON ACK — is a MigrationError;
+    the caller's release-on-ACK discipline keeps the sender's copy."""
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlsplit(url)
+    if u.scheme != "http" or not u.hostname:
+        raise MigrationError(f"unsupported migration receiver url {url!r}")
+
+    def body() -> Iterator[bytes]:
+        for frame in frames:
+            yield (json.dumps(frame) + "\n").encode("utf-8")
+
+    conn = http.client.HTTPConnection(
+        u.hostname, u.port or 80, timeout=max(0.05, timeout_s)
+    )
+    try:
+        try:
+            # the receiver binds under the SENDER's budget (clamped by the
+            # handler): without this a raised fleet-migrate-timeout-s
+            # would bound only the push while the bind still died at the
+            # receiver's default
+            conn.request(
+                "POST",
+                f"/fleet/migrate?timeout-s={max(0.05, timeout_s):.3f}",
+                body=body(),
+                headers={"Content-Type": "application/x-ndjson"},
+                encode_chunked=True,
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+        except MigrationError:
+            raise
+        except Exception as e:  # noqa: BLE001 — one verdict: hop failed
+            raise MigrationError(f"migration push to {url} failed: {e}") from e
+        if resp.status != 200:
+            raise MigrationError(
+                f"migration receiver {url} answered HTTP {resp.status}: "
+                f"{raw[:200]!r}"
+            )
+        try:
+            ack = json.loads(raw.decode("utf-8"))
+        except ValueError as e:
+            raise MigrationError(
+                f"migration receiver {url} sent a non-JSON ACK"
+            ) from e
+        if not ack.get("ok"):
+            raise MigrationError(
+                f"migration receiver {url} rejected the transfer: "
+                f"{ack.get('error')!r}"
+            )
+        return ack
+    finally:
+        conn.close()
+        close = getattr(frames, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001
+                log.exception("migration frame close failed")

@@ -111,6 +111,15 @@ FLEET_HISTOGRAMS: dict[str, dict[str, Any]] = {
                 "tail moves during incidents",
         "buckets": log_buckets(1e-3, 600.0, 4),
     },
+    # disaggregated prefill/decode (docs/SERVING.md §18): one sample per
+    # attempted KV-page migration, snapshot-to-ACK (or to the failure
+    # that triggered the decode-in-place fallback — failed migrations
+    # count, so the panel moves during incidents)
+    "fleet_migrate_s": {
+        "help": "KV-page migration wall time, snapshot dispatch to "
+                "receiver ACK or failure (s) — failed migrations count",
+        "buckets": log_buckets(1e-4, 120.0, 4),
+    },
 }
 
 
@@ -272,6 +281,11 @@ DUMP_REASONS = (
     # (seq/kind/count metadata, never token content) in extra — its
     # iteration ring is empty because the router runs no engine loop
     "fleet-failover",
+    # a KV-page migration between replicas failed (checksum mismatch,
+    # wire cut, deadline, receiver pool exhaustion — docs/SERVING.md
+    # §18): dumped by the ROUTER with per-phase timings (snapshot /
+    # transfer / bind ms) and the fallback taken, never page content
+    "migrate-failed",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

@@ -72,6 +72,19 @@ def prefix_digest(tokens) -> str:
     return hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
 
 
+def page_checksum(blocks) -> bytes:
+    """blake2b-16 over one page's leaf blocks (``jax.tree.leaves`` order,
+    C-contiguous). ONE definition shared by the host spill tier and the
+    inter-replica migration wire (serving/migrate.py): a page spilled to
+    host RAM and a page serialized onto the fleet wire carry the SAME
+    digest, so a hibernated session migrates straight from the arena with
+    its stamped checksum — no device restore, no re-hash drift."""
+    h = hashlib.blake2b(digest_size=16)
+    for b in blocks:
+        h.update(np.ascontiguousarray(b))
+    return h.digest()
+
+
 def table_len_for(max_seq_len: int, page_size: int) -> int:
     """Per-slot worst-case page-table length: enough logical pages to map
     every position a slot can ever write (the memory-plan term)."""
@@ -321,10 +334,9 @@ class HostPageTier:
 
     @staticmethod
     def _digest_blocks(blocks: list) -> bytes:
-        h = hashlib.blake2b(digest_size=16)
-        for b in blocks:
-            h.update(b)  # C-contiguous ndarray: buffer protocol, no copy
-        return h.digest()
+        # the module-level page_checksum: the migration wire stamps the
+        # SAME digest, so arena pages ship with their stored sum
+        return page_checksum(blocks)
 
     def _slot_blocks(self, slot: int) -> list:
         return [np.ascontiguousarray(a[:, slot]) for a in self._arrays]
@@ -364,6 +376,14 @@ class HostPageTier:
         if self._digest_blocks(blocks) != want:
             return None
         return jax.tree.unflatten(self._treedef, blocks)
+
+    def checksum(self, slot: int) -> Optional[bytes]:
+        """The digest stamped at spill time for arena slot ``slot`` (None
+        when the slot holds no completed spill). The migration wire sends
+        a hibernated page with THIS sum — recomputing would hash bytes
+        that rot may already have touched, laundering the corruption."""
+        with self._sum_lock:
+            return self._sums.get(slot)
 
     def corrupt(self, slot: int) -> None:
         """Flip one byte of the slot's first leaf — the ``spill`` fault
@@ -558,6 +578,17 @@ class PrefixPageIndex:
         inverting the eviction order real admissions deserve."""
         cands = self.candidates(tokens)
         return cands[-1][0] if cands else 0
+
+    def deepest_entry(self, tokens) -> Optional[tuple[int, "PrefixPages"]]:
+        """Non-mutating: the deepest live, non-dropped entry usable for
+        ``tokens`` as ``(length, entry)``, or None. The migration export
+        serializes THIS entry's pages (serving/migrate.py); like
+        ``match_len`` it must not touch LRU recency — probing a session
+        for migration must not pin it."""
+        for p, entry in reversed(self.candidates(tokens)):
+            if not entry.dropped and (entry.pages or entry.host):
+                return p, entry
+        return None
 
     def advertised(self, top_k: int = 32) -> list[tuple[str, int, str]]:
         """Most-recently-used ``top_k`` prefix digests as ``(digest,

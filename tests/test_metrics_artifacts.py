@@ -130,6 +130,10 @@ def test_dashboard_regexes_match_live_exposition():
         "fleet_stream_failovers_total",
         "fleet_circuit_open_total",
         "fleet_beacon_failures_total",
+        "fleet_migrations_total",
+        "fleet_pages_migrated_total",
+        "fleet_migrate_bytes_total",
+        "fleet_migrate_fallbacks_total",
     ):
         serving.gauge(n)
     exposed = {
@@ -221,6 +225,41 @@ def test_fleet_wire_panels_present():
     assert hop is not None, "fleet hop-latency panel missing"
     assert "fleet_hop_s_bucket" in hop
     assert "histogram_quantile" in hop
+
+
+def test_migration_panels_present():
+    """The ISSUE-13 disaggregated-serving panels must survive dashboard
+    edits: the migration-traffic panel (completed migrations, pages/bytes
+    moved, decode-in-place fallbacks — serving/migrate.py + fleet.py,
+    docs/SERVING.md §18) and the migration-latency panel reading the
+    fleet_migrate_s histogram buckets."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    traffic = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "migration traffic" in t.lower()
+        ),
+        None,
+    )
+    assert traffic is not None, "KV migration-traffic panel missing"
+    assert "fleet_migrations_total" in traffic
+    assert "fleet_pages_migrated_total" in traffic
+    assert "fleet_migrate_bytes_total" in traffic
+    assert "fleet_migrate_fallbacks_total" in traffic
+    latency = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "migration latency" in t.lower()
+        ),
+        None,
+    )
+    assert latency is not None, "KV migration-latency panel missing"
+    assert "fleet_migrate_s_bucket" in latency
+    assert "histogram_quantile" in latency
 
 
 def test_agentic_panels_present():
