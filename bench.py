@@ -731,6 +731,104 @@ def bench_tiered_kv(preset: str, quantize: bool, *, n_sessions: int = 8,
     return out
 
 
+def bench_tenancy(preset: str, quantize: bool, *, max_batch: int = 4,
+                  n_requests: int = 24, new_tokens: int = 16,
+                  max_seq_len: int = 256, decode_chunk: int = 4) -> dict:
+    """Noisy-neighbor pair (docs/SERVING.md §19): the victim tenant's
+    TTFT p50/p99 SOLO vs under a deterministic `tenant-burst` aggressor
+    on a fair-share engine (weights 2:1, aggressor queue-share-capped).
+    The headline numbers are the victim's p99 ratio (the acceptance bound
+    is 2×) and the shed split (the aggressor must absorb ALL of it)."""
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine, ShedError
+    from langstream_tpu.serving.faultinject import FaultInjector
+
+    config = MODEL_PRESETS[preset]
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=24).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def run(burst: bool) -> dict:
+        engine = ServingEngine(
+            config, params, max_batch=max_batch,
+            max_seq_len=min(max_seq_len, config.max_seq_len),
+            prefill_buckets=(64,), decode_chunk=decode_chunk,
+            shed_policy="reject", queue_depth=max_batch * 2,
+            tenants=[
+                {"name": "victim", "weight": 2.0},
+                {"name": "chaos-burst", "weight": 1.0, "queue-share": 0.5},
+            ],
+            fault_injector=(
+                FaultInjector("tenant-burst@1:2", seed=0) if burst else None
+            ),
+        )
+        engine.start()
+        try:
+            # warm under a THROWAWAY tenant: the compile-heavy first TTFT
+            # must not own the victim histogram's p99 on both legs (the
+            # per-tenant histograms are cumulative; engine.reset_histograms
+            # covers only the engine set)
+            warm = GenerationRequest(
+                prompt_tokens=prompts[0],
+                options=GenerationOptions(max_new_tokens=4, tenant="warmup"),
+            )
+            engine.submit(warm)
+            warm.result(timeout=600)
+            for p in prompts:
+                req = GenerationRequest(
+                    prompt_tokens=p,
+                    options=GenerationOptions(
+                        max_new_tokens=new_tokens, tenant="victim",
+                    ),
+                )
+                for _ in range(400):
+                    try:
+                        engine.submit(req)
+                        break
+                    except ShedError:
+                        time.sleep(0.01)
+                req.result(timeout=600)
+            stats = engine.stats()
+            t = stats["tenants"]
+            return {
+                "victim_ttft_p50_ms": round(
+                    t["victim"]["ttft-p50-s"] * 1e3, 3
+                ),
+                "victim_ttft_p99_ms": round(
+                    t["victim"]["ttft-p99-s"] * 1e3, 3
+                ),
+                "victim_shed": t["victim"]["shed-total"],
+                "aggressor_shed": (
+                    t.get("chaos-burst", {}).get("shed-total", 0)
+                ),
+                "aggressor_admitted": (
+                    t.get("chaos-burst", {}).get("admitted-total", 0)
+                ),
+                "brownout_transitions": stats["brownout-transitions-total"],
+            }
+        finally:
+            engine.stop()
+
+    solo = run(burst=False)
+    noisy = run(burst=True)
+    p99_ratio = (
+        noisy["victim_ttft_p99_ms"] / solo["victim_ttft_p99_ms"]
+        if solo["victim_ttft_p99_ms"] > 0
+        else 0.0
+    )
+    return {"tenancy": {
+        "solo": solo, "noisy": noisy,
+        "victim_p99_ratio": round(p99_ratio, 3),
+    }}
+
+
 def bench_degradation(preset: str, quantize: bool, max_batch: int,
                       new_tokens: int, n_requests: int, max_seq_len: int,
                       decode_chunk: int) -> dict:
@@ -1572,6 +1670,21 @@ def main() -> None:
         ))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] degradation phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # multi-tenant noisy-neighbor pair (ISSUE 14 acceptance, docs §19):
+    # the victim tenant's TTFT tail solo vs under the deterministic
+    # tenant-burst aggressor — the p99 ratio is the isolation headline
+    # (acceptance bound 2×), and the shed split proves the aggressor
+    # absorbed all of it
+    print("[bench] tenancy (noisy-neighbor) phase", file=sys.stderr, flush=True)
+    try:
+        extras.update(bench_tenancy(
+            preset, quantize, max_batch=max_batch,
+            n_requests=min(n_requests, 24), new_tokens=min(new_tokens, 16),
+            max_seq_len=max_seq_len, decode_chunk=decode_chunk,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] tenancy phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # fleet routing pair (ISSUE 8 acceptance): 3-process CPU fleet,
     # shared-preamble 10× burst, prefix-affinity vs round-robin — the

@@ -201,6 +201,32 @@ class _BaseCompletionsStep(Step):
             "host-side constrained-decoding bookkeeping per dispatch "
             "(grammar swaps + verify state tables), EMA ms",
         )
+        # multi-tenant overload control (serving/tenancy.py, docs/
+        # SERVING.md §19): cross-tenant shed volume, the worst tenant's
+        # queue-wait EMA (the noisy-neighbor victim signal — per-tenant
+        # detail lives in stats()["tenants"] and the fleet beacons), and
+        # the brownout ladder level
+        self._m_tenant_shed = metrics.gauge(
+            "tenant_shed_total",
+            "requests shed across ALL tenants (quota, queue share, "
+            "brownout, overload), cumulative — per-tenant split in "
+            "engine stats and beacons",
+        )
+        self._m_tenant_wait = metrics.gauge(
+            "tenant_queue_wait",
+            "WORST per-tenant queue-wait EMA (s) — the noisy-neighbor "
+            "victim signal; flat while the aggregate climbs means "
+            "isolation is holding",
+        )
+        self._m_brownout_level = metrics.gauge(
+            "brownout_level",
+            "brownout degradation-ladder level (0 normal, 1 spec-shrink, "
+            "2 spec-off, 3 reject-low, 4 reject-quota)",
+        )
+        self._m_brownout_transitions = metrics.gauge(
+            "brownout_transitions_total",
+            "brownout ladder transitions (either direction), cumulative",
+        )
         # observability layer (serving/observability.py, docs/SERVING.md
         # §12): the engine-derived load score the replica balancer routes
         # on, the flight-recorder dump counter, and the full streaming-
@@ -336,6 +362,23 @@ class _BaseCompletionsStep(Step):
         self._m_adapter_swaps.set(stats.get("adapter-swaps-total", 0))
         self._m_constrained.set(stats.get("constrained-requests-total", 0))
         self._m_constrain_overhead.set(stats.get("constrain-overhead-ms", 0))
+        tenants = stats.get("tenants") or {}
+        self._m_tenant_shed.set(
+            sum(int(t.get("shed-total", 0)) for t in tenants.values())
+        )
+        self._m_tenant_wait.set(
+            max(
+                (
+                    float(t.get("queue-wait-ema-s", 0.0))
+                    for t in tenants.values()
+                ),
+                default=0.0,
+            )
+        )
+        self._m_brownout_level.set(stats.get("brownout-level", 0))
+        self._m_brownout_transitions.set(
+            stats.get("brownout-transitions-total", 0)
+        )
         self._m_load.set(stats.get("load-score", 0))
         self._m_flight_dumps.set(stats.get("flight-dumps-total", 0))
         fleet = getattr(self._service, "fleet_stats", lambda: None)() or {}
@@ -394,6 +437,10 @@ class _BaseCompletionsStep(Step):
                 # MUST be forwarded or the documented knobs are dead code
                 # (the round-8 whitelist lesson)
                 "adapter", "response-format",
+                # multi-tenant overload control (docs/SERVING.md §19):
+                # the tenant/priority/cost-budget policy inputs — the
+                # per-record tenant header overrides `tenant` in process()
+                "tenant", "priority", "max-cost-tokens",
             )
             if self.config.get(k) is not None
         }
@@ -452,10 +499,18 @@ class _BaseCompletionsStep(Step):
         # cancel the in-flight generation (serving/lifecycle.py; only the
         # tpu-serving provider acts on it, remote providers ignore it)
         from langstream_tpu.serving.lifecycle import SESSION_HEADER
+        from langstream_tpu.serving.tenancy import TENANT_HEADER
 
         session_id = record.properties.get(SESSION_HEADER)
         if session_id:
             options["cancel-key"] = str(session_id)
+        # multi-tenant overload control (docs/SERVING.md §19): the record's
+        # gateway-stamped tenant header is the per-request truth — it wins
+        # over any static `tenant` in the step config (the gateway already
+        # resolved client-header-vs-path precedence at the front door)
+        record_tenant = record.properties.get(TENANT_HEADER)
+        if record_tenant:
+            options["tenant"] = str(record_tenant)
         # trace propagation: the record's gateway-stamped ls-trace-id (or
         # the agent span the runner opened for this batch) rides into the
         # GenerationRequest AND back out on every streamed chunk, so the
@@ -470,7 +525,34 @@ class _BaseCompletionsStep(Step):
                 record, asyncio.get_running_loop(), chunk_futures,
                 trace_id=str(trace_id) if trace_id else None,
             )
-        result = await self._complete(record, options, chunks_consumer)
+        try:
+            result = await self._complete(record, options, chunks_consumer)
+        except RuntimeError as shed:
+            # quota/overload shed (engine ShedError / mapped fleet shed:
+            # any RuntimeError carrying retry_after_s). On a SERVICE
+            # gateway request/reply roundtrip, answer the caller with a
+            # shed REPLY record instead of erroring the pipeline — the
+            # gateway maps the properties to HTTP 429 + Retry-After
+            # (docs/SERVING.md §19). Topic-driven flows keep the raise:
+            # their errors policy (retry/dead-letter) owns the outcome.
+            from langstream_tpu.serving.tenancy import (
+                RETRY_AFTER_PROPERTY,
+                SERVICE_REQUEST_ID_PROPERTY,
+                SHED_PROPERTY,
+            )
+
+            retry_after = getattr(shed, "retry_after_s", None)
+            if (
+                retry_after is None
+                or not record.properties.get(SERVICE_REQUEST_ID_PROPERTY)
+            ):
+                raise
+            record.properties[SHED_PROPERTY] = "true"
+            record.properties[RETRY_AFTER_PROPERTY] = (
+                f"{max(float(retry_after), 0.05):.3f}"
+            )
+            _set_result_field(record, self.completion_field, "")
+            return
         self._record_metrics(result)
         if chunk_futures:
             # all chunks reach the stream topic before the final record commits

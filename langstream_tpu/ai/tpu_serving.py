@@ -82,6 +82,23 @@ Resource configuration:
   queue-depth / shed-policy: bounded admission queue; "block" (default)
     backpressures the broker poll loop, "reject" sheds with a retry-after
     (ShedError) so front doors degrade to fast 429s under overload
+  tenants: multi-tenant overload control (serving/tenancy.py, docs
+    §19) — list of {name, weight (1.0), max-slots, queue-share,
+    token-rate, burst-s} blocks. Admission becomes per-tenant weighted
+    deficit round-robin (the fused iteration's prefill-token budget and
+    the free-slot pool divide by weight, work-conserving), per-tenant
+    queue shares shed the burster instead of backpressuring everyone,
+    and token-rate quotas make over-quota tenants shed FIRST under
+    pressure. Unknown tenants get weight 1.0 and no caps; requests
+    without a tenant land in "default".
+  brownout: auto (default) | off — the graceful-degradation ladder
+    (docs §19): under sustained load (engine load_score ≥
+    `brownout-enter-load`, default 2.0, held for `brownout-dwell-s`,
+    default 0.5) the engine walks spec-shrink → spec-off → reject-low →
+    reject-quota one hysteresis-gated step at a time, and walks back
+    down once load holds ≤ `brownout-exit-load` (default 1.0). Every
+    transition is counted, logged and flight-dumped (`brownout` reason);
+    decode of admitted work is never degraded in correctness.
   engine-restart-backoff / engine-max-restarts: loop-crash recovery —
     quarantine in-flight slots, rebuild device state, restart under
     bounded exponential backoff (single-host only; SPMD stays crash-only)
@@ -443,6 +460,20 @@ class _EngineHolder:
                 else None
             ),
             shed_policy=str(self.config.get("shed-policy", "block")),
+            # multi-tenant overload control + brownout (docs/SERVING.md
+            # §19): validated inside ServingEngine/TenantSpec so a bad
+            # block fails the build, not the first burst
+            tenants=list(self.config.get("tenants") or []),
+            brownout=self.config.get("brownout", "auto"),
+            brownout_enter_load=float(
+                self.config.get("brownout-enter-load", 2.0)
+            ),
+            brownout_exit_load=float(
+                self.config.get("brownout-exit-load", 1.0)
+            ),
+            brownout_dwell_s=float(
+                self.config.get("brownout-dwell-s", 0.5)
+            ),
             restart_backoff_s=float(
                 self.config.get("engine-restart-backoff", 0.1)
             ),
@@ -621,27 +652,44 @@ class _EngineHolder:
                 )
             return self._embed_fn
 
-    def close(self) -> None:
+    def begin_drain(self) -> None:
+        """The graceful HALF of teardown, callable while the runtime HTTP
+        server is still up: stop routing, unregister the fleet beacon
+        (peers see /state go empty within one refresh instead of racing
+        new remote routes into the drain window — routes that would die
+        as hop failures and charge the WRONG replica's breaker), then
+        drain the engine so in-flight remote streams finish over the
+        still-open wire. Idempotent; close() finishes with the hard
+        stop."""
         with self._lock:
-            if self._fleet_router is not None:
-                self._fleet_router.stop()
-                self._fleet_router = None
-            if self._fleet_replica_id is not None:
-                from langstream_tpu.serving import fleet as fleet_mod
+            if getattr(self, "_drain_begun", False):
+                # idempotent for real: _serve() drains before its server
+                # stops, then close() runs — a second drain() here would
+                # wait the full grace period AGAIN on a wedged stream,
+                # doubling worst-case shutdown
+                return
+            self._drain_begun = True
+            router, self._fleet_router = self._fleet_router, None
+            rid, self._fleet_replica_id = self._fleet_replica_id, None
+            engine = self._engine
+        if router is not None:
+            router.stop()
+        if rid is not None:
+            from langstream_tpu.serving import fleet as fleet_mod
 
-                fleet_mod.unregister_local(self._fleet_replica_id)
-                self._fleet_replica_id = None
-            if self._engine is not None:
-                # graceful teardown: drain (finish in-flight, reject new)
-                # for a bounded grace period, THEN stop — stop() alone
-                # _fail_alls work that only needed a few more chunks
-                try:
-                    self._engine.drain(
-                        float(self.config.get("drain-grace-s", 10.0))
-                    )
-                finally:
-                    self._engine.stop()
-                self._engine = None
+            fleet_mod.unregister_local(rid)
+        if engine is not None:
+            # graceful: finish in-flight, reject new (ShedError) for a
+            # bounded grace period — stop() alone _fail_alls work that
+            # only needed a few more chunks
+            engine.drain(float(self.config.get("drain-grace-s", 10.0)))
+
+    def close(self) -> None:
+        self.begin_drain()
+        with self._lock:
+            engine, self._engine = self._engine, None
+        if engine is not None:
+            engine.stop()
 
 
 class _StreamState:

@@ -39,9 +39,27 @@ from langstream_tpu.gateway.core import (
     resolve_common_headers,
 )
 
+from langstream_tpu.serving.tenancy import (
+    RETRY_AFTER_PROPERTY,
+    SHED_PROPERTY,
+    TENANT_HEADER,
+)
+
 log = logging.getLogger(__name__)
 
 SERVICE_REQUEST_ID_HEADER = "langstream-service-request-id"
+
+
+def _with_tenant(headers: list[Header], tenant: str) -> list[Header]:
+    """Stamp the langstream tenant id onto every produced record's common
+    headers (multi-tenant overload control, docs/SERVING.md §19) — the
+    completions step reads it into GenerationOptions.tenant. A header the
+    gateway's own mappings (or, later, the client payload — record-level
+    headers append after common ones) already set WINS: front doors may
+    map their own identity onto serving tenants."""
+    if any(h.key == TENANT_HEADER for h in headers):
+        return headers
+    return [*headers, Header(TENANT_HEADER, tenant)]
 
 
 def _cancel_session_requests(headers: list[Header]) -> None:
@@ -264,8 +282,11 @@ class GatewayServer:
         mappings = (
             context.gateway.produce_options.headers if context.gateway.produce_options else []
         )
-        headers = resolve_common_headers(
-            mappings, context.user_parameters, context.principal_values
+        headers = _with_tenant(
+            resolve_common_headers(
+                mappings, context.user_parameters, context.principal_values
+            ),
+            context.tenant,
         )
         ws = web.WebSocketResponse()
         await ws.prepare(request)
@@ -351,8 +372,11 @@ class GatewayServer:
             raise web.HTTPBadRequest(
                 reason="chat gateway requires chat-options.questions-topic and answers-topic"
             )
-        headers = resolve_common_headers(
-            chat.headers, context.user_parameters, context.principal_values
+        headers = _with_tenant(
+            resolve_common_headers(
+                chat.headers, context.user_parameters, context.principal_values
+            ),
+            context.tenant,
         )
         filters = build_message_filters(
             chat.headers, context.user_parameters, context.principal_values
@@ -405,8 +429,11 @@ class GatewayServer:
         mappings = (
             context.gateway.produce_options.headers if context.gateway.produce_options else []
         )
-        headers = resolve_common_headers(
-            mappings, context.user_parameters, context.principal_values
+        headers = _with_tenant(
+            resolve_common_headers(
+                mappings, context.user_parameters, context.principal_values
+            ),
+            context.tenant,
         )
         produce = ProduceGateway(gw_app.topic_runtime)
         await produce.start(topic, headers)
@@ -471,8 +498,11 @@ class GatewayServer:
         try:
             await consume.setup(service.output_topic, filters, "latest")
             consume.start_reading(on_message)
-            headers = resolve_common_headers(
-                service.headers, context.user_parameters, context.principal_values
+            headers = _with_tenant(
+                resolve_common_headers(
+                    service.headers, context.user_parameters, context.principal_values
+                ),
+                context.tenant,
             )
             await produce.start(service.input_topic, headers)
             await produce.produce(produce_request)
@@ -480,7 +510,31 @@ class GatewayServer:
                 message = await asyncio.wait_for(reply, timeout)
             except asyncio.TimeoutError:
                 raise web.HTTPGatewayTimeout(reason="no reply from pipeline") from None
-            return web.json_response(json.loads(message))
+            reply_doc = json.loads(message)
+            # quota/overload shed (docs/SERVING.md §19): the completions
+            # step answers a service roundtrip's shed with a reply record
+            # carrying the shed properties — map them to HTTP 429 with
+            # Retry-After from the engine's own estimate, the same
+            # contract the fleet hop has had since round 12
+            reply_headers = (reply_doc.get("record") or {}).get("headers") or {}
+            if str(reply_headers.get(SHED_PROPERTY, "")).lower() == "true":
+                try:
+                    retry_after = max(
+                        float(reply_headers.get(RETRY_AFTER_PROPERTY, 1.0)),
+                        0.05,
+                    )
+                except (TypeError, ValueError):
+                    retry_after = 1.0
+                return web.json_response(
+                    {
+                        "error": "shed",
+                        "reason": "engine overloaded or tenant over quota",
+                        "retry_after_s": retry_after,
+                    },
+                    status=429,
+                    headers={"Retry-After": f"{retry_after:.3f}"},
+                )
+            return web.json_response(reply_doc)
         except ProduceException as e:
             return web.json_response({"status": e.status, "reason": str(e)}, status=400)
         finally:

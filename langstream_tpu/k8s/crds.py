@@ -106,6 +106,13 @@ class AgentCustomResource:
     # status.fleet.desiredReplicas by the ops loop — so a scale decision
     # never touches the spec checksum (no pod rollout, just more pods)
     autoscale: Optional[dict[str, Any]] = None
+    # multi-tenant overload control (serving/tenancy.py, docs/SERVING.md
+    # §19): the declared tenants and their scheduling policy — list of
+    # {name, weight, max-slots, queue-share, token-rate} blocks, passed
+    # through to the tpu-serving `tenants:` config. Spec state (changing
+    # a tenant's weight/quota IS a rollout — the engine builds its
+    # registry at startup), unlike the autoscale hint above.
+    tenants: Optional[list[dict[str, Any]]] = None
     status: dict[str, Any] = field(default_factory=dict)
     generation: int = 1
 
@@ -141,6 +148,7 @@ class AgentCustomResource:
                     "disk": self.disk,
                     "tpu": self.tpu,
                     "autoscale": self.autoscale,
+                    "tenants": self.tenants,
                 },
             },
             "status": dict(self.status),
@@ -167,6 +175,7 @@ class AgentCustomResource:
             disk=resources.get("disk"),
             tpu=resources.get("tpu"),
             autoscale=resources.get("autoscale"),
+            tenants=resources.get("tenants"),
             status=dict(m.get("status", {})),
             generation=int(meta.get("generation", 1)),
         )

@@ -237,6 +237,22 @@ class GenerationOptions:
     # (the state indexes that grammar's DFA); validated against the
     # compiled DFA at submit.
     grammar_resume_state: Optional[int] = None
+    # multi-tenant overload control (serving/tenancy.py, docs/SERVING.md
+    # §19): the tenant this request is billed and scheduled under. The
+    # gateway stamps it from the langstream tenant id (a client-supplied
+    # `langstream-tenant` header wins); None lands in the shared
+    # "default" tenant.
+    tenant: Optional[str] = None
+    # scheduling priority WITHIN the tenant (low | normal | high): breaks
+    # ties among one tenant's own queued requests and is the admission
+    # class the brownout ladder sheds first (level 3 rejects "low").
+    # Never a cross-tenant queue jump — fair share is weight-only.
+    priority: str = "normal"
+    # per-request cost budget in TOKENS (prompt + generated): generation
+    # finishes with finish_reason="length" once the budget is spent, and
+    # a prompt that cannot afford a single generated token is rejected at
+    # submit. Feeds the tenant's token-rate quota accounting.
+    max_cost_tokens: Optional[int] = None
 
     @staticmethod
     def from_dict(d: dict) -> "GenerationOptions":
@@ -249,6 +265,12 @@ class GenerationOptions:
         resume = d.get(
             "grammar-resume-state", d.get("grammar_resume_state")
         )
+        priority = str(d.get("priority") or "normal").lower()
+        if priority not in ("low", "normal", "high"):
+            raise ValueError(
+                f"unknown priority {priority!r}; supported: low, normal, high"
+            )
+        cost = d.get("max-cost-tokens", d.get("max_cost_tokens"))
         return GenerationOptions(
             max_new_tokens=int(d.get("max-tokens", d.get("max_new_tokens", 256))),
             temperature=float(d.get("temperature", 0.0)),
@@ -265,4 +287,7 @@ class GenerationOptions:
             grammar_resume_state=(
                 int(resume) if resume is not None else None
             ),
+            tenant=(str(d["tenant"]) if d.get("tenant") else None),
+            priority=priority,
+            max_cost_tokens=(int(cost) if cost is not None else None),
         )

@@ -52,6 +52,15 @@ from langstream_tpu.serving.observability import (
 )
 from langstream_tpu.serving.sampling import sample, speculative_verify
 from langstream_tpu.serving.speculation import NGramIndex
+from langstream_tpu.serving.tenancy import (
+    DEFAULT_TENANT,
+    BrownoutController,
+    TenantQueue,
+    TenantRegistry,
+    TenantShareExceeded,
+    TenantSpec,
+    effective_max_new_tokens,
+)
 
 log = logging.getLogger(__name__)
 
@@ -1084,6 +1093,11 @@ class ServingEngine:
         grammar_tokenizer: Optional[Any] = None,
         queue_depth: Optional[int] = None,
         shed_policy: str = "block",
+        tenants: Optional[list] = None,
+        brownout: Any = "auto",
+        brownout_enter_load: float = 2.0,
+        brownout_exit_load: float = 1.0,
+        brownout_dwell_s: float = 0.5,
         restart_backoff_s: float = 0.1,
         max_restarts: int = 5,
         fault_injector: Optional[FaultInjector] = None,
@@ -1116,9 +1130,45 @@ class ServingEngine:
             # mean "no queueing" — reject loudly instead of silently
             # substituting the default
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
-        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(
-            maxsize=int(queue_depth) if queue_depth is not None else max_batch * 4
+        # multi-tenant overload control (serving/tenancy.py, docs/SERVING.md
+        # §19): per-tenant weights / slot caps / queue shares / token-rate
+        # quotas, the per-tenant lifecycle counters, and the admission
+        # queue itself — weighted deficit round-robin in prefill-token
+        # units, so the fused iteration's budget and the free-slot pool
+        # divide by weight. With no tenants configured every request lands
+        # in the shared "default" tenant and the queue degrades to the
+        # pre-tenancy FIFO exactly.
+        tenant_specs = [
+            t if isinstance(t, TenantSpec) else TenantSpec.from_dict(t)
+            for t in (tenants or [])
+        ]
+        self._tenants = TenantRegistry(tenant_specs)
+        self._queue: TenantQueue = TenantQueue(
+            maxsize=(
+                int(queue_depth) if queue_depth is not None else max_batch * 4
+            ),
+            registry=self._tenants,
+            cost_fn=lambda r: float(
+                self._bucket(len(getattr(r, "prompt_tokens", None) or ()))
+            ),
+            quantum=float(self.prefill_buckets[-1]),
         )
+        # brownout controller (docs/SERVING.md §19): walks the declared
+        # degradation ladder off the round-11 load score — spec shrink →
+        # spec off → reject low priority → reject over-quota — each step
+        # hysteresis-gated, counted, flight-dumped and fully reversed.
+        brownout_off = str(brownout).lower() in ("off", "false", "0", "none")
+        self._brownout = (
+            None
+            if brownout_off
+            else BrownoutController(
+                enter_load=float(brownout_enter_load),
+                exit_load=float(brownout_exit_load),
+                dwell_s=float(brownout_dwell_s),
+            )
+        )
+        self.brownout_dumps_total = 0
+        self._brownout_checked_at = 0.0
         if shed_policy not in ("block", "reject"):
             raise ValueError(
                 f"unknown shed_policy {shed_policy!r}; supported: block, reject"
@@ -1807,8 +1857,10 @@ class ServingEngine:
         # the retry sleep as queue wait — expiring max_queue_wait_s
         # immediately and feeding the inflated wait into the shed EMA
         request.submitted_at = time.monotonic()
+        tenant = getattr(request.options, "tenant", None) or DEFAULT_TENANT
+        self._tenants.note_submit(tenant)
         if self._draining:
-            self._count_shed()
+            self._count_shed(tenant)
             raise ShedError("serving engine is draining", retry_after_s=5.0)
         limit = self.max_seq_len - 1
         if len(request.prompt_tokens) > limit:
@@ -1817,6 +1869,62 @@ class ServingEngine:
                 f"engine limit of {limit} (max_seq_len - 1)"
             )
         opts = request.options
+        cost_budget = getattr(opts, "max_cost_tokens", None)
+        if cost_budget is not None:
+            if int(cost_budget) <= 0:
+                raise ValueError(
+                    f"max_cost_tokens must be >= 1, got {cost_budget}"
+                )
+            if len(request.prompt_tokens) + 1 > int(cost_budget):
+                # the budget cannot afford a single generated token: a
+                # client error, not a capacity problem — never a 429
+                raise ValueError(
+                    f"prompt of {len(request.prompt_tokens)} tokens leaves "
+                    f"no generation room in a max_cost_tokens budget of "
+                    f"{cost_budget}"
+                )
+        # brownout admission gates (docs/SERVING.md §19): ladder level 3
+        # sheds low-priority work at the door, level 4 sheds over-quota
+        # tenants outright — decode of admitted work is never touched
+        bo = self._brownout
+        if bo is not None and bo.reject_low and (
+            getattr(opts, "priority", "normal") == "low"
+        ):
+            self._count_shed(tenant)
+            raise ShedError(
+                f"brownout level {bo.level}: low-priority admissions are "
+                "shed until load clears",
+                retry_after_s=max(self._tenant_wait_estimate(tenant), 0.5),
+            )
+        over_quota = self._tenants.over_quota(tenant)
+        if bo is not None and bo.reject_quota and over_quota:
+            self._count_shed(tenant)
+            raise ShedError(
+                f"brownout level {bo.level}: tenant {tenant!r} is over its "
+                "token-rate quota",
+                retry_after_s=max(
+                    self._tenants.quota_retry_after_s(tenant), 0.5
+                ),
+            )
+        # quota-aware shedding OUTSIDE brownout: over-quota tenants shed
+        # FIRST — whenever there is queue pressure AND someone else's work
+        # is waiting, the over-quota tenant yields before any in-quota
+        # tenant is shed. With the engine otherwise idle its work still
+        # runs (work-conserving: quotas bound sustained rate, not access
+        # to spare capacity).
+        if over_quota and self._queue.qsize() > 0:
+            others = [
+                t for t in self._queue.tenants_with_work() if t != tenant
+            ]
+            if others:
+                self._count_shed(tenant)
+                raise ShedError(
+                    f"tenant {tenant!r} is over its token-rate quota while "
+                    "other tenants wait",
+                    retry_after_s=max(
+                        self._tenants.quota_retry_after_s(tenant), 0.1
+                    ),
+                )
         adapter_name = getattr(opts, "adapter", None)
         if adapter_name and self._adapters is None:
             raise ValueError(
@@ -1856,9 +1964,12 @@ class ServingEngine:
                 return request
         deadline_s = request.options.deadline_s
         if deadline_s is not None:
-            est_wait = self._queue_wait_ema_s
+            # the tenant's OWN observed wait decides hopelessness (and the
+            # retry-after estimate): a victim tenant with an empty lane is
+            # not hopeless just because an aggressor inflated the global EMA
+            est_wait = self._tenant_wait_estimate(tenant)
             if deadline_s <= 0 or (self._queue.qsize() > 0 and est_wait >= deadline_s):
-                self._count_shed()
+                self._count_shed(tenant)
                 raise ShedError(
                     f"deadline of {deadline_s:.2f}s cannot survive the "
                     f"current ~{est_wait:.2f}s queue wait",
@@ -1867,22 +1978,45 @@ class ServingEngine:
         with self._waiting_lock:
             self._waiting[id(request)] = request
         try:
-            if self.shed_policy == "reject":
-                try:
+            try:
+                if self.shed_policy == "reject":
                     self._queue.put_nowait(request)
-                except queue.Full:
-                    self._count_shed()
-                    raise ShedError(
-                        f"admission queue full ({self._queue.maxsize} deep)",
-                        retry_after_s=max(self._queue_wait_ema_s, 0.1),
-                    ) from None
-            else:
-                self._queue.put(request)
+                else:
+                    self._queue.put(request)
+            except queue.Full:
+                self._count_shed(tenant)
+                raise ShedError(
+                    f"admission queue full ({self._queue.maxsize} deep)",
+                    retry_after_s=max(self._tenant_wait_estimate(tenant), 0.1),
+                ) from None
+            except TenantShareExceeded as e:
+                # the tenant's SLICE is full even though the global queue
+                # may have room: always a shed for that tenant — blocking
+                # the shared submitter on one tenant's backlog would be
+                # the noisy-neighbor coupling tenancy exists to remove
+                self._count_shed(tenant)
+                raise ShedError(
+                    str(e),
+                    retry_after_s=max(self._tenant_wait_estimate(tenant), 0.1),
+                ) from None
         except BaseException:
             with self._waiting_lock:
                 self._waiting.pop(id(request), None)
             raise
         return request
+
+    def _tenant_wait_estimate(self, tenant: str) -> float:
+        """The queue-wait estimate shed decisions and Retry-After use:
+        a NAMED tenant's own EMA when it has one — a victim with an empty
+        lane must not look hopeless because an aggressor inflated the
+        average — falling back to the global EMA for first contact. The
+        default tenant IS the untenanted population, so it reads the
+        global EMA directly (the pre-tenancy semantics, which the §9
+        hopeless-deadline drill pins)."""
+        if tenant == DEFAULT_TENANT:
+            return self._queue_wait_ema_s
+        own = self._tenants.queue_wait_ema_s(tenant)
+        return own if own > 0 else self._queue_wait_ema_s
 
     def generate(
         self,
@@ -1917,13 +2051,16 @@ class ServingEngine:
             req.cancel()
             raise
 
-    def _count_shed(self) -> None:
+    def _count_shed(self, tenant: Optional[str] = None) -> None:
         """Shed bookkeeping shared by every shed site: count under the
-        stats lock, then let the flight recorder's sliding window decide
-        whether this shed completes a BURST worth a postmortem dump (an
-        isolated shed is routine backpressure, not an incident)."""
+        stats lock (attributed to the shedding tenant when known), then
+        let the flight recorder's sliding window decide whether this shed
+        completes a BURST worth a postmortem dump (an isolated shed is
+        routine backpressure, not an incident)."""
         with self._stats_lock:
             self.shed_total += 1
+        if tenant is not None:
+            self._tenants.note_shed(tenant)
         if self._obs.on and self._obs.flight.note_shed():
             self._flight_dump("shed-burst")
 
@@ -1993,8 +2130,32 @@ class ServingEngine:
         # BEFORE the stats lock so lock order is always hist→stats-free
         hist = self._obs.histograms()
         queue_wait_p90 = hist.get("engine_queue_wait_s", {}).get("p90", 0.0)
+        # per-tenant block (registry + queue locks, never nested with the
+        # stats lock): counters, quota state, live queue depth and active
+        # slots by tenant — what beacons and the Grafana gauges consume
+        active_by_tenant: dict[str, int] = {}
+        for s in self._slots:
+            req = s.request
+            if req is not None:
+                t = getattr(req.options, "tenant", None) or DEFAULT_TENANT
+                active_by_tenant[t] = active_by_tenant.get(t, 0) + 1
+        tenants = self._tenants.snapshot(
+            queued=self._queue.depth_by_tenant(), active=active_by_tenant
+        )
         with self._stats_lock:
             out = self._stats_locked()
+        out["tenants"] = tenants
+        out["brownout"] = (
+            self._brownout.snapshot() if self._brownout is not None else None
+        )
+        out["brownout-level"] = (
+            self._brownout.level if self._brownout is not None else 0
+        )
+        out["brownout-transitions-total"] = (
+            self._brownout.transitions_total
+            if self._brownout is not None
+            else 0
+        )
         out["observability"] = self._obs.on
         out["histograms"] = hist
         # load score (ROADMAP item 3): the replica-balancer routing signal
@@ -2857,6 +3018,14 @@ class ServingEngine:
         # a migration never waits behind more than one iteration
         self._drain_migrations()
         self._sweep_waiting()
+        # brownout ladder (docs/SERVING.md §19): throttled load check on
+        # the engine thread — transitions count, dump and log here
+        if self._brownout is not None:
+            self._brownout_tick()
+        # deterministic noisy-neighbor drill: the `tenant-burst` fault
+        # site injects a synthetic aggressor burst at the iteration top
+        if self._injector is not None:
+            self._tenant_burst_tick()
         t_sweep = time.monotonic() if obs_on else 0.0
         # chunks dispatched in previous iterations are still unfetched when
         # this iteration's dispatch computes its headroom bound — subtract
@@ -2915,8 +3084,14 @@ class ServingEngine:
             for entry in new_pending:
                 self._process_entry(entry)
             new_pending = []
-        if self._spec_enabled and (
-            new_pending or pending or any(s.active for s in self._slots)
+        if (
+            self._spec_enabled
+            # brownout level 2 (spec-off) falls back to plain decode
+            # chunks — token-exact for greedy streams by the round-9
+            # invariant, so in-flight work is never degraded in
+            # correctness, only in weight-read amortization
+            and not (self._brownout is not None and self._brownout.spec_off)
+            and (new_pending or pending or any(s.active for s in self._slots))
         ):
             # self-speculation serializes the host loop on fetched results:
             # the next iteration's drafts must CONTINUE from the last
@@ -3040,6 +3215,93 @@ class ServingEngine:
         ):
             self._held_back = None
 
+    def _current_load_score(self) -> float:
+        """The brownout controller's input: the §12 load-score formula
+        over CURRENT signals. The wait term is the queue-wait EMA gated
+        on an actual backlog — NOT the stats() histogram p90, which is
+        cumulative and would hold the ladder engaged forever after one
+        bad burst (the full-reversal contract), and not the bare EMA,
+        which freezes at its last value the moment the queue empties."""
+        backlog_wait = (
+            self._queue_wait_ema_s if self._queue.qsize() > 0 else 0.0
+        )
+        pool = self._pagepool
+        page_pressure = (
+            pool.pages_in_use / max(1, pool.num_pages)
+            if pool is not None
+            else min(1.0, self._queue.qsize() / max(1, self._queue.maxsize))
+        )
+        occupancy = (
+            sum(1 for s in self._slots if s.active) / max(1, self.max_batch)
+        )
+        return load_score(backlog_wait, occupancy, page_pressure)
+
+    def _brownout_tick(self) -> None:
+        """Advance the brownout ladder off the current load score
+        (throttled — the p90 walk is cheap but not free at a ~1ms idle
+        loop). A transition in EITHER direction is counted, logged and
+        flight-dumped (`brownout` reason, debounced by the recorder) —
+        the full reversal back to level 0 is part of the contract."""
+        now = time.monotonic()
+        if now - self._brownout_checked_at < 0.05:
+            return
+        self._brownout_checked_at = now
+        transition = self._brownout.observe(self._current_load_score(), now)
+        if transition is None:
+            return
+        old, new = transition
+        snap = self._brownout.snapshot()
+        log.warning(
+            "brownout %s: level %d -> %d (step %s, load %.3f)",
+            "escalated" if new > old else "released",
+            old, new, snap["step"], snap["last-load"],
+        )
+        dumped = self._flight_dump("brownout", extra={
+            "brownout-from": old,
+            "brownout-to": new,
+            "brownout-step": snap["step"],
+            "load-score": snap["last-load"],
+        })
+        if dumped is not None:
+            with self._stats_lock:
+                self.brownout_dumps_total += 1
+
+    BURST_TENANT = "chaos-burst"
+
+    def _tenant_burst_tick(self) -> None:
+        """`tenant-burst` fault site (docs/SERVING.md §19): when the
+        schedule fires, enqueue a burst of synthetic low-priority
+        admissions under the "chaos-burst" tenant — the deterministic
+        aggressor of the noisy-neighbor drill. The burst takes the normal
+        submit bookkeeping EXCEPT the blocking put (the engine thread
+        must never park on its own full queue): full-queue/share
+        rejections count as the aggressor's sheds, exactly what the drill
+        asserts the victim never absorbs."""
+        if not self._injector.fires("tenant-burst"):
+            return
+        for j in range(self.max_batch):
+            prompt = [3 + (j % 5), 5, 7, 11, 13, 17, 19, 23]
+            request = GenerationRequest(
+                prompt_tokens=prompt,
+                options=GenerationOptions(
+                    max_new_tokens=16,
+                    tenant=self.BURST_TENANT,
+                    priority="low",
+                ),
+            )
+            self._tenants.note_submit(self.BURST_TENANT)
+            if self._draining:
+                self._count_shed(self.BURST_TENANT)
+                continue
+            with self._waiting_lock:
+                self._waiting[id(request)] = request
+            try:
+                self._queue.put_nowait(request)
+            except (queue.Full, TenantShareExceeded):
+                with self._waiting_lock:
+                    self._waiting.pop(id(request), None)
+                self._count_shed(self.BURST_TENANT)
+
     def _flush_row_resets(self) -> None:
         """Zero the KV rows of NaN-quarantined slots, coalesced into one
         row-reset dispatch per iteration. SPMD: the dispatch rides the
@@ -3108,6 +3370,14 @@ class ServingEngine:
                     self._obs.record(
                         "engine_ttft_s", now - request.submitted_at
                     )
+                # per-tenant TTFT (the noisy-neighbor drill's victim-p99
+                # evidence — docs/SERVING.md §19); engine thread only,
+                # the histogram single-writer contract
+                self._tenants.note_ttft(
+                    getattr(request.options, "tenant", None)
+                    or DEFAULT_TENANT,
+                    now - request.submitted_at,
+                )
                 self._deliver_token(idx, int(first[j]))
         elif kind == "verify":
             self._process_verify(entry)
@@ -3181,9 +3451,11 @@ class ServingEngine:
         if request._done.is_set():
             return True  # already resolved elsewhere — don't double-count
         wait = now - request.submitted_at
+        tenant = getattr(request.options, "tenant", None) or DEFAULT_TENANT
         if request.cancelled:
             with self._stats_lock:
                 self.cancelled_total += 1
+            self._tenants.note_cancelled(tenant)
             request._finish(GenerationResult(
                 tokens=[], finish_reason="cancelled",
                 prompt_tokens=len(request.prompt_tokens),
@@ -3195,6 +3467,7 @@ class ServingEngine:
             opts = request.options
             with self._stats_lock:
                 self.deadline_queue_total += 1
+            self._tenants.note_deadline(tenant)
             request._finish(GenerationResult(
                 tokens=[], finish_reason="deadline",
                 prompt_tokens=len(request.prompt_tokens),
@@ -3243,6 +3516,9 @@ class ServingEngine:
                 if self._queue_wait_ema_s == 0
                 else 0.8 * self._queue_wait_ema_s + 0.2 * wait
             )
+        self._tenants.note_queue_wait(
+            getattr(request.options, "tenant", None) or DEFAULT_TENANT, wait
+        )
         if self._obs.on:
             # the DISTRIBUTION the EMA flattens: queue-wait p90 is the
             # dominant term of the load score the balancer routes on
@@ -3291,7 +3567,9 @@ class ServingEngine:
                 # (ShedError → HTTP 429; the front door's paced retries
                 # will land once an in-flight tenant finishes) — the
                 # contract the registries document
-                self._count_shed()
+                self._count_shed(
+                    getattr(opts, "tenant", None) or DEFAULT_TENANT
+                )
                 e = ShedError(
                     str(e),
                     retry_after_s=max(self._queue_wait_ema_s, 0.25),
@@ -3518,6 +3796,12 @@ class ServingEngine:
         # admissions wait for pool pages, only they retry — the queue keeps
         # its entries (and its submit()-side backpressure/shedding)
         allow_new = not (self._paged and self._page_deferred)
+        # fair-share slot division (docs/SERVING.md §19): tenants admitted
+        # THIS call count toward their share immediately, so one pop loop
+        # cannot hand a bursting tenant every free slot before the skip
+        # set notices
+        pending_counts: dict[str, int] = {}
+        tenant_occupancy = self._tenant_occupancy()
         # a held-back long request gets first claim on freed backlog space
         if (
             self._held_back is not None
@@ -3541,9 +3825,17 @@ class ServingEngine:
                 ):
                     break
                 try:
-                    request = self._pop_admission(allow_new)
+                    request = self._pop_admission(
+                        allow_new,
+                        skip=self._tenant_slot_skip(
+                            tenant_occupancy, pending_counts
+                        ),
+                    )
                 except queue.Empty:
                     break
+                req_tenant = (
+                    getattr(request.options, "tenant", None) or DEFAULT_TENANT
+                )
                 with self._waiting_lock:
                     self._waiting.pop(id(request), None)
                 if request._done.is_set():
@@ -3558,11 +3850,17 @@ class ServingEngine:
                         self._held_back = request
                         break
                     self._long_queue.append(request)
+                    pending_counts[req_tenant] = (
+                        pending_counts.get(req_tenant, 0) + 1
+                    )
                 elif self._agentic and not self._resolve_agentic(request):
                     continue  # unknown adapter / pinned-full pool: resolved
                 else:
                     pairs.append((idx, request))
                     admitted_tokens += self._bucket(len(request.prompt_tokens))
+                    pending_counts[req_tenant] = (
+                        pending_counts.get(req_tenant, 0) + 1
+                    )
                     got_short = True
             if not got_short:
                 break
@@ -3692,6 +3990,7 @@ class ServingEngine:
             self._slot_bind_agentic(idx, request)
             with self._stats_lock:
                 self.total_requests += 1
+            self._note_tenant_admitted(request)
             self._spec_admit(idx, request.prompt_tokens)
             self._maybe_publish(idx, request.prompt_tokens)
         return [("prefill", self._fetcher.submit(first), list(group))]
@@ -3915,6 +4214,7 @@ class ServingEngine:
         self._slot_bind_agentic(idx, request)
         with self._stats_lock:
             self.total_requests += 1
+        self._note_tenant_admitted(request)
         self._spec_admit(idx, prompt)
         # the prompt may extend past the reused prefix's bucket boundary:
         # publish the deeper prefix so the next lookup reuses more
@@ -3997,19 +4297,85 @@ class ServingEngine:
 
     # -- paged admission / prefix aliasing -----------------------------------
 
-    def _pop_admission(self, allow_new: bool = True) -> GenerationRequest:
+    def _pop_admission(
+        self, allow_new: bool = True, skip: Optional[set] = None,
+    ) -> GenerationRequest:
         """Admission source for _admit: page-deferred requests (popped
         earlier, waiting for pool pages) retry ahead of the queue so
         allocator pressure never reorders them behind newer arrivals.
         ``allow_new=False`` (set while deferred admissions wait) stops
         draining the queue — the deferred list must stay bounded so the
         bounded queue keeps backpressuring submit() during exhaustion
-        instead of silently absorbing the backlog host-side."""
+        instead of silently absorbing the backlog host-side. ``skip``:
+        tenants held back this pop (at their slot cap / fair share) —
+        forwarded to the tenant queue's DRR, never applied to deferred
+        retries (those already own a pop)."""
         if self._page_deferred:
             return self._page_deferred.pop(0)
         if not allow_new:
             raise queue.Empty
-        return self._queue.get_nowait()
+        return self._queue.get_nowait(skip=skip)
+
+    def _tenant_occupancy(self) -> dict[str, int]:
+        """Active-slot + long-prefill-stream counts by tenant. Computed
+        ONCE per _admit call (slot occupancy cannot change inside it —
+        slots activate after the pop loop); per-pop deltas ride the
+        caller's pending_counts."""
+        active: dict[str, int] = {}
+
+        def _bump(req) -> None:
+            t = getattr(req.options, "tenant", None) or DEFAULT_TENANT
+            active[t] = active.get(t, 0) + 1
+
+        for s in self._slots:
+            if s.active:
+                _bump(s.request)
+        for st in self._longs.values():
+            r = st.get("request")
+            if r is not None:
+                _bump(r)
+        return active
+
+    def _tenant_slot_skip(
+        self, occupancy: dict[str, int], pending_counts: dict[str, int],
+    ) -> set:
+        """Tenants that must NOT claim another free slot right now: at
+        their configured ``max_slots`` hard cap, or at their weighted fair
+        share of the slot pool while OTHER tenants have queued work. Fair
+        share = max_batch × weight / Σweights over the contending set —
+        the "a bursting tenant can never exceed its weight when others
+        are waiting" rule. Work-conserving both ways: a single tenant is
+        never capped by fairness, and when EVERY waiting tenant would be
+        fair-capped with slots still free, the caps relax (hard max_slots
+        never does). Engine thread only."""
+        waiting = self._queue.tenants_with_work()
+        if not waiting:
+            return set()
+        active: dict[str, int] = dict(occupancy)
+        for t, n in pending_counts.items():
+            active[t] = active.get(t, 0) + n
+        hard: set = set()
+        fair_skip: set = set()
+        contending = set(waiting) | {t for t, n in active.items() if n}
+        multi = len(contending) > 1
+        total_w = sum(self._tenants.weight(t) for t in contending) or 1.0
+        for t in waiting:
+            spec = self._tenants.state(t).spec
+            n = active.get(t, 0)
+            if spec.max_slots is not None and n >= spec.max_slots:
+                hard.add(t)
+                continue
+            if multi:
+                fair = max(
+                    1,
+                    round(self.max_batch * self._tenants.weight(t) / total_w),
+                )
+                if n >= fair:
+                    fair_skip.add(t)
+        if fair_skip and set(waiting) <= (fair_skip | hard):
+            # everyone waiting is fair-capped yet slots are free: borrow
+            fair_skip = set()
+        return fair_skip | hard
 
     def _paged_bind(self, idx: int, request: GenerationRequest) -> Optional[int]:
         """Reserve slot ``idx``'s worst-case pages, aliasing the deepest
@@ -4023,7 +4389,13 @@ class ServingEngine:
         the alias/COW/eviction rules cannot drift between them."""
         pool, index = self._pagepool, self._prefix_index
         prompt = request.prompt_tokens
-        need = pool.pages_needed(len(prompt), request.options.max_new_tokens)
+        # reserve only what the request can actually write: a
+        # max_cost_tokens budget below max_new_tokens shrinks the
+        # worst-case page reservation too (§19)
+        need = pool.pages_needed(
+            len(prompt),
+            max(1, effective_max_new_tokens(request.options, len(prompt))),
+        )
         if need > pool.num_pages:
             # only reachable with an explicit kv-pages override below the
             # per-slot worst case: deferring would hang forever, so fail
@@ -4229,6 +4601,7 @@ class ServingEngine:
         self._slot_bind_agentic(idx, request)
         with self._stats_lock:
             self.total_requests += 1
+        self._note_tenant_admitted(request)
         self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         entries.append(("prefill", self._fetcher.submit(first), [(idx, request)]))
@@ -5352,6 +5725,7 @@ class ServingEngine:
         self._slot_bind_agentic(idx, request)
         with self._stats_lock:
             self.total_requests += 1
+        self._note_tenant_admitted(request)
         self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
@@ -5405,6 +5779,7 @@ class ServingEngine:
         slot.reset_obs("ring", 1)
         with self._stats_lock:
             self.total_requests += 1
+        self._note_tenant_admitted(request)
         self._spec_admit(idx, prompt)
         self._maybe_publish(idx, prompt)
         return [("prefill", self._fetcher.submit(first), [(idx, request)])]
@@ -5733,6 +6108,11 @@ class ServingEngine:
             self._page_integrity_check()  # before the announce (see chunk)
         self._adapter_integrity_check()
         k = self.spec_tokens
+        # brownout level 1 (spec-shrink) proposes fewer drafts — data,
+        # not shape, so the compiled verify program never changes (§19)
+        k_prop = (
+            self._brownout.draft_k(k) if self._brownout is not None else k
+        )
         kv_bound = 0 if self._paged else self._decode_kv_bound(k + 1)
         stale = self._collect_stale()
         drafts = np.zeros((self.max_batch, k), np.int32)
@@ -5743,7 +6123,7 @@ class ServingEngine:
             index = self._spec_index.get(i)
             if index is None:
                 continue
-            prop = index.propose(k)
+            prop = index.propose(k_prop)
             with self._stats_lock:
                 self.spec_draft_lookups_total += 1
                 if prop:
@@ -6001,6 +6381,15 @@ class ServingEngine:
         if slot.request is request:  # not freed mid-chunk
             slot.last_token_at = now_t
 
+    def _note_tenant_admitted(self, request: GenerationRequest) -> None:
+        """Tenant attribution + token-rate charge for one admission: the
+        prompt's prefill tokens bill the tenant's quota bucket the moment
+        the slot activates (generated tokens bill per delivery)."""
+        self._tenants.note_admitted(
+            getattr(request.options, "tenant", None) or DEFAULT_TENANT,
+            len(request.prompt_tokens),
+        )
+
     def _deliver_token(self, idx: int, token: int) -> None:
         slot = self._slots[idx]
         request = slot.request
@@ -6049,6 +6438,9 @@ class ServingEngine:
         if deadline is not None and time.monotonic() >= deadline:
             with self._stats_lock:
                 self.deadline_decode_total += 1
+            self._tenants.note_deadline(
+                getattr(opts, "tenant", None) or DEFAULT_TENANT
+            )
             self._finish_slot(idx, "deadline")
             return
         if self._injector is not None:
@@ -6099,12 +6491,19 @@ class ServingEngine:
                     finished_reason = "stop"
             with self._stats_lock:
                 self.total_generated += 1
+            self._tenants.note_generated(
+                getattr(opts, "tenant", None) or DEFAULT_TENANT
+            )
             if request.on_token is not None:
                 try:
                     request.on_token(token)
                 except Exception:  # noqa: BLE001 — stream consumer must not kill the loop
                     log.exception("on_token callback failed")
-            if finished_reason is None and len(slot.generated) >= opts.max_new_tokens:
+            # the request's max_cost_tokens budget (prompt + generated)
+            # caps the generation length alongside max_new_tokens (§19)
+            if finished_reason is None and len(slot.generated) >= (
+                effective_max_new_tokens(opts, len(request.prompt_tokens))
+            ):
                 finished_reason = "length"
             elif finished_reason is None and slot.position >= self.max_seq_len - 1:
                 # cache full — scattering past the buffer would silently drop

@@ -93,6 +93,13 @@ SITES = (
     # bind (no leaked pages), and the sender must RETAIN its copy so the
     # router can fall back to decode-in-place, token-exact
     "migrate",
+    # multi-tenant noisy-neighbor site (docs/SERVING.md §19): when it
+    # fires, the engine injects a burst of synthetic low-priority
+    # admissions under the "chaos-burst" tenant at the iteration top —
+    # the deterministic aggressor of the fair-share drill. The victim
+    # tenant's streams must stay token-exact with bounded p99 TTFT while
+    # the aggressor absorbs ALL the shedding.
+    "tenant-burst",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;

@@ -315,6 +315,16 @@ def beacon_from_engine(
                 else ()
             )
         ],
+        # per-tenant queue pressure (docs/SERVING.md §19): the router's
+        # tenant-aware shed/route signal — an aggressor's backlog on THIS
+        # replica must not get its overflow balanced onto the replica
+        # serving the victim. Tenant IDS only (they already ride HTTP
+        # headers), never token content; bounded to the busiest 16.
+        "tenants": _beacon_tenants(stats.get("tenants") or {}),
+        # brownout ladder level (0 = normal): routers prefer un-browned
+        # replicas at equal affinity, and operators see degradation
+        # fleet-wide
+        "brownout_level": int(stats.get("brownout-level", 0) or 0),
         # wire capabilities (§18): what this replica's VERSION understands.
         # "kvmig" = binds inbound KV-page migrations; "dfa-resume" =
         # honors grammar-resume-state. The router refuses to migrate to —
@@ -323,6 +333,30 @@ def beacon_from_engine(
         # option and restart the DFA at state 0 (invalid output dressed
         # as valid), the exact class the §17 refusal existed to prevent.
         "caps": ["kvmig", "dfa-resume"],
+    }
+
+
+def _beacon_tenants(tenants: dict[str, Any]) -> dict[str, dict[str, Any]]:
+    """Compact per-tenant pressure block for the beacon: queue depth,
+    wait EMA, quota state and cumulative sheds — the fields the router's
+    tenant-aware decisions read. Bounded to the 16 busiest tenants so a
+    many-tenant replica cannot bloat every beacon fetch."""
+    busiest = sorted(
+        tenants.items(),
+        key=lambda kv: (
+            -int(kv[1].get("queued", 0)),
+            -float(kv[1].get("queue-wait-ema-s", 0.0)),
+        ),
+    )[:16]
+    return {
+        str(name): {
+            "queued": int(t.get("queued", 0)),
+            "queue_wait_ema_s": float(t.get("queue-wait-ema-s", 0.0)),
+            "over_quota": bool(t.get("over-quota", False)),
+            "shed_total": int(t.get("shed-total", 0)),
+            "active_slots": int(t.get("active-slots", 0)),
+        }
+        for name, t in busiest
     }
 
 
@@ -360,6 +394,20 @@ def validate_beacon(doc: dict[str, Any]) -> bool:
     for j, cap in enumerate(doc.get("caps") or []):
         if not isinstance(cap, str):
             raise ValueError(f"capability advertisement {j} is not a string")
+    tenants = doc.get("tenants")
+    if tenants is not None:
+        if not isinstance(tenants, dict):
+            raise ValueError("beacon tenants must be an object")
+        for name, t in tenants.items():
+            if not isinstance(name, str) or not isinstance(t, dict):
+                raise ValueError(
+                    f"tenant advertisement {name!r} is not name -> object"
+                )
+            for key in ("queued", "queue_wait_ema_s", "over_quota"):
+                if key not in t:
+                    raise ValueError(
+                        f"tenant advertisement {name!r} missing {key!r}"
+                    )
     for forbidden in ("tokens", "prompt", "text", "prompt_tokens"):
         if forbidden in doc:
             raise ValueError(f"beacon carries token-content key {forbidden!r}")
@@ -1188,6 +1236,12 @@ class _ReplicaState:
     # legacy peers — the router only migrates to / resumes constrained
     # streams on replicas that prove they understand the payload
     caps: frozenset = frozenset()
+    # per-tenant queue pressure (docs/SERVING.md §19): tenant id →
+    # {queued, queue_wait_ema_s, over_quota, ...} from the beacon; empty
+    # for legacy peers (tenant-aware routing simply has no signal then)
+    tenants: dict[str, dict] = field(default_factory=dict)
+    # the replica's brownout ladder level (0 = normal)
+    brownout_level: int = 0
     # circuit breaker (docs/SERVING.md §17): consecutive beacon-fetch +
     # dispatch failures drive an exponential probe backoff — the refresh
     # loop stops hammering a dead peer's /state every interval, and the
@@ -1237,6 +1291,8 @@ class FleetRouter:
         fail_cooldown_s: float = 5.0,
         shed_queue_wait_s: float = 30.0,
         adapter_affinity_tokens: float = 512.0,
+        tenant_affinity_tokens: float = 256.0,
+        brownout_penalty_tokens: float = 128.0,
         spill_discount: float = 0.5,
         beacon_backoff_max_s: float = 30.0,
         circuit_failures: int = 3,
@@ -1262,6 +1318,13 @@ class FleetRouter:
         # warm prefix tokens (a hot-swap dispatch ≈ re-prefilling that
         # much prompt on the engines measured; tune alongside λ — §15)
         self.adapter_affinity_tokens = float(adapter_affinity_tokens)
+        # tenant-aware routing (§19): a tenant's queued backlog on a
+        # replica scores its NEXT request toward that same replica (in
+        # prefix-token units) — aggressor overflow concentrates where the
+        # aggressor already queues, away from the victim's replica; a
+        # browned-out replica is penalized per ladder level
+        self.tenant_affinity_tokens = float(tenant_affinity_tokens)
+        self.brownout_penalty_tokens = float(brownout_penalty_tokens)
         # a HIBERNATED prefix match (the owner spilled the session's pages
         # to host RAM) is worth this fraction of a device-resident match:
         # the restore is a DMA upload, cheaper than re-prefilling but not
@@ -1311,6 +1374,12 @@ class FleetRouter:
         self.stream_failover_total = 0
         self.beacon_failures_total = 0
         self.circuit_open_total = 0
+        # multi-tenant overload control (§19): router-level tenant sheds
+        # (over-quota fleet-wide — counted inside shed_total too) and
+        # tenant-pressure-affinity routes (the aggressor's overflow kept
+        # on its own replica instead of balanced onto the victim's)
+        self.tenant_shed_total = 0
+        self.routed_tenant_affinity_total = 0
         # disaggregated serving (§18): prefill-handoff routes, completed
         # migrations (pages/bytes by receiver ACK), and fallbacks (the
         # migration failed and the stream decoded in place / re-prefilled)
@@ -1395,6 +1464,14 @@ class FleetRouter:
                 )
                 state.caps = frozenset(
                     str(c) for c in (beacon.get("caps") or [])
+                )
+                state.tenants = {
+                    str(name): dict(t)
+                    for name, t in (beacon.get("tenants") or {}).items()
+                    if isinstance(t, dict)
+                }
+                state.brownout_level = int(
+                    beacon.get("brownout_level", 0) or 0
                 )
                 # a fresh beacon is the half-open probe SUCCEEDING: close
                 # the circuit and forget the backoff
@@ -1504,17 +1581,21 @@ class FleetRouter:
         session_id: Optional[str] = None,
         exclude: Optional[set] = None,
         adapter: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> RouteDecision:
         """Pick the replica for one request. Raises FleetShedError when no
         replica is routable or every routable replica is saturated (full
         admission queue, or queue-wait EMA past ``shed_queue_wait_s``).
         ``adapter``: the request's LoRA adapter name — replicas advertising
         it resident score an ``adapter_affinity_tokens`` bonus alongside
-        prefix affinity."""
+        prefix affinity. ``tenant``: the request's tenant id — drives the
+        tenant-aware shed (over-quota anywhere → 429, never balanced onto
+        another replica) and the pressure-affinity term that keeps an
+        aggressor's overflow off the replica serving the victim (§19)."""
         t0 = time.perf_counter()
         try:
             return self._route(
-                list(tokens), session_id, exclude or set(), adapter
+                list(tokens), session_id, exclude or set(), adapter, tenant
             )
         finally:
             # Histogram.record is single-writer by contract (the engine's
@@ -1526,7 +1607,7 @@ class FleetRouter:
 
     def _route(
         self, tokens: list, session_id: Optional[str], exclude: set,
-        adapter: Optional[str] = None,
+        adapter: Optional[str] = None, tenant: Optional[str] = None,
     ) -> RouteDecision:
         now = time.monotonic()
         with self._lock:
@@ -1542,6 +1623,29 @@ class FleetRouter:
                     "or excluded)",
                     retry_after_s=max(self.refresh_interval_s, 0.5),
                 )
+            # tenant-aware shed (docs/SERVING.md §19): a tenant over its
+            # token-rate quota on any routable replica is shed AT THE
+            # ROUTER — its overflow must never be balanced onto the
+            # replica serving a within-quota victim. Retry-After comes
+            # from the tenant's own worst queue-wait EMA, not the fleet's.
+            if tenant:
+                pressured = [
+                    s.tenants[tenant] for s in live if tenant in s.tenants
+                ]
+                if any(t.get("over_quota") for t in pressured):
+                    self.shed_total += 1
+                    self.tenant_shed_total += 1
+                    raise FleetShedError(
+                        f"tenant {tenant!r} is over its token-rate quota "
+                        "fleet-wide",
+                        retry_after_s=max(
+                            (
+                                float(t.get("queue_wait_ema_s", 0.0))
+                                for t in pressured
+                            ),
+                            default=0.0,
+                        ) or 1.0,
+                    )
             # fleet-level shed: every routable replica says it cannot take
             # more — the replicas' OWN exported signals, not a blind bound
             saturated = [
@@ -1652,18 +1756,36 @@ class FleetRouter:
             # candidates stays the full scored set
             best, best_score, best_match = None, None, 0
             best_adapter_hit = False
+            best_tenant_hit = False
             for s, effective, adapter_hit in candidates:
+                # tenant pressure affinity (§19): a tenant with queued
+                # work on a replica scores a bonus THERE — the burster's
+                # overflow concentrates where its backlog (and its sheds)
+                # already live instead of spilling onto the replica
+                # serving a quiet victim. A replica deep into brownout is
+                # penalized one backlog-unit per ladder level.
+                tenant_hit = bool(
+                    tenant
+                    and int(
+                        s.tenants.get(tenant, {}).get("queued", 0)
+                    ) > 0
+                )
                 score = (
                     effective
                     + (self.adapter_affinity_tokens if adapter_hit else 0.0)
+                    + (self.tenant_affinity_tokens if tenant_hit else 0.0)
                     - self.lam * self._load(s.beacon)
+                    - self.brownout_penalty_tokens * s.brownout_level
                 )
                 if best_score is None or score > best_score:
                     best, best_score, best_match = s, score, effective
                     best_adapter_hit = adapter_hit
+                    best_tenant_hit = tenant_hit
             assert best is not None
             if best_adapter_hit:
                 self.routed_adapter_total += 1
+            if best_tenant_hit:
+                self.routed_tenant_affinity_total += 1
             if kind_override is not None:
                 kind = kind_override
             elif best_match > 0 or best_adapter_hit:
@@ -1984,6 +2106,7 @@ class FleetRouter:
         # "failover" (the metric means RESUMED, §17)
         pending_failover: Optional[dict] = None
         adapter = str(options.get("adapter") or "") or None
+        tenant = getattr(parsed, "tenant", None)
         # disaggregated handoff state (§18): ``forced`` short-circuits
         # route() for the hop that must land on a SPECIFIC replica (the
         # decode target the KV just migrated to, or the prefill replica
@@ -2017,7 +2140,7 @@ class FleetRouter:
                 try:
                     decision = self.route(
                         prompt, session_id=session_id, exclude=excluded,
-                        adapter=adapter,
+                        adapter=adapter, tenant=tenant,
                     )
                 except FleetShedError as e:
                     if delivered:
@@ -2432,6 +2555,10 @@ class FleetRouter:
                 "fleet-routed-sticky-total": self.routed_sticky_total,
                 "fleet-routed-balanced-total": self.routed_balanced_total,
                 "fleet-routed-adapter-total": self.routed_adapter_total,
+                "fleet-routed-tenant-affinity-total": (
+                    self.routed_tenant_affinity_total
+                ),
+                "fleet-tenant-shed-total": self.tenant_shed_total,
                 "fleet-shed-total": self.shed_total,
                 "fleet-failover-total": self.failover_total,
                 "fleet-stream-failovers-total": self.stream_failover_total,
@@ -2528,6 +2655,14 @@ async def _serve(config: dict[str, Any], host: str, port: int) -> None:
     loop = asyncio.get_running_loop()
     # parent closes our stdin to stop us (portable subprocess lifecycle)
     await loop.run_in_executor(None, sys.stdin.read)
+    # teardown ORDER matters (§19 satellite): unregister the beacon and
+    # drain the engine FIRST, while the HTTP server still serves — peers
+    # stop routing here within one refresh (empty /state beats the old
+    # race where new remote routes landed mid-drain and died as hop
+    # failures against the wrong breaker), and in-flight remote streams
+    # finish over the still-open wire. Only then drop the server and
+    # hard-stop.
+    await loop.run_in_executor(None, holder.begin_drain)
     await server.stop()
     holder.close()
 

@@ -286,6 +286,11 @@ DUMP_REASONS = (
     # §18): dumped by the ROUTER with per-phase timings (snapshot /
     # transfer / bind ms) and the fallback taken, never page content
     "migrate-failed",
+    # the brownout controller walked the degradation ladder (either
+    # direction — docs/SERVING.md §19): dumped with the level, the step
+    # name and the load score that drove it, so a postmortem shows WHAT
+    # the engine turned off (and back on) under the overload it captured
+    "brownout",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

@@ -120,6 +120,10 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_restored_hits_total",
         "engine_recompute_fallbacks_total",
         "engine_shed_total",
+        "tenant_shed_total",
+        "tenant_queue_wait",
+        "brownout_level",
+        "brownout_transitions_total",
         "engine_deadline_exceeded_total",
         "engine_cancelled_total",
         "engine_quarantined_slots_total",
@@ -317,6 +321,36 @@ def test_tiered_kv_panels_present():
     assert wake is not None, "restore-vs-recompute panel missing"
     assert "engine_restored_hits_total" in wake
     assert "engine_recompute_fallbacks_total" in wake
+
+
+def test_tenancy_panels_present():
+    """The ISSUE-14 multi-tenant overload-control panels must survive
+    dashboard edits: the tenant-overload panel (cross-tenant shed volume +
+    the worst per-tenant queue-wait EMA — the noisy-neighbor victim
+    signal, serving/tenancy.py) and the brownout-ladder panel
+    (docs/SERVING.md §19)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    overload = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "tenant overload" in t.lower()
+        ),
+        None,
+    )
+    assert overload is not None, "tenant-overload panel missing"
+    assert "tenant_shed_total" in overload
+    assert "tenant_queue_wait" in overload
+    brownout = next(
+        (e for t, e in exprs_by_title.items() if "brownout" in t.lower()),
+        None,
+    )
+    assert brownout is not None, "brownout-ladder panel missing"
+    assert "brownout_level" in brownout
+    assert "brownout_transitions_total" in brownout
 
 
 def test_grafana_provisioning_parses():
