@@ -1158,7 +1158,7 @@ def bench_spmd_wire(*, preset: str = "tiny-test", new_tokens: int = 48,
         t.join(timeout=60)
     announces = stats["spmd-announces-total"]
     wire_bytes = stats["spmd-announce-bytes-total"]
-    return {
+    out = {
         "spmd_devices": len(devices),
         "spmd_backend": _jax.default_backend(),
         "spmd_tokens_per_sec": round(generated / wall, 1),
@@ -1174,6 +1174,65 @@ def bench_spmd_wire(*, preset: str = "tiny-test", new_tokens: int = 48,
             wire_bytes / max(1, generated), 1
         ),
     }
+    # recovery drill (round 19, docs/SERVING.md §20): deterministic
+    # leader-loop crashes mid-burst on a FRESH loopback pair per trial
+    # (same shapes as above, so every program is already jit-cached and
+    # the latency below is the rebuild+requeue cost, not compiles);
+    # recorded: fault → first post-recovery delivered token. In-flight
+    # streams fail by §9 contract; queued admissions survive and resume.
+    from langstream_tpu.serving.faultinject import FaultInjector as _FI
+
+    recov_ms = []
+    for trial in range(3):
+        inj = _FI("decode@4", seed=trial)
+        ch2 = LoopbackChannel(
+            prefill_batch=4, max_width=max(buckets), max_batch=4,
+            table_len=table_len_for(max_seq_len, page_size), spec_tokens=4,
+        )
+        lead = ServingEngine(
+            config, params, spmd=ch2, fault_injector=inj,
+            restart_backoff_s=0.05, **kw,
+        )
+        folw = ServingEngine(config, params, **kw)
+        th = threading.Thread(
+            target=follower_loop, args=(folw, ch2), daemon=True,
+        )
+        th.start()
+        lead.start()
+        token_times: list = []
+        try:
+            reqs = [
+                lead.submit(GenerationRequest(
+                    prompt_tokens=preamble + [2 + i], options=opts,
+                    on_token=lambda t: token_times.append(time.time()),
+                ))
+                for i in range(n_requests)
+            ]
+            for r in reqs:
+                try:
+                    r.result(600)
+                except Exception:  # noqa: BLE001 — in-flight at the crash
+                    pass
+            assert lead.stats()["spmd-recoveries-total"] >= 1
+            fault_t = next(
+                e["t"] for e in inj.events_snapshot() if e["site"] == "decode"
+            )
+            after = [t for t in token_times if t > fault_t]
+            if after:
+                recov_ms.append((min(after) - fault_t) * 1e3)
+        finally:
+            lead.stop()
+            th.join(timeout=60)
+    recov_ms.sort()
+    if recov_ms:
+        out["spmd_recovery_trials"] = len(recov_ms)
+        out["spmd_recovery_fault_to_first_token_p50_ms"] = round(
+            recov_ms[len(recov_ms) // 2], 1
+        )
+        out["spmd_recovery_fault_to_first_token_max_ms"] = round(
+            recov_ms[-1], 1
+        )
+    return out
 
 
 def bench_disagg(*, n_steady: int = 12, steady_tokens: int = 16,

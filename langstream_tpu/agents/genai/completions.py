@@ -180,6 +180,30 @@ class _BaseCompletionsStep(Step):
             "engine-loop restarts after a crash (bounded-backoff recovery), "
             "cumulative",
         )
+        # SPMD slice resilience (parallel/spmd_serving.py, docs/SERVING.md
+        # §20): coordinated recover-in-place epochs, divergence resyncs
+        # and watchdog escalations — zeros single-host, gauges like the
+        # lifecycle set above
+        self._m_spmd_recoveries = metrics.gauge(
+            "engine_spmd_recoveries_total",
+            "coordinated SPMD recoveries (leader crash -> OP_RECOVER, both "
+            "sides rebuilt in place, zero process exits), cumulative",
+        )
+        self._m_spmd_epoch = metrics.gauge(
+            "engine_spmd_recovery_epoch",
+            "current SPMD recovery epoch (bumped per coordinated recovery "
+            "or divergence resync; 0 = never recovered)",
+        )
+        self._m_spmd_resyncs = metrics.gauge(
+            "engine_spmd_resyncs_total",
+            "coordinated divergence resyncs granted (OP_RESYNC answered a "
+            "follower's echo-mismatch/seq-gap report), cumulative",
+        )
+        self._m_spmd_watchdog = metrics.gauge(
+            "engine_spmd_watchdog_trips_total",
+            "leader-side watchdog escalations (a wedged iteration's fetch "
+            "exceeded spmd-watchdog-s and forced OP_RECOVER), cumulative",
+        )
         # the agentic serving tier (serving/adapters.py + constrain.py,
         # docs/SERVING.md §15): adapter residency/swap pressure and the
         # constrained-decoding volume + host-side mask overhead
@@ -358,6 +382,10 @@ class _BaseCompletionsStep(Step):
         self._m_cancelled.set(stats.get("cancelled-total", 0))
         self._m_quarantined.set(stats.get("quarantined-slots-total", 0))
         self._m_restarts.set(stats.get("engine-restarts-total", 0))
+        self._m_spmd_recoveries.set(stats.get("spmd-recoveries-total", 0))
+        self._m_spmd_epoch.set(stats.get("spmd-recovery-epoch", 0))
+        self._m_spmd_resyncs.set(stats.get("spmd-resyncs-total", 0))
+        self._m_spmd_watchdog.set(stats.get("spmd-watchdog-trips-total", 0))
         self._m_adapters_resident.set(stats.get("adapters-resident", 0))
         self._m_adapter_swaps.set(stats.get("adapter-swaps-total", 0))
         self._m_constrained.set(stats.get("constrained-requests-total", 0))

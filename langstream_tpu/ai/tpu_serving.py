@@ -141,8 +141,16 @@ Resource configuration:
   spmd-parity-echo: false (default) → on multi-host replicas, re-broadcast
     every processed decode/verify chunk's tokens so followers verify them
     against their own device results (one extra broadcast per chunk; a
-    mismatch dumps the flight recorder and crashes the replica —
-    docs/SERVING.md §14 divergence semantics)
+    mismatch attempts ONE coordinated resync, then crashes the replica
+    with a flight dump — docs/SERVING.md §14/§20 divergence semantics)
+  spmd-watchdog-s: 30 (0 = off) → slice resilience bound (docs/SERVING.md
+    §20): follower recv deadline (2×), leader idle-heartbeat cadence
+    (¼×) and the leader's per-iteration fetch bound (1×) — a wedged or
+    dead leader is detected within 2× instead of parking every pod in a
+    collective forever; must exceed the worst single warmup family's
+    compile stall (warm the compile cache, or raise it)
+  spmd-resync-window-s: 60 → a second divergence within this window of a
+    granted resync stays fatal (transient wire loss does not repeat)
   compile-cache-dir: persistent XLA compile cache directory — a scale-up
     replica pointed at a warm (shared) cache dir skips the warmup
     ladder's compile wall and serves in seconds (fleet cold-start lever)
@@ -371,6 +379,19 @@ class _EngineHolder:
                 spec_tokens=spec_tokens,
                 echo=bool(self.config.get("spmd-parity-echo", False)),
                 decode_chunk=int(self.config.get("decode-chunk", 16)),
+                # slice resilience (docs/SERVING.md §20): the watchdog
+                # bound arms idle heartbeats, the follower recv deadline
+                # AND the leader's per-iteration fetch bound; 0 disables
+                # all three (the pre-round-19 park-in-the-collective
+                # behavior). Default 30s: it must exceed the worst single
+                # warmup family's compile stall (seconds against a warm
+                # persistent compile cache; set higher — or 0 — for cold
+                # caches through a slow tunnel). The resync window is the
+                # follower's repeat-divergence fatality rule.
+                watchdog_s=float(self.config.get("spmd-watchdog-s", 30.0)),
+                resync_window_s=float(
+                    self.config.get("spmd-resync-window-s", 60.0)
+                ),
             )
         # disaggregated serving (docs/SERVING.md §18): the replica's role —
         # validated HERE so a bad knob fails before the engine builds, and
@@ -534,6 +555,9 @@ class _EngineHolder:
                     engine, payload
                 ),
                 reset_fn=engine.reset_histograms,
+                # one attribute read (never stats()) — /healthz surfaces
+                # the crash→rebuild→backoff window for readiness probes
+                recovering_fn=lambda: engine.recovering,
             )
         return engine
 

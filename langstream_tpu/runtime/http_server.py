@@ -323,7 +323,17 @@ class RuntimeHttpServer:
         return web.json_response(self._agents_info())
 
     async def _healthz(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "OK"})
+        """Liveness stays OK through an engine-loop recovery (§20): the
+        supervisor rebuilds in place, so killing the pod for it would turn
+        a seconds-long recovery into a full cold start. `recovering` is
+        surfaced for readiness probes that want to hold traffic instead."""
+        try:
+            from langstream_tpu.serving.fleet import local_recovering
+
+            recovering = local_recovering()
+        except Exception:  # noqa: BLE001 — health endpoint must not 500
+            recovering = False
+        return web.json_response({"status": "OK", "recovering": recovering})
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)

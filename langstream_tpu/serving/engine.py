@@ -114,6 +114,15 @@ class LogitsNaNError(RuntimeError):
     quarantined and its KV rows zeroed; other slots were untouched."""
 
 
+class EngineWedgedError(RuntimeError):
+    """A per-iteration device wait exceeded the SPMD watchdog bound
+    (``spmd-watchdog-s``): a dispatch hung past the deadline, which on a
+    multi-host slice would otherwise hang every pod of the replica. Raised
+    out of the iteration so the loop supervisor escalates to a coordinated
+    OP_RECOVER instead of the slice wedging silently (docs/SERVING.md
+    §20). A plain Exception: the recovery path IS the handler."""
+
+
 @dataclass
 class GenerationRequest:
     prompt_tokens: list[int]
@@ -853,13 +862,29 @@ class _Fetch:
     def done(self) -> bool:
         return self._event.is_set()
 
-    def result(self):
+    def result(self, timeout_s: Optional[float] = None):
+        """``timeout_s`` bounds the wait (the leader's per-iteration SPMD
+        watchdog — docs/SERVING.md §20): expiry raises EngineWedgedError,
+        which the loop supervisor escalates to a coordinated OP_RECOVER.
+        None (single-host default) keeps the unbounded wait."""
         if not self._event.is_set() and not self._fetcher.alive():
             return np.asarray(jax.device_get(self.array))
-        while not self._event.wait(0.5):
+        deadline = (
+            time.monotonic() + timeout_s
+            if timeout_s is not None and timeout_s > 0
+            else None
+        )
+        poll = 0.5 if deadline is None else min(0.5, max(0.01, timeout_s / 8))
+        while not self._event.wait(poll):
             if not self._fetcher.alive():
                 # fetch thread went away before reaching this handle
                 return np.asarray(jax.device_get(self.array))
+            if deadline is not None and time.monotonic() > deadline:
+                raise EngineWedgedError(
+                    f"device fetch exceeded the {timeout_s:.1f}s dispatch "
+                    "bound (spmd-watchdog-s); escalating to coordinated "
+                    "recovery"
+                )
         if isinstance(self._value, BaseException):
             raise self._value
         return self._value
@@ -1288,6 +1313,9 @@ class ServingEngine:
         )
         # follower-side accumulation buffer for OP_RING token chunks
         self._spmd_ring_buf: list = []
+        # kept: the deterministic crash-recovery rebuild derives the fresh
+        # PRNG key from seed + recovery epoch, identically on every host
+        self._rng_seed = int(rng_seed)
         self._key = jax.random.PRNGKey(rng_seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -1589,13 +1617,26 @@ class ServingEngine:
         # queue but not yet assigned to a slot; _quiesced() (drain, caller
         # thread) reads it
         self._mid_iteration = False
-        # loop-restart supervisor (single-host only; SPMD keeps crash-only
-        # semantics): a crashed iteration quarantines the in-flight slots,
-        # rebuilds device state, and restarts under bounded exponential
-        # backoff instead of killing the process's serving capacity
+        # loop-restart supervisor: a crashed iteration quarantines the
+        # in-flight slots, rebuilds device state, and restarts under
+        # bounded exponential backoff instead of killing the process's
+        # serving capacity. Since round 19 this covers SPMD replicas too
+        # (docs/SERVING.md §20): the leader announces OP_RECOVER with a
+        # fresh epoch instead of STOP, both sides run the identical
+        # deterministic rebuild, and QUEUED admissions survive leader-side.
         self.restart_backoff_s = max(0.01, float(restart_backoff_s))
         self.max_restarts = max(0, int(max_restarts))
         self._last_crash_t = 0.0
+        # SPMD slice resilience state (§20): the recovery epoch both sides
+        # rebuild under (also the PRNG-reset input, so sampled streams stay
+        # host-identical after recovery), the beacon's `recovering` window,
+        # and the divergence-poll throttle clock
+        self._spmd_epoch = 0
+        self._recovering = False
+        self._spmd_div_checked_at = 0.0
+        self.spmd_recoveries_total = 0
+        self.spmd_resyncs_total = 0
+        self.spmd_watchdog_trips_total = 0
         # slots whose KV rows must be zeroed on the next iteration (NaN
         # quarantine); coalesced into ONE row-reset dispatch
         self._pending_row_resets: list[int] = []
@@ -2113,6 +2154,9 @@ class ServingEngine:
                 "quarantined-slots": self.quarantined_slots_total,
                 "nan-guard": self.nan_guard_total,
                 "engine-restarts": self.engine_restarts_total,
+                "spmd-recoveries": self.spmd_recoveries_total,
+                "spmd-resyncs": self.spmd_resyncs_total,
+                "spmd-watchdog-trips": self.spmd_watchdog_trips_total,
                 "total-requests": self.total_requests,
                 "total-generated-tokens": self.total_generated,
                 "queued": self._queue.qsize(),
@@ -2381,7 +2425,25 @@ class ServingEngine:
                 if self._spmd is not None
                 else 0
             ),
+            # SPMD slice resilience (§20): the recover-in-place ledger.
+            # `recovering` is True through the crash→rebuild→backoff
+            # window — beacons advertise it so routers exclude the
+            # replica WITHOUT quarantining it (sticky sessions held).
+            # Zeros single-host, so the exporter sets gauges
+            # unconditionally (the standing contract of every block here)
+            "recovering": self._recovering,
+            "spmd-recovery-epoch": self._spmd_epoch,
+            "spmd-recoveries-total": self.spmd_recoveries_total,
+            "spmd-resyncs-total": self.spmd_resyncs_total,
+            "spmd-watchdog-trips-total": self.spmd_watchdog_trips_total,
         }
+
+    @property
+    def recovering(self) -> bool:
+        """True while the loop supervisor is between a crash and the
+        post-backoff restart — the cheap accessor /healthz and beacons
+        read (one attribute, no stats() walk)."""
+        return self._recovering
 
     def _prefix_index_bytes(self) -> int:
         """HBM held by pages the paged alias index references (distinct —
@@ -2730,15 +2792,21 @@ class ServingEngine:
         """Engine-thread supervisor: run the serving loop; on a crash,
         quarantine the in-flight slots, rebuild device state, and restart
         under bounded exponential backoff instead of leaving the process
-        alive but unable to serve until a pod restart. Unrecoverable paths
-        (SPMD replicas — a diverged follower must crash with the leader —
-        non-Exception BaseExceptions, or the restart budget exhausted) keep
-        the crash-only contract: fail everything, announce STOP."""
+        alive but unable to serve until a pod restart. Under SPMD the crash
+        is COORDINATED (docs/SERVING.md §20): OP_RECOVER with a fresh epoch
+        rides the wire before the rebuild, followers run the identical
+        deterministic rebuild in place, and idle heartbeats keep their
+        watchdogs fed through the backoff wait — zero process exits.
+        Unrecoverable paths (a proven divergence — half the mesh must never
+        serve alone — non-Exception BaseExceptions, or the restart budget
+        exhausted) keep the crash-only contract: fail everything, announce
+        STOP."""
         backoff = self.restart_backoff_s
         restarts = 0
         try:
             while True:
                 try:
+                    self._recovering = False
                     self._run_once(warm=restarts == 0)
                     return  # clean stop
                 except BaseException as e:  # noqa: BLE001 — classify below
@@ -2751,7 +2819,10 @@ class ServingEngine:
                     self._last_crash_t = now
                     recoverable = (
                         isinstance(e, Exception)
-                        and self._spmd is None
+                        # a PROVEN leader/follower divergence stays fatal:
+                        # rebuilding in place would let half the mesh serve
+                        # state the other half provably disagrees with
+                        and not isinstance(e, wire.SpmdDivergenceError)
                         and restarts < self.max_restarts
                         and not self._stop.is_set()
                     )
@@ -2760,8 +2831,15 @@ class ServingEngine:
                         self._fail_all(e)
                         return
                     restarts += 1
+                    self._recovering = True
                     with self._stats_lock:
                         self.engine_restarts_total += 1
+                        if self._spmd is not None:
+                            self.spmd_recoveries_total += 1
+                        if isinstance(e, EngineWedgedError):
+                            # the leader-side watchdog caught a wedged
+                            # iteration and escalated it here (§20)
+                            self.spmd_watchdog_trips_total += 1
                     # dump BEFORE _recover clears state: the ring holds the
                     # iterations that led to the crash — the postmortem
                     self._flight_dump(
@@ -2774,6 +2852,34 @@ class ServingEngine:
                         sum(1 for s in self._slots if s.active) + len(self._longs),
                         backoff, restarts, self.max_restarts,
                     )
+                    # SPMD only: epoch bump FIRST (the deterministic
+                    # rebuild keys its PRNG reset off it), then the
+                    # coordinated announce — followers start their
+                    # identical rebuild while the leader tears down, and
+                    # the seq chain restarts at the epoch base on both
+                    # sides. Single-host restarts keep epoch 0 and their
+                    # live PRNG (no cross-host determinism to protect).
+                    if self._spmd is not None:
+                        self._spmd_epoch += 1
+                        try:
+                            self._spmd.announce(wire.ControlBlock(
+                                op=wire.OP_RECOVER, count=self._spmd_epoch,
+                            ))
+                            self._spmd.reset_seq()
+                        except Exception:  # noqa: BLE001 — transport gone:
+                            # followers will watchdog out and the pods
+                            # restart together (the pre-round-19 contract)
+                            log.exception(
+                                "failed to announce OP_RECOVER to followers"
+                            )
+                        self._flight_dump(
+                            "spmd-recover",
+                            extra={
+                                "epoch": self._spmd_epoch,
+                                "error": type(e).__name__,
+                                "restart": restarts,
+                            },
+                        )
                     try:
                         self._recover(e)
                     except BaseException as e2:  # noqa: BLE001 — recovery itself failed
@@ -2784,10 +2890,11 @@ class ServingEngine:
                         log.exception("crash recovery failed; engine is dead")
                         self._fail_all(e2)
                         return
-                    if self._stop.wait(backoff):
+                    if self._backoff_wait(backoff):
                         return  # stop() raced the backoff; it fails the rest
                     backoff = min(backoff * 2, 30.0)
         finally:
+            self._recovering = False
             if self._spmd is not None:
                 # release follower processes parked in recv() — best-effort
                 # on the crash path too, else they block in the collective
@@ -2798,6 +2905,24 @@ class ServingEngine:
                     self._spmd.announce(wire.ControlBlock(op=wire.OP_STOP))
                 except Exception:  # noqa: BLE001 — transport may be gone too
                     log.exception("failed to announce STOP to SPMD followers")
+
+    def _backoff_wait(self, backoff_s: float) -> bool:
+        """The restart-backoff sleep, sliced so SPMD followers keep seeing
+        idle heartbeats through it (their watchdog cannot tell a backoff
+        wait from a dead leader otherwise — §20). Returns True when stop()
+        raced the wait. Single-host (or watchdog off): one plain wait."""
+        spmd = self._spmd
+        if spmd is None or getattr(spmd, "watchdog_s", 0) <= 0:
+            return self._stop.wait(backoff_s)
+        slice_s = max(0.05, spmd.watchdog_s / 4)
+        deadline = time.monotonic() + backoff_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            if self._stop.wait(min(slice_s, remaining)):
+                return True
+            self._spmd_heartbeat()
 
     def _run_once(self, warm: bool) -> None:
         from collections import deque
@@ -2900,13 +3025,43 @@ class ServingEngine:
         self._longs.clear()
         self._long_caches.clear()
         self._reserved.clear()
+        for request, result in finished:
+            request._finish(result)
+        self._inflight_steps = 0
+        # PRNG reset is an SPMD determinism measure (both hosts re-key
+        # from seed+epoch); a single-host restart keeps its live key —
+        # the pre-round-19 behavior, nothing cross-host to protect
+        self._rebuild_device_state(reset_key=self._spmd is not None)
+        if isinstance(error, EngineWedgedError):
+            # the fetch worker may still be parked inside the hung
+            # device_get that tripped the watchdog — every post-recovery
+            # fetch would queue BEHIND it on the FIFO and re-wedge until
+            # the restart budget burned down to the old crash-only
+            # outcome. Abandon it like the device arrays (its late
+            # result lands in an orphaned handle) and start fresh.
+            log.warning("abandoning the wedged fetch worker")
+            self._fetcher = _TokenFetcher(self._injector, self._obs)
+        if not self._fetcher.alive():
+            self._fetcher.start()
+
+    def _rebuild_device_state(self, reset_key: bool = True) -> None:
+        """Deterministic device-state rebuild after a loop crash — every
+        device-resident array is remade from scratch (with buffer donation
+        there is no safe way to keep arrays a failed dispatch may have
+        invalidated), same shapes so no recompiles land on restart.
+
+        SHARED by the leader's ``_recover`` and the SPMD follower's
+        OP_RECOVER replay (``_spmd_follower_recover``): same config + same
+        epoch ⇒ byte-identical post-recovery state on every host — the
+        OP_WARMUP rule applied to recovery (docs/SERVING.md §20). With
+        ``reset_key`` the fresh PRNG key derives from seed + recovery
+        epoch so even SAMPLED streams stay host-identical after a
+        recovery (the crashed dispatch may have consumed the key on one
+        side only)."""
         self._spmd_ring_buf.clear()
         self._freed_slots.clear()
         self._spec_index.clear()
         self._pending_row_resets.clear()
-        for request, result in finished:
-            request._finish(result)
-        self._inflight_steps = 0
         self._step_time_ema_s = 0.0
         self._last_chunk_ready_t = 0.0
         # fresh device state (same shapes → no recompiles on restart)
@@ -2987,8 +3142,17 @@ class ServingEngine:
             # pool rows may hold rows published from the poisoned cache (or
             # the pool buffer itself may be donation-invalidated mid-publish)
             self._prefix_pool.reset()
-        if not self._fetcher.alive():
-            self._fetcher.start()
+        if reset_key:
+            self._key = jax.random.PRNGKey(self._rng_seed + self._spmd_epoch)
+
+    def _spmd_follower_recover(self, epoch: int) -> None:
+        """Follower half of OP_RECOVER (parallel/spmd_serving.py): adopt
+        the leader's recovery epoch and run the identical deterministic
+        rebuild. The follower never owns requests or a queue — only its
+        device arrays and page tables evolve — so the rebuild IS its whole
+        recovery; replay resumes at the epoch-base seq afterwards."""
+        self._spmd_epoch = int(epoch)
+        self._rebuild_device_state()
 
     def _iterate(self, pending) -> None:
         """ONE fused engine iteration: a token-budgeted slice of pending
@@ -3001,6 +3165,11 @@ class ServingEngine:
         obs_on = self._obs.on
         self._iterations_total += 1
         t0 = time.monotonic() if obs_on else 0.0
+        # SPMD slice resilience (§20): the spmd-crash drill site, the
+        # divergence-resync poll, and the idle heartbeat — all at the
+        # iteration top, OUTSIDE any dispatch's announce sequence
+        if self._spmd is not None:
+            self._spmd_tick()
         if self._pending_row_resets:
             self._flush_row_resets()
         if self._pending_page_zero:
@@ -3347,6 +3516,19 @@ class ServingEngine:
                 continue
         return True
 
+    def _fetch_result(self, handle):
+        """Materialize one deferred fetch. Under SPMD with the watchdog
+        armed, the wait is BOUNDED by ``spmd-watchdog-s``: a fetch that
+        never lands (wedged device, hung tunnel) raises EngineWedgedError
+        out of the iteration, and the supervisor escalates to the
+        coordinated OP_RECOVER — a leader must never hang the whole slice
+        on one dispatch (docs/SERVING.md §20). Single-host keeps the
+        unbounded wait (a pod-local hang has pod-local blast radius)."""
+        if not isinstance(handle, _Fetch):
+            return np.asarray(jax.device_get(handle))
+        bound = getattr(self._spmd, "watchdog_s", 0) if self._spmd else 0
+        return handle.result(timeout_s=bound if bound > 0 else None)
+
     def _process_entry(self, entry: tuple) -> None:
         kind = entry[0]
         if kind == "prefill":
@@ -3354,11 +3536,7 @@ class ServingEngine:
             # fetches cost a full tunnel round trip each (~100ms); the
             # fetch thread has usually landed the bytes already
             _, first_dev, group = entry
-            first = (
-                first_dev.result()
-                if isinstance(first_dev, _Fetch)
-                else np.asarray(jax.device_get(first_dev))
-            )
+            first = self._fetch_result(first_dev)
             now = time.monotonic()
             for j, (idx, request) in enumerate(group):
                 slot = self._slots[idx]
@@ -3913,7 +4091,8 @@ class ServingEngine:
                         # multi-host: an announced dispatch that failed here
                         # may have diverged (or killed) the followers —
                         # catch-and-continue would wedge every collective.
-                        # Crash the replica; the pods restart together.
+                        # Raise: the supervisor escalates to the coordinated
+                        # OP_RECOVER (both sides rebuild in place, §20).
                         raise
                     log.exception("prefill failed for a batch of %d requests", len(sub))
                     for idx, request in sub:
@@ -4745,6 +4924,89 @@ class ServingEngine:
                 wire.ControlBlock(op=wire.OP_PAGE_FREE, long_idx=idx)
             )
         return self._pagepool.free_slot(idx)
+
+    def _spmd_tick(self) -> None:
+        """SPMD resilience bookkeeping at the iteration top (leader only,
+        engine thread — docs/SERVING.md §20): fire the ``spmd-crash``
+        drill site (a raise here IS an engine-loop crash, driving the
+        coordinated OP_RECOVER path end to end), answer at most one
+        pending divergence-resync request (throttled — the KV-store poll
+        is a coordinator round trip), and keep follower watchdogs fed
+        with OP_IDLE heartbeats when no dispatch has announced lately."""
+        if self._injector is not None:
+            self._injector.fire("spmd-crash")
+        now = time.monotonic()
+        # poll at the heartbeat cadence, never faster than 4 Hz: on a
+        # real slice each poll is one coordinator KV round trip PER
+        # follower, and a resync is rare + not latency-critical (the
+        # follower keeps replaying while it waits)
+        wd = getattr(self._spmd, "watchdog_s", 0)
+        if now - self._spmd_div_checked_at >= max(0.25, wd / 4):
+            self._spmd_div_checked_at = now
+            try:
+                req = self._spmd.poll_divergence()
+            except Exception:  # noqa: BLE001 — side channel gone ≠ crash
+                req = None
+            if req is not None:
+                self._spmd_resync(req)
+        self._spmd_heartbeat()
+
+    def _spmd_heartbeat(self) -> None:
+        """Announce OP_IDLE when the wire has been quiet for a quarter of
+        the watchdog bound — silence then cleanly separates 'idle replica'
+        from 'dead leader' on the follower side. No-op with the watchdog
+        off (watchdog_s == 0), so pre-round-19 channels see zero extra
+        traffic."""
+        ch = self._spmd
+        wd = getattr(ch, "watchdog_s", 0)
+        if ch is None or wd <= 0:
+            return
+        if time.monotonic() - ch.last_announce_t >= max(0.05, wd / 4):
+            try:
+                ch.announce(wire.ControlBlock(op=wire.OP_IDLE))
+            except Exception:  # noqa: BLE001 — heartbeats are best-effort
+                log.exception("SPMD idle heartbeat failed")
+
+    def _spmd_resync(self, req: dict) -> None:
+        """Answer a follower's divergence report with ONE coordinated
+        OP_RESYNC: re-broadcast the authoritative per-slot page tables
+        and device positions at a fresh epoch, then reset the seq chain
+        to the epoch base. (The active-slot MASK is per-dispatch wire
+        data — every decode/verify block ships it — so a resync has
+        nothing persistent to re-broadcast for it.) The follower
+        VERIFIES its own state against the snapshot and rejoins on a
+        match; mismatch (or a repeat divergence inside its window) stays
+        fatal on its side — the leader just answers, it never decides
+        (§20)."""
+        pool = self._pagepool
+        b = self.max_batch
+        tl = pool.table_len if pool is not None else 0
+        parts = []
+        if tl:
+            parts.append(
+                np.asarray(pool.tables[:b, :tl], np.int32).reshape(-1)
+            )
+        parts.append(np.asarray(
+            jax.device_get(self._positions_dev), np.int32
+        )[:b])
+        payload = np.concatenate(parts)
+        epoch = self._spmd_epoch + 1
+        log.warning(
+            "SPMD follower reported divergence (%s); answering with "
+            "OP_RESYNC at epoch %d", req.get("why", "?"), epoch,
+        )
+        self._spmd.announce(wire.ControlBlock(
+            op=wire.OP_RESYNC, long_idx=epoch, count=len(payload),
+            n_rows=b, width=tl, echo=payload,
+        ))
+        self._spmd.reset_seq()
+        self._spmd_epoch = epoch
+        with self._stats_lock:
+            self.spmd_resyncs_total += 1
+        self._flight_dump(
+            "spmd-recover",
+            extra={"kind": "resync", "epoch": epoch, "requested": dict(req)},
+        )
 
     def _spmd_echo(self, kind: int, host: np.ndarray) -> None:
         """Re-broadcast a processed chunk's fetched tokens to followers in
@@ -6269,11 +6531,7 @@ class ServingEngine:
         chunks (stop/length/cancel/deadline/NaN-sentinel all behave
         identically mid-verify)."""
         _, packed, snapshot, proposed, t_dispatch, clean = entry
-        host = (
-            packed.result()
-            if isinstance(packed, _Fetch)
-            else np.asarray(jax.device_get(packed))
-        )
+        host = self._fetch_result(packed)
         # divergence echo BEFORE the injector's host-side corruption: the
         # echo is the DEVICE truth both sides must agree on — a leader-host
         # corruption drill must not read as an SPMD divergence
@@ -6337,10 +6595,9 @@ class ServingEngine:
         self, chunk, snapshot, steps: int, t_dispatch: float = 0.0,
         clean: bool = False, pipelined: bool = False,
     ) -> None:
-        if isinstance(chunk, _Fetch):
-            host = chunk.result()  # [steps, B], fetched by the fetch thread
-        else:
-            host = np.asarray(jax.device_get(chunk))  # [steps, B]
+        # [steps, B], fetched by the fetch thread (wait watchdog-bounded
+        # under SPMD — see _fetch_result)
+        host = self._fetch_result(chunk)
         # gauge BEFORE delivery: see _sample_step_time's rationale
         self._sample_step_time(snapshot, steps, t_dispatch, clean, pipelined)
         self._spmd_echo(wire.ECHO_DECODE, host)  # before host-side corruption

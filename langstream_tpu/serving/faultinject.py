@@ -100,6 +100,17 @@ SITES = (
     # tenant's streams must stay token-exact with bounded p99 TTFT while
     # the aggressor absorbs ALL the shedding.
     "tenant-burst",
+    # SPMD slice-resilience sites (docs/SERVING.md §20). spmd-crash is
+    # consulted by the LEADER engine at the iteration top — a raise there
+    # is an engine-loop crash under SPMD, driving the coordinated
+    # OP_RECOVER drill (both sides rebuild in place, zero process exits).
+    # spmd-wedge and spmd-drop are consulted by the CHANNEL at announce
+    # time (transport-layer wire loss, the leader believes it announced):
+    # wedge silences the leader permanently (the follower watchdog must
+    # detect it within the bound and leave a spmd-wedge flight dump);
+    # drop loses ONE idle heartbeat, so the next delivered announcement
+    # carries the seq gap the divergence-resync path must heal.
+    "spmd-crash", "spmd-wedge", "spmd-drop",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;

@@ -288,6 +288,12 @@ def beacon_from_engine(
         "kv_pages_free": max(0, pages_total - stats.get("kv-pages-in-use", 0)),
         "draining": bool(stats.get("draining", False)),
         "quarantined": bool(dead),
+        # SPMD slice resilience (§20): True through the replica's
+        # crash→rebuild→backoff window. Routers EXCLUDE a recovering
+        # replica without quarantining it — recovery is seconds, the
+        # fail_cooldown_s quarantine is not — and HOLD its sticky
+        # sessions so they resume on their owner when it returns.
+        "recovering": bool(stats.get("recovering", False)),
         "prefix_hit_rate": stats.get("prefix-cache-hit-rate", 0.0),
         "prefill_tokens_saved_total": stats.get("prefill-tokens-saved-total", 0),
         "ttft_p50_ms": round(float(ttft.get("p50", 0.0)) * 1e3, 3),
@@ -433,6 +439,7 @@ def register_local(
     generate_stream_fn: Optional[Callable[[dict], Iterator[dict]]] = None,
     migrate_bind_fn: Optional[Callable[..., dict]] = None,
     migrate_out_fn: Optional[Callable[[dict], dict]] = None,
+    recovering_fn: Optional[Callable[[], bool]] = None,
 ) -> None:
     """Expose this process's engine on the runtime HTTP server: ``GET
     /state`` serves ``beacon_fn``, ``POST /fleet/generate`` runs
@@ -449,7 +456,26 @@ def register_local(
             "generate_stream": generate_stream_fn,
             "migrate_bind": migrate_bind_fn,
             "migrate_out": migrate_out_fn,
+            "recovering": recovering_fn,
         }
+
+
+def local_recovering() -> bool:
+    """True while ANY engine registered in this process is inside its
+    crash→rebuild→backoff recovery window (§20). Reads one attribute per
+    engine (never stats()), cheap enough for /healthz — k8s readiness can
+    hold traffic through a recovery without killing the pod."""
+    with _LOCAL_LOCK:
+        fns = [e.get("recovering") for e in _LOCAL.values()]
+    out = False
+    for fn in fns:
+        if fn is None:
+            continue
+        try:
+            out = out or bool(fn())
+        except Exception:  # noqa: BLE001 — health probes must not raise
+            log.exception("recovering probe failed")
+    return out
 
 
 def unregister_local(replica_id: str) -> None:
@@ -1363,6 +1389,10 @@ class FleetRouter:
         # acceptance criterion reads
         self.routed_affinity_total = 0
         self.routed_sticky_total = 0
+        # sticky pins held through an owner's recovery window (§20): the
+        # session served elsewhere WITHOUT repointing, so it lands back on
+        # its owner after the backoff
+        self.sticky_held_total = 0
         self.routed_balanced_total = 0
         self.routed_adapter_total = 0
         self.shed_total = 0
@@ -1567,7 +1597,27 @@ class FleetRouter:
         if now - state.beacon_at > self.beacon_ttl_s:
             return False
         b = state.beacon
-        return not (b.get("draining") or b.get("quarantined"))
+        # `recovering` excludes WITHOUT quarantining (§20): no failed_at
+        # stamp, no circuit-breaker count — the replica readmits itself
+        # with its first post-recovery beacon instead of serving a
+        # fail_cooldown_s sentence for a recovery that took seconds
+        return not (
+            b.get("draining") or b.get("quarantined") or b.get("recovering")
+        )
+
+    def _recovering_hold(self, state: Optional["_ReplicaState"], now: float) -> bool:
+        """True when a sticky session's replica is out of rotation ONLY
+        because its fresh beacon says `recovering`: the pin is HELD (not
+        popped, not repointed) so the session resumes on its owner after
+        the backoff window instead of migrating cold elsewhere (§20)."""
+        return (
+            state is not None
+            and now - state.beacon_at <= self.beacon_ttl_s
+            and now - state.failed_at >= self.fail_cooldown_s
+            and bool(state.beacon.get("recovering"))
+            and not state.beacon.get("quarantined")
+            and not state.beacon.get("draining")
+        )
 
     # -- routing ------------------------------------------------------------
 
@@ -1676,6 +1726,7 @@ class FleetRouter:
                 return self._decide(state, "balanced", 0, session_id, now)
             # sticky: same session stays on its replica while that replica
             # stays routable (its aliased pages are live there)
+            pin_session = session_id
             if session_id:
                 self._prune_sticky(now)
                 held = self._sticky.get(session_id)
@@ -1689,14 +1740,26 @@ class FleetRouter:
                     ):
                         self.routed_sticky_total += 1
                         return self._decide(state, "sticky", 0, session_id, now)
-                    # replica gone or the session idled past its TTL (its
-                    # pages are likely evicted by now): fall through — the
-                    # session re-routes cold to whatever wins below
-                    self._sticky.pop(session_id, None)
+                    if (
+                        now - last_used <= self.sticky_ttl_s
+                        and self._recovering_hold(state, now)
+                    ):
+                        # the owner is merely RECOVERING (§20): serve this
+                        # request elsewhere but HOLD the pin — no pop, no
+                        # repoint — so the session lands back on its owner
+                        # once its post-recovery beacon readmits it
+                        self.sticky_held_total += 1
+                        pin_session = None
+                    else:
+                        # replica gone or the session idled past its TTL
+                        # (its pages are likely evicted by now): fall
+                        # through — the session re-routes cold to whatever
+                        # wins below
+                        self._sticky.pop(session_id, None)
             if self.policy == "least-loaded":
                 state = min(live, key=lambda s: self._load(s.beacon))
                 self.routed_balanced_total += 1
-                return self._decide(state, "balanced", 0, session_id, now)
+                return self._decide(state, "balanced", 0, pin_session, now)
             # affinity scoring: hash the prompt once per advertised length
             # (device-resident AND hibernated advertisements both probe)
             lengths = sorted(
@@ -1798,7 +1861,7 @@ class FleetRouter:
                 self.routed_balanced_total += 1
                 kind = "balanced"
             return self._decide(
-                best, kind, best_match, session_id, now, disagg=disagg
+                best, kind, best_match, pin_session, now, disagg=disagg
             )
 
     def _decide(
@@ -2553,6 +2616,7 @@ class FleetRouter:
                 "fleet-routable-replicas": routable,
                 "fleet-routed-affinity-total": self.routed_affinity_total,
                 "fleet-routed-sticky-total": self.routed_sticky_total,
+                "fleet-sticky-held-total": self.sticky_held_total,
                 "fleet-routed-balanced-total": self.routed_balanced_total,
                 "fleet-routed-adapter-total": self.routed_adapter_total,
                 "fleet-routed-tenant-affinity-total": (

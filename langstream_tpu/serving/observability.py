@@ -273,7 +273,9 @@ DUMP_REASONS = (
     "spill-stall",
     # SPMD leader/follower disagreement (echo mismatch, sequence gap, or a
     # failed replay): dumped on the FOLLOWER, tagged with the ControlBlock
-    # seq, before the replica crashes — docs/SERVING.md §14
+    # seq — on first detection a resync is requested (§20) and the dump is
+    # the evidence; a fatal repeat/structural divergence dumps the same
+    # reason (debounced per reason like every dump path)
     "spmd-divergence",
     # a replica died mid-STREAM on the fleet wire and the router re-
     # dispatched prompt + delivered tokens to a survivor (docs/SERVING.md
@@ -291,6 +293,17 @@ DUMP_REASONS = (
     # name and the load score that drove it, so a postmortem shows WHAT
     # the engine turned off (and back on) under the overload it captured
     "brownout",
+    # SPMD slice resilience (docs/SERVING.md §20). spmd-recover: the
+    # LEADER entered coordinated recovery — an engine-loop crash answered
+    # with OP_RECOVER at a fresh epoch (extra: epoch, error, restart), or
+    # a follower divergence report answered with OP_RESYNC (extra: kind
+    # "resync", the follower's request). spmd-wedge: the FOLLOWER
+    # watchdog detected a silenced leader (no announcement, heartbeats
+    # included, within spmd-watchdog-s) and is exiting for a coordinated
+    # pod restart — the dump (extra: last-seq, watchdog-s) is the
+    # incident artifact a hung slice otherwise never leaves
+    "spmd-recover",
+    "spmd-wedge",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

@@ -724,6 +724,11 @@ def test_replica_death_mid_burst_fails_over_with_zero_hangs():
         # the dead one dropped was re-routed (failover counted when the
         # death raced an in-flight dispatch)
         assert survivor.stats()["total-requests"] >= 1
+        # the stalled burst can outlive the 10s beacon TTL on a slow box,
+        # and this router runs no refresh loop (interval 3600, by-hand
+        # refreshes) — refresh like production would have, THEN assert
+        # the survivor is the one routable replica
+        router.refresh_all()
         assert router.route(prompts[0]).replica_id == "ok"
     finally:
         dying.stop()

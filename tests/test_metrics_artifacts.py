@@ -128,6 +128,11 @@ def test_dashboard_regexes_match_live_exposition():
         "engine_cancelled_total",
         "engine_quarantined_slots_total",
         "engine_restarts_total",
+        "engine_spmd_recoveries_total",
+        "engine_spmd_recovery_epoch",
+        "engine_spmd_resyncs_total",
+        "engine_spmd_watchdog_trips_total",
+        "engine_flight_dumps_total",
         "fleet_routed_affinity_total",
         "fleet_routed_balanced_total",
         "fleet_replica_count",
@@ -351,6 +356,38 @@ def test_tenancy_panels_present():
     assert brownout is not None, "brownout-ladder panel missing"
     assert "brownout_level" in brownout
     assert "brownout_transitions_total" in brownout
+
+
+def test_spmd_resilience_panels_present():
+    """The ISSUE-15 SPMD slice-resilience panels must survive dashboard
+    edits: the recovery-epochs panel (coordinated OP_RECOVER recoveries,
+    divergence resyncs and the epoch gauge — parallel/spmd_serving.py)
+    and the watchdog-detections panel (docs/SERVING.md §20)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    recovery = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "spmd slice recovery" in t.lower()
+        ),
+        None,
+    )
+    assert recovery is not None, "SPMD recovery-epochs panel missing"
+    assert "engine_spmd_recoveries_total" in recovery
+    assert "engine_spmd_resyncs_total" in recovery
+    assert "engine_spmd_recovery_epoch" in recovery
+    watchdog = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "spmd watchdog" in t.lower()
+        ),
+        None,
+    )
+    assert watchdog is not None, "SPMD watchdog-detections panel missing"
+    assert "engine_spmd_watchdog_trips_total" in watchdog
 
 
 def test_grafana_provisioning_parses():

@@ -367,10 +367,13 @@ def test_nan_fault_quarantines_victim_only_on_both():
 
 def test_divergence_detected_dumped_and_fatal():
     """A REAL divergence (follower built with different params) must be
-    caught by the echo check: the follower crashes with
+    caught by the echo check and stay FATAL: the first mismatch may
+    request a resync (round 19 — a one-off wire corruption deserves one
+    chance), but the weights keep disagreeing, so the REPEAT mismatch
+    inside the resync window crashes the follower with
     SpmdDivergenceError and leaves a schema-valid flight dump tagged with
-    the ControlBlock seq — SPMD incidents leave evidence like single-host
-    ones (satellite: follower-divergence flight dump)."""
+    the ControlBlock seq — persistent divergence is never survived
+    (docs/SERVING.md §20)."""
     from langstream_tpu.serving.observability import (
         recent_dumps,
         validate_flight_dump,
@@ -381,9 +384,14 @@ def test_divergence_detected_dumped_and_fatal():
         follower_params=init_params(CFG, jax.random.PRNGKey(99)),
     )
     try:
-        # the follower's different weights produce different tokens; the
-        # first processed chunk's echo must catch it
-        pair.leader.generate([5, 6, 7], GREEDY, timeout=120)
+        # the follower's different weights produce different tokens on
+        # EVERY chunk: enough tokens for at least two decode-chunk echoes
+        # (first mismatch → resync request; repeat → fatal)
+        pair.leader.generate(
+            [5, 6, 7],
+            GenerationOptions(max_new_tokens=12, temperature=0.0),
+            timeout=120,
+        )
         pair.thread.join(timeout=60)
         assert pair.follower_error, "divergence went undetected"
         assert isinstance(pair.follower_error[0], SpmdDivergenceError)
