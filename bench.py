@@ -1384,6 +1384,186 @@ def bench_disagg(*, n_steady: int = 12, steady_tokens: int = 16,
     return out
 
 
+def bench_wire(*, prompt_len: int = 96, new_tokens: int = 24) -> dict:
+    """Binary fleet wire v2 phase (ISSUE 16 acceptance, docs §21):
+    measured pairs, not claims — (1) encoded migration bytes per page,
+    v1 NDJSON+base64 vs the v2 binary codec (the v2/v1 ratio is the
+    ≤ 0.76× acceptance bound; it is exact layout math, identical on CPU
+    and chip); (2) migration wall-clock MB/s over the HTTP loopback
+    wire under each codec; (3) token-stream wire bytes per streamed
+    token, v1 vs v2; (4) the P2P page-fetch TTFT pair (ROADMAP 2a) — a
+    radix-miss replica admitting WARM from a peer's fetched pages vs
+    the same miss re-prefilling cold. The tiny CPU model keeps the
+    absolute MB/s and TTFT numbers modest; the byte ratios and the
+    warm-vs-cold shape are what the round records."""
+    import dataclasses
+    import threading as _threading
+
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import GenerationOptions, MODEL_PRESETS
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.runtime.http_server import RuntimeHttpServer
+    from langstream_tpu.serving import fleet as fleet_mod
+    from langstream_tpu.serving import migrate as migrate_mod
+    from langstream_tpu.serving import wire as wire_mod
+    from langstream_tpu.serving.engine import ServingEngine
+    from langstream_tpu.serving.fleet import (
+        FleetRouter,
+        HttpReplica,
+        InProcessReplica,
+        beacon_from_engine,
+        engine_generate,
+        engine_generate_stream,
+        engine_migrate_bind,
+        engine_migrate_pages,
+        engine_p2p_fetch,
+    )
+
+    config = dataclasses.replace(MODEL_PRESETS["tiny-test"], dtype="float32")
+    params = init_params(config, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(16)
+    opts = GenerationOptions(max_new_tokens=new_tokens, temperature=0.0)
+
+    def _engine():
+        e = ServingEngine(
+            config, params, max_batch=4, max_seq_len=512,
+            prefill_buckets=(32, 64, 128, 256), decode_chunk=4,
+            prefix_cache="auto", precompile=True,
+        )
+        e.start()
+        return e
+
+    a, b = _engine(), _engine()
+    # compile the prompt bucket + decode ladder on BOTH engines before
+    # any clock starts (the TTFT pair measures serving, not XLA)
+    warm_prompt = rng.integers(1, 200, size=prompt_len).tolist()
+    for e in (a, b):
+        e.generate(list(warm_prompt),
+                   GenerationOptions(max_new_tokens=8, temperature=0.0))
+        e.reset_histograms()
+    prompts = [
+        rng.integers(1, 200, size=prompt_len).tolist() for _ in range(4)
+    ]
+    out: dict = {"wire_prompt_len": prompt_len}
+
+    # --- (1) encoded bytes per migrated page: the acceptance ratio ----
+    a.generate(prompts[0], opts)
+    v2_pages = [
+        len(wire_mod.encode_mig_frame(f))
+        for f in migrate_mod.export_frames(a, prompts[0], raw=True)
+        if f["kind"] == "page"
+    ]
+    v1_pages = [
+        len((json.dumps(f) + "\n").encode())
+        for f in migrate_mod.export_frames(a, prompts[0])
+        if f["kind"] == "page"
+    ]
+    out.update({
+        "wire_pages": len(v1_pages),
+        "wire_v1_bytes_per_page": round(sum(v1_pages) / len(v1_pages), 1),
+        "wire_v2_bytes_per_page": round(sum(v2_pages) / len(v2_pages), 1),
+        "wire_v2_over_v1_page_ratio": round(
+            sum(v2_pages) / sum(v1_pages), 4
+        ),
+    })
+
+    # --- (2) + (3): the HTTP loopback wire, both codecs ---------------
+    loop = asyncio.new_event_loop()
+    server = RuntimeHttpServer(
+        metrics_text=lambda: "", agents_info=lambda: [], port=0
+    )
+    thread = _threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(10)
+    fleet_mod.register_local(
+        "bench-wire",
+        beacon_fn=lambda: beacon_from_engine("bench-wire", b, url=server.url),
+        generate_fn=lambda p: engine_generate(b, p),
+        generate_stream_fn=lambda p: engine_generate_stream(b, p),
+        migrate_bind_fn=(
+            lambda frames, timeout_s=30.0:
+            engine_migrate_bind(b, frames, timeout_s)
+        ),
+        migrate_pages_fn=lambda p: engine_migrate_pages(b, p),
+        p2p_fetch_fn=lambda p: engine_p2p_fetch(b, p),
+        migrate_limits_fn=b.migrate_limits,
+    )
+    try:
+        for proto, prompt in (("v1", prompts[1]), ("v2", prompts[2])):
+            a.generate(prompt, opts)
+            wire_mod.reset_wire_stats()
+            t0 = time.monotonic()
+            ack = migrate_mod.push_migration(
+                server.url,
+                migrate_mod.export_frames(a, prompt, raw=proto == "v2"),
+                timeout_s=60.0, wire=proto,
+            )
+            took = time.monotonic() - t0
+            sent = wire_mod.wire_stats().get(proto, 0)
+            out[f"wire_{proto}_migrate_wire_bytes"] = sent
+            out[f"wire_{proto}_migrate_page_bytes"] = ack.get("bytes", 0)
+            out[f"wire_{proto}_migrate_mbps"] = round(
+                sent / max(took, 1e-9) / 1e6, 2
+            )
+        replica = HttpReplica("bench-wire", server.url)
+        for proto in ("v1", "v2"):
+            replica.caps = (
+                frozenset({"frames2"}) if proto == "v2" else frozenset()
+            )
+            wire_mod.reset_wire_stats()
+            n = 0
+            for frame in replica.generate_stream(
+                prompts[3], {"max-tokens": new_tokens, "temperature": 0.0}
+            ):
+                if frame.get("kind") == "tokens":
+                    n += len(frame["tokens"])
+            out[f"wire_{proto}_stream_bytes_per_token"] = round(
+                wire_mod.wire_stats().get(proto, 0) / max(n, 1), 1
+            )
+    finally:
+        fleet_mod.unregister_local("bench-wire")
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        loop.close()
+
+    # --- (4) P2P-warm admit vs local cold re-prefill TTFT -------------
+    def _ttft(router, prompt):
+        t0 = time.monotonic()
+        for frame in router.stream_generate(
+            prompt, {"max-tokens": 8, "temperature": 0.0}
+        ):
+            if frame.get("kind") == "tokens":
+                return time.monotonic() - t0
+        return 0.0
+
+    for mode, p2p in (("cold", False), ("p2p_warm", True)):
+        prompt = rng.integers(1, 200, size=prompt_len).tolist()
+        a.generate(prompt, opts)  # the owner publishes the prefix
+        router = FleetRouter(
+            [InProcessReplica("owner", a), InProcessReplica("dest", b)],
+            refresh_interval_s=3600.0, lam=16.0,
+            p2p=p2p, p2p_threshold=16,
+        )
+        router.refresh_all()
+        # drown the owner's affinity win so the radix-miss replica takes
+        # the request — exactly the load shape P2P fetch exists for
+        router._replicas["owner"].beacon["load_score"] = 50.0
+        out[f"wire_{mode}_ttft_ms"] = round(_ttft(router, prompt) * 1e3, 1)
+        if p2p:
+            st = router.stats()
+            out["wire_p2p_fetches"] = st["fleet-p2p-fetch-total"]
+            out["wire_p2p_fallbacks"] = st["fleet-p2p-fetch-fallback-total"]
+            out["wire_p2p_bytes_in"] = st["fleet-p2p-bytes-in-total"]
+    a.stop()
+    b.stop()
+    print(f"[bench] wire: { {k: v for k, v in out.items()} }",
+          file=sys.stderr, flush=True)
+    return out
+
+
 def bench_fleet(*, n_replicas: int = 3, n_groups: int = 4,
                 preamble_len: int = 256, burst_mult: int = 10,
                 new_tokens: int = 16, lam: float = 128.0) -> dict:
@@ -1768,6 +1948,17 @@ def main() -> None:
         extras.update(bench_disagg())
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] disagg phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # binary fleet wire v2 + P2P page fetch (ISSUE 16 acceptance, docs
+    # §21): v1-vs-v2 encoded bytes per migrated page (the ≤0.76× bound)
+    # and per streamed token, migration MB/s over the HTTP loopback under
+    # both codecs, and the P2P-warm-admit vs cold-re-prefill TTFT pair
+    print("[bench] fleet wire v1-vs-v2 + P2P fetch phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_wire())
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] wire phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # SPMD fast-path wire (ISSUE 9 acceptance): loopback leader+follower
     # on a TP mesh over all local devices with prefix + speculation +

@@ -329,6 +329,37 @@ class _BaseCompletionsStep(Step):
             "migrations that failed (checksum, cut, deadline, exhaustion) "
             "and fell back to decode-in-place, cumulative",
         )
+        # binary fleet wire v2 + P2P page fetch (docs/SERVING.md §21):
+        # bytes on the replica-to-replica wire by protocol (the v1-vs-v2
+        # overhead pair), and the radix-miss fetch outcomes — a rising
+        # fallback share means the P2P wire (or the owners' arenas) is
+        # unhealthy while requests silently re-prefill cold
+        self._m_fleet_wire_bytes = {
+            proto: metrics.gauge(
+                "fleet_wire_bytes_total",
+                "bytes written to the replica-to-replica fleet wire by "
+                "protocol (v1 NDJSON vs v2 binary), sender-side, "
+                "cumulative",
+                labels={"proto": proto},
+            )
+            for proto in ("v1", "v2")
+        }
+        self._m_fleet_p2p_fetch = metrics.gauge(
+            "fleet_p2p_fetch_total",
+            "peer-to-peer page fetches that bound warm on a radix miss "
+            "(owner kept its copy), cumulative",
+        )
+        self._m_fleet_p2p_fallback = metrics.gauge(
+            "fleet_p2p_fetch_fallback_total",
+            "peer-to-peer page fetches that failed (checksum, net-cut, "
+            "deadline, no capable peer) and re-prefilled locally, "
+            "cumulative",
+        )
+        self._m_fleet_p2p_bytes_in = metrics.gauge(
+            "fleet_p2p_bytes_in_total",
+            "page bytes admitted from peers by completed P2P fetches "
+            "(receiver-ACKed), cumulative",
+        )
         from langstream_tpu.serving.observability import (
             ENGINE_HISTOGRAMS,
             FLEET_HISTOGRAMS,
@@ -432,6 +463,19 @@ class _BaseCompletionsStep(Step):
         )
         self._m_fleet_migrate_fallbacks.set(
             fleet.get("fleet-migrate-fallbacks-total", 0)
+        )
+        self._m_fleet_wire_bytes["v1"].set(
+            fleet.get("fleet-wire-bytes-v1-total", 0)
+        )
+        self._m_fleet_wire_bytes["v2"].set(
+            fleet.get("fleet-wire-bytes-v2-total", 0)
+        )
+        self._m_fleet_p2p_fetch.set(fleet.get("fleet-p2p-fetch-total", 0))
+        self._m_fleet_p2p_fallback.set(
+            fleet.get("fleet-p2p-fetch-fallback-total", 0)
+        )
+        self._m_fleet_p2p_bytes_in.set(
+            fleet.get("fleet-p2p-bytes-in-total", 0)
         )
         for name, snap in (stats.get("histograms") or {}).items():
             mirror = self._m_hists.get(name)

@@ -554,6 +554,19 @@ class _EngineHolder:
                 migrate_out_fn=lambda payload: fleet_mod.engine_migrate_out(
                     engine, payload
                 ),
+                # peer-to-peer page fetch (docs/SERVING.md §21): serve
+                # pages to a radix-missing peer (copy, never release) and
+                # pull from an owner on command; the limits probe bounds
+                # what /fleet/migrate will read off the wire
+                migrate_pages_fn=(
+                    lambda payload: fleet_mod.engine_migrate_pages(
+                        engine, payload
+                    )
+                ),
+                p2p_fetch_fn=lambda payload: fleet_mod.engine_p2p_fetch(
+                    engine, payload
+                ),
+                migrate_limits_fn=engine.migrate_limits,
                 reset_fn=engine.reset_histograms,
                 # one attribute read (never stats()) — /healthz surfaces
                 # the crash→rebuild→backoff window for readiness probes
@@ -643,6 +656,13 @@ class _EngineHolder:
                     ).lower() not in ("off", "false", "0", "none"),
                     migrate_timeout_s=float(
                         self.config.get("fleet-migrate-timeout-s", 30.0)
+                    ),
+                    # peer-to-peer page fetch on radix miss (§21)
+                    p2p=str(
+                        self.config.get("fleet-p2p", "auto")
+                    ).lower() not in ("off", "false", "0", "none"),
+                    p2p_threshold=int(
+                        self.config.get("fleet-p2p-threshold", 256)
                     ),
                 )
                 router.start()

@@ -16,12 +16,25 @@ import threading
 from typing import Optional, Sequence
 
 
-class Counter:
-    __slots__ = ("name", "help", "_value", "_lock")
+def _render_labels(labels: dict) -> str:
+    """Canonical ``{k="v",...}`` rendering (sorted keys) — used both as
+    the registry-key suffix and in the exposition line, so one (name,
+    labels) pair is always one series."""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
 
-    def __init__(self, name: str, help_: str = "") -> None:
+
+class Counter:
+    __slots__ = ("name", "help", "labels", "_value", "_lock")
+
+    def __init__(
+        self, name: str, help_: str = "", labels: Optional[dict] = None,
+    ) -> None:
         self.name = name
         self.help = help_
+        # optional Prometheus labels: one Counter object IS one labeled
+        # series (``name{k="v"}``); unlabeled stays the common case
+        self.labels = dict(labels) if labels else None
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -35,11 +48,14 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("name", "help", "_value")
+    __slots__ = ("name", "help", "labels", "_value")
 
-    def __init__(self, name: str, help_: str = "") -> None:
+    def __init__(
+        self, name: str, help_: str = "", labels: Optional[dict] = None,
+    ) -> None:
         self.name = name
         self.help = help_
+        self.labels = dict(labels) if labels else None
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -227,20 +243,26 @@ class MetricsReporter:
     def _full(self, name: str) -> str:
         return f"{self._prefix}_{name}" if self._prefix else name
 
-    def counter(self, name: str, help_: str = "") -> Counter:
+    def counter(
+        self, name: str, help_: str = "", labels: Optional[dict] = None,
+    ) -> Counter:
         full = self._full(name)
-        c = self._registry.get(full)
+        key = full + _render_labels(labels) if labels else full
+        c = self._registry.get(key)
         if not isinstance(c, Counter):
-            c = Counter(full, help_)
-            self._registry[full] = c
+            c = Counter(full, help_, labels)
+            self._registry[key] = c
         return c
 
-    def gauge(self, name: str, help_: str = "") -> Gauge:
+    def gauge(
+        self, name: str, help_: str = "", labels: Optional[dict] = None,
+    ) -> Gauge:
         full = self._full(name)
-        g = self._registry.get(full)
+        key = full + _render_labels(labels) if labels else full
+        g = self._registry.get(key)
         if not isinstance(g, Gauge):
-            g = Gauge(full, help_)
-            self._registry[full] = g
+            g = Gauge(full, help_, labels)
+            self._registry[key] = g
         return g
 
     def histogram(
@@ -254,19 +276,31 @@ class MetricsReporter:
         return h
 
     def prometheus_text(self) -> str:
-        """Render all metrics in Prometheus text exposition format."""
+        """Render all metrics in Prometheus text exposition format.
+        Labeled series of one metric share a single HELP/TYPE block (the
+        ``seen`` set dedupes by base name — registry keys carry the
+        rendered labels, metric ``name`` attributes do not)."""
         lines: list[str] = []
-        for name, m in sorted(self._registry.items()):
-            safe = name.replace("-", "_").replace(".", "_")
-            if m.help:
-                lines.append(f"# HELP {safe} {m.help}")
+        seen: set[str] = set()
+        for _key, m in sorted(self._registry.items()):
+            safe = m.name.replace("-", "_").replace(".", "_")
+            if safe not in seen:
+                seen.add(safe)
+                if m.help:
+                    lines.append(f"# HELP {safe} {m.help}")
+                if isinstance(m, Histogram):
+                    lines.append(f"# TYPE {safe} histogram")
+                else:
+                    kind = "counter" if isinstance(m, Counter) else "gauge"
+                    lines.append(f"# TYPE {safe} {kind}")
             if isinstance(m, Histogram):
-                lines.append(f"# TYPE {safe} histogram")
                 lines.extend(m.exposition(safe))
                 continue
-            kind = "counter" if isinstance(m, Counter) else "gauge"
-            lines.append(f"# TYPE {safe} {kind}")
-            lines.append(f"{safe} {m.value}")
+            labels = m.labels
+            if labels:
+                lines.append(f"{safe}{_render_labels(labels)} {m.value}")
+            else:
+                lines.append(f"{safe} {m.value}")
         return "\n".join(lines) + "\n"
 
 

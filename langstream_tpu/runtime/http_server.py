@@ -41,6 +41,8 @@ class RuntimeHttpServer:
                 web.post("/fleet/cancel", self._fleet_cancel),
                 web.post("/fleet/migrate", self._fleet_migrate),
                 web.post("/fleet/migrate-out", self._fleet_migrate_out),
+                web.post("/fleet/pages", self._fleet_pages),
+                web.post("/fleet/fetch", self._fleet_fetch),
                 web.post("/fleet/reset", self._fleet_reset),
                 web.get("/healthz", self._healthz),
             ]
@@ -67,10 +69,13 @@ class RuntimeHttpServer:
         newline-delimited-JSON frame stream (``lstpu-frames-v1``,
         docs/SERVING.md §17): token chunks flow as the engine delivers
         them, heartbeats keep the wire provably alive between chunks, and
-        one terminal frame carries finish_reason + usage. Pre-stream
-        failures (shed / bad request / dead engine) still answer with
-        real status codes — the submit happens BEFORE the response
-        commits to chunked encoding."""
+        one terminal frame carries finish_reason + usage. With ``wire:
+        "v2"`` the same frames ship as the ``lstpu-frames-v2`` binary
+        stream instead (§21) — the response Content-Type tells the
+        client which codec it got. Pre-stream failures (shed / bad
+        request / dead engine) still answer with real status codes — the
+        submit happens BEFORE the response commits to chunked
+        encoding."""
         import asyncio
 
         from langstream_tpu.serving.fleet import (
@@ -90,7 +95,9 @@ class RuntimeHttpServer:
                 frames = await loop.run_in_executor(
                     None, local_generate_stream, payload
                 )
-                return await self._stream_frames(request, frames)
+                return await self._stream_frames(
+                    request, frames, binary=payload.get("wire") == "v2"
+                )
             result = await loop.run_in_executor(None, local_generate, payload)
         except FleetShedError as e:
             return web.json_response(
@@ -105,23 +112,30 @@ class RuntimeHttpServer:
         return web.json_response(result)
 
     async def _stream_frames(
-        self, request: web.Request, frames
+        self, request: web.Request, frames, binary: bool = False
     ) -> web.StreamResponse:
-        """Write one frame iterator as the chunked NDJSON hop body, with
-        the wire fault sites applied per frame (serving/faultinject.py,
-        docs/SERVING.md §17): ``net-stall`` goes silent mid-token,
-        ``net-cut`` aborts the transport in a frame's place (connection
-        reset, no terminal frame), ``net-corrupt`` writes a malformed
-        line. Closing the frame iterator on ANY exit cancels the engine
-        request when the stream never finished — a vanished client must
-        not burn the slot."""
+        """Write one frame iterator as the chunked hop body — NDJSON
+        (``lstpu-frames-v1``) or, with ``binary``, the ``lstpu-frames-v2``
+        packed layout (§21) — with the wire fault sites applied per frame
+        (serving/faultinject.py, docs/SERVING.md §17): ``net-stall`` goes
+        silent mid-token, ``net-cut`` aborts the transport in a frame's
+        place (connection reset, no terminal frame), ``net-corrupt``
+        writes a malformed line / a CRC-breaking garbage record — the
+        same chaos semantics on both codecs. Closing the frame iterator
+        on ANY exit cancels the engine request when the stream never
+        finished — a vanished client must not burn the slot."""
         import asyncio
         import json as _json
 
+        from langstream_tpu.serving import wire as wire_mod
         from langstream_tpu.serving.fleet import close_frames, wire_injector
 
+        proto = "v2" if binary else "v1"
         resp = web.StreamResponse()
-        resp.content_type = "application/x-ndjson"
+        resp.content_type = (
+            "application/x-lstpu-frames2" if binary
+            else "application/x-ndjson"
+        )
         resp.enable_chunked_encoding()
         loop = asyncio.get_running_loop()
         injector = wire_injector()
@@ -137,6 +151,11 @@ class RuntimeHttpServer:
             # commit must still close the (eagerly-submitted) stream so
             # the engine request is cancelled, not decoded to the budget
             await resp.prepare(request)
+            if binary:
+                wire_mod.count_wire_bytes(
+                    proto, len(wire_mod.FRAMES2_PREAMBLE)
+                )
+                await resp.write(wire_mod.FRAMES2_PREAMBLE)
             while True:
                 frame = await loop.run_in_executor(None, _next)
                 if frame is None:
@@ -153,13 +172,20 @@ class RuntimeHttpServer:
                             transport.abort()  # RST, mid-stream death
                         return resp
                     if injector.fires("net-corrupt"):
-                        # a malformed line in the frame's place: the
-                        # client's frame validation must fail the hop
-                        await resp.write(b'{"seq": "corrupt", "kind"\n')
+                        # garbage in the frame's place: the client's
+                        # frame validation (JSON parse / magic + CRC)
+                        # must fail the hop
+                        await resp.write(
+                            b"\xff" * wire_mod.PRELUDE.size if binary
+                            else b'{"seq": "corrupt", "kind"\n'
+                        )
                         continue
-                await resp.write(
-                    _json.dumps(frame).encode("utf-8") + b"\n"
+                chunk = (
+                    wire_mod.encode_stream_frame(frame) if binary
+                    else _json.dumps(frame).encode("utf-8") + b"\n"
                 )
+                wire_mod.count_wire_bytes(proto, len(chunk))
+                await resp.write(chunk)
         except (ConnectionResetError, ConnectionError, OSError) as e:
             # client went away mid-stream: the finally closes the frame
             # iterator, which cancels the engine request
@@ -178,31 +204,66 @@ class RuntimeHttpServer:
 
     async def _fleet_migrate(self, request: web.Request) -> web.Response:
         """Inbound KV-page migration (docs/SERVING.md §18): the body is a
-        chunked ``lstpu-kvmig-v1`` NDJSON frame stream; the local engine
-        verifies every page's checksum and binds the pages into its pool.
-        The response is the ACK the SENDER frees against, so protocol
-        failures (checksum mismatch, cut stream, pool exhaustion) answer
+        chunked ``lstpu-kvmig-v1`` NDJSON frame stream — or, sniffed from
+        its 8-byte preamble, the ``lstpu-kvmig-v2`` binary codec (§21);
+        the local engine verifies every page's checksum and binds the
+        pages into its pool. The response is the ACK the SENDER frees
+        against, so protocol failures (checksum mismatch, cut stream,
+        oversized or length-prefix-corrupt frame, pool exhaustion) answer
         ``{"ok": false}`` with HTTP 200 — the transfer failed, the
         transport worked — and the sender retains its copy. Nothing is
-        ever left allocated on a failed bind (receiver frees on abort)."""
+        ever left allocated on a failed bind (receiver frees on abort).
+
+        Hardening (§21): every byte count is bounded by the LOCAL pool's
+        geometry, never by a wire-supplied length — the whole body by
+        pages_total and each decoded frame payload by bytes_per_page
+        (with v1's base64+JSON inflation headroom), so a corrupt or
+        hostile length prefix is refused before any allocation."""
         import asyncio
         import json as _json
 
+        from langstream_tpu.serving import wire as wire_mod
         from langstream_tpu.serving.fleet import (
             ReplicaError,
             local_migrate_bind,
+            local_migrate_limits,
         )
         from langstream_tpu.serving.migrate import MigrationError
 
-        # the frame stream is bounded (one prefix's pages): read it whole,
-        # parse line-by-line — binding runs on the engine thread anyway,
-        # so there is nothing to overlap with a streaming parse
+        limits = local_migrate_limits()
+        bpp = int(limits.get("bytes_per_page") or 0)
+        pages_total = int(limits.get("pages_total") or 0)
+        # one decoded page payload is bpp bytes; v1 ships it base64+JSON
+        # (~4/3 inflation) so 2× covers both codecs' frame overhead. The
+        # flat fallbacks only apply when no paged engine is registered —
+        # the bind below then refuses anyway, cheaply.
+        max_payload = max(2 * bpp, 1 << 20) if bpp else 64 << 20
+        max_total = (
+            2 * bpp * pages_total + (1 << 20)
+            if bpp and pages_total else 256 << 20
+        )
+        # the frame stream is bounded (one prefix's pages): read it whole
+        # — bounded INCREMENTALLY, so a rogue Content-Length or endless
+        # chunked body never lands in host memory — then parse; binding
+        # runs on the engine thread anyway, so there is nothing to
+        # overlap with a streaming parse
+        body = bytearray()
         try:
-            raw = await request.read()
+            async for chunk in request.content.iter_any():
+                body.extend(chunk)
+                if len(body) > max_total:
+                    return web.json_response({
+                        "ok": False,
+                        "error": (
+                            f"migration body exceeds this pool's "
+                            f"{max_total}-byte bound"
+                        ),
+                    })
         except (ConnectionResetError, ConnectionError, OSError):
             return web.json_response(
                 {"ok": False, "error": "body read failed (cut wire)"}
             )
+        raw = bytes(body)
         # the SENDER's budget governs the bind too (clamped so a rogue
         # peer cannot park an executor thread for hours) — a raised
         # fleet-migrate-timeout-s must bound the whole transfer, not just
@@ -214,6 +275,28 @@ class RuntimeHttpServer:
         timeout_s = min(max(timeout_s, 0.05), 600.0)
 
         def _bind() -> dict:
+            if raw.startswith(wire_mod.KVMIG2_PREAMBLE):
+                view = memoryview(raw)
+                pos = len(wire_mod.KVMIG2_PREAMBLE)
+
+                def read(n: int) -> bytes:
+                    nonlocal pos
+                    chunk = bytes(view[pos:pos + n])
+                    pos += len(chunk)
+                    return chunk
+
+                def v2_frames():
+                    try:
+                        yield from wire_mod.decode_mig_frames(
+                            read, max_payload=max_payload
+                        )
+                    except wire_mod.WireError as e:
+                        raise MigrationError(
+                            f"corrupt v2 migration frame ({e})"
+                        ) from e
+
+                return local_migrate_bind(v2_frames(), timeout_s)
+
             def frames():
                 for line in raw.splitlines():
                     line = line.strip()
@@ -259,6 +342,132 @@ class RuntimeHttpServer:
             ack = await loop.run_in_executor(
                 None, local_migrate_out, payload
             )
+        except MigrationError as e:
+            return web.json_response({"ok": False, "error": str(e)})
+        except ReplicaError as e:
+            return web.json_response({"ok": False, "error": str(e)}, status=503)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from None
+        return web.json_response(ack)
+
+    async def _fleet_pages(self, request: web.Request) -> web.StreamResponse:
+        """Peer-to-peer page serve (docs/SERVING.md §21, ROADMAP 2a): a
+        radix-missing peer asks for the pages covering ``prompt_tokens``'s
+        deepest published prefix. The response body is the same migration
+        frame stream ``/fleet/migrate`` consumes — ``lstpu-kvmig-v2``
+        binary when the body asks ``wire: "v2"``, NDJSON otherwise — and
+        the local engine RELEASES NOTHING (a fetch copies; only a
+        migration moves). Pre-stream failures (no published prefix, dead
+        engine) answer a JSON error document instead of committing to a
+        stream, so the fetcher can tell refusal from a cut wire; an
+        export death MID-stream aborts the transport — the fetcher reads
+        truncation, never a clean-looking short transfer."""
+        import asyncio
+        import json as _json
+
+        from langstream_tpu.serving import wire as wire_mod
+        from langstream_tpu.serving.fleet import (
+            ReplicaError,
+            close_frames,
+            local_migrate_pages,
+        )
+        from langstream_tpu.serving.migrate import MigrationError
+
+        try:
+            payload = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="body must be JSON") from None
+        v2 = payload.get("wire") == "v2"
+        proto = "v2" if v2 else "v1"
+        loop = asyncio.get_running_loop()
+        try:
+            frames = await loop.run_in_executor(
+                None, local_migrate_pages, payload
+            )
+        except MigrationError as e:
+            return web.json_response({"ok": False, "error": str(e)})
+        except ReplicaError as e:
+            return web.json_response({"ok": False, "error": str(e)}, status=503)
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from None
+
+        def _next():
+            try:
+                return next(frames)
+            except StopIteration:
+                return None
+
+        # pull the FIRST frame before committing to a stream: the export
+        # snapshot (no-such-prefix, engine dead) fails here, and the
+        # fetcher still gets a real JSON refusal
+        try:
+            first = await loop.run_in_executor(None, _next)
+        except (MigrationError, ValueError) as e:
+            close_frames(frames)
+            return web.json_response({"ok": False, "error": str(e)})
+        except ReplicaError as e:
+            close_frames(frames)
+            return web.json_response({"ok": False, "error": str(e)}, status=503)
+        resp = web.StreamResponse()
+        resp.content_type = (
+            "application/x-lstpu-kvmig2" if v2 else "application/x-ndjson"
+        )
+        resp.enable_chunked_encoding()
+        try:
+            await resp.prepare(request)
+            if v2:
+                wire_mod.count_wire_bytes(
+                    proto, len(wire_mod.KVMIG2_PREAMBLE)
+                )
+                await resp.write(wire_mod.KVMIG2_PREAMBLE)
+            frame = first
+            while frame is not None:
+                chunk = (
+                    wire_mod.encode_mig_frame(frame) if v2
+                    else _json.dumps(frame).encode("utf-8") + b"\n"
+                )
+                wire_mod.count_wire_bytes(proto, len(chunk))
+                await resp.write(chunk)
+                frame = await loop.run_in_executor(None, _next)
+        except (ConnectionResetError, ConnectionError, OSError) as e:
+            log.debug("fleet pages client disconnected: %s", e)
+            return resp
+        except (MigrationError, ReplicaError, wire_mod.WireError) as e:
+            log.warning("p2p page export died mid-stream: %s", e)
+            transport = request.transport
+            if transport is not None:
+                transport.abort()  # fetcher must read a dead wire
+            return resp
+        finally:
+            close_frames(frames)
+        try:
+            await resp.write_eof()
+        except (ConnectionResetError, ConnectionError, OSError):
+            pass
+        return resp
+
+    async def _fleet_fetch(self, request: web.Request) -> web.Response:
+        """Inbound P2P fetch command (§21): the router asks THIS replica
+        to pull the pages covering ``prompt_tokens`` from ``source``'s
+        ``POST /fleet/pages`` and bind them. Same ACK contract as
+        ``/fleet/migrate``: a failed fetch answers ``{"ok": false}`` with
+        HTTP 200 (the command transport worked) and the router degrades
+        to the cold path."""
+        import asyncio
+
+        from langstream_tpu.serving.fleet import (
+            ReplicaError,
+            local_p2p_fetch,
+        )
+        from langstream_tpu.serving.migrate import MigrationError
+
+        try:
+            payload = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="body must be JSON") from None
+        loop = asyncio.get_running_loop()
+        try:
+            ack = await loop.run_in_executor(None, local_p2p_fetch, payload)
         except MigrationError as e:
             return web.json_response({"ok": False, "error": str(e)})
         except ReplicaError as e:

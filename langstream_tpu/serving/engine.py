@@ -5561,6 +5561,20 @@ class ServingEngine:
             timeout_s,
         )
 
+    def migrate_limits(self) -> dict:
+        """Static pool geometry the migration RECEIVER uses to bound what
+        it will read off the wire (runtime/http_server.py §21): page-bytes
+        and total page count are fixed at pool construction, so any thread
+        may read them lock-free. Empty dict when this engine has no paged
+        pool (nothing can bind, so the receiver refuses early)."""
+        pool = getattr(self, "_pagepool", None)
+        if pool is None:
+            return {}
+        return {
+            "bytes_per_page": int(pool.bytes_per_page),
+            "pages_total": int(pool.num_pages),
+        }
+
     def _spec_admit(self, idx: int, prompt: list[int]) -> None:
         """Create the slot's draft index at admission, seeded with the
         prompt (prompt-lookup: the prompt is where repeated spans live).

@@ -322,6 +322,15 @@ class FaultInjector:
         mutated in place)."""
         if frame.get("kind") != "page" or not self.fires("migrate"):
             return False
+        raw = frame.get("raw")
+        if raw:
+            # v2 binary payload (serving/wire.py): flip the first raw
+            # byte — same bit-rot class, same checksum-must-catch-it
+            # contract as the base64 branch below
+            damaged = bytearray(raw)
+            damaged[0] ^= 0xFF
+            frame["raw"] = bytes(damaged)
+            return True
         data = frame.get("data") or []
         if not data or not data[0]:
             return False

@@ -331,14 +331,21 @@ def beacon_from_engine(
         # replicas at equal affinity, and operators see degradation
         # fleet-wide
         "brownout_level": int(stats.get("brownout-level", 0) or 0),
-        # wire capabilities (§18): what this replica's VERSION understands.
-        # "kvmig" = binds inbound KV-page migrations; "dfa-resume" =
-        # honors grammar-resume-state. The router refuses to migrate to —
+        # wire capabilities (§18/§21): what this replica's VERSION
+        # understands. "kvmig" = binds inbound KV-page migrations;
+        # "dfa-resume" = honors grammar-resume-state; "kvmig2"/"frames2"
+        # = speaks the v2 binary codecs (lstpu-kvmig-v2 /
+        # lstpu-frames-v2); "p2p" = serves and fetches pages
+        # peer-to-peer on radix miss. The router refuses to migrate to —
         # or resume a constrained stream on — a peer that does not
         # advertise the capability: a legacy peer would silently drop the
         # option and restart the DFA at state 0 (invalid output dressed
         # as valid), the exact class the §17 refusal existed to prevent.
-        "caps": ["kvmig", "dfa-resume"],
+        # Version negotiation for the binary wire rides this same field:
+        # senders emit v2 only toward peers that advertise it, so a
+        # mixed-version fleet keeps exchanging byte-identical v1 NDJSON
+        # with legacy members (rolling-upgrade safe).
+        "caps": ["kvmig", "kvmig2", "dfa-resume", "p2p", "frames2"],
     }
 
 
@@ -440,6 +447,9 @@ def register_local(
     migrate_bind_fn: Optional[Callable[..., dict]] = None,
     migrate_out_fn: Optional[Callable[[dict], dict]] = None,
     recovering_fn: Optional[Callable[[], bool]] = None,
+    migrate_pages_fn: Optional[Callable[[dict], Iterator[dict]]] = None,
+    p2p_fetch_fn: Optional[Callable[[dict], dict]] = None,
+    migrate_limits_fn: Optional[Callable[[], dict]] = None,
 ) -> None:
     """Expose this process's engine on the runtime HTTP server: ``GET
     /state`` serves ``beacon_fn``, ``POST /fleet/generate`` runs
@@ -449,7 +459,13 @@ def register_local(
     /fleet/reset`` runs ``reset_fn`` (bench warmup hygiene), ``POST
     /fleet/migrate`` binds an inbound KV-page migration through
     ``migrate_bind_fn`` and ``POST /fleet/migrate-out`` commands this
-    replica to push one through ``migrate_out_fn`` (docs/SERVING.md §18)."""
+    replica to push one through ``migrate_out_fn`` (docs/SERVING.md §18).
+    The §21 P2P surface: ``POST /fleet/pages`` serves migration frames
+    covering a prefix WITHOUT releasing them through
+    ``migrate_pages_fn`` (a fetch copies, a migration moves), ``POST
+    /fleet/fetch`` commands this replica to pull pages from a named
+    owner through ``p2p_fetch_fn``, and ``migrate_limits_fn`` reports
+    the pool geometry the migrate receiver uses to bound wire reads."""
     with _LOCAL_LOCK:
         _LOCAL[str(replica_id)] = {
             "beacon": beacon_fn, "generate": generate_fn, "reset": reset_fn,
@@ -457,6 +473,9 @@ def register_local(
             "migrate_bind": migrate_bind_fn,
             "migrate_out": migrate_out_fn,
             "recovering": recovering_fn,
+            "migrate_pages": migrate_pages_fn,
+            "p2p_fetch": p2p_fetch_fn,
+            "migrate_limits": migrate_limits_fn,
         }
 
 
@@ -572,6 +591,55 @@ def local_migrate_out(payload: dict) -> dict:
     return out(payload)
 
 
+def local_migrate_pages(payload: dict) -> Iterator[dict]:
+    """P2P page serve (the POST /fleet/pages body, §21): export migration
+    frames covering the deepest published prefix of ``prompt_tokens``
+    WITHOUT releasing anything — the owner keeps its copy. Pre-stream
+    failures (no engine, no published prefix) raise here so the HTTP
+    layer can still answer a JSON error instead of a broken stream."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            raise ReplicaError("no serving engine registered in this process")
+        fns = next(iter(_LOCAL.values()))
+    pages = fns.get("migrate_pages")
+    if pages is None:
+        raise ReplicaError("registered engine does not serve P2P page fetch")
+    return pages(payload)
+
+
+def local_p2p_fetch(payload: dict) -> dict:
+    """Inbound P2P fetch command (the POST /fleet/fetch body, §21): this
+    process's engine pulls pages from the ``source`` peer and admits the
+    prefix warm. Blocking — the HTTP server runs it in an executor."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            raise ReplicaError("no serving engine registered in this process")
+        fns = next(iter(_LOCAL.values()))
+    fetch = fns.get("p2p_fetch")
+    if fetch is None:
+        raise ReplicaError("registered engine does not serve P2P page fetch")
+    return fetch(payload)
+
+
+def local_migrate_limits() -> dict:
+    """Static pool geometry for the migrate receiver's wire bounds (§21
+    hardening): ``{"bytes_per_page", "pages_total"}``, or ``{}`` when no
+    engine (or a non-paged one) is registered — the receiver then falls
+    back to flat caps."""
+    with _LOCAL_LOCK:
+        if not _LOCAL:
+            return {}
+        fns = next(iter(_LOCAL.values()))
+    limits = fns.get("migrate_limits")
+    if limits is None:
+        return {}
+    try:
+        return dict(limits() or {})
+    except Exception:  # noqa: BLE001 — bounds probe must not kill a bind
+        log.exception("migrate limits probe failed")
+        return {}
+
+
 def engine_migrate_bind(
     engine: Any, frames: Iterator[dict], timeout_s: float = 30.0,
 ) -> dict:
@@ -597,17 +665,60 @@ def engine_migrate_out(engine: Any, payload: dict) -> dict:
     if not dest:
         raise ValueError("migrate-out payload carries no dest url")
     timeout_s = float(payload.get("timeout-s") or 30.0)
+    wire = "v2" if payload.get("wire") == "v2" else "v1"
     phases: dict[str, Any] = {}
     frames = migrate_mod.export_frames(
         engine, tokens, timeout_s=timeout_s,
         state=payload.get("state") or {}, phases=phases,
+        raw=wire == "v2",
     )
     t0 = time.monotonic()
-    ack = migrate_mod.push_migration(dest, frames, timeout_s)
+    ack = migrate_mod.push_migration(dest, frames, timeout_s, wire=wire)
     phases["transfer_ms"] = round((time.monotonic() - t0) * 1e3, 3)
     migrate_mod._release_on_ack(engine, tokens, ack)  # noqa: SLF001
     ack["phases"] = dict(phases, **(ack.get("phases") or {}))
     return ack
+
+
+def engine_migrate_pages(engine: Any, payload: dict) -> Iterator[dict]:
+    """The canonical ``migrate_pages_fn`` for ``register_local``: export
+    the prefix covering ``prompt_tokens`` for a P2P fetch (§21) — same
+    frames as a migration but the owner RELEASES NOTHING; the fetcher
+    gets a copy and both replicas keep serving the prefix. ``wire: v2``
+    asks for raw leaf-byte payloads (the binary codec's data plane);
+    hibernated entries ship straight from the host arena either way."""
+    from langstream_tpu.serving import migrate as migrate_mod
+
+    tokens = [int(t) for t in payload.get("prompt_tokens") or []]
+    if not tokens:
+        raise ValueError("page-fetch payload carries no prompt_tokens")
+    return migrate_mod.export_frames(
+        engine, tokens,
+        timeout_s=float(payload.get("timeout-s") or 30.0),
+        raw=payload.get("wire") == "v2",
+    )
+
+
+def engine_p2p_fetch(engine: Any, payload: dict) -> dict:
+    """The canonical ``p2p_fetch_fn`` for ``register_local``: pull the
+    prefix covering ``prompt_tokens`` from the ``source`` peer's ``POST
+    /fleet/pages`` and bind it into the local engine (§21). Failures
+    propagate as MigrationError — the commanding router degrades to the
+    local cold path; nothing here retries."""
+    from langstream_tpu.serving import migrate as migrate_mod
+
+    tokens = [int(t) for t in payload.get("prompt_tokens") or []]
+    if not tokens:
+        raise ValueError("p2p-fetch payload carries no prompt_tokens")
+    source = str(payload.get("source") or "")
+    if not source:
+        raise ValueError("p2p-fetch payload carries no source url")
+    timeout_s = float(payload.get("timeout-s") or 30.0)
+    frames = migrate_mod.fetch_pages(
+        source, tokens, timeout_s,
+        wire="v2" if payload.get("wire") == "v2" else "v1",
+    )
+    return migrate_mod.bind_frames(engine, frames, timeout_s=timeout_s)
 
 
 def local_reset() -> None:
@@ -957,6 +1068,11 @@ class HttpReplica:
         # the router's warm failover takes over. The request's deadline
         # (when tighter) bounds the whole hop regardless.
         self.stream_idle_timeout_s = float(stream_idle_timeout_s)
+        # wire capabilities from the peer's last beacon (§21 negotiation):
+        # dispatch asks for the v2 binary stream only once the peer has
+        # PROVEN it speaks it — before the first beacon lands (or toward
+        # a legacy peer) every hop stays v1 NDJSON
+        self.caps: frozenset = frozenset()
 
     def _get(self, path: str, timeout_s: float) -> dict[str, Any]:
         with urllib.request.urlopen(self.url + path, timeout=timeout_s) as r:
@@ -985,8 +1101,12 @@ class HttpReplica:
         replicas = doc.get("replicas") or []
         for b in replicas:
             if b.get("id") == self.replica_id:
+                self.caps = frozenset(str(c) for c in b.get("caps") or ())
                 return b
         if replicas:
+            self.caps = frozenset(
+                str(c) for c in replicas[0].get("caps") or ()
+            )
             return replicas[0]
         raise ReplicaError(f"replica {self.replica_id}: empty /state")
 
@@ -1049,13 +1169,20 @@ class HttpReplica:
         # and then every individual recv — exactly the per-read bound we
         # want between frames
         read_timeout = max(0.1, min(total_s, idle_s))
-        body = json.dumps({
+        payload: dict[str, Any] = {
             "prompt_tokens": list(map(int, tokens)),
             "options": options,
             "stream": True,
             # ask the peer to heartbeat well inside our idle timeout
             "heartbeat-s": round(max(0.05, read_timeout / 4.0), 3),
-        }).encode("utf-8")
+        }
+        if "frames2" in self.caps:
+            # §21 negotiation: the peer's beacon advertised the binary
+            # token-stream codec — ask for it; its answer's Content-Type
+            # is authoritative (a restarted-as-v1 peer still answers
+            # NDJSON and the hop just reads v1)
+            payload["wire"] = "v2"
+        body = json.dumps(payload).encode("utf-8")
         req = urllib.request.Request(
             self.url + "/fleet/generate", data=body,
             headers={"Content-Type": "application/json"}, method="POST",
@@ -1089,6 +1216,15 @@ class HttpReplica:
         except (urllib.error.URLError, OSError, ValueError) as e:
             raise ReplicaError(f"replica {self.replica_id}: {e}") from e
         self._tighten_read_timeout(resp, read_timeout)
+        ctype = str(resp.headers.get("Content-Type") or "")
+        if "lstpu-frames2" in ctype:
+            try:
+                with resp:
+                    yield from self._v2_frames(resp, hard_stop, total_s)
+            except GeneratorExit:
+                resp.close()
+                raise
+            return
         expected_seq = 0
         try:
             with resp:
@@ -1188,15 +1324,80 @@ class HttpReplica:
             resp.close()
             raise
 
+    def _v2_frames(
+        self, resp: Any, hard_stop: float, total_s: float,
+    ) -> Iterator[dict]:
+        """Read one ``lstpu-frames-v2`` binary stream body (§21) and yield
+        the same validated §17 frame dicts the NDJSON path yields — seq
+        contiguity, error→ReplicaError, terminal-frame-required and the
+        hop budget all enforced identically; only the bytes differ. Any
+        codec violation (truncated prelude, CRC mismatch, bad magic) is a
+        dead hop: ReplicaError, the router's failover signal, never a
+        hang (the socket timeout bounds every read underneath)."""
+        from langstream_tpu.serving import wire as wire_mod
+
+        def read(n: int) -> bytes:
+            try:
+                return resp.read(n)
+            except (OSError, http.client.HTTPException, ValueError) as e:
+                raise ReplicaError(
+                    f"replica {self.replica_id}: stream read failed "
+                    f"({e or type(e).__name__})"
+                ) from e
+
+        expected_seq = 0
+        ended = False
+        try:
+            preamble = wire_mod.read_exact(
+                read, len(wire_mod.FRAMES2_PREAMBLE)
+            )
+            if preamble != wire_mod.FRAMES2_PREAMBLE:
+                raise wire_mod.WireError(
+                    f"bad frames2 preamble {preamble!r}"
+                )
+            for frame in wire_mod.decode_stream_frames(read):
+                if time.monotonic() >= hard_stop:
+                    raise ReplicaError(
+                        f"replica {self.replica_id}: hop budget "
+                        f"({total_s:.1f}s) exhausted mid-stream"
+                    )
+                if frame.get("seq") != expected_seq:
+                    raise ReplicaError(
+                        f"replica {self.replica_id}: stream sequence "
+                        f"broken (got {frame.get('seq')!r}, "
+                        f"want {expected_seq})"
+                    )
+                expected_seq += 1
+                kind = frame.get("kind")
+                if kind == "error":
+                    raise ReplicaError(
+                        f"replica {self.replica_id}: {frame.get('error')}"
+                    )
+                yield frame
+                if kind == "end":
+                    ended = True
+                    break
+        except wire_mod.WireError as e:
+            raise ReplicaError(
+                f"replica {self.replica_id}: corrupt v2 stream ({e})"
+            ) from e
+        if not ended:
+            raise ReplicaError(
+                f"replica {self.replica_id}: stream closed before "
+                "terminal frame"
+            )
+
     def migrate_out(
         self, tokens, dest_url: str, state: Optional[dict],
-        timeout_s: float,
+        timeout_s: float, wire: str = "v1",
     ) -> dict:
         """Command this (remote) replica to push a KV-page migration to
-        ``dest_url``'s ``POST /fleet/migrate`` (§18). Returns the
-        receiver's ACK as relayed by the source. Failures raise
-        MigrationError — the source retains its pages (it frees only on
-        the ACK it relays here)."""
+        ``dest_url``'s ``POST /fleet/migrate`` (§18). ``wire="v2"`` asks
+        the source to ship the binary codec — set only when the DEST
+        advertises ``kvmig2`` (the source falls back to v1 if its own
+        version predates the key). Returns the receiver's ACK as relayed
+        by the source. Failures raise MigrationError — the source retains
+        its pages (it frees only on the ACK it relays here)."""
         from langstream_tpu.serving.migrate import MigrationError
 
         body = json.dumps({
@@ -1204,6 +1405,7 @@ class HttpReplica:
             "dest": str(dest_url),
             "state": dict(state or {}),
             "timeout-s": float(timeout_s),
+            "wire": "v2" if wire == "v2" else "v1",
         }).encode("utf-8")
         req = urllib.request.Request(
             self.url + "/fleet/migrate-out", data=body,
@@ -1221,6 +1423,42 @@ class HttpReplica:
         if not ack.get("ok"):
             raise MigrationError(
                 f"replica {self.replica_id} migrate-out rejected: "
+                f"{ack.get('error')!r}"
+            )
+        return ack
+
+    def p2p_fetch(
+        self, tokens, source_url: str, timeout_s: float, wire: str = "v1",
+    ) -> dict:
+        """Command this (remote) replica to pull the pages covering
+        ``tokens`` from ``source_url``'s ``POST /fleet/pages`` and bind
+        them (§21). Returns the bind ACK. Failures raise MigrationError —
+        the commanding router falls back to the cold path; the owner
+        never released anything (a fetch copies)."""
+        from langstream_tpu.serving.migrate import MigrationError
+
+        body = json.dumps({
+            "prompt_tokens": [int(t) for t in tokens],
+            "source": str(source_url),
+            "timeout-s": float(timeout_s),
+            "wire": "v2" if wire == "v2" else "v1",
+        }).encode("utf-8")
+        req = urllib.request.Request(
+            self.url + "/fleet/fetch", data=body,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=max(0.1, float(timeout_s) + 2.0)
+            ) as r:
+                ack = json.loads(r.read().decode("utf-8"))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            raise MigrationError(
+                f"replica {self.replica_id} p2p fetch failed: {e}"
+            ) from e
+        if not ack.get("ok"):
+            raise MigrationError(
+                f"replica {self.replica_id} p2p fetch rejected: "
                 f"{ack.get('error')!r}"
             )
         return ack
@@ -1291,6 +1529,13 @@ class RouteDecision:
     # completions fast path must NOT short-circuit such a route even when
     # it is local (the router owns the orchestration)
     disagg: bool = False
+    # P2P page fetch hint (§21): the live peer whose advertised prefix
+    # beats this replica's own match by ≥ p2p_threshold tokens — the
+    # router pulls the pages from it before dispatch so the prefix admits
+    # warm; None when nobody qualifies. Best-effort: every fetch failure
+    # degrades to the local cold path.
+    p2p_source: Optional[str] = None
+    p2p_match: int = 0
 
 
 class FleetRouter:
@@ -1325,6 +1570,8 @@ class FleetRouter:
         prefill_route_threshold: int = 2048,
         migrate: bool = True,
         migrate_timeout_s: float = 30.0,
+        p2p: bool = True,
+        p2p_threshold: int = 256,
     ) -> None:
         if policy not in self.POLICIES:
             raise ValueError(
@@ -1375,6 +1622,16 @@ class FleetRouter:
         self.prefill_route_threshold = max(1, int(prefill_route_threshold))
         self.migrate_enabled = bool(migrate)
         self.migrate_timeout_s = float(migrate_timeout_s)
+        # peer-to-peer page fetch on radix miss (§21, ROADMAP 2a): when
+        # the chosen replica's own best match trails another live peer's
+        # advertised (resident or spilled) prefix by at least
+        # p2p_threshold tokens, the router commands a page fetch from the
+        # owner over the migration wire before dispatch — the prefix
+        # admits warm instead of re-prefilling, and every failure
+        # (checksum, net-cut, deadline, no capable peer) degrades to the
+        # local cold path. Both sides must advertise the "p2p" cap.
+        self.p2p_enabled = bool(p2p)
+        self.p2p_threshold = max(1, int(p2p_threshold))
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -1418,6 +1675,12 @@ class FleetRouter:
         self.migrate_pages_total = 0
         self.migrate_bytes_total = 0
         self.migrate_fallbacks_total = 0
+        # P2P page fetch (§21): completed fetches (with bytes pulled in,
+        # by receiver ACK) and fallbacks — a failed fetch costs one
+        # counter bump and a flight dump, then the request prefills cold
+        self.p2p_fetch_total = 0
+        self.p2p_fetch_fallback_total = 0
+        self.p2p_bytes_in_total = 0
         self._hist_lock = threading.Lock()
         self.dispatch_hist = Histogram(
             "fleet_dispatch_s",
@@ -1772,7 +2035,7 @@ class FleetRouter:
                 }
             )
             probe = {n: prefix_digest(tokens[:n]) for n in lengths}
-            scored: list[tuple[_ReplicaState, int, bool]] = []
+            scored: list[tuple[_ReplicaState, int, bool, int]] = []
             for s in live:
                 match, spilled_match = 0, 0
                 for n in lengths:
@@ -1790,7 +2053,11 @@ class FleetRouter:
                     match, int(spilled_match * self.spill_discount)
                 )
                 adapter_hit = bool(adapter) and adapter in s.adapters
-                scored.append((s, effective, adapter_hit))
+                # the UNDISCOUNTED depth this replica can SERVE pages for
+                # (resident or hibernated — a P2P fetch reads the host
+                # arena either way, §21): the owner-selection signal
+                raw = max(match, spilled_match)
+                scored.append((s, effective, adapter_hit, raw))
             # role-aware candidate set (disaggregated serving, §18): with
             # BOTH roles routable, a prefill-heavy admission (estimated
             # prefill = prompt minus the best warm match anywhere) lands
@@ -1806,7 +2073,7 @@ class FleetRouter:
                 t for t in scored if t[0].role in ("decode", "mixed")
             ]
             if prefill_pool and decode_pool:
-                best_anywhere = max(m for _, m, _ in scored)
+                best_anywhere = max(m for _, m, _, _ in scored)
                 est_prefill = len(tokens) - best_anywhere
                 if est_prefill >= self.prefill_route_threshold:
                     candidates = prefill_pool
@@ -1818,9 +2085,10 @@ class FleetRouter:
             # no role split (prefill-only or decode/mixed-only fleets):
             # candidates stays the full scored set
             best, best_score, best_match = None, None, 0
+            best_raw = 0
             best_adapter_hit = False
             best_tenant_hit = False
-            for s, effective, adapter_hit in candidates:
+            for s, effective, adapter_hit, raw in candidates:
                 # tenant pressure affinity (§19): a tenant with queued
                 # work on a replica scores a bonus THERE — the burster's
                 # overflow concentrates where its backlog (and its sheds)
@@ -1842,6 +2110,7 @@ class FleetRouter:
                 )
                 if best_score is None or score > best_score:
                     best, best_score, best_match = s, score, effective
+                    best_raw = raw
                     best_adapter_hit = adapter_hit
                     best_tenant_hit = tenant_hit
             assert best is not None
@@ -1860,8 +2129,35 @@ class FleetRouter:
                 # everyone, since score reduces to −λ·load)
                 self.routed_balanced_total += 1
                 kind = "balanced"
+            # P2P page fetch hint (§21, ROADMAP 2a): the chosen replica's
+            # trie misses (or matches shallow) while another LIVE peer
+            # advertises the prefix ≥ p2p_threshold tokens deeper — pull
+            # the pages from that owner over the migration wire before
+            # dispatch and admit warm instead of re-prefilling. Both the
+            # owner (serves /fleet/pages) and the destination (binds and,
+            # when remote, runs the fetch) must advertise "p2p"; the
+            # disaggregated prefill handoff keeps its own migration path.
+            p2p_source, p2p_match = None, 0
+            if (
+                self.p2p_enabled
+                and kind_override is None
+                and "p2p" in best.caps
+            ):
+                owner, owner_raw = None, 0
+                for s, _, _, raw in scored:
+                    if s is best or "p2p" not in s.caps:
+                        continue
+                    if raw > owner_raw:
+                        owner, owner_raw = s, raw
+                if (
+                    owner is not None
+                    and owner_raw - best_raw >= self.p2p_threshold
+                ):
+                    p2p_source = owner.handle.replica_id
+                    p2p_match = owner_raw
             return self._decide(
-                best, kind, best_match, pin_session, now, disagg=disagg
+                best, kind, best_match, pin_session, now, disagg=disagg,
+                p2p_source=p2p_source, p2p_match=p2p_match,
             )
 
     def _decide(
@@ -1872,6 +2168,8 @@ class FleetRouter:
         session_id: Optional[str],
         now: float,
         disagg: bool = False,
+        p2p_source: Optional[str] = None,
+        p2p_match: int = 0,
     ) -> RouteDecision:
         rid = state.handle.replica_id
         if session_id:
@@ -1883,6 +2181,8 @@ class FleetRouter:
             expected_match=match,
             score=match - self.lam * self._load(state.beacon),
             disagg=disagg,
+            p2p_source=p2p_source,
+            p2p_match=p2p_match,
         )
 
     def _prune_sticky(self, now: float) -> None:
@@ -2033,24 +2333,36 @@ class FleetRouter:
         t0 = time.perf_counter()
         phases: dict[str, Any] = {}
         try:
+            # wire negotiation (§21): push the binary codec only toward a
+            # receiver that advertises it — everything else stays v1
+            # NDJSON, byte-identical to the pre-v2 wire
+            wire = (
+                "v2" if self._has_cap(dst.replica_id, "kvmig2") else "v1"
+            )
             if getattr(src.handle, "is_local", False):
                 from langstream_tpu.serving import migrate as migrate_mod
 
-                frames = migrate_mod.export_frames(
-                    src.handle.engine, prompt,
-                    timeout_s=self.migrate_timeout_s,
-                    state=state, phases=phases,
-                )
                 if getattr(dst.handle, "is_local", False):
+                    frames = migrate_mod.export_frames(
+                        src.handle.engine, prompt,
+                        timeout_s=self.migrate_timeout_s,
+                        state=state, phases=phases,
+                    )
                     ack = migrate_mod.bind_frames(
                         dst.handle.engine, frames,
                         timeout_s=self.migrate_timeout_s,
                     )
                 else:
+                    frames = migrate_mod.export_frames(
+                        src.handle.engine, prompt,
+                        timeout_s=self.migrate_timeout_s,
+                        state=state, phases=phases,
+                        raw=wire == "v2",
+                    )
                     t1 = time.perf_counter()
                     ack = migrate_mod.push_migration(
                         str(getattr(dst.handle, "url", "")), frames,
-                        self.migrate_timeout_s,
+                        self.migrate_timeout_s, wire=wire,
                     )
                     phases["transfer_ms"] = round(
                         (time.perf_counter() - t1) * 1e3, 3
@@ -2067,9 +2379,22 @@ class FleetRouter:
                         "destination (no migrate-out transport / non-HTTP "
                         "receiver)"
                     )
-                ack = migrate_out(
-                    prompt, dst_url, state, self.migrate_timeout_s
-                )
+                if wire == "v2":
+                    try:
+                        ack = migrate_out(
+                            prompt, dst_url, state,
+                            self.migrate_timeout_s, wire="v2",
+                        )
+                    except TypeError:
+                        # a pre-v2 source handle: its NDJSON push is
+                        # still valid toward a v2 receiver
+                        ack = migrate_out(
+                            prompt, dst_url, state, self.migrate_timeout_s
+                        )
+                else:
+                    ack = migrate_out(
+                        prompt, dst_url, state, self.migrate_timeout_s
+                    )
                 phases.update(ack.get("phases") or {})
             took = time.perf_counter() - t0
             with self._hist_lock:
@@ -2111,6 +2436,90 @@ class FleetRouter:
                 src.replica_id, dst.replica_id, took * 1e3, e,
             )
             return None
+
+    def _p2p_fetch(self, decision: RouteDecision, prompt: list) -> bool:
+        """Pull the pages backing ``prompt``'s prefix from the owning
+        peer (``decision.p2p_source``) into the routed replica BEFORE
+        dispatch (§21, ROADMAP 2a) — the owner keeps its copy (a fetch
+        copies, a migration moves) and the routed replica admits warm
+        instead of re-prefilling. Returns True when the prefix bound;
+        EVERY failure — checksum mismatch, net-cut, deadline, owner gone,
+        no transport — counts one fallback, dumps a flight record and
+        returns False: the request then prefills cold exactly as if no
+        owner existed (same §17 ladder shape as a failed migration)."""
+        from langstream_tpu.serving import migrate as migrate_mod
+
+        src_id = str(decision.p2p_source)
+        with self._lock:
+            src_state = self._replicas.get(src_id)
+        t0 = time.perf_counter()
+        try:
+            if src_state is None:
+                raise migrate_mod.MigrationError(
+                    f"p2p owner {src_id} is not a fleet member"
+                )
+            src = src_state.handle
+            # codec negotiation rides the OWNER's caps here — it is the
+            # sender of the page bytes
+            wire = "v2" if "kvmig2" in src_state.caps else "v1"
+            timeout_s = self.migrate_timeout_s
+            if getattr(decision.handle, "is_local", False):
+                if getattr(src, "is_local", False):
+                    frames = migrate_mod.export_frames(
+                        src.engine, prompt, timeout_s=timeout_s,
+                    )
+                else:
+                    src_url = str(getattr(src, "url", "") or "")
+                    if not src_url.startswith("http"):
+                        raise migrate_mod.MigrationError(
+                            f"p2p owner {src_id} has no page-fetch "
+                            "transport"
+                        )
+                    frames = migrate_mod.fetch_pages(
+                        src_url, prompt, timeout_s, wire=wire
+                    )
+                ack = migrate_mod.bind_frames(
+                    decision.handle.engine, frames, timeout_s=timeout_s
+                )
+            else:
+                fetch = getattr(decision.handle, "p2p_fetch", None)
+                src_url = str(getattr(src, "url", "") or "")
+                if fetch is None or not src_url.startswith("http"):
+                    raise migrate_mod.MigrationError(
+                        "routed replica cannot run a p2p fetch "
+                        "(no transport)"
+                    )
+                ack = fetch(prompt, src_url, timeout_s, wire=wire)
+            with self._lock:
+                self.p2p_fetch_total += 1
+                self.p2p_bytes_in_total += int(ack.get("bytes", 0))
+            log.info(
+                "p2p fetched %s pages (%s bytes) %s → %s in %.1f ms",
+                ack.get("pages"), ack.get("bytes"), src_id,
+                decision.replica_id, (time.perf_counter() - t0) * 1e3,
+            )
+            return True
+        except Exception as e:  # noqa: BLE001 — every failure falls back
+            with self._lock:
+                self.p2p_fetch_fallback_total += 1
+                fallbacks = self.p2p_fetch_fallback_total
+            self._flight.dump(
+                "p2p-fetch-failed",
+                counters={"p2p_fetch_fallback_total": fallbacks},
+                extra={
+                    "error": str(e), "src": src_id,
+                    "dst": decision.replica_id,
+                    "match": int(decision.p2p_match),
+                    "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                    "fallback": "local-cold-prefill",
+                },
+                force=True,
+            )
+            log.warning(
+                "p2p page fetch %s → %s failed (%s); prefilling cold",
+                src_id, decision.replica_id, e,
+            )
+            return False
 
     def stream_generate(
         self,
@@ -2224,6 +2633,16 @@ class FleetRouter:
                     # (the all-attempts exit below).
                     excluded.add(decision.replica_id)
                     continue
+            # P2P page fetch (§21): the route says another live peer owns
+            # this prompt's prefix ≥ p2p_threshold tokens deeper than the
+            # chosen replica — pull the pages over the migration wire
+            # BEFORE dispatch so the prefill below starts warm. First hop
+            # only (a resume's prefix already lives where it streamed),
+            # and strictly best-effort: a failed fetch costs one counter
+            # bump + flight dump inside _p2p_fetch, then this same hop
+            # prefills cold.
+            if decision.p2p_source and not delivered:
+                self._p2p_fetch(decision, prompt)
             # prefill handoff (§18): run prefill + the FIRST token on the
             # prefill-tagged replica (TTFT comes from there), then migrate
             # the KV pages to a decode replica and finish the stream where
@@ -2633,6 +3052,11 @@ class FleetRouter:
                 "fleet-migrate-pages-total": self.migrate_pages_total,
                 "fleet-migrate-bytes-total": self.migrate_bytes_total,
                 "fleet-migrate-fallbacks-total": self.migrate_fallbacks_total,
+                "fleet-p2p-fetch-total": self.p2p_fetch_total,
+                "fleet-p2p-fetch-fallback-total": (
+                    self.p2p_fetch_fallback_total
+                ),
+                "fleet-p2p-bytes-in-total": self.p2p_bytes_in_total,
                 "fleet-roles": {
                     role: sum(
                         1 for s in self._replicas.values() if s.role == role
@@ -2672,6 +3096,14 @@ class FleetRouter:
         out["fleet-desired-replicas-by-role"] = (
             self.desired_replicas_by_role()
         )
+        # process-wide wire byte accounting by protocol (§21): counted at
+        # each SENDING site in serving/wire-aware code paths — the
+        # v1-vs-v2 overhead panel's raw series
+        from langstream_tpu.serving import wire as wire_mod
+
+        wb = wire_mod.wire_stats()
+        out["fleet-wire-bytes-v1-total"] = int(wb.get("v1", 0))
+        out["fleet-wire-bytes-v2-total"] = int(wb.get("v2", 0))
         return out
 
 

@@ -70,6 +70,7 @@ def export_frames(
     timeout_s: float = 30.0,
     state: Optional[dict] = None,
     phases: Optional[dict] = None,
+    raw: bool = False,
 ) -> Iterator[dict]:
     """Serialize the deepest published prefix covering ``tokens`` into
     migration frames. The snapshot happens EAGERLY (before the first
@@ -78,8 +79,14 @@ def export_frames(
     half-sent stream. The wire injector's ``migrate`` site corrupts one
     page payload in flight; ``net-cut`` aborts between frames —
     both leave the sender's copy intact (release happens only on ACK,
-    outside this generator)."""
+    outside this generator).
+
+    ``raw=True`` ships page payloads as one contiguous native-width byte
+    field per frame (``raw``, the lstpu-kvmig-v2 data plane — no base64
+    tax) instead of the v1 ``data`` base64 list; checksums and frame
+    discipline are identical either way."""
     from langstream_tpu.serving.fleet import wire_injector
+    from langstream_tpu.serving.pagepool import join_page_bytes
 
     tokens = [int(t) for t in tokens]
     t0 = time.monotonic()
@@ -110,9 +117,12 @@ def export_frames(
                 )
             frame = {
                 "seq": i + 1, "kind": "page", "i": i,
-                "data": [_b64(leaf) for leaf in leaves],
                 "checksum": checksum.hex(),
             }
+            if raw:
+                frame["raw"] = join_page_bytes(leaves)
+            else:
+                frame["data"] = [_b64(leaf) for leaf in leaves]
             if injector is not None:
                 injector.corrupt_migration_frame(frame)
             yield frame
@@ -143,8 +153,14 @@ def bind_frames(
     pages into ``engine``'s pool and prefix index. ALL verification
     happens before anything is allocated — a cut stream, a corrupt
     payload, or a checksum mismatch aborts with the receiver's free list
-    untouched. Returns the ACK dict the sender frees against."""
-    from langstream_tpu.serving.pagepool import page_checksum
+    untouched. Returns the ACK dict the sender frees against.
+
+    Accepts BOTH codecs' frame dicts: v1 pages carry a base64 ``data``
+    list, v2 pages one contiguous ``raw`` byte field split against this
+    pool's leaf layout — checksum discipline is identical either way
+    (the §17/§18 chaos semantics hold on both wires)."""
+    from langstream_tpu.serving.pagepool import page_checksum, split_page_bytes
+    from langstream_tpu.serving.wire import MIG_SCHEMA_V2
 
     deadline = time.monotonic() + max(0.05, timeout_s)
     t0 = time.monotonic()
@@ -168,7 +184,7 @@ def bind_frames(
             expected_seq += 1
             kind = frame.get("kind")
             if kind == "begin":
-                if frame.get("v") != MIG_SCHEMA:
+                if frame.get("v") not in (MIG_SCHEMA, MIG_SCHEMA_V2):
                     raise MigrationError(
                         f"unknown migration schema {frame.get('v')!r}"
                     )
@@ -179,12 +195,15 @@ def bind_frames(
                     raise MigrationError("page frame before begin")
                 page = []
                 try:
-                    for (shape, dtype), b64 in zip(
-                        specs, frame.get("data") or []
-                    ):
-                        raw = base64.b64decode(b64, validate=True)
-                        arr = np.frombuffer(raw, dtype=dtype)
-                        page.append(arr.reshape(shape))
+                    if frame.get("raw") is not None:
+                        page = split_page_bytes(bytes(frame["raw"]), specs)
+                    else:
+                        for (shape, dtype), b64 in zip(
+                            specs, frame.get("data") or []
+                        ):
+                            raw = base64.b64decode(b64, validate=True)
+                            arr = np.frombuffer(raw, dtype=dtype)
+                            page.append(arr.reshape(shape))
                     want = bytes.fromhex(str(frame.get("checksum") or ""))
                 except (ValueError, TypeError) as e:
                     raise MigrationError(
@@ -273,22 +292,45 @@ def _release_on_ack(src_engine: Any, tokens, ack: dict) -> None:
         log.warning("post-ACK migration release failed (retained): %s", e)
 
 
-def push_migration(url: str, frames: Iterator[dict], timeout_s: float) -> dict:
+def push_migration(
+    url: str, frames: Iterator[dict], timeout_s: float, wire: str = "v1",
+) -> dict:
     """HTTP sender: POST the frame stream chunked to the receiver's
     ``POST /fleet/migrate`` and return its ACK. Any transport failure —
     refused connect, reset mid-body, non-JSON ACK — is a MigrationError;
-    the caller's release-on-ACK discipline keeps the sender's copy."""
+    the caller's release-on-ACK discipline keeps the sender's copy.
+
+    ``wire`` picks the codec: ``"v1"`` ships the frames as NDJSON lines
+    (byte-identical to the pre-v2 wire — the legacy-peer fallback),
+    ``"v2"`` ships the lstpu-kvmig-v2 binary body (preamble + framed
+    records; pair with ``export_frames(raw=True)`` so page payloads skip
+    the base64 round-trip entirely). The caller negotiates via the
+    receiver's ``kvmig2`` beacon cap (docs/SERVING.md §21)."""
     import http.client
     import urllib.parse
+
+    from langstream_tpu.serving import wire as wire_mod
 
     u = urllib.parse.urlsplit(url)
     if u.scheme != "http" or not u.hostname:
         raise MigrationError(f"unsupported migration receiver url {url!r}")
+    v2 = wire == "v2"
 
     def body() -> Iterator[bytes]:
+        if v2:
+            wire_mod.count_wire_bytes("v2", len(wire_mod.KVMIG2_PREAMBLE))
+            yield wire_mod.KVMIG2_PREAMBLE
         for frame in frames:
-            yield (json.dumps(frame) + "\n").encode("utf-8")
+            if v2:
+                chunk = wire_mod.encode_mig_frame(frame)
+            else:
+                chunk = (json.dumps(frame) + "\n").encode("utf-8")
+            wire_mod.count_wire_bytes("v2" if v2 else "v1", len(chunk))
+            yield chunk
 
+    content_type = (
+        "application/x-lstpu-kvmig2" if v2 else "application/x-ndjson"
+    )
     conn = http.client.HTTPConnection(
         u.hostname, u.port or 80, timeout=max(0.05, timeout_s)
     )
@@ -302,7 +344,7 @@ def push_migration(url: str, frames: Iterator[dict], timeout_s: float) -> dict:
                 "POST",
                 f"/fleet/migrate?timeout-s={max(0.05, timeout_s):.3f}",
                 body=body(),
-                headers={"Content-Type": "application/x-ndjson"},
+                headers={"Content-Type": content_type},
                 encode_chunked=True,
             )
             resp = conn.getresponse()
@@ -336,3 +378,104 @@ def push_migration(url: str, frames: Iterator[dict], timeout_s: float) -> dict:
                 close()
             except Exception:  # noqa: BLE001
                 log.exception("migration frame close failed")
+
+
+def fetch_pages(
+    url: str, tokens, timeout_s: float, wire: str = "v2",
+) -> Iterator[dict]:
+    """Peer-to-peer page fetch client (ROADMAP 2a, docs/SERVING.md §21):
+    POST the owning peer's ``/fleet/pages`` and return an iterator of
+    migration frames covering the deepest published prefix of ``tokens``
+    — the same frames ``bind_frames`` consumes, so the fetch admits warm
+    through the one checksum-verified bind path. The owner KEEPS its
+    pages (a fetch copies; only a migration moves).
+
+    ``wire`` asks for the codec (``"v2"`` binary when the owner
+    advertises ``kvmig2``, ``"v1"`` NDJSON otherwise); the response's
+    content type is authoritative. A pre-stream failure on the owner (no
+    published prefix, dead engine) answers a JSON error body — raised
+    here as MigrationError, like every transport/codec failure, so the
+    caller's ladder degrades to the local cold path."""
+    import http.client
+    import urllib.parse
+
+    from langstream_tpu.serving import wire as wire_mod
+
+    u = urllib.parse.urlsplit(url)
+    if u.scheme != "http" or not u.hostname:
+        raise MigrationError(f"unsupported page-fetch source url {url!r}")
+    body = json.dumps({
+        "prompt_tokens": [int(t) for t in tokens],
+        "timeout-s": max(0.05, float(timeout_s)),
+        "wire": "v2" if wire == "v2" else "v1",
+    }).encode("utf-8")
+    conn = http.client.HTTPConnection(
+        u.hostname, u.port or 80, timeout=max(0.05, timeout_s)
+    )
+    try:
+        conn.request(
+            "POST", "/fleet/pages", body=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+    except Exception as e:  # noqa: BLE001 — one verdict: no pages fetched
+        conn.close()
+        raise MigrationError(f"page fetch from {url} failed: {e}") from e
+    ctype = str(resp.getheader("Content-Type") or "")
+    if resp.status != 200 or "json" in ctype:
+        # pre-stream refusal: the owner answered a JSON error document
+        # instead of committing to a frame stream
+        try:
+            raw = resp.read()
+        finally:
+            conn.close()
+        if resp.status != 200:
+            raise MigrationError(
+                f"page-fetch source {url} answered HTTP {resp.status}: "
+                f"{raw[:200]!r}"
+            )
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+        except ValueError:
+            doc = {}
+        raise MigrationError(
+            f"page-fetch source {url} refused: {doc.get('error')!r}"
+        )
+
+    def frames() -> Iterator[dict]:
+        try:
+            if "lstpu-kvmig2" in ctype:
+                preamble = wire_mod.read_exact(
+                    resp.read, len(wire_mod.KVMIG2_PREAMBLE)
+                )
+                if preamble != wire_mod.KVMIG2_PREAMBLE:
+                    raise wire_mod.WireError(
+                        f"bad kvmig2 preamble {preamble!r}"
+                    )
+                # page payloads from the wire are bounded like the
+                # migration receiver's: nothing larger than the begin
+                # frame's own bytes_per_page claim should ever arrive,
+                # but the DECODE bound must not trust it — use the flat
+                # transfer cap (the engine's checksum still gates binds)
+                yield from wire_mod.decode_mig_frames(
+                    resp.read, max_payload=64 << 20
+                )
+                return
+            while True:
+                line = resp.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if not line:
+                    continue
+                yield json.loads(line.decode("utf-8"))
+        except MigrationError:
+            raise
+        except Exception as e:  # noqa: BLE001 — dead wire mid-fetch
+            raise MigrationError(
+                f"page fetch from {url} died mid-stream: {e}"
+            ) from e
+        finally:
+            conn.close()
+
+    return frames()

@@ -304,6 +304,11 @@ DUMP_REASONS = (
     # incident artifact a hung slice otherwise never leaves
     "spmd-recover",
     "spmd-wedge",
+    # a P2P page fetch from a prefix-owning peer failed (checksum, cut
+    # wire, deadline, owner gone — docs/SERVING.md §21): dumped by the
+    # ROUTER with the owner/destination ids, the advertised match depth
+    # and the fallback taken (local cold prefill), never page content
+    "p2p-fetch-failed",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

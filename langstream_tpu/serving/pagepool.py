@@ -85,6 +85,43 @@ def page_checksum(blocks) -> bytes:
     return h.digest()
 
 
+def join_page_bytes(blocks) -> bytes:
+    """One page's leaf blocks (``jax.tree.leaves`` order) → the raw
+    concatenated byte image the v2 migration wire ships (serving/wire.py):
+    every leaf at its NATIVE dtype width, C-contiguous — int8 pools move
+    int8 bytes, no base64 tax. The byte order matches ``page_checksum``'s
+    update order, so the stamped digest verifies either representation."""
+    return b"".join(
+        np.ascontiguousarray(b).tobytes() for b in blocks
+    )
+
+
+def split_page_bytes(raw: bytes, specs) -> list:
+    """Inverse of ``join_page_bytes``: split one raw page payload back
+    into per-leaf arrays against the receiver pool's layout ``specs``
+    (``(page_shape, dtype)`` pairs, serving/migrate._leaf_specs order).
+    Raises ValueError on any size mismatch — a truncated or padded
+    payload must abort BEFORE the checksum, never reshape garbage."""
+    out = []
+    off = 0
+    for shape, dtype in specs:
+        nb = int(math.prod(shape)) * np.dtype(dtype).itemsize
+        chunk = raw[off:off + nb]
+        if len(chunk) != nb:
+            raise ValueError(
+                f"page payload truncated at leaf {len(out)} "
+                f"({len(chunk)} of {nb} bytes)"
+            )
+        out.append(np.frombuffer(chunk, dtype=dtype).reshape(shape))
+        off += nb
+    if off != len(raw):
+        raise ValueError(
+            f"page payload carries {len(raw) - off} trailing byte(s) "
+            f"past its {off}-byte leaf layout"
+        )
+    return out
+
+
 def table_len_for(max_seq_len: int, page_size: int) -> int:
     """Per-slot worst-case page-table length: enough logical pages to map
     every position a slot can ever write (the memory-plan term)."""

@@ -281,7 +281,15 @@ class ControlPlaneServer:
                 last_seq = max(last_seq, entry["seq"])
                 await resp.write(json.dumps(entry).encode() + b"\n")
             while True:
-                entry = await queue.get()
+                try:
+                    entry = await asyncio.wait_for(queue.get(), timeout=2.0)
+                except asyncio.TimeoutError:
+                    # keepalive blank line: a vanished client only surfaces
+                    # on a WRITE, so a quiet app would otherwise park this
+                    # handler until the next log line — and runner.cleanup()
+                    # would stall its full shutdown_timeout on the zombie
+                    await resp.write(b"\n")
+                    continue
                 if entry["seq"] <= last_seq:
                     continue
                 if replica and entry["replica"] != replica:

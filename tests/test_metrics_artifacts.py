@@ -143,8 +143,14 @@ def test_dashboard_regexes_match_live_exposition():
         "fleet_pages_migrated_total",
         "fleet_migrate_bytes_total",
         "fleet_migrate_fallbacks_total",
+        "fleet_p2p_fetch_total",
+        "fleet_p2p_fetch_fallback_total",
+        "fleet_p2p_bytes_in_total",
     ):
         serving.gauge(n)
+    # the wire byte counter is a LABELED pair of series (§21 protocol split)
+    for proto in ("v1", "v2"):
+        serving.gauge("fleet_wire_bytes_total", labels={"proto": proto})
     exposed = {
         # histogram bucket lines carry a {le="…"} label — strip it so the
         # dashboard __name__ matchers compare against the series name
@@ -388,6 +394,38 @@ def test_spmd_resilience_panels_present():
     )
     assert watchdog is not None, "SPMD watchdog-detections panel missing"
     assert "engine_spmd_watchdog_trips_total" in watchdog
+
+
+def test_fleet_wire_v2_panels_present():
+    """The ISSUE-16 binary-wire + P2P panels must survive dashboard edits:
+    the per-protocol wire-bytes panel (v1 NDJSON vs v2 binary — the rollout
+    health signal for the lstpu-kvmig-v2/frames-v2 codecs, serving/wire.py,
+    docs/SERVING.md §21) and the peer-to-peer page-fetch panel (warm admits
+    vs local-cold fallbacks plus bytes pulled in from peers)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    wire = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "wire bytes by protocol" in t.lower()
+        ),
+        None,
+    )
+    assert wire is not None, "fleet wire-bytes-by-protocol panel missing"
+    assert "fleet_wire_bytes_total" in wire
+    assert 'proto="v1"' in wire
+    assert 'proto="v2"' in wire
+    p2p = next(
+        (e for t, e in exprs_by_title.items() if "p2p page fetch" in t.lower()),
+        None,
+    )
+    assert p2p is not None, "fleet P2P page-fetch panel missing"
+    assert "fleet_p2p_fetch_total" in p2p
+    assert "fleet_p2p_fetch_fallback_total" in p2p
+    assert "fleet_p2p_bytes_in_total" in p2p
 
 
 def test_grafana_provisioning_parses():
