@@ -360,6 +360,17 @@ class _BaseCompletionsStep(Step):
             "page bytes admitted from peers by completed P2P fetches "
             "(receiver-ACKed), cumulative",
         )
+        self._m_weight_load_s = metrics.gauge(
+            "weight_load_s",
+            "checkpoint→device weight load wall time for this engine "
+            "build (read + transform + transfer, s); the cold-start drill "
+            "compares streamed vs eager on this gauge",
+        )
+        self._m_weight_load_bytes = metrics.gauge(
+            "weight_load_bytes_total",
+            "checkpoint bytes read by the engine weight load (streamed: "
+            "summed tensor spans; eager: materialized tree bytes)",
+        )
         from langstream_tpu.serving.observability import (
             ENGINE_HISTOGRAMS,
             FLEET_HISTOGRAMS,
@@ -440,6 +451,8 @@ class _BaseCompletionsStep(Step):
         )
         self._m_load.set(stats.get("load-score", 0))
         self._m_flight_dumps.set(stats.get("flight-dumps-total", 0))
+        self._m_weight_load_s.set(stats.get("weight-load-s", 0))
+        self._m_weight_load_bytes.set(stats.get("weight-load-bytes-total", 0))
         fleet = getattr(self._service, "fleet_stats", lambda: None)() or {}
         self._m_fleet_affinity.set(
             fleet.get("fleet-routed-affinity-total", 0)

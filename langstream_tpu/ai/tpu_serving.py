@@ -12,6 +12,25 @@ Resource configuration:
   model: preset name (models.configs.MODEL_PRESETS) — gemma-2b, llama-3-8b, …
   tokenizer: "byte" (default) | "hf:<local path>"
   weights: "random" (default) | path to HF safetensors dir (models.loader)
+  weight-streaming: auto (default) | off → streamed sharded weight load
+    (models/streamload.py, docs/SERVING.md §22): safetensors shards are
+    header-indexed and mmapped, a parallel reader pool assembles one
+    LAYER at a time into staging, and per-layer device uploads overlap
+    the next layer's host work — host RAM peaks at the readahead window
+    instead of the eager path's ~2× weights, and the transfer TAIL
+    overlaps engine compile-warmup (the load returns with uploads still
+    in flight). Bit-exact vs the eager path on every architecture ×
+    dtype; "off" is the escape hatch (and the bench_cold_start baseline)
+  weight-load-workers: parallel shard-reader threads (default 4) — also
+    sizes the readahead window (workers + 1 layers) the host-RAM staging
+    peak is bounded by
+  quantize-on-load: auto (default) | off → with `quantization: int8`,
+    quantize each layer ON DEVICE as it streams in, so an int8
+    deployment never materializes the full-precision tree anywhere —
+    host holds one staging window, device holds the int8 tree plus one
+    full-precision layer. "off" loads full-precision first, then runs
+    the eager quantize_params pass (big models fall back to the
+    host-staged eager path). Identical numerics either way
   max-batch / max-seq-len / prefill-buckets / decode-chunk: engine knobs
   kv-layout: paged (default) | dense → KV memory layout. "paged" is the
     unified page-table-indexed device pool (serving/pagepool.py): decode,
@@ -203,6 +222,16 @@ class _EngineHolder:
         self._mesh = None
         self._fleet_router = None
         self._fleet_replica_id: Optional[str] = None
+        # checkpoint→device load accounting (filled by params(), read by
+        # build_engine → ServingEngine stats()/memory plan); {} for
+        # random-init weights
+        self._weight_load_report: dict[str, Any] = {}
+        # ONE injector instance for the whole holder: the streamed loader
+        # consults the `weight-load` site during params() and the engine
+        # consults every other site — sharing the instance keeps the
+        # seeded schedule and call counters coherent across both
+        self._injector: Any = None
+        self._injector_built = False
 
     def mesh(self):
         """Device mesh for TP/EP sharding when `mesh` is configured."""
@@ -245,6 +274,7 @@ class _EngineHolder:
 
         if self._params is None:
             import contextlib
+            import time
 
             from langstream_tpu.models.transformer import init_params
 
@@ -256,36 +286,110 @@ class _EngineHolder:
                     f"unknown quantization {quant_mode!r}; supported: int8"
                 )
             quantize = quant_mode in ("int8", "w8")
+            streaming = self.config.get("weight-streaming", "auto")
+            if not isinstance(streaming, bool) and str(streaming).lower() not in (
+                "auto", "off",
+            ):
+                raise ValueError(
+                    f"unknown weight-streaming {streaming!r}; "
+                    "supported: auto, off"
+                )
+            stream_on = (
+                streaming is True
+                or (not isinstance(streaming, bool)
+                    and str(streaming).lower() == "auto")
+            )
+            workers = int(self.config.get("weight-load-workers", 4))
+            if workers < 1:
+                raise ValueError(
+                    f"weight-load-workers must be >= 1, got {workers}"
+                )
+            qol = self.config.get("quantize-on-load", "auto")
+            if not isinstance(qol, bool) and str(qol).lower() not in (
+                "auto", "off",
+            ):
+                raise ValueError(
+                    f"unknown quantize-on-load {qol!r}; supported: auto, off"
+                )
+            qol_on = quantize and (
+                qol is True
+                or (not isinstance(qol, bool) and str(qol).lower() == "auto")
+            )
             # models whose full-precision tree would not fit device HBM are
             # built + quantized on the HOST and shipped int8 (host init is
-            # slower, so small models stay on-device)
+            # slower, so small models stay on-device). The STREAMED int8
+            # path never materializes the full-precision tree, so it skips
+            # the host stage entirely — unless quantize-on-load is forced
+            # off, which reinstates the eager host-staged economics.
             hbm_budget = int(self.config.get("hbm-bytes", 16 * 1024**3))
             needs_host = quantize and mc.approx_params * 2 > hbm_budget // 2
-            scope = (
-                jax.default_device(jax.devices("cpu")[0])
-                if needs_host
-                else contextlib.nullcontext()
-            )
-            with scope:
-                if weights in (None, "random"):
-                    params = init_params(mc, jax.random.PRNGKey(0))
-                else:
-                    from langstream_tpu.models.loader import load_params
+            if stream_on and needs_host and quantize and not qol_on:
+                stream_on = False
+            report: dict[str, Any] = {}
+            if weights not in (None, "random") and stream_on:
+                from langstream_tpu.models.streamload import (
+                    load_params_streamed,
+                )
 
-                    params = load_params(weights, mc)
-                if quantize:
+                # block=False: the transfer tail rides JAX async dispatch,
+                # so engine construction + compile-warmup below overlap the
+                # last layers' uploads (the §22 cold-start lever)
+                params, rep = load_params_streamed(
+                    weights,
+                    mc,
+                    workers=workers,
+                    quantize=qol_on,
+                    fault_injector=self._fault_injector(),
+                    block=False,
+                )
+                if quantize and not qol_on:
                     from langstream_tpu.models.quant import quantize_params
 
                     params = quantize_params(params, mc)
-            if needs_host and self.mesh() is None:
-                # no mesh: move the int8 tree onto the accelerator ourselves
-                # (with a mesh, shard_params below owns placement)
-                params = jax.device_put(params, jax.devices()[0])
+                report = rep.as_dict()
+            else:
+                t0 = time.perf_counter()
+                scope = (
+                    jax.default_device(jax.devices("cpu")[0])
+                    if needs_host
+                    else contextlib.nullcontext()
+                )
+                with scope:
+                    if weights in (None, "random"):
+                        params = init_params(mc, jax.random.PRNGKey(0))
+                    else:
+                        from langstream_tpu.models.loader import load_params
+
+                        params = load_params(weights, mc)
+                    if quantize:
+                        from langstream_tpu.models.quant import quantize_params
+
+                        params = quantize_params(params, mc)
+                if needs_host and self.mesh() is None:
+                    # no mesh: move the int8 tree onto the accelerator
+                    # ourselves (with a mesh, shard_params below owns
+                    # placement)
+                    params = jax.device_put(params, jax.devices()[0])
+                if weights not in (None, "random"):
+                    # the eager baseline's ledger, so streamed-vs-eager is
+                    # comparable in stats()/bench without a code path probe
+                    jax.block_until_ready(params)
+                    report = {
+                        "streamed": False,
+                        "workers": 1,
+                        "quantize-on-load": False,
+                        "total-s": round(time.perf_counter() - t0, 4),
+                        "bytes-read": sum(
+                            leaf.size * leaf.dtype.itemsize
+                            for leaf in jax.tree.leaves(params)
+                        ),
+                    }
             mesh = self.mesh()
             if mesh is not None:
                 from langstream_tpu.parallel.sharding import shard_params
 
                 params = shard_params(params, mesh, mc)
+            self._weight_load_report = report
             self._params = params
         return self._params
 
@@ -501,6 +605,10 @@ class _EngineHolder:
             max_restarts=int(self.config.get("engine-max-restarts", 5)),
             fault_injector=self._fault_injector(),
             migrate_staging=fleet_role != "mixed",
+            # per-phase load timings + staging peak from params() (§22);
+            # params is an earlier argument, so the report is populated by
+            # the time this kwarg is evaluated
+            weight_load_report=self._weight_load_report,
             # observability layer (docs/SERVING.md §12): histograms +
             # request spans + flight recorder; off is the escape hatch for
             # the measured (<1%) hot-loop overhead
@@ -577,18 +685,26 @@ class _EngineHolder:
     def _fault_injector(self):
         """Config-driven fault injection (staging drills): `fault-injection`
         is the spec string (serving/faultinject.py grammar), `fault-seed`
-        pins the schedule. None (the default) still leaves the LSTPU_FAULTS
-        env activation to the engine."""
-        spec = str(self.config.get("fault-injection", "") or "").strip()
-        if not spec:
-            return None
-        from langstream_tpu.serving.faultinject import FaultInjector
+        pins the schedule. Built ONCE and cached: the streamed weight loader
+        consults the `weight-load` site during params() and the engine
+        consults every other site — a single instance keeps the seeded
+        per-site schedule coherent across both consumers. With no config
+        spec we fall back to LSTPU_FAULTS env activation here (instead of
+        leaving it to the engine) for the same sharing reason."""
+        if not self._injector_built:
+            from langstream_tpu.serving.faultinject import FaultInjector
 
-        return FaultInjector(
-            spec,
-            seed=int(self.config.get("fault-seed", 0)),
-            stall_s=float(self.config.get("fault-stall-s", 0.05)),
-        )
+            spec = str(self.config.get("fault-injection", "") or "").strip()
+            if spec:
+                self._injector = FaultInjector(
+                    spec,
+                    seed=int(self.config.get("fault-seed", 0)),
+                    stall_s=float(self.config.get("fault-stall-s", 0.05)),
+                )
+            else:
+                self._injector = FaultInjector.from_env()
+            self._injector_built = True
+        return self._injector
 
     def engine(self):
         with self._lock:

@@ -187,12 +187,29 @@ def load_lora_params(
     L = config.n_layers
     t = np.transpose
 
+    # peft wraps layer keys in an export-dependent prefix (base_model.model.
+    # model.layers.… etc.), so lookups match on the canonical suffix from
+    # the LAST "layers." on. One O(keys) pass builds the suffix→key map —
+    # the old per-(layer, proj, factor) endswith scan was O(L·P·K) — and a
+    # duplicate suffix (two prefixes, same tail) fails LOUDLY instead of
+    # silently loading whichever key iterated first.
+    suffix_to_key: dict[str, str] = {}
+    for key in raw:
+        pos = key.rfind("layers.")
+        if pos < 0:
+            continue  # non-layer tensors can never match a factor lookup
+        suffix = key[pos:]
+        other = suffix_to_key.get(suffix)
+        if other is not None:
+            raise ValueError(
+                f"ambiguous LoRA checkpoint under {path}: {other!r} and "
+                f"{key!r} both end in {suffix!r}"
+            )
+        suffix_to_key[suffix] = key
+
     def find(i: int, hf_proj: str, factor: str) -> np.ndarray | None:
-        suffix = f"layers.{i}.{hf_proj}.{factor}.weight"
-        for key, value in raw.items():
-            if key.endswith(suffix):
-                return value
-        return None
+        key = suffix_to_key.get(f"layers.{i}.{hf_proj}.{factor}.weight")
+        return raw[key] if key is not None else None
 
     out: dict[str, dict[str, np.ndarray]] = {}
     found_any = False
@@ -222,9 +239,21 @@ def load_lora_params(
     return out
 
 
-def save_params_hf(params: Params, config: ModelConfig, path: str | Path) -> None:
+def save_params_hf(
+    params: Params,
+    config: ModelConfig,
+    path: str | Path,
+    *,
+    max_shard_bytes: int | None = None,
+) -> None:
     """Inverse mapping (ours → HF naming), for tests and for exporting
-    fine-tuned weights back to the HF ecosystem."""
+    fine-tuned weights back to the HF ecosystem.
+
+    ``max_shard_bytes`` splits the export into HF-style
+    ``model-00001-of-0000N.safetensors`` shards (greedy, insertion order,
+    at least one tensor per shard) plus the ``model.safetensors.index.json``
+    weight map — how real multi-file checkpoints are laid out, and the
+    fixture knob the streamed-loader tests shard tiny models with."""
     from safetensors import numpy as st_numpy
 
     norm_offset = 1.0 if _gemma_like(config) else 0.0
@@ -268,4 +297,33 @@ def save_params_hf(params: Params, config: ModelConfig, path: str | Path) -> Non
 
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
-    st_numpy.save_file(out, str(path / "model.safetensors"))
+    if not max_shard_bytes or sum(v.nbytes for v in out.values()) <= max_shard_bytes:
+        st_numpy.save_file(out, str(path / "model.safetensors"))
+        return
+
+    shards: list[dict[str, np.ndarray]] = [{}]
+    used = 0
+    for name, value in out.items():
+        if shards[-1] and used + value.nbytes > max_shard_bytes:
+            shards.append({})
+            used = 0
+        shards[-1][name] = value
+        used += value.nbytes
+    n = len(shards)
+    import json
+
+    weight_map: dict[str, str] = {}
+    for idx, shard in enumerate(shards, start=1):
+        fname = f"model-{idx:05d}-of-{n:05d}.safetensors"
+        st_numpy.save_file(shard, str(path / fname))
+        for name in shard:
+            weight_map[name] = fname
+    (path / "model.safetensors.index.json").write_text(
+        json.dumps(
+            {
+                "metadata": {"total_size": sum(v.nbytes for v in out.values())},
+                "weight_map": weight_map,
+            },
+            indent=1,
+        )
+    )

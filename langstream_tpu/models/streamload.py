@@ -1,0 +1,713 @@
+"""Streamed sharded safetensors → device: the cold-start weight pipeline.
+
+``loader.load_params`` (the eager path) reads every shard fully into host
+RAM, stacks per-layer numpy copies (~2× the weight bytes at peak), and only
+then uploads — a cold pod pays read + transform + transfer strictly in
+sequence. This module rebuilds the load as a three-stage pipeline
+(ROADMAP 3a, the scale-to-zero wall):
+
+  1. **Read** — a parallel reader pool slices tensors lazily out of the
+     safetensors files. The 8-byte little-endian header length + JSON
+     header give every tensor's byte range up front, so each tensor is
+     one GIL-releasing positioned read (os.pread) and no shard is ever
+     materialized whole; ``workers`` readers pull layers ahead of the
+     consumer.
+  2. **Transform** — per-LAYER host assembly: transpose (HF [out, in] →
+     ours [in, out]), the gemma norm offset, and the contiguous staging
+     copy happen one layer at a time, so host RAM holds at most the
+     readahead window of layers — never the tree.
+  3. **Transfer** — each assembled layer is written into its stacked
+     [L, ...] device buffer with a jitted donated dynamic-update (one
+     compile per stacked key, the layer index is a traced scalar). JAX
+     dispatch is async, so layer N+1's host work overlaps layer N's
+     upload; with ``block=False`` the TAIL of the transfer also overlaps
+     whatever the caller does next (engine compile-warmup — the holder's
+     cold-start lever).
+
+With ``quantize=True`` stage 3 quantizes each layer ON DEVICE with the
+exact ``models/quant.py`` ops before it lands in the int8 buffers, so an
+int8 deployment never holds the full-precision tree anywhere: host peak is
+the staging window, device peak is the int8 tree + one full-precision
+layer. Running the same jnp ops per layer that the eager path runs on the
+stacked tree makes streamed==eager BIT-exact (amax reduces over the
+within-layer axis, so per-layer and stacked quantization agree).
+
+A short or torn read NEVER produces silently-wrong weights: every tensor's
+byte range is validated against the shard's real size at index time, and
+any violation raises ``WeightLoadError`` naming the shard file and tensor.
+The ``weight-load`` fault site (serving/faultinject.py) drives the same
+path on demand for chaos drills.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import warnings
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import ml_dtypes
+import numpy as np
+
+from langstream_tpu.models.configs import ModelConfig
+from langstream_tpu.models.loader import (
+    Params,
+    _check_shapes,
+    _gemma_like,
+    _iter_safetensor_files,
+    _strip_prefix,
+)
+
+log = logging.getLogger(__name__)
+
+# safetensors dtype tags → numpy dtypes. BF16 comes from ml_dtypes (a jax
+# dependency — no new package), the same extended-dtype registry jax uses.
+_ST_DTYPES: dict[str, Any] = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _np_dtype(tag: str, *, file: Path, name: str) -> np.dtype:
+    if tag == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(_ST_DTYPES[tag])
+    except KeyError:
+        raise WeightLoadError(
+            f"shard {file.name}: tensor {name!r} has unsupported dtype {tag!r}"
+        ) from None
+
+
+class WeightLoadError(RuntimeError):
+    """A checkpoint read that must not be retried: truncated/corrupt shard,
+    malformed header, or an injected weight-load fault. The message always
+    names the shard file and, when one is implicated, the tensor — the
+    difference between "which of 40 shards rotted" and an opaque crash."""
+
+
+@dataclass(frozen=True)
+class _TensorRef:
+    """One tensor's location: an absolute byte range inside one shard."""
+
+    file: Path
+    dtype: np.dtype
+    shape: tuple[int, ...]
+    start: int  # absolute file offset of the first byte
+    end: int  # absolute file offset past the last byte
+
+
+class ShardIndex:
+    """Parsed safetensors headers for a checkpoint dir (or single file):
+    tensor name → byte range, with every range validated against the real
+    file size so truncation fails HERE, loudly, before any weight is used.
+
+    The safetensors layout is [8-byte LE header length N][N bytes of JSON
+    header][data]; each header entry carries ``data_offsets`` relative to
+    the data section. Building the index reads only the headers — a few KB
+    per shard — never the payloads."""
+
+    def __init__(self, path: str | Path) -> None:
+        self.files: list[Path] = list(_iter_safetensor_files(path))
+        self.tensors: dict[str, _TensorRef] = {}
+        for file in self.files:
+            size = file.stat().st_size
+            with open(file, "rb") as f:
+                head = f.read(8)
+                if len(head) < 8:
+                    raise WeightLoadError(
+                        f"shard {file.name}: truncated safetensors header "
+                        f"(file is {size} bytes)"
+                    )
+                header_len = int.from_bytes(head, "little")
+                if header_len <= 0 or 8 + header_len > size:
+                    raise WeightLoadError(
+                        f"shard {file.name}: header claims {header_len} "
+                        f"bytes but the file holds {size}"
+                    )
+                try:
+                    header = json.loads(f.read(header_len))
+                except ValueError as e:
+                    raise WeightLoadError(
+                        f"shard {file.name}: corrupt safetensors header: {e}"
+                    ) from e
+            data_start = 8 + header_len
+            for raw_name, entry in header.items():
+                if raw_name == "__metadata__":
+                    continue
+                name = _strip_prefix(raw_name)
+                dtype = _np_dtype(entry["dtype"], file=file, name=raw_name)
+                shape = tuple(int(d) for d in entry["shape"])
+                begin, stop = entry["data_offsets"]
+                ref = _TensorRef(
+                    file=file,
+                    dtype=dtype,
+                    shape=shape,
+                    start=data_start + int(begin),
+                    end=data_start + int(stop),
+                )
+                expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+                if ref.end - ref.start != expect:
+                    raise WeightLoadError(
+                        f"shard {file.name}: tensor {raw_name!r} spans "
+                        f"{ref.end - ref.start} bytes but {shape} × "
+                        f"{dtype.name} needs {expect}"
+                    )
+                if ref.end > size:
+                    # THE short-read case: the header promises bytes the
+                    # file does not have (torn download, truncated write)
+                    raise WeightLoadError(
+                        f"shard {file.name} is truncated: tensor "
+                        f"{raw_name!r} needs bytes {ref.start}:{ref.end} "
+                        f"but the file ends at {size}"
+                    )
+                if name in self.tensors:
+                    raise WeightLoadError(
+                        f"tensor {raw_name!r} appears in both "
+                        f"{self.tensors[name].file.name} and {file.name}"
+                    )
+                self.tensors[name] = ref
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r.end - r.start for r in self.tensors.values())
+
+
+class _ShardReader:
+    """Positioned-read lazy tensor slicing; thread-safe, one fd per shard.
+
+    ``read`` pulls exactly one tensor's byte span via ``os.pread`` — never
+    a whole shard. pread, not mmap: the positioned-read syscall RELEASES
+    the GIL, so `workers` reader threads genuinely overlap I/O with each
+    other and with the main thread's transform/upload work. An mmap view
+    looks cheaper (zero-copy) but its page faults happen under whatever
+    numpy op first touches the pages — GIL held — which serializes the
+    whole pool back into one effective thread (measured: the mmap pool
+    was ~4× SLOWER than the eager loader on a warm multi-shard
+    checkpoint; pread flipped it)."""
+
+    def __init__(
+        self, index: ShardIndex, fault_injector: Optional[Any] = None
+    ) -> None:
+        self._index = index
+        self._injector = fault_injector
+        self._fds: dict[Path, int] = {}
+        self._lock = threading.Lock()
+        self.reads = 0
+
+    def _fd(self, file: Path) -> int:
+        with self._lock:
+            fd = self._fds.get(file)
+            if fd is None:
+                fd = os.open(file, os.O_RDONLY)
+                self._fds[file] = fd
+            return fd
+
+    def read(self, name: str) -> np.ndarray:
+        ref = self._index.tensors.get(name)
+        if ref is None:
+            raise WeightLoadError(
+                f"checkpoint is missing tensor {name!r}; shards: "
+                f"{[f.name for f in self._index.files]}, found e.g. "
+                f"{sorted(self._index.tensors)[:8]}"
+            )
+        if self._injector is not None and self._injector.fires("weight-load"):
+            # the chaos drill's stand-in for a torn mid-load read: same
+            # error class, same shard+tensor naming, same no-retry contract
+            raise WeightLoadError(
+                f"injected weight-load fault: truncated read of tensor "
+                f"{name!r} from shard {ref.file.name} "
+                f"(bytes {ref.start}:{ref.end})"
+            )
+        want = ref.end - ref.start
+        buf = os.pread(self._fd(ref.file), want, ref.start)
+        if len(buf) != want:
+            # the index validated spans against the size at open time, so a
+            # short read here means the file changed (or lied) under us
+            raise WeightLoadError(
+                f"short read from shard {ref.file.name}: tensor {name!r} "
+                f"needs bytes {ref.start}:{ref.end} but pread returned "
+                f"{len(buf)} of {want}"
+            )
+        with self._lock:
+            self.reads += 1
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        return arr.view(ref.dtype).reshape(ref.shape)
+
+    def close(self) -> None:
+        with self._lock:
+            for fd in self._fds.values():
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            self._fds.clear()
+
+
+@dataclass
+class WeightLoadReport:
+    """Per-phase accounting of one load — the stats()/bench/memory-plan
+    surface. ``read_s``/``transform_s`` are summed across reader threads
+    (they overlap each other and the transfer wall time); ``total_s`` is
+    the honest end-to-end wall."""
+
+    streamed: bool = True
+    workers: int = 1
+    quantize_on_load: bool = False
+    shards: int = 0
+    tensors: int = 0
+    bytes_read: int = 0
+    read_s: float = 0.0
+    transform_s: float = 0.0
+    transfer_s: float = 0.0
+    total_s: float = 0.0
+    staging_peak_bytes: int = 0
+    # False ⇔ the caller took the transfer tail async (block=False): device
+    # uploads were still in flight when the load returned, overlapping the
+    # engine's compile-warmup
+    blocked: bool = True
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "streamed": self.streamed,
+            "workers": self.workers,
+            "quantize-on-load": self.quantize_on_load,
+            "shards": self.shards,
+            "tensors": self.tensors,
+            "bytes-read": self.bytes_read,
+            "read-s": round(self.read_s, 4),
+            "transform-s": round(self.transform_s, 4),
+            "transfer-s": round(self.transfer_s, 4),
+            "total-s": round(self.total_s, 4),
+            "staging-peak-bytes": self.staging_peak_bytes,
+            "blocked": self.blocked,
+        }
+
+
+class _Staging:
+    """Host staging accounting: live bytes now + the high-water mark the
+    memory plan reports. The bound this enforces-by-measurement is the
+    tentpole's host-RAM claim: readahead-window × per-layer bytes, never
+    the stacked tree."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.now = 0
+        self.peak = 0
+        self.read_s = 0.0
+        self.transform_s = 0.0
+        self.bytes_read = 0
+
+    def grow(self, n: int) -> None:
+        with self._lock:
+            self.now += n
+            self.peak = max(self.peak, self.now)
+
+    def shrink(self, n: int) -> None:
+        with self._lock:
+            self.now -= n
+
+    def account(self, read_s: float, transform_s: float, nbytes: int) -> None:
+        with self._lock:
+            self.read_s += read_s
+            self.transform_s += transform_s
+            self.bytes_read += nbytes
+
+
+# the jitted per-layer assembler: ONE dispatch writes a whole layer into
+# every stacked buffer (tree-mapped dynamic updates; per-KEY dispatches
+# cost ~1ms each on CPU and made the streamed path LOSE to eager on
+# multi-MB checkpoints). The layer index is a TRACED scalar and the jit
+# caches on tree structure + shapes, so every layer reuses one compile;
+# the buffer tree is donated so device peak never holds two copies.
+# Quantize itself runs EAGERLY before this (upload_layer): fusing
+# quant.quantize_weight into the jit lets XLA rewrite the /127.0 into a
+# reciprocal multiply, 1 ulp off the eager quantize_params reference —
+# and streamed==eager is a BIT-exactness contract, not a tolerance. The
+# in-jit astype matches the eager path's cast for plain keys and is an
+# identity for the precomputed int8/f32 quant leaves.
+_LAYER_SETTER: list[Callable] = []
+
+
+def _layer_setter() -> Callable:
+    if not _LAYER_SETTER:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(bufs, xs, i):
+            return jax.tree.map(
+                lambda b, x: jax.lax.dynamic_update_index_in_dim(
+                    b, x.astype(b.dtype), i, 0
+                ),
+                bufs,
+                xs,
+            )
+
+        _LAYER_SETTER.append(fn)
+    return _LAYER_SETTER[0]
+
+
+_NP_BF16 = np.dtype(ml_dtypes.bfloat16)
+
+# XLA CPU's bf16 dynamic_update_slice converts ELEMENTWISE — measured
+# ~14× slower than the same-byte-width integer update, which is a plain
+# memcpy. bf16 layers are therefore staged into uint16 buffers as raw bit
+# patterns (numpy .view, zero-copy) and reinterpreted back to bf16 ONCE
+# here after the last layer lands — a bitcast, so streamed==eager stays
+# bit-exact by construction. Donated: the stacked tree is never held
+# twice. int8/f32 leaves (quant {q,s} sub-dicts, f32 models) pass
+# through untouched — their updates are already memcpy-fast.
+_BITCAST16: list[Callable] = []
+
+
+def _bitcast16() -> Callable:
+    if not _BITCAST16:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def fn(bufs):
+            return jax.tree.map(
+                lambda b: (
+                    jax.lax.bitcast_convert_type(b, jnp.bfloat16)
+                    if b.dtype == jnp.uint16
+                    else b
+                ),
+                bufs,
+            )
+
+        _BITCAST16.append(fn)
+    return _BITCAST16[0]
+
+
+# the stacked-layer keys the eager quantize_params pass quantizes — the
+# streamed pass must agree leaf-for-leaf (models/quant._QUANT_LAYER_KEYS)
+_QUANT_KEYS = frozenset(("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"))
+
+
+def load_params_streamed(
+    path: str | Path,
+    config: ModelConfig,
+    dtype: Any = None,
+    *,
+    workers: int = 4,
+    quantize: bool = False,
+    fault_injector: Optional[Any] = None,
+    block: bool = True,
+) -> tuple[Params, WeightLoadReport]:
+    """Streamed equivalent of ``loader.load_params`` (+ optional fused
+    ``quant.quantize_params``): returns the same pytree bit-for-bit, built
+    through the read∥transform∥transfer pipeline described in the module
+    docstring. ``block=False`` returns with the transfer tail still in
+    flight (JAX async dispatch) so engine warmup can overlap it."""
+    import jax
+    import jax.numpy as jnp
+
+    from langstream_tpu.models.quant import quantize_row_wise, quantize_weight
+
+    t_start = time.perf_counter()
+    dtype = jnp.dtype(dtype or config.dtype)
+    # bf16 models stage through uint16 buffers (see _bitcast16): the
+    # bit-pattern view makes every stacked update a memcpy on XLA CPU
+    raw16 = dtype == jnp.bfloat16
+    index = ShardIndex(path)
+    reader = _ShardReader(index, fault_injector)
+    staging = _Staging()
+    consumed: set[str] = set()
+    consumed_lock = threading.Lock()
+    L = config.n_layers
+    norm_offset = 1.0 if _gemma_like(config) else 0.0
+    t = np.transpose  # HF [out, in] → ours [in, out]
+
+    def materialize(name: str, transform: Callable | None) -> np.ndarray:
+        """Stages 1+2 for one tensor: positioned read → contiguous staged
+        host array (an identity 'transform' keeps the read buffer; a real
+        transform replaces it, so the second copy is transient)."""
+        t0 = time.perf_counter()
+        arr = reader.read(name)
+        t1 = time.perf_counter()
+        out = np.ascontiguousarray(transform(arr)) if transform else arr
+        t2 = time.perf_counter()
+        with consumed_lock:
+            consumed.add(name)
+        staging.account(t1 - t0, t2 - t1, arr.nbytes)
+        staging.grow(out.nbytes)
+        return out
+
+    add_norm = (lambda w: w + norm_offset) if norm_offset else (lambda w: w + 0.0)
+    contig_t = lambda w: t(w)  # noqa: E731 — ascontiguousarray copies above
+
+    def read_layer(i: int) -> dict[str, np.ndarray]:
+        """One layer's full host-side assembly — the reader pool's unit of
+        work, so `workers` layers read+transform concurrently."""
+        out = {
+            "attn_norm": materialize(
+                f"layers.{i}.input_layernorm.weight", add_norm
+            ),
+            "wq": materialize(f"layers.{i}.self_attn.q_proj.weight", contig_t),
+            "wk": materialize(f"layers.{i}.self_attn.k_proj.weight", contig_t),
+            "wv": materialize(f"layers.{i}.self_attn.v_proj.weight", contig_t),
+            "wo": materialize(f"layers.{i}.self_attn.o_proj.weight", contig_t),
+            "ffn_norm": materialize(
+                f"layers.{i}.post_attention_layernorm.weight", add_norm
+            ),
+        }
+        if config.is_moe:
+            E = config.n_experts
+            out["router"] = materialize(
+                f"layers.{i}.block_sparse_moe.gate.weight", contig_t
+            )
+            for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+                per = [
+                    materialize(
+                        f"layers.{i}.block_sparse_moe.experts.{e}"
+                        f".{theirs}.weight",
+                        contig_t,
+                    )
+                    for e in range(E)
+                ]
+                stacked = np.stack(per)
+                staging.grow(stacked.nbytes)
+                for p in per:
+                    staging.shrink(p.nbytes)
+                out[ours] = stacked
+        else:
+            out["w_gate"] = materialize(
+                f"layers.{i}.mlp.gate_proj.weight", contig_t
+            )
+            out["w_up"] = materialize(f"layers.{i}.mlp.up_proj.weight", contig_t)
+            out["w_down"] = materialize(
+                f"layers.{i}.mlp.down_proj.weight", contig_t
+            )
+        return out
+
+    transfer_s = 0.0
+    # the stacked device-buffer TREE, allocated lazily at the first layer
+    # (shapes come from the data, _check_shapes validates against the
+    # config after); quantized keys hold {"q", "s"} sub-dicts so one
+    # tree-mapped setter call writes the whole layer
+    bufs: dict[str, Any] = {}
+
+    def upload_layer(i: int, layer: dict[str, np.ndarray]) -> None:
+        nonlocal transfer_s, bufs
+        t0 = time.perf_counter()
+        xs: dict[str, Any] = {}
+        for key, x in layer.items():
+            if quantize and key in _QUANT_KEYS:
+                # cast to the model dtype FIRST (the eager path quantizes
+                # the cast tree, and f32→bf16→f32 is not identity), then
+                # the exact quant.quantize_weight ops, eagerly — per-layer
+                # and stacked quantization agree bit-for-bit because amax
+                # reduces within the layer (axis=-2)
+                xs[key] = quantize_weight(jnp.asarray(x, dtype))
+            elif raw16 and x.dtype == _NP_BF16:
+                # checkpoint dtype == model dtype: ship the raw bit
+                # pattern (zero-copy view) into a uint16 buffer; the
+                # in-jit astype is then an identity and the update a
+                # memcpy instead of XLA CPU's elementwise bf16 path
+                xs[key] = x.view(np.uint16)
+            else:
+                xs[key] = x
+        if not bufs:
+            for key, v in xs.items():
+                if isinstance(v, dict):
+                    bufs[key] = {
+                        "q": jnp.zeros((L, *v["q"].shape), jnp.int8),
+                        "s": jnp.zeros((L, *v["s"].shape), jnp.float32),
+                    }
+                elif v.dtype == np.uint16:
+                    bufs[key] = jnp.zeros((L, *np.shape(v)), jnp.uint16)
+                else:
+                    bufs[key] = jnp.zeros((L, *np.shape(v)), dtype)
+        bufs = _layer_setter()(bufs, xs, i)
+        transfer_s += time.perf_counter() - t0
+        for x in layer.values():
+            staging.shrink(x.nbytes)
+
+    params: Params = {}
+
+    def upload_single(key: str, x: np.ndarray, mode: str) -> None:
+        """Singletons (embed / final_norm / lm_head): upload then quantize
+        on device with the same quant.py ops the eager pass runs."""
+        nonlocal transfer_s
+        t0 = time.perf_counter()
+        dev = jnp.asarray(x, dtype)
+        if mode == "col":
+            dev = quantize_weight(dev)
+        elif mode == "row":
+            dev = quantize_row_wise(dev)
+        params[key] = dev
+        transfer_s += time.perf_counter() - t0
+        staging.shrink(x.nbytes)
+
+    pool = ThreadPoolExecutor(
+        max_workers=max(1, int(workers)), thread_name_prefix="weight-load"
+    )
+    try:
+        window = max(1, int(workers)) + 1  # readahead: workers busy + 1 done
+        futures: deque = deque()
+        submitted = 0
+        while submitted < min(window, L):
+            futures.append(pool.submit(read_layer, submitted))
+            submitted += 1
+        # singletons ride the main thread while the pool reads layer 0 —
+        # the embedding table is the single largest transfer, start it first
+        upload_single(
+            "embed",
+            materialize("embed_tokens.weight", None),
+            "row" if quantize and config.tie_embeddings else "plain",
+        )
+        for i in range(L):
+            layer = futures.popleft().result()
+            if submitted < L:
+                futures.append(pool.submit(read_layer, submitted))
+                submitted += 1
+            upload_layer(i, layer)
+        upload_single(
+            "final_norm", materialize("norm.weight", add_norm), "plain"
+        )
+        if not config.tie_embeddings:
+            upload_single(
+                "lm_head",
+                materialize("lm_head.weight", contig_t),
+                "col" if quantize else "plain",
+            )
+        else:
+            consumed.add("lm_head.weight")  # some exports duplicate the tie
+    finally:
+        # a failed read must not be retried NOR keep pulling more of a
+        # poisoned checkpoint: cancel the readahead, then drain
+        pool.shutdown(wait=True, cancel_futures=True)
+        reader.close()
+
+    if bufs and raw16:
+        # one donated reinterpret of the stacked tree: uint16 → bf16.
+        # XLA CPU can't alias a dtype-changing bitcast (it copies and
+        # warns the donation went unused); the donation is for backends
+        # that can, so the warning is noise here, not a leak.
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            bufs = _bitcast16()(dict(bufs))
+        transfer_s += time.perf_counter() - t0
+    params["layers"] = dict(bufs)
+    # re-key to the eager tree's ordering contract: embed, layers,
+    # final_norm(, lm_head) — purely cosmetic, tree.map is order-insensitive
+    params = {
+        k: params[k]
+        for k in ("embed", "layers", "final_norm", "lm_head")
+        if k in params
+    }
+
+    unused = set(index.tensors) - consumed
+    if unused:
+        log.warning(
+            "checkpoint tensors unused by %s: %s", config.name, sorted(unused)[:10]
+        )
+    if not quantize:
+        _check_shapes(params, config)
+    else:
+        # the quantized tree's leaves are {"q","s"} dicts — validate the
+        # q shapes against the config's init tree instead
+        _check_quantized_shapes(params, config)
+
+    if block:
+        t0 = time.perf_counter()
+        jax.block_until_ready(params)
+        transfer_s += time.perf_counter() - t0
+
+    report = WeightLoadReport(
+        streamed=True,
+        workers=max(1, int(workers)),
+        quantize_on_load=bool(quantize),
+        shards=len(index.files),
+        tensors=len(consumed & set(index.tensors)),
+        bytes_read=staging.bytes_read,
+        read_s=staging.read_s,
+        transform_s=staging.transform_s,
+        transfer_s=transfer_s,
+        total_s=time.perf_counter() - t_start,
+        staging_peak_bytes=staging.peak,
+        blocked=bool(block),
+    )
+    log.info(
+        "streamed weight load: %s — %d shards, %d tensors, %.2fGiB in "
+        "%.2fs (read %.2fs ∥ transform %.2fs ∥ transfer %.2fs%s), "
+        "staging peak %.1fMiB, %d workers%s",
+        config.name,
+        report.shards,
+        report.tensors,
+        report.bytes_read / 1024**3,
+        report.total_s,
+        report.read_s,
+        report.transform_s,
+        report.transfer_s,
+        "" if block else " dispatched",
+        report.staging_peak_bytes / 1024**2,
+        report.workers,
+        ", int8 on load" if quantize else "",
+    )
+    return params, report
+
+
+def _check_quantized_shapes(params: Params, config: ModelConfig) -> None:
+    """Shape-validate a quantize-on-load tree against the config: the
+    ``q`` leaf of every quantized dict must match the init tree's weight
+    shape (scales are derived and checked implicitly by construction)."""
+    import jax
+
+    from langstream_tpu.models.quant import is_quantized
+
+    from langstream_tpu.models.transformer import init_params
+
+    expected = jax.eval_shape(
+        lambda key: init_params(config, key), jax.random.PRNGKey(0)
+    )
+    mismatches: list[str] = []
+
+    def walk(path: str, exp: Any, got: Any) -> None:
+        if is_quantized(got):
+            if tuple(exp.shape) != tuple(got["q"].shape):
+                mismatches.append(
+                    f"{path}: expected {tuple(exp.shape)}, got "
+                    f"{tuple(got['q'].shape)} (int8)"
+                )
+        elif isinstance(exp, dict):
+            for key in exp:
+                if key not in got:
+                    mismatches.append(f"{path}.{key}: missing")
+                else:
+                    walk(f"{path}.{key}", exp[key], got[key])
+        elif tuple(exp.shape) != tuple(got.shape):
+            mismatches.append(
+                f"{path}: expected {tuple(exp.shape)}, got {tuple(got.shape)}"
+            )
+
+    walk("params", expected, params)
+    if mismatches:
+        raise ValueError(
+            f"checkpoint does not match config {config.name!r}: "
+            + "; ".join(mismatches)
+        )

@@ -1127,6 +1127,7 @@ class ServingEngine:
         max_restarts: int = 5,
         fault_injector: Optional[FaultInjector] = None,
         migrate_staging: bool = False,
+        weight_load_report: Optional[dict] = None,
         observability: bool = True,
         flight_iterations: int = 256,
         flight_dir: Optional[str] = None,
@@ -1654,6 +1655,17 @@ class ServingEngine:
             flight_capacity=flight_iterations,
             flight_dir=flight_dir,
         )
+        # checkpoint→device load accounting (models/streamload.py via the
+        # tpu-serving holder; docs/SERVING.md §22): surfaced in stats()
+        # and sampled ONCE into the cold-start histogram — engines build
+        # once, so the fleet-wide distribution is the scale-up drill's
+        # weight-load bound
+        self._weight_load_report: dict[str, Any] = dict(weight_load_report or {})
+        if self._weight_load_report.get("total-s"):
+            self._obs.record(
+                "engine_weight_load_s",
+                float(self._weight_load_report["total-s"]),
+            )
         # engine iterations, idle included (the flight recorder's clock)
         self._iterations_total = 0
         # dedicated device→host token fetch thread (started with the loop);
@@ -1763,6 +1775,12 @@ class ServingEngine:
                 # role-tagged replicas (§18): budget the host-RAM staging
                 # one in-flight KV migration claims on this end
                 migrate_staging=bool(migrate_staging) and self._paged,
+                # streamed weight load (§22): the measured host staging
+                # high-water mark, so the startup log's RSS story covers
+                # the load phase the pod was health-probed through
+                weight_load_staging=int(
+                    self._weight_load_report.get("staging-peak-bytes", 0)
+                ),
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -2436,6 +2454,38 @@ class ServingEngine:
             "spmd-recoveries-total": self.spmd_recoveries_total,
             "spmd-resyncs-total": self.spmd_resyncs_total,
             "spmd-watchdog-trips-total": self.spmd_watchdog_trips_total,
+            # streamed weight load (docs/SERVING.md §22): the cold-start
+            # ledger — per-phase wall times of the checkpoint→device
+            # pipeline this engine was built from (zeros for random init,
+            # so the metrics exporter sets its gauges unconditionally —
+            # the standing contract of every block here)
+            "weight-load-streamed": bool(
+                self._weight_load_report.get("streamed", False)
+            ),
+            "weight-load-s": float(
+                self._weight_load_report.get("total-s", 0.0)
+            ),
+            "weight-load-read-s": float(
+                self._weight_load_report.get("read-s", 0.0)
+            ),
+            "weight-load-transform-s": float(
+                self._weight_load_report.get("transform-s", 0.0)
+            ),
+            "weight-load-transfer-s": float(
+                self._weight_load_report.get("transfer-s", 0.0)
+            ),
+            "weight-load-bytes-total": int(
+                self._weight_load_report.get("bytes-read", 0)
+            ),
+            "weight-load-staging-peak-bytes": int(
+                self._weight_load_report.get("staging-peak-bytes", 0)
+            ),
+            "weight-load-shards": int(
+                self._weight_load_report.get("shards", 0)
+            ),
+            "weight-load-workers": int(
+                self._weight_load_report.get("workers", 0)
+            ),
         }
 
     @property

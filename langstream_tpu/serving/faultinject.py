@@ -32,6 +32,10 @@ Sites (where the engine asks ``fires(site)``):
             host-RAM-rot drill) — the arena checksum must catch it and the
             victim admission must fall back to a cold re-prefill, token-
             exact, while survivors and the free lists stay untouched
+  weight-load  raise from the streamed shard reader as if a safetensors
+            shard came up short mid-read (models/streamload.py) — the
+            engine build must abort loudly with the shard + tensor named,
+            never retry the poisoned bytes, never serve partial weights
   fetch     stall the device→host fetch thread (slow-tunnel simulation)
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
@@ -111,6 +115,13 @@ SITES = (
     # drop loses ONE idle heartbeat, so the next delivered announcement
     # carries the seq gap the divergence-resync path must heal.
     "spmd-crash", "spmd-wedge", "spmd-drop",
+    # streamed weight load (models/streamload.py, docs/SERVING.md §22):
+    # consulted by the shard reader before each tensor slice — a firing
+    # simulates a truncated/corrupt shard read. The load must fail with a
+    # WeightLoadError naming the shard file AND the tensor, no partial
+    # engine may come up, and the poisoned checkpoint must never be
+    # re-read (zero retries — wrong weights are worse than no weights)
+    "weight-load",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;

@@ -95,6 +95,14 @@ class ServingMemoryPlan:
     # serialized end-to-end). Host RAM like host_spill_bytes — excluded
     # from the HBM total; 0 on mixed-role replicas.
     migrate_staging_bytes: int = 0
+    # streamed weight load (models/streamload.py, docs/SERVING.md §22):
+    # the host-RAM staging high-water mark of the shard→device pipeline —
+    # the readahead window of per-layer assembly buffers, NOT the ~2×
+    # weights the eager path peaks at. HOST RAM like host_spill_bytes;
+    # excluded from the HBM total, and transient (released once the last
+    # layer uploads) — it appears so the startup log's RSS story covers
+    # the load, the phase the pod is being health-probed through.
+    weight_load_staging_bytes: int = 0
     # self-speculative verify chunk (engine._verify_chunk): the multi-token
     # forward materializes fp32 logits for ALL k+1 positions of every slot
     # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
@@ -148,6 +156,15 @@ class ServingMemoryPlan:
             parts.append(f"grammar-pool {self.grammar_pool_bytes / gib:.2f}GiB + ")
         return "".join(parts)
 
+    def _weight_load_suffix(self) -> str:
+        if not self.weight_load_staging_bytes:
+            return ""
+        return (
+            f" [+ weight-load staging "
+            f"{self.weight_load_staging_bytes / 1024**3:.2f}GiB RAM, "
+            f"transient]"
+        )
+
     def summary(self) -> str:
         gib = 1024**3
         if self.page_pool_bytes:
@@ -161,6 +178,7 @@ class ServingMemoryPlan:
                     f" [+ migrate staging "
                     f"{self.migrate_staging_bytes / gib:.2f}GiB RAM]"
                 )
+            host += self._weight_load_suffix()
             return (
                 f"weights {self.weights_bytes / gib:.2f}GiB + "
                 f"page-pool {self.page_pool_bytes / gib:.2f}GiB "
@@ -183,6 +201,7 @@ class ServingMemoryPlan:
             f"{self._agentic_summary()}"
             f"workspace {self.workspace_bytes / gib:.2f}GiB = "
             f"{self.total_bytes / gib:.2f}GiB"
+            f"{self._weight_load_suffix()}"
         )
 
 
@@ -223,6 +242,7 @@ def plan_serving_memory(
     grammar_slots: int = 0,
     grammar_states: int = 0,
     migrate_staging: bool = False,
+    weight_load_staging: int = 0,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -256,6 +276,10 @@ def plan_serving_memory(
     pool (serving/adapters.py) — 0 omits the term (no adapters).
     ``grammar_slots``/``grammar_states``: shape of the constrained-decoding
     DFA pool (serving/constrain.py) — 0 omits the term.
+    ``weight_load_staging``: measured (or estimated) host-RAM high-water
+    mark of the streamed weight-load pipeline (models/streamload.py) —
+    reported like host_spill_bytes, excluded from the HBM total; 0 omits
+    it (eager load, or no checkpoint).
     """
     from langstream_tpu.models.quant import init_random_quantized_params
     from langstream_tpu.models.transformer import init_params, make_kv_cache
@@ -337,6 +361,7 @@ def plan_serving_memory(
             page_pool_bytes=pool_bytes,
             host_spill_bytes=host_spill_bytes,
             migrate_staging_bytes=migrate_staging_bytes,
+            weight_load_staging_bytes=max(0, int(weight_load_staging)),
             verify_chunk_bytes=(
                 5 * max_batch * (speculation_tokens + 1) * config.vocab_size * 4
                 if speculation_tokens > 0
@@ -408,6 +433,7 @@ def plan_serving_memory(
         ),
         adapter_pool_bytes=adapter_bytes,
         grammar_pool_bytes=grammar_bytes,
+        weight_load_staging_bytes=max(0, int(weight_load_staging)),
     )
 
 

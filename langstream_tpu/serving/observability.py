@@ -97,6 +97,17 @@ ENGINE_HISTOGRAMS: dict[str, dict[str, Any]] = {
                 "admission (s)",
         "buckets": log_buckets(1e-4, 60.0, 4),
     },
+    # cold start (docs/SERVING.md §22, ROADMAP 3a): one sample per engine
+    # build — checkpoint-to-device wall time of the weight load (streamed
+    # pipeline or eager). Sparse by design (engines build once), but the
+    # fleet-wide histogram is exactly the scale-up drill's headline: a
+    # replica resurrected against a warm compile cache should be weight-
+    # load-bound, and this is that bound
+    "engine_weight_load_s": {
+        "help": "checkpoint→device weight load per engine build, read + "
+                "transform + transfer wall (s)",
+        "buckets": log_buckets(1e-2, 600.0, 4),
+    },
 }
 
 

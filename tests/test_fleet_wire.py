@@ -742,7 +742,10 @@ def test_fleet_cancel_racing_stream_completion(eng_plain, http_ring):
     """A cancel that lands AFTER the stream finished is a no-op: the
     peer's registry entry is gone (engine_generate_stream unregisters in
     its finally), the endpoint reports 0 cancelled, the engine stays
-    healthy."""
+    healthy. The client's last read races the server handler's finally,
+    so on a slow box the first cancel can still find the entry of the
+    ALREADY-FINISHED stream — poll to the settled state (0 within the
+    deadline) instead of asserting the first response."""
     key = "sess-race"
     with http_ring.serve(eng_plain) as replica:
         _frames, tokens = _drain(replica.generate_stream(
@@ -750,13 +753,20 @@ def test_fleet_cancel_racing_stream_completion(eng_plain, http_ring):
             {"max-tokens": 4, "temperature": 0.0, "cancel-key": key},
         ))
         assert len(tokens) == 4
-        req = urllib.request.Request(
-            http_ring.url + "/fleet/cancel",
-            data=json.dumps({"session": key}).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=5) as r:
-            assert json.loads(r.read())["cancelled"] == 0
+        deadline = time.monotonic() + 5.0
+        while True:
+            req = urllib.request.Request(
+                http_ring.url + "/fleet/cancel",
+                data=json.dumps({"session": key}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                if json.loads(r.read())["cancelled"] == 0:
+                    break
+            assert time.monotonic() < deadline, (
+                "finished stream's cancel entry never unregistered"
+            )
+            time.sleep(0.05)
         # engine unaffected: the next dispatch completes normally
         _frames, tokens = _drain(replica.generate_stream(
             PROMPT, {"max-tokens": 4, "temperature": 0.0},

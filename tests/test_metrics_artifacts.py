@@ -146,6 +146,8 @@ def test_dashboard_regexes_match_live_exposition():
         "fleet_p2p_fetch_total",
         "fleet_p2p_fetch_fallback_total",
         "fleet_p2p_bytes_in_total",
+        "weight_load_s",
+        "weight_load_bytes_total",
     ):
         serving.gauge(n)
     # the wire byte counter is a LABELED pair of series (§21 protocol split)
@@ -426,6 +428,27 @@ def test_fleet_wire_v2_panels_present():
     assert "fleet_p2p_fetch_total" in p2p
     assert "fleet_p2p_fetch_fallback_total" in p2p
     assert "fleet_p2p_bytes_in_total" in p2p
+
+
+def test_cold_start_panels_present():
+    """The ISSUE-17 cold-start panel must survive dashboard edits: the
+    streamed weight-load panel (models/streamload.py, docs/SERVING.md §22)
+    carries the per-build load wall gauge, the checkpoint bytes-read gauge
+    and the cross-build engine_weight_load_s histogram quantile — the
+    rollout/autoscale health trio for engine build time."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    cold = next(
+        (e for t, e in exprs_by_title.items() if "cold start" in t.lower()),
+        None,
+    )
+    assert cold is not None, "cold-start weight-load panel missing"
+    assert "weight_load_s" in cold
+    assert "weight_load_bytes_total" in cold
+    assert "engine_weight_load_s" in cold
 
 
 def test_grafana_provisioning_parses():
