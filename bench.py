@@ -731,6 +731,116 @@ def bench_tiered_kv(preset: str, quantize: bool, *, n_sessions: int = 8,
     return out
 
 
+def bench_hibernate(preset: str, quantize: bool, *, n_sessions: int = 4,
+                    new_tokens: int = 16, page_size: int = 16) -> dict:
+    """Durable-tier resurrection phase (ISSUE 18 acceptance; docs
+    §23): N chat sessions take a turn on replica A, A hibernates
+    (checkpoints every live arena to the durable dir) and exits; a cold
+    replica B on the same dir rehydrates the index and serves each
+    session's next turn from disk. Measured against a third engine with
+    the tier OFF serving the identical turns cold — the TTFT pair is
+    the price of a replica death WITH vs WITHOUT the durable tier, and
+    the restore accounting proves the warm leg actually came from disk
+    (durable-restored-hits == sessions, zero restore failures)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+
+    config = MODEL_PRESETS[preset]
+    if quantize:
+        from langstream_tpu.models.quant import init_random_quantized_params
+
+        params = init_random_quantized_params(config, jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+    else:
+        params = init_params(config, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(23)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=80).tolist()
+        for _ in range(n_sessions)
+    ]
+    opts = GenerationOptions(max_new_tokens=new_tokens, temperature=0.0)
+    durable_dir = tempfile.mkdtemp(prefix="lstpu-bench-durable-")
+
+    def make(durable: bool) -> ServingEngine:
+        return ServingEngine(
+            config,
+            params,
+            max_batch=2,
+            max_seq_len=256,
+            prefill_buckets=(16, 32, 64),
+            decode_chunk=8,
+            kv_layout="paged",
+            page_size=page_size,
+            kv_pages=4 * n_sessions * (96 // page_size),
+            prefix_cache="auto",
+            prefix_cache_entries=n_sessions * 2,
+            durable="on" if durable else "off",
+            durable_dir=durable_dir if durable else None,
+            precompile=True,
+        )
+
+    out: dict = {"hibernate_sessions": n_sessions}
+    try:
+        # replica A: first turns, then hibernate (checkpoint + exit)
+        a = make(durable=True)
+        a.start()
+        try:
+            for p in prompts:
+                a.submit(GenerationRequest(
+                    prompt_tokens=list(p), options=opts,
+                )).result(timeout=1200)
+            t0 = time.perf_counter()
+            ledger = a.hibernate("bench-a")
+            out["hibernate_wall_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            out["hibernate_entries"] = ledger.get("entries", 0)
+            out["hibernate_mib"] = round(
+                ledger.get("bytes", 0) / 2**20, 2)
+        finally:
+            a.stop()
+        _reclaim()
+
+        # replica B: resurrection — rehydrate the index, serve the next
+        # turns warm from disk; vs a tier-off engine serving them cold
+        for tag, durable in (("resurrect", True), ("cold", False)):
+            eng = make(durable=durable)
+            eng.start()
+            try:
+                ttfts = []
+                for p in prompts:
+                    r = eng.submit(GenerationRequest(
+                        prompt_tokens=list(p), options=opts,
+                    )).result(timeout=1200)
+                    ttfts.append(r.ttft_s)
+                stats = eng.stats()
+            finally:
+                eng.stop()
+            arr = np.asarray(ttfts)
+            out[f"{tag}_next_turn_p50_ttft_ms"] = round(
+                float(np.percentile(arr, 50)) * 1e3, 2)
+            out[f"{tag}_next_turn_p99_ttft_ms"] = round(
+                float(np.percentile(arr, 99)) * 1e3, 2)
+            if durable:
+                out["resurrect_restored_hits"] = stats[
+                    "durable-restored-hits-total"]
+                out["resurrect_restore_mib"] = round(
+                    stats["durable-restore-bytes-total"] / 2**20, 2)
+                out["resurrect_restore_failures"] = stats[
+                    "durable-restore-failures-total"]
+            _reclaim()
+    finally:
+        shutil.rmtree(durable_dir, ignore_errors=True)
+    return out
+
+
 def bench_tenancy(preset: str, quantize: bool, *, max_batch: int = 4,
                   n_requests: int = 24, new_tokens: int = 16,
                   max_seq_len: int = 256, decode_chunk: int = 4) -> dict:
@@ -1964,6 +2074,20 @@ def main() -> None:
         ))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] tiered-KV phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # durable-tier resurrection (ISSUE 18 acceptance, docs §23): replica
+    # A hibernates N sessions to disk, replica B resurrects them — the
+    # next-turn TTFT pair vs a tier-off cold engine is the price of a
+    # replica death with vs without the durable tier
+    print("[bench] durable-tier hibernate/resurrect phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_hibernate(
+            preset, quantize, n_sessions=4 if not on_tpu else 16,
+            new_tokens=16,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] hibernate phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # observability overhead pair: histograms + spans + flight recorder on
     # vs off over the same decode workload (§12; PERF.md round 11) — the

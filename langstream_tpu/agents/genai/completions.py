@@ -360,6 +360,67 @@ class _BaseCompletionsStep(Step):
             "page bytes admitted from peers by completed P2P fetches "
             "(receiver-ACKed), cumulative",
         )
+        # durable session tier (serving/durable.py, docs/SERVING.md §23):
+        # disk checkpoint/restore volume plus the two failure modes an
+        # operator alerts on — restore failures (rot, torn writes) and
+        # dead entries (checkpoints discarded as unreadable). All
+        # engine-cumulative, gauges like the spill set above.
+        self._m_durable_entries = metrics.gauge(
+            "durable_entries",
+            "session checkpoints resident in the durable tier's on-disk "
+            "index right now",
+        )
+        self._m_durable_bytes = metrics.gauge(
+            "durable_bytes_on_disk",
+            "bytes the durable tier currently holds on disk (frame "
+            "streams + manifests)",
+        )
+        self._m_durable_checkpoints = metrics.gauge(
+            "durable_checkpoints_total",
+            "session checkpoints durably committed (temp+fsync+rename "
+            "landed), cumulative",
+        )
+        self._m_durable_ckpt_bytes = metrics.gauge(
+            "durable_checkpoint_bytes_total",
+            "bytes durably committed by session checkpoints, cumulative",
+        )
+        self._m_durable_restores = metrics.gauge(
+            "durable_restores_total",
+            "sessions resurrected from the durable tier (disk → device "
+            "bind verified), cumulative",
+        )
+        self._m_durable_restore_bytes = metrics.gauge(
+            "durable_restore_bytes_total",
+            "bytes read back by durable-tier restores, cumulative",
+        )
+        self._m_durable_restore_failures = metrics.gauge(
+            "durable_restore_failures_total",
+            "durable restores that failed (torn frame, checksum "
+            "mismatch, stall, dead entry) and degraded to local cold "
+            "prefill, cumulative",
+        )
+        self._m_durable_dead = metrics.gauge(
+            "durable_dead_entries_total",
+            "checkpoints discarded as unreadable (torn write, rot, "
+            "missing manifest), cumulative",
+        )
+        # prefetch-on-hint (§23): beacon-driven warm fetches issued ahead
+        # of request routing, router-cumulative like the P2P set
+        self._m_fleet_prefetch = metrics.gauge(
+            "fleet_prefetch_total",
+            "prefetch hints accepted by the router (beacon said a deeper "
+            "owner exists), cumulative",
+        )
+        self._m_fleet_prefetch_fetch = metrics.gauge(
+            "fleet_prefetch_fetch_total",
+            "prefetch hints that completed a P2P/durable page fetch "
+            "before the request routed, cumulative",
+        )
+        self._m_fleet_cost_routed = metrics.gauge(
+            "fleet_p2p_cost_routed_total",
+            "P2P fetch decisions made by the bytes-vs-prefill cost model "
+            "(rather than the flat threshold floor), cumulative",
+        )
         self._m_weight_load_s = metrics.gauge(
             "weight_load_s",
             "checkpoint→device weight load wall time for this engine "
@@ -453,6 +514,22 @@ class _BaseCompletionsStep(Step):
         self._m_flight_dumps.set(stats.get("flight-dumps-total", 0))
         self._m_weight_load_s.set(stats.get("weight-load-s", 0))
         self._m_weight_load_bytes.set(stats.get("weight-load-bytes-total", 0))
+        self._m_durable_entries.set(stats.get("durable-entries", 0))
+        self._m_durable_bytes.set(stats.get("durable-bytes-on-disk", 0))
+        self._m_durable_checkpoints.set(
+            stats.get("durable-checkpoints-total", 0)
+        )
+        self._m_durable_ckpt_bytes.set(
+            stats.get("durable-checkpoint-bytes-total", 0)
+        )
+        self._m_durable_restores.set(stats.get("durable-restores-total", 0))
+        self._m_durable_restore_bytes.set(
+            stats.get("durable-restore-bytes-total", 0)
+        )
+        self._m_durable_restore_failures.set(
+            stats.get("durable-restore-failures-total", 0)
+        )
+        self._m_durable_dead.set(stats.get("durable-dead-entries-total", 0))
         fleet = getattr(self._service, "fleet_stats", lambda: None)() or {}
         self._m_fleet_affinity.set(
             fleet.get("fleet-routed-affinity-total", 0)
@@ -489,6 +566,13 @@ class _BaseCompletionsStep(Step):
         )
         self._m_fleet_p2p_bytes_in.set(
             fleet.get("fleet-p2p-bytes-in-total", 0)
+        )
+        self._m_fleet_prefetch.set(fleet.get("fleet-prefetch-total", 0))
+        self._m_fleet_prefetch_fetch.set(
+            fleet.get("fleet-prefetch-fetch-total", 0)
+        )
+        self._m_fleet_cost_routed.set(
+            fleet.get("fleet-p2p-cost-routed-total", 0)
         )
         for name, snap in (stats.get("histograms") or {}).items():
             mirror = self._m_hists.get(name)

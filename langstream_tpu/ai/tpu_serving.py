@@ -189,6 +189,7 @@ the first token becomes the first chunk — TTFT is one decode step.
 from __future__ import annotations
 
 import asyncio
+import logging
 import threading
 import uuid
 from typing import Any, Optional
@@ -205,6 +206,8 @@ from langstream_tpu.ai.provider import (
     StreamingChunksConsumer,
 )
 from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions, ModelConfig
+
+log = logging.getLogger(__name__)
 
 
 class _EngineHolder:
@@ -547,6 +550,19 @@ class _EngineHolder:
             restore_stall_dump_s=float(
                 self.config.get("restore-stall-dump-s", 1.0)
             ),
+            # durable session tier (docs/SERVING.md §23): crash-safe disk
+            # checkpoints — `durable: auto` turns on iff `durable-dir` is
+            # set, so the block is one knob in the common case
+            durable=self.config.get("durable", "auto"),
+            durable_dir=(
+                str(self.config["durable-dir"])
+                if self.config.get("durable-dir")
+                else None
+            ),
+            durable_max_bytes=int(self.config.get("durable-max-bytes", 0)),
+            durable_timeout_s=float(
+                self.config.get("durable-timeout-s", 5.0)
+            ),
             prefix_cache=px,  # validated at the top of this method
             prefix_cache_fraction=float(
                 self.config.get("prefix-cache-fraction", 0.25)
@@ -679,6 +695,10 @@ class _EngineHolder:
                 # one attribute read (never stats()) — /healthz surfaces
                 # the crash→rebuild→backoff window for readiness probes
                 recovering_fn=lambda: engine.recovering,
+                # same discipline for the durable tier (§23): True while
+                # a disk restore is serving an admission, so readiness
+                # can tell resurrection-in-progress from wedged
+                restoring_fn=lambda: getattr(engine, "restoring", False),
             )
         return engine
 
@@ -730,6 +750,7 @@ class _EngineHolder:
                     FleetRouter,
                     HttpReplica,
                     InProcessReplica,
+                    register_local_router,
                 )
 
                 rid = self._fleet_replica_id or "local"
@@ -780,8 +801,16 @@ class _EngineHolder:
                     p2p_threshold=int(
                         self.config.get("fleet-p2p-threshold", 256)
                     ),
+                    # fetch-vs-prefill cost model floor (§23): below this
+                    # token gap a hint never fetches, estimates or not
+                    p2p_min_gap=int(
+                        self.config.get("fleet-p2p-min-gap", 0)
+                    ),
                 )
                 router.start()
+                # the HTTP prefetch surface (§23): POST /fleet/prefetch
+                # reaches this router through the process registry
+                register_local_router(router)
                 self._fleet_router = router
             return self._fleet_router
 
@@ -833,6 +862,9 @@ class _EngineHolder:
             rid, self._fleet_replica_id = self._fleet_replica_id, None
             engine = self._engine
         if router is not None:
+            from langstream_tpu.serving.fleet import unregister_local_router
+
+            unregister_local_router()
             router.stop()
         if rid is not None:
             from langstream_tpu.serving import fleet as fleet_mod
@@ -843,6 +875,21 @@ class _EngineHolder:
             # bounded grace period — stop() alone _fail_alls work that
             # only needed a few more chunks
             engine.drain(float(self.config.get("drain-grace-s", 10.0)))
+            # replica hibernation (§23): with the durable tier on,
+            # checkpoint every live session to disk AFTER the drain
+            # (streams finished; entries quiesced) and BEFORE close()'s
+            # engine.stop() kills the command loop. No-op ({}) with the
+            # tier off; failure degrades to whatever already checkpointed
+            # — the drain itself never blocks on a wedged disk.
+            if hasattr(engine, "hibernate"):
+                ledger = engine.hibernate(rid or "")
+                if ledger:
+                    log.info(
+                        "replica %s hibernated: %s session prefix(es), "
+                        "%s bytes, %s failure(s)",
+                        rid or "local", ledger.get("entries"),
+                        ledger.get("bytes"), ledger.get("failures"),
+                    )
 
     def close(self) -> None:
         self.begin_drain()

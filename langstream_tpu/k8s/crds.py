@@ -104,7 +104,11 @@ class AgentCustomResource:
     # {enabled, min-replicas, max-replicas}. The DESIRED count itself is
     # runtime state — the router's queue-wait-EMA hint, written to
     # status.fleet.desiredReplicas by the ops loop — so a scale decision
-    # never touches the spec checksum (no pod rollout, just more pods)
+    # never touches the spec checksum (no pod rollout, just more pods).
+    # min-replicas: 0 is legal (scale-to-zero, §23) — the router emits a
+    # zero hint only when every replica checkpoints its sessions to the
+    # durable tier, so scaling down loses nothing a resurrection can't
+    # restore
     autoscale: Optional[dict[str, Any]] = None
     # multi-tenant overload control (serving/tenancy.py, docs/SERVING.md
     # §19): the declared tenants and their scheduling policy — list of

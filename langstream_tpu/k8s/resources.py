@@ -156,7 +156,16 @@ class AgentResourcesFactory:
         ``status.fleet.desiredReplicas`` — then the hint wins, clamped to
         the spec's ``min-replicas``/``max-replicas`` bounds so a runaway
         signal can never scale past what the operator budgeted
-        (docs/SERVING.md §13)."""
+        (docs/SERVING.md §13).
+
+        ``min-replicas: 0`` is LEGAL (scale-to-zero, §23): the router
+        only emits a zero hint when demand has been quiet and every
+        replica advertises durable checkpoints, and the drain hook
+        hibernates each replica's sessions to the durable volume on the
+        way down — so desired=0 is satisfiable without losing a single
+        session. A later hint (the round-13 prefetch or any route)
+        resurrects the StatefulSet and the replicas rehydrate from
+        disk."""
         base = max(1, agent.parallelism)
         auto = agent.autoscale or {}
         if not auto.get("enabled"):
@@ -164,7 +173,7 @@ class AgentResourcesFactory:
         hint = (agent.status.get("fleet") or {}).get("desiredReplicas")
         if hint is None:
             return base
-        lo = max(1, int(auto.get("min-replicas", 1)))
+        lo = max(0, int(auto.get("min-replicas", 1)))
         hi = max(lo, int(auto.get("max-replicas", max(base, 8))))
         return max(lo, min(int(hint), hi))
 
@@ -398,6 +407,11 @@ class FleetAutoscaleReconciler:
     - Crash-tolerant: a failed read/patch logs and retries next tick; the
       hint is advisory, so staleness degrades to "no scaling", never to a
       wrong spec.
+    - Scale-to-zero passes through untouched (§23): a zero hint is only
+      emitted by the router when the fleet is quiet AND fully durable
+      (every replica hibernates its sessions to disk on drain), and
+      ``fleet_consumers`` only honors it under ``min-replicas: 0`` — the
+      reconciler itself never second-guesses either side.
 
     Works against any client with ``get(kind, ns, name)`` +
     ``patch_status(kind, ns, name, status)`` — the in-cluster HTTPS client

@@ -43,6 +43,7 @@ class RuntimeHttpServer:
                 web.post("/fleet/migrate-out", self._fleet_migrate_out),
                 web.post("/fleet/pages", self._fleet_pages),
                 web.post("/fleet/fetch", self._fleet_fetch),
+                web.post("/fleet/prefetch", self._fleet_prefetch),
                 web.post("/fleet/reset", self._fleet_reset),
                 web.get("/healthz", self._healthz),
             ]
@@ -476,6 +477,40 @@ class RuntimeHttpServer:
             raise web.HTTPBadRequest(reason=str(e)) from None
         return web.json_response(ack)
 
+    async def _fleet_prefetch(self, request: web.Request) -> web.Response:
+        """Prefetch-on-hint (§23): warm a session's pages on the replica
+        its next request WILL route to, before the request exists — a
+        gateway posts ``prompt_tokens`` (plus optional ``session`` /
+        ``adapter`` / ``tenant``) when it knows a turn is coming (client
+        typing, an agent's scheduled step, a scale-from-zero
+        resurrection hint). Best-effort by contract: every failure
+        answers ``{"prefetched": false}`` with HTTP 200 and the eventual
+        request simply pays its normal cold path."""
+        import asyncio
+
+        from langstream_tpu.serving.fleet import (
+            FleetShedError,
+            ReplicaError,
+            local_prefetch,
+        )
+
+        try:
+            payload = await request.json()
+        except ValueError:
+            raise web.HTTPBadRequest(reason="body must be JSON") from None
+        loop = asyncio.get_running_loop()
+        try:
+            ack = await loop.run_in_executor(None, local_prefetch, payload)
+        except FleetShedError as e:
+            return web.json_response({"prefetched": False, "error": str(e)})
+        except ReplicaError as e:
+            return web.json_response(
+                {"prefetched": False, "error": str(e)}, status=503
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(reason=str(e)) from None
+        return web.json_response(ack)
+
     async def _fleet_cancel(self, request: web.Request) -> web.Response:
         """Cross-process session cancellation (ROADMAP 3b, docs/SERVING.md
         §13): the gateway that saw the client disconnect forwards the
@@ -537,12 +572,24 @@ class RuntimeHttpServer:
         a seconds-long recovery into a full cold start. `recovering` is
         surfaced for readiness probes that want to hold traffic instead."""
         try:
-            from langstream_tpu.serving.fleet import local_recovering
+            from langstream_tpu.serving.fleet import (
+                local_recovering,
+                local_restoring,
+            )
 
             recovering = local_recovering()
+            restoring = local_restoring()
         except Exception:  # noqa: BLE001 — health endpoint must not 500
             recovering = False
-        return web.json_response({"status": "OK", "recovering": recovering})
+            restoring = False
+        return web.json_response({
+            "status": "OK",
+            "recovering": recovering,
+            # durable-tier restore in progress (§23): scale-from-zero
+            # readiness can hold traffic through a resurrection without
+            # killing the pod for being "slow"
+            "restoring": restoring,
+        })
 
     async def start(self) -> None:
         self._runner = web.AppRunner(self.app)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import functools
 import logging
 import math
+import os
 import queue
 import threading
 import time
@@ -1050,6 +1051,94 @@ class _SpillWorker:
             handle.event.set()
 
 
+def _durable_empty_stats() -> dict:
+    """Zeroed durable-tier stats keys (tier off) — the exporter sets its
+    gauges unconditionally, so the keys must exist either way."""
+    from langstream_tpu.serving.durable import DurableStore
+
+    return DurableStore.empty_stats()
+
+
+class _DurableWorker:
+    """Dedicated checkpoint thread for the durable tier (docs/SERVING.md
+    §23; the _SpillWorker pattern one tier down): the engine thread
+    materializes immutable checkpoint jobs — raw page byte images + their
+    spill-time checksums, copied OUT of the arena so a later drop/evict
+    cannot race the write — and the fsync-heavy temp+rename disk write
+    runs here, strictly off the hot loop. Failures are counted by the
+    store and logged, never raised: a failed checkpoint leaves the
+    session restorable from its owner, and crash-safety is the store's
+    on-disk construction, not this thread's error handling."""
+
+    def __init__(self, store: Any, obs: Optional[EngineObservability] = None):
+        self._store = store
+        self._obs = obs
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._thread: Optional[threading.Thread] = None
+
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self) -> None:
+        if self.alive():
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="serving-durable", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> bool:
+        t = self._thread
+        if t is None:
+            return True
+        self._queue.put(None)
+        t.join(timeout=timeout)
+        if t.is_alive():
+            return False
+        self._thread = None
+        return True
+
+    def submit(self, job: dict) -> None:
+        self._queue.put(job)
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Barrier: True once every job enqueued BEFORE this call has
+        been written (or failed). Hibernation flushes before it walks
+        the index so no session is checkpointed twice."""
+        if not self.alive():
+            return True
+        ev = threading.Event()
+        self._queue.put(ev)
+        return ev.wait(timeout)
+
+    def _run(self) -> None:
+        from langstream_tpu.serving.durable import DurableError
+
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            if isinstance(job, threading.Event):
+                job.set()
+                continue
+            t0 = time.monotonic()
+            try:
+                self._store.checkpoint(
+                    job["digest"], job["length"], job["tokens"],
+                    job["pages_raw"], job["checksums"],
+                    job["page_size"], job["bytes_per_page"],
+                )
+                if self._obs is not None and self._obs.on:
+                    self._obs.record(
+                        "engine_durable_checkpoint_s", time.monotonic() - t0
+                    )
+            except DurableError as e:
+                log.warning("durable checkpoint failed: %s", e)
+            except BaseException:  # noqa: BLE001 — degrade one entry only
+                log.exception("durable checkpoint crashed")
+
+
 def _make_insert_group():
     @functools.partial(jax.jit, donate_argnames=("cache",))
     def insert_group(cache, local_cache, slots):
@@ -1103,6 +1192,10 @@ class ServingEngine:
         spill: Any = "auto",
         spill_idle_s: float = 0.0,
         restore_stall_dump_s: float = 1.0,
+        durable: Any = "auto",
+        durable_dir: Optional[str] = None,
+        durable_max_bytes: int = 0,
+        durable_timeout_s: float = 5.0,
         prefix_cache: Any = False,
         prefix_cache_fraction: float = 0.25,
         prefix_cache_entries: Optional[int] = None,
@@ -1273,6 +1366,54 @@ class ServingEngine:
         # recorder phase_ms; reset at iteration top)
         self._spill_ms_iter = 0.0
         self._restore_ms_iter = 0.0
+        # -- durable session tier: crash-safe KV checkpoints on disk
+        # (docs/SERVING.md §23, ROADMAP 2b/3b). durable-dir names the
+        # checkpoint directory (shared volume / object-store mount); the
+        # tier checkpoints hibernated arenas there so sessions survive
+        # replica death, drain and scale-to-zero, and a cold replica
+        # rehydrates the index at boot (resurrection).
+        if str(durable).lower() not in ("auto", "on", "true", "1", "off",
+                                        "false", "0"):
+            raise ValueError(
+                f"unknown durable {durable!r}; supported: auto, off"
+            )
+        durable_off = str(durable).lower() in ("off", "false", "0")
+        durable_ask = str(durable).lower() in ("on", "true", "1")
+        self.durable_dir = str(durable_dir) if durable_dir else None
+        self.durable_timeout_s = max(0.1, float(durable_timeout_s))
+        self._durable_max_bytes = max(0, int(durable_max_bytes))
+        durable_on = (
+            self._paged and not durable_off and self.durable_dir is not None
+        )
+        if spmd is not None and durable_on:
+            # same wire gap as the host tier above: checkpoint/restore
+            # decisions are leader-side host state and the restore upload
+            # is a device dispatch followers would need to replay. LOUD
+            # disable — durable-dir is an explicit ask.
+            log.warning(
+                "durable KV tier is not on the SPMD wire yet; off on this "
+                "multi-host replica (durable-dir %s ignored)",
+                self.durable_dir,
+            )
+            durable_on = False
+        if durable_ask and not durable_on:
+            log.warning(
+                "durable: on requested but unavailable (needs kv-layout: "
+                "paged + durable-dir, single-host) — tier stays off"
+            )
+        self._durable_on = durable_on
+        self._durable = None  # DurableStore, built with the pool below
+        self._durable_worker: Optional[_DurableWorker] = None
+        # admissions served by a durable-tier resurrection (the restore
+        # split's third rung: device hit / host restore / durable restore)
+        self.durable_restored_hits_total = 0
+        # True while a durable restore is serving an admission — the
+        # /healthz "restoring" readiness signal during resurrection
+        self._durable_restoring = False
+        # tokens covered by landed prefill dispatches: with the dispatch
+        # histogram's wall-time sum this yields the landed prefill
+        # throughput the router's fetch-vs-prefill cost model consumes
+        self._prefill_tokens_dispatched = 0
         # -- KV-page migration (disaggregated serving, docs/SERVING.md §18):
         # commands from migration threads (HTTP handlers, the fleet
         # router's dispatch executors) executed at iteration top on the
@@ -1781,6 +1922,10 @@ class ServingEngine:
                 weight_load_staging=int(
                     self._weight_load_report.get("staging-peak-bytes", 0)
                 ),
+                # durable tier (§23): disk budget, reported-only
+                durable_max_bytes=(
+                    self._durable_max_bytes if self._durable_on else 0
+                ),
             )
             self._plan = plan
             devices = mesh.devices.size if mesh is not None else 1
@@ -1846,6 +1991,46 @@ class ServingEngine:
                     host_pages, self._host_tier.bytes_total / 1024**3,
                     self.host_kv_fraction, self.spill_idle_s,
                 )
+            if self._durable_on and self._prefix_index is not None:
+                from langstream_tpu.serving.durable import DurableStore
+
+                try:
+                    self._durable = DurableStore(
+                        self.durable_dir,
+                        max_bytes=self._durable_max_bytes,
+                        injector=self._injector,
+                    )
+                    rehydrated = self._durable.rehydrate()
+                except OSError:
+                    # an unwritable volume must not fail the boot — the
+                    # tier degrades to off, sessions fall back to the
+                    # host tier / re-prefill exactly as with durable: off
+                    log.exception(
+                        "durable tier unavailable (%s) — off", self.durable_dir
+                    )
+                    self._durable = None
+                if self._durable is not None:
+                    self._durable_worker = _DurableWorker(
+                        self._durable, self._obs
+                    )
+                    log.info(
+                        "durable KV tier: %s (%d checkpointed session "
+                        "prefix(es) rehydrated%s) — hibernated arenas "
+                        "checkpoint crash-safe; sessions survive replica "
+                        "death and scale-to-zero",
+                        self.durable_dir, rehydrated,
+                        (
+                            f", cap {self._durable_max_bytes / 1024**3:.2f} GiB"
+                            if self._durable_max_bytes
+                            else ""
+                        ),
+                    )
+            elif self._durable_on:
+                log.warning(
+                    "durable tier needs the prefix index (prefix-cache: "
+                    "auto) — off"
+                )
+                self._durable_on = False
 
     # -- public API ---------------------------------------------------------
 
@@ -1857,6 +2042,8 @@ class ServingEngine:
         self._fetcher.start()
         if self._spill_worker is not None:
             self._spill_worker.start()
+        if self._durable_worker is not None:
+            self._durable_worker.start()
         self._thread = threading.Thread(target=self._run, name="serving-engine", daemon=True)
         self._thread.start()
 
@@ -1868,6 +2055,8 @@ class ServingEngine:
         self._fetcher.stop()
         if self._spill_worker is not None:
             self._spill_worker.stop()
+        if self._durable_worker is not None:
+            self._durable_worker.stop()
         # resolve everything still in flight so blocked callers return now
         self._fail_all(RuntimeError("serving engine stopped"))
 
@@ -2160,7 +2349,22 @@ class ServingEngine:
         index = self._prefix_index if self._prefix_index is not None else self._prefix_pool
         if index is None:
             return (), []
-        return tuple(index.boundaries), index.advertised(top_k)
+        ads = index.advertised(top_k)
+        if self._prefix_index is not None and self._durable is not None:
+            # checkpoints that outlived their live entry still serve (the
+            # snapshot path reads them off disk): beacon them at tier
+            # "durable" so the router can prefetch/route onto them —
+            # resurrection is useless if nobody knows the bytes exist
+            live = {d for d, _, _ in ads}
+            extra = top_k
+            for digest, length in self._durable.entries():
+                if extra <= 0:
+                    break
+                if digest in live:
+                    continue
+                ads.append((digest, length, "durable"))
+                extra -= 1
+        return tuple(index.boundaries), ads
 
     def _counters_snapshot(self) -> dict[str, Any]:
         with self._stats_lock:
@@ -2269,6 +2473,9 @@ class ServingEngine:
             "kv-pages-in-use": (
                 self._pagepool.pages_in_use if self._pagepool else 0
             ),
+            "kv-bytes-per-page": (
+                self._pagepool.bytes_per_page if self._pagepool else 0
+            ),
             "kv-page-alias-rate": (
                 round(
                     self._pagepool.aliased_pages_total
@@ -2352,6 +2559,15 @@ class ServingEngine:
             "migrate-pages-in-total": self.migrate_pages_in_total,
             "migrate-bytes-in-total": self.migrate_bytes_in_total,
             "migrate-failures-total": self.migrate_failures_total,
+            # durable session tier (§23) — zeros with the tier off, same
+            # exporter contract as every block above
+            "durable-tier": self._durable is not None,
+            "durable-restored-hits-total": self.durable_restored_hits_total,
+            **(
+                self._durable.stats()
+                if self._durable is not None
+                else _durable_empty_stats()
+            ),
             # self-speculative decoding (zeros with speculation off, so the
             # metrics exporter sets its gauges unconditionally)
             "speculation": self._spec_enabled,
@@ -4207,6 +4423,9 @@ class ServingEngine:
             self._obs.record(
                 "engine_prefill_dispatch_s", time.monotonic() - started
             )
+        self._prefill_tokens_dispatched += sum(
+            len(r.prompt_tokens) for _, r in group
+        )
 
         for idx, request in group:
             slot = self._slots[idx]
@@ -4433,6 +4652,7 @@ class ServingEngine:
             self._obs.record(
                 "engine_prefill_dispatch_s", time.monotonic() - started
             )
+        self._prefill_tokens_dispatched += len(suffix)
         slot = self._slots[idx]
         slot.request = request
         slot.position = len(prompt)
@@ -4675,6 +4895,13 @@ class ServingEngine:
                 # a cold ending (its retry may restore and must not land
                 # on both sides of the restore-vs-recompute split)
                 request._tier_fallback_counted = True
+            if hit is None and self._durable is not None:
+                # third rung of the ladder (§23): nothing live covered the
+                # prompt — resurrect from the durable store if a checkpoint
+                # does. Any failure degrades to cold prefill right here.
+                hit = self._durable_admit(request, prompt)
+                if hit is not None:
+                    request._tier_restored = True
         shared: tuple[int, ...] = ()
         cow_src = None
         p, entry = 0, None
@@ -4820,6 +5047,7 @@ class ServingEngine:
             self._obs.record(
                 "engine_prefill_dispatch_s", time.monotonic() - started
             )
+        self._prefill_tokens_dispatched += len(suffix)
         slot = self._slots[idx]
         slot.request = request
         slot.position = len(prompt)
@@ -5159,6 +5387,10 @@ class ServingEngine:
             self._prefix_index._note_tier(entry)
             self.spill_pages_total += len(handle.slots)
             self.spill_bytes_total += len(handle.slots) * tier.bytes_per_page
+            # durable tier (§23): a completed spill is the checkpoint
+            # trigger — the arena bytes and their stamps are final now,
+            # so the session can be made to survive THIS replica too
+            self._maybe_checkpoint(entry)
 
     def _ensure_spilled(self, entry) -> bool:
         """Secure a host copy for ``entry`` (the demote-before-drop gate):
@@ -5215,6 +5447,11 @@ class ServingEngine:
                 # benign — the sweep's host/spilling checks skip them)
                 self._spill_candidates.append(victim)
             else:
+                # durable rescue (§23): a host-only victim is gone for
+                # good after the drop — materialize its checkpoint job
+                # FIRST (the worker holds its own byte copies, so the
+                # drop below cannot race the disk write)
+                self._maybe_checkpoint(victim)
                 index._drop(self._pagepool, victim)
             index.host_evictions += 1
 
@@ -5366,6 +5603,251 @@ class ServingEngine:
             })
         return True
 
+    # -- durable session tier (docs/SERVING.md §23) --------------------------
+
+    def _durable_job(self, entry) -> Optional[dict]:
+        """Materialize one entry's checkpoint job (engine thread): raw
+        page byte images + their SPILL-TIME checksums. Host-resident
+        entries read the arena and ship the stored stamps as-is;
+        device-only entries (hibernation's device path) fetch their page
+        snapshots and stamp here — for a page that never spilled, this
+        first hash IS its spill-time stamp. None when the entry holds
+        nothing checkpointable (in-flight spill, arena rot, no token
+        path) — the caller skips, never fails."""
+        from langstream_tpu.serving.pagepool import (
+            join_page_bytes, page_checksum,
+        )
+
+        tier, pool, index = self._host_tier, self._pagepool, self._prefix_index
+        if entry.dropped or not entry.digest or entry.length <= 0:
+            return None
+        tokens = index.entry_tokens(entry)
+        if len(tokens) != entry.length:
+            return None
+        n = math.ceil(entry.length / self.page_size)
+        pages_raw: list[bytes] = []
+        sums: list[str] = []
+        if (
+            entry.host
+            and entry.spilling is None
+            and tier is not None
+            and len(entry.host) >= n
+        ):
+            for slot in entry.host[:n]:
+                block = tier.read(slot)
+                if block is None:
+                    return None  # arena rot: restore paths count it
+                leaves = jax.tree.leaves(block)
+                pages_raw.append(join_page_bytes(leaves))
+                sums.append(tier.checksum(slot).hex())
+        elif entry.pages and len(entry.pages) >= n:
+            self._record_program("page-snapshot")
+            for pg in entry.pages[:n]:
+                block = _page_snapshot(pool.dev, jnp.asarray(pg, jnp.int32))
+                leaves = [
+                    np.asarray(jax.device_get(leaf))
+                    for leaf in jax.tree.leaves(block)
+                ]
+                pages_raw.append(join_page_bytes(leaves))
+                sums.append(page_checksum(leaves).hex())
+        else:
+            return None
+        return {
+            "digest": entry.digest, "length": int(entry.length),
+            "tokens": tokens, "pages_raw": pages_raw, "checksums": sums,
+            "page_size": self.page_size,
+            "bytes_per_page": pool.bytes_per_page,
+        }
+
+    def _maybe_checkpoint(self, entry) -> None:
+        """Enqueue a durable checkpoint for ``entry`` if the tier is on
+        and no checkpoint exists yet (engine thread; the disk write runs
+        on the durable worker). Failure-free by design: anything not
+        checkpointable is simply skipped — the session keeps its
+        host/device copy and a later trigger retries."""
+        if self._durable is None or self._durable_worker is None:
+            return
+        if self._durable.contains(entry.digest):
+            return
+        job = self._durable_job(entry)
+        if job is not None:
+            self._durable_worker.submit(job)
+
+    def _durable_admit(self, request, prompt) -> Optional[tuple]:
+        """Admission-path resurrection: no live index candidate covered
+        ``prompt``, so probe the durable store at the deepest boundary,
+        restore + verify the checkpoint and bind it INLINE on the engine
+        thread (_migrate_rpc would deadlock the loop against itself).
+        Returns ``(length, entry)`` like a radix hit, or None with the
+        request degrading to a cold prefill. EVERY failure — torn file,
+        CRC/checksum mismatch, stale manifest, stalled volume, full pool
+        — dumps ``durable-restore-failed`` (token-content-free) and the
+        store marks its entry dead, so a failure fires once, never a
+        retry loop on poison."""
+        from langstream_tpu.serving.durable import DurableError
+        from langstream_tpu.serving.migrate import MigrationError, _leaf_specs
+        from langstream_tpu.serving.pagepool import (
+            page_checksum, prefix_digest, split_page_bytes,
+        )
+
+        store, index = self._durable, self._prefix_index
+        if store is None or getattr(request, "_durable_failed", False):
+            return None
+        digest, length = None, 0
+        for b in reversed(index.boundaries):
+            if b <= len(prompt) - 1:
+                d = prefix_digest(prompt[:b])
+                if store.contains(d):
+                    digest, length = d, b
+                    break
+        if digest is None:
+            return None
+        t0 = time.monotonic()
+        self._durable_restoring = True
+        try:
+            rec = store.restore(digest, timeout_s=self.durable_timeout_s)
+            specs = _leaf_specs(self)
+            blocks = []
+            for i, raw in enumerate(rec["pages"]):
+                leaves = split_page_bytes(raw, specs)
+                if page_checksum(leaves).hex() != rec["checksums"][i]:
+                    # the manifest stamp (spill-time, never re-hashed) is
+                    # the authority: poison must not be retried
+                    store.invalidate(
+                        digest, f"page {i} failed its spill-time checksum"
+                    )
+                    raise DurableError(
+                        f"page {i} failed its spill-time checksum"
+                    )
+                blocks.append(leaves)
+            self._migrate_cmd("bind", {
+                "tokens": list(prompt[:length]), "length": length,
+                "blocks": blocks,
+            })
+        except (DurableError, MigrationError, ValueError) as e:
+            # a full receiver pool is the ONE retryable failure (a later
+            # iteration may have evicted room); everything else is a dead
+            # entry and must degrade to cold prefill exactly once
+            request._durable_failed = not isinstance(e, MigrationError)
+            self._flight_dump("durable-restore-failed", extra={
+                "error": str(e),
+                "entry-digest": digest,
+                "reuse-tokens": length,
+                "total-ms": round((time.monotonic() - t0) * 1e3, 3),
+                "fallback": "local-cold-prefill",
+            }, force=True)
+            log.warning(
+                "durable restore of %s failed (%s); prefilling cold",
+                digest, e,
+            )
+            return None
+        finally:
+            self._durable_restoring = False
+        took = time.monotonic() - t0
+        if self._obs.on:
+            self._obs.record("engine_durable_restore_s", took)
+        self.durable_restored_hits_total += 1
+        self._restore_ms_iter += took * 1e3
+        # the bind inserted a live entry: serve it like any radix hit
+        for p_cand, cand in reversed(index.candidates(prompt)):
+            if not cand.dropped and cand.pages:
+                return p_cand, cand
+        return None
+
+    def _durable_snapshot(self, tokens) -> Optional[dict]:
+        """Snapshot branch for prefixes that outlived their index entry
+        (engine thread, under _migrate_cmd): a P2P fetch / migration can
+        be served STRAIGHT from the durable checkpoint — the wire codec
+        is the disk format, so the bytes just change transports. None
+        when the store has no covering entry or the read fails (the
+        caller's no-prefix error stands)."""
+        from langstream_tpu.serving.durable import DurableError
+        from langstream_tpu.serving.migrate import _leaf_specs
+        from langstream_tpu.serving.pagepool import (
+            prefix_digest, split_page_bytes,
+        )
+
+        store, index = self._durable, self._prefix_index
+        if store is None:
+            return None
+        toks = list(tokens)
+        for b in reversed(index.boundaries):
+            if b > len(toks):
+                continue
+            digest = prefix_digest(toks[:b])
+            if not store.contains(digest):
+                continue
+            try:
+                rec = store.restore(digest, timeout_s=self.durable_timeout_s)
+                specs = _leaf_specs(self)
+                blocks = [
+                    split_page_bytes(raw, specs) for raw in rec["pages"]
+                ]
+            except (DurableError, ValueError) as e:
+                log.warning(
+                    "durable snapshot of %s failed (%s)", digest, e
+                )
+                return None
+            return {
+                "tier": "durable", "length": b, "digest": digest,
+                "blocks": blocks,
+                "checksums": [bytes.fromhex(s) for s in rec["checksums"]],
+                "page_size": int(rec["page_size"]),
+                "bytes_per_page": int(rec["bytes_per_page"]),
+            }
+        return None
+
+    def hibernate(self, replica_id: str = "", timeout_s: float = 60.0) -> dict:
+        """Checkpoint EVERY live prefix entry to the durable tier and
+        write the replica hibernation record — the drained-replica half
+        of scale-to-zero (docs/SERVING.md §23). Call AFTER drain() and
+        BEFORE stop() (the holder.begin_drain ordering): the engine loop
+        must still be serving commands. Returns the ledger
+        ``{"entries", "bytes", "failures"}``; ``{}`` with the tier off.
+        Synchronous and deadline-bounded — a wedged disk fails the
+        hibernation, never the shutdown."""
+        from langstream_tpu.serving.migrate import MigrationError
+
+        if self._durable is None:
+            return {}
+        if self._durable_worker is not None:
+            # in-flight spill-triggered checkpoints first, so the walk
+            # below sees them via store.contains and skips the re-write
+            self._durable_worker.flush(timeout_s)
+        try:
+            return self._migrate_rpc(
+                "hibernate", {"replica": str(replica_id)}, timeout_s
+            )
+        except MigrationError as e:
+            log.warning("hibernation failed (%s) — sessions stay "
+                        "restorable from earlier checkpoints only", e)
+            return {"entries": 0, "bytes": 0, "failures": -1}
+
+    @property
+    def restoring(self) -> bool:
+        """True while a durable-tier restore is serving an admission —
+        the cheap accessor /healthz surfaces as resurrection-in-progress
+        (readiness probes during scale-from-zero)."""
+        return self._durable_restoring
+
+    def prefill_tps_estimate(self) -> float:
+        """Landed prefill throughput (tokens/s) off the prefill-dispatch
+        histogram: tokens covered by landed dispatches over their summed
+        wall time. The fleet beacon ships this for the router's
+        fetch-vs-prefill cost model (docs/SERVING.md §21/§23); 0.0 until
+        a dispatch lands (the router then falls back to its flat
+        threshold)."""
+        if not self._obs.on:
+            return 0.0
+        h = self._obs.hist.get("engine_prefill_dispatch_s")
+        if h is None:
+            return 0.0
+        snap = h.snapshot()
+        total_s = float(snap.get("sum", 0.0))
+        if total_s <= 0.0:
+            return 0.0
+        return round(self._prefill_tokens_dispatched / total_s, 1)
+
     # -- KV-page migration (disaggregated serving, docs/SERVING.md §18) ------
 
     def _drain_migrations(self) -> None:
@@ -5403,6 +5885,12 @@ class ServingEngine:
         if kind == "snapshot":
             hit = index.deepest_entry(payload["tokens"])
             if hit is None:
+                # the live index lost it, but a durable checkpoint may
+                # still cover the prompt (§23): the wire codec is the
+                # disk format, so serve the P2P fetch from disk directly
+                durable = self._durable_snapshot(payload["tokens"])
+                if durable is not None:
+                    return durable
                 raise MigrationError("no published prefix covers this prompt")
             length, entry = hit
             n = math.ceil(length / self.page_size)
@@ -5524,6 +6012,61 @@ class ServingEngine:
             self.migrate_pages_out_total += n
             self.migrate_bytes_out_total += n * pool.bytes_per_page
             return {"released": True, "pages": n}
+        if kind == "hibernate":
+            # drained-replica shutdown (§23): checkpoint EVERY live entry
+            # synchronously (the worker queue was flushed by hibernate()
+            # before this RPC, so contains() skips already-durable ones),
+            # then stamp the hibernation record — the resurrection beacon
+            from langstream_tpu.serving.durable import DurableError
+
+            store = self._durable
+            if store is None:
+                raise MigrationError("durable tier is off")
+            done, failures, total_bytes, digests = 0, 0, 0, []
+            for entry in list(index._live):
+                if entry.dropped or not entry.digest:
+                    continue
+                if store.contains(entry.digest):
+                    digests.append(entry.digest)
+                    continue
+                job = self._durable_job(entry)
+                if job is None:
+                    failures += 1
+                    continue
+                t0 = time.monotonic()
+                try:
+                    total_bytes += store.checkpoint(
+                        job["digest"], job["length"], job["tokens"],
+                        job["pages_raw"], job["checksums"],
+                        job["page_size"], job["bytes_per_page"],
+                    )
+                except (DurableError, OSError) as e:
+                    log.warning(
+                        "hibernation checkpoint of %s failed: %s",
+                        entry.digest, e,
+                    )
+                    failures += 1
+                    continue
+                if self._obs.on:
+                    self._obs.record(
+                        "engine_durable_checkpoint_s",
+                        time.monotonic() - t0,
+                    )
+                done += 1
+                digests.append(entry.digest)
+            try:
+                store.write_hibernation(
+                    payload.get("replica") or "", digests,
+                    compile_cache_dir=os.environ.get(
+                        "JAX_COMPILATION_CACHE_DIR"
+                    ),
+                )
+            except OSError as e:
+                log.warning("hibernation record write failed: %s", e)
+                failures += 1
+            return {
+                "entries": done, "bytes": total_bytes, "failures": failures,
+            }
         raise MigrationError(f"unknown migration command {kind!r}")
 
     def _migrate_rpc(self, kind: str, payload: dict, timeout_s: float) -> dict:
@@ -6035,6 +6578,7 @@ class ServingEngine:
             self._obs.record(
                 "engine_prefill_dispatch_s", time.monotonic() - t_disp
             )
+        self._prefill_tokens_dispatched += len(seg)
         if not final:
             return []  # more segments to go
 

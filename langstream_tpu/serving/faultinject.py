@@ -40,6 +40,16 @@ Sites (where the engine asks ``fires(site)``):
   client    stall token delivery before the on_token callback (slow-client
             backpressure simulation)
 
+Durable-tier disk sites (serving/durable.py — docs/SERVING.md §23; these
+are consulted by the checkpoint store the engine hands its injector to):
+  disk-torn     truncate a just-written checkpoint mid-frame (torn write:
+                the CRC32 frame prelude must read it as a dead entry)
+  disk-corrupt  flip one payload byte under a valid manifest (bit rot:
+                the frame CRC / spill-time checksum must catch it)
+  disk-stall    sleep ``stall_s`` inside checkpoint/restore (slow or hung
+                volume — the restore deadline must fire, never a hang)
+  disk-full     raise before any byte is written (ENOSPC simulation)
+
 Network sites (the fleet wire, serving/fleet.py + runtime/http_server.py —
 docs/SERVING.md §17; these drive the replica-to-replica streaming
 transport, not the engine, and are consulted by the process-wide WIRE
@@ -122,6 +132,17 @@ SITES = (
     # engine may come up, and the poisoned checkpoint must never be
     # re-read (zero retries — wrong weights are worse than no weights)
     "weight-load",
+    # durable-tier disk sites (serving/durable.py, docs/SERVING.md §23):
+    # consulted by the checkpoint store around its read/write paths.
+    # disk-torn truncates a just-renamed checkpoint mid-frame (the torn
+    # write a crash between rename and the last flushed block leaves);
+    # disk-corrupt flips one payload byte (bit rot under a valid
+    # manifest); disk-stall sleeps stall_s inside checkpoint/restore
+    # (slow or hung volume — the restore deadline must fire); disk-full
+    # raises before any byte is written (ENOSPC). Every firing must
+    # degrade to a local cold prefill with a durable-restore-failed
+    # flight dump — dead entries, never wrong KV, never a hang.
+    "disk-torn", "disk-corrupt", "disk-stall", "disk-full",
 )
 
 # the NaN-guard sentinel sampling.sample() emits for a non-finite logits row;

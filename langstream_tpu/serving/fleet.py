@@ -305,10 +305,18 @@ def beacon_from_engine(
         # restore is cheap but not free). Advertisement triples may come
         # from the dense pool too, where everything is device-resident.
         "prefixes": [
-            [d, int(n)] for d, n, tier in prefixes if tier != "host"
+            [d, int(n)]
+            for d, n, tier in prefixes
+            if tier not in ("host", "durable")
         ],
+        # "host" AND "durable" tiers beacon here (§16/§23): both serve a
+        # sticky hit without device residency — host via arena restore,
+        # durable via disk restore (or a P2P fetch from this replica's
+        # checkpoint). Routers score both at the same discount.
         "spilled_prefixes": [
-            [d, int(n)] for d, n, tier in prefixes if tier == "host"
+            [d, int(n)]
+            for d, n, tier in prefixes
+            if tier in ("host", "durable")
         ],
         # resident LoRA adapters (NAMES only, never factors): the router's
         # adapter-affinity signal — landing a tenant's request on a replica
@@ -345,7 +353,29 @@ def beacon_from_engine(
         # senders emit v2 only toward peers that advertise it, so a
         # mixed-version fleet keeps exchanging byte-identical v1 NDJSON
         # with legacy members (rolling-upgrade safe).
-        "caps": ["kvmig", "kvmig2", "dfa-resume", "p2p", "frames2"],
+        "caps": ["kvmig", "kvmig2", "dfa-resume", "p2p", "frames2"]
+        + (
+            # "durable" = crash-safe disk checkpoints (§23): the replica
+            # can hibernate, serve P2P fetches from disk, and resurrect
+            # sessions after a restart. Scale-to-zero requires EVERY live
+            # replica to advertise it (sessions must survive the drain).
+            ["durable"]
+            if getattr(engine, "_durable", None) is not None
+            else []
+        ),
+        # landed prefill throughput (tokens/s) for the router's
+        # fetch-vs-prefill cost model (§23): what recomputing a prefix
+        # locally costs, measured, not configured. 0.0 until a dispatch
+        # lands — the router then falls back to its flat page threshold.
+        "prefill_tps": (
+            engine.prefill_tps_estimate()
+            if hasattr(engine, "prefill_tps_estimate")
+            else 0.0
+        ),
+        # page geometry so a router can turn "pages" into "bytes" for the
+        # same cost model without a second RPC
+        "bytes_per_page": int(stats.get("kv-bytes-per-page", 0) or 0),
+        "page_size": int(stats.get("page-size", 0) or 0),
     }
 
 
@@ -450,6 +480,7 @@ def register_local(
     migrate_pages_fn: Optional[Callable[[dict], Iterator[dict]]] = None,
     p2p_fetch_fn: Optional[Callable[[dict], dict]] = None,
     migrate_limits_fn: Optional[Callable[[], dict]] = None,
+    restoring_fn: Optional[Callable[[], bool]] = None,
 ) -> None:
     """Expose this process's engine on the runtime HTTP server: ``GET
     /state`` serves ``beacon_fn``, ``POST /fleet/generate`` runs
@@ -476,6 +507,7 @@ def register_local(
             "migrate_pages": migrate_pages_fn,
             "p2p_fetch": p2p_fetch_fn,
             "migrate_limits": migrate_limits_fn,
+            "restoring": restoring_fn,
         }
 
 
@@ -494,6 +526,25 @@ def local_recovering() -> bool:
             out = out or bool(fn())
         except Exception:  # noqa: BLE001 — health probes must not raise
             log.exception("recovering probe failed")
+    return out
+
+
+def local_restoring() -> bool:
+    """True while ANY engine registered in this process is serving a
+    durable-tier restore (§23) — the resurrection-in-progress signal
+    /healthz surfaces so scale-from-zero readiness probes can tell "still
+    rehydrating sessions" from "wedged". Same cheap-attribute discipline
+    as local_recovering()."""
+    with _LOCAL_LOCK:
+        fns = [e.get("restoring") for e in _LOCAL.values()]
+    out = False
+    for fn in fns:
+        if fn is None:
+            continue
+        try:
+            out = out or bool(fn())
+        except Exception:  # noqa: BLE001 — health probes must not raise
+            log.exception("restoring probe failed")
     return out
 
 
@@ -619,6 +670,51 @@ def local_p2p_fetch(payload: dict) -> dict:
     if fetch is None:
         raise ReplicaError("registered engine does not serve P2P page fetch")
     return fetch(payload)
+
+
+_LOCAL_ROUTER: Optional[Any] = None
+
+
+def register_local_router(router: Any) -> None:
+    """Expose this process's FleetRouter for the HTTP prefetch surface
+    (POST /fleet/prefetch, §23). One router per process — latest wins,
+    matching the _EngineHolder singleton that builds it."""
+    global _LOCAL_ROUTER
+    with _LOCAL_LOCK:
+        _LOCAL_ROUTER = router
+
+
+def unregister_local_router() -> None:
+    global _LOCAL_ROUTER
+    with _LOCAL_LOCK:
+        _LOCAL_ROUTER = None
+
+
+def local_prefetch(payload: dict) -> dict:
+    """Prefetch-on-hint command (the POST /fleet/prefetch body, §23): a
+    gateway that KNOWS a session's next turn is coming (client typing, a
+    scheduled agent step, a resurrection hint for a hibernated replica)
+    posts the session's token prefix here, and the router pulls the
+    pages to the replica the request WILL route to — before the request
+    exists. Blocking — the HTTP server runs it in an executor."""
+    with _LOCAL_LOCK:
+        router = _LOCAL_ROUTER
+    if router is None:
+        raise ReplicaError("no fleet router in this process")
+    tokens = payload.get("prompt_tokens")
+    if not isinstance(tokens, list) or not all(
+        isinstance(t, int) for t in tokens
+    ):
+        raise ValueError("prompt_tokens must be a list of token ids")
+    session = payload.get("session")
+    adapter = payload.get("adapter")
+    tenant = payload.get("tenant")
+    return router.prefetch(
+        tokens,
+        session_id=str(session) if session else None,
+        adapter=str(adapter) if adapter else None,
+        tenant=str(tenant) if tenant else None,
+    )
 
 
 def local_migrate_limits() -> dict:
@@ -1572,6 +1668,7 @@ class FleetRouter:
         migrate_timeout_s: float = 30.0,
         p2p: bool = True,
         p2p_threshold: int = 256,
+        p2p_min_gap: int = 0,
     ) -> None:
         if policy not in self.POLICIES:
             raise ValueError(
@@ -1632,6 +1729,26 @@ class FleetRouter:
         # local cold path. Both sides must advertise the "p2p" cap.
         self.p2p_enabled = bool(p2p)
         self.p2p_threshold = max(1, int(p2p_threshold))
+        # fetch-vs-prefill cost model (§23): once both sides publish the
+        # inputs — the owner's page geometry, the destination's measured
+        # prefill tokens/s, and this router's observed fetch bandwidth —
+        # the P2P decision compares ESTIMATED seconds (bytes moved over
+        # the wire vs the gap re-prefilled locally) instead of the flat
+        # token threshold. The flat threshold stays as the fallback when
+        # any input is missing (legacy beacons, cold router), and
+        # p2p_min_gap is the compat FLOOR either way: a gap below it
+        # never fetches, however favorable the estimate — pulling 3
+        # pages' worth of tokens is never worth a wire round-trip. 0
+        # derives the floor from the threshold.
+        self.p2p_min_gap = (
+            max(1, int(p2p_min_gap))
+            if p2p_min_gap
+            else min(64, self.p2p_threshold)
+        )
+        # observed P2P fetch bandwidth (bytes/s, EMA over landed fetches):
+        # the cost model's wire-speed input — measured, like the beacon's
+        # prefill_tps, so the estimate tracks the actual deployment
+        self._p2p_bw_ema = 0.0
         self._lock = threading.Lock()
         self._replicas: dict[str, _ReplicaState] = {}
         for r in replicas:
@@ -1681,6 +1798,16 @@ class FleetRouter:
         self.p2p_fetch_total = 0
         self.p2p_fetch_fallback_total = 0
         self.p2p_bytes_in_total = 0
+        # fetch-vs-prefill cost model + prefetch-on-hint (§23): hints
+        # admitted by the ESTIMATE (not the flat threshold), prefetch
+        # calls taken, and prefetches that actually moved pages
+        self.p2p_cost_routed_total = 0
+        self.prefetch_total = 0
+        self.prefetch_fetch_total = 0
+        # scale-to-zero (§23): monotonic stamp of the last routed demand —
+        # desired_replicas() returns 0 only once demand has been quiet for
+        # a full target window AND every live replica checkpoints durably
+        self._last_demand_t = time.monotonic()
         self._hist_lock = threading.Lock()
         self.dispatch_hist = Histogram(
             "fleet_dispatch_s",
@@ -1924,6 +2051,10 @@ class FleetRouter:
     ) -> RouteDecision:
         now = time.monotonic()
         with self._lock:
+            # scale-to-zero demand clock (§23): EVERY route attempt is
+            # demand, even one that sheds — the autoscaler must not scale
+            # to zero under a backlog it happens to be rejecting
+            self._last_demand_t = now
             live = [
                 s
                 for rid, s in self._replicas.items()
@@ -2149,9 +2280,8 @@ class FleetRouter:
                         continue
                     if raw > owner_raw:
                         owner, owner_raw = s, raw
-                if (
-                    owner is not None
-                    and owner_raw - best_raw >= self.p2p_threshold
+                if owner is not None and self._p2p_worth_it(
+                    best, owner, best_raw, owner_raw
                 ):
                     p2p_source = owner.handle.replica_id
                     p2p_match = owner_raw
@@ -2159,6 +2289,43 @@ class FleetRouter:
                 best, kind, best_match, pin_session, now, disagg=disagg,
                 p2p_source=p2p_source, p2p_match=p2p_match,
             )
+
+    def _p2p_worth_it(
+        self,
+        best: _ReplicaState,
+        owner: _ReplicaState,
+        best_raw: int,
+        owner_raw: int,
+    ) -> bool:
+        """Should the router pull ``owner``'s advertised prefix into
+        ``best`` before dispatch? The fetch-vs-prefill cost model (§23):
+        estimated wire seconds (pages moved at the observed fetch
+        bandwidth) against estimated prefill seconds (the token gap at
+        the destination's measured landed throughput). Falls back to the
+        flat ``p2p_threshold`` when any estimate input is missing —
+        legacy beacons without geometry/tps, or a router that has not
+        landed a fetch yet. ``p2p_min_gap`` floors BOTH modes: a
+        few-page gap never justifies a wire round-trip, whatever the
+        arithmetic says (and it keeps the model from thrashing on
+        near-tie advertisements). Caller holds ``_lock``."""
+        gap = owner_raw - best_raw
+        if gap < self.p2p_min_gap:
+            return False
+        tps = float(best.beacon.get("prefill_tps", 0.0) or 0.0)
+        bw = self._p2p_bw_ema
+        bpp = int(owner.beacon.get("bytes_per_page", 0) or 0)
+        page = int(owner.beacon.get("page_size", 0) or 0)
+        if tps > 0.0 and bw > 0.0 and bpp > 0 and page > 0:
+            # the fetch moves the WHOLE advertised prefix (bind needs a
+            # boundary-aligned entry), while prefilling only pays the gap
+            # the fetch would have saved
+            est_fetch_s = math.ceil(owner_raw / page) * bpp / bw
+            est_prefill_s = gap / tps
+            if est_fetch_s < est_prefill_s:
+                self.p2p_cost_routed_total += 1
+                return True
+            return False
+        return gap >= self.p2p_threshold
 
     def _decide(
         self,
@@ -2490,9 +2657,20 @@ class FleetRouter:
                         "(no transport)"
                     )
                 ack = fetch(prompt, src_url, timeout_s, wire=wire)
+            elapsed = time.perf_counter() - t0
             with self._lock:
                 self.p2p_fetch_total += 1
                 self.p2p_bytes_in_total += int(ack.get("bytes", 0))
+                # feed the cost model's bandwidth EMA from LANDED fetches
+                # only (a failed fetch says nothing about wire speed);
+                # idempotent re-binds ack 0 bytes and are skipped
+                if int(ack.get("bytes", 0)) > 0 and elapsed > 0:
+                    obs_bw = int(ack["bytes"]) / elapsed
+                    self._p2p_bw_ema = (
+                        obs_bw
+                        if self._p2p_bw_ema <= 0.0
+                        else 0.8 * self._p2p_bw_ema + 0.2 * obs_bw
+                    )
             log.info(
                 "p2p fetched %s pages (%s bytes) %s → %s in %.1f ms",
                 ack.get("pages"), ack.get("bytes"), src_id,
@@ -2520,6 +2698,49 @@ class FleetRouter:
                 src_id, decision.replica_id, e,
             )
             return False
+
+    def prefetch(
+        self,
+        tokens,
+        session_id: Optional[str] = None,
+        adapter: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> dict:
+        """Prefetch-on-hint (§23): a beacon hint — 'this session's next
+        turn is coming' — warms the pages BEFORE the request routes.
+        Runs the exact route() the request will run (so the sticky pin
+        and the eventual dispatch agree on the replica), then fires the
+        P2P/durable page fetch immediately instead of on the dispatch
+        path; by the time the real request arrives, its prefix admits
+        warm. Best-effort end to end: a shed, a hint nobody can improve
+        on, or a failed fetch all return ``prefetched: False`` and cost
+        the caller nothing — the request path is unchanged either way."""
+        with self._lock:
+            self.prefetch_total += 1
+        try:
+            decision = self.route(
+                tokens, session_id=session_id, adapter=adapter,
+                tenant=tenant,
+            )
+        except FleetShedError as e:
+            return {"prefetched": False, "reason": str(e)}
+        if not decision.p2p_source:
+            return {
+                "prefetched": False,
+                "replica": decision.replica_id,
+                "match": int(decision.expected_match),
+                "reason": "no-deeper-owner",
+            }
+        ok = self._p2p_fetch(decision, list(tokens))
+        if ok:
+            with self._lock:
+                self.prefetch_fetch_total += 1
+        return {
+            "prefetched": ok,
+            "replica": decision.replica_id,
+            "source": decision.p2p_source,
+            "match": int(decision.p2p_match if ok else decision.expected_match),
+        }
 
     def stream_generate(
         self,
@@ -2930,15 +3151,24 @@ class FleetRouter:
         fleet), scale IN one replica at a time only when queues are empty
         AND occupancy is low (conservative — killing a warm replica throws
         away its aliased pages). With no routable beacon the hint holds the
-        current size: never scale on missing data."""
+        current size: never scale on missing data.
+
+        ``min_replicas=0`` legalizes scale-to-zero (§23), gated three
+        ways: demand has been quiet for 60× the target window (the next
+        route() stamp resurrects the fleet), every queue is empty with
+        zero occupancy, and EVERY routable replica advertises the
+        ``durable`` cap — the drain hibernates its sessions to disk, so
+        going dark loses nothing. One non-durable replica in the fleet
+        vetoes zero: its sessions would die with it."""
         now = time.monotonic()
         with self._lock:
             total = len(self._replicas)
-            live = [
-                s.beacon
-                for s in self._replicas.values()
-                if self._routable(s, now)
+            routable = [
+                s for s in self._replicas.values() if self._routable(s, now)
             ]
+            live = [s.beacon for s in routable]
+            caps = [s.caps for s in routable]
+            quiet_s = now - self._last_demand_t
         if not live:
             return max(min_replicas, min(total, max_replicas))
         n = len(live)
@@ -2947,12 +3177,24 @@ class FleetRouter:
             float(b.get("active_slots", 0)) / max(1, b.get("max_batch", 1))
             for b in live
         ) / n
+        busy = sum(
+            int(b.get("active_slots", 0) or 0) + int(b.get("queued", 0) or 0)
+            for b in live
+        )
         if ema > target_queue_wait_s:
             want = math.ceil(n * min(ema / target_queue_wait_s, 4.0))
         elif ema < 0.1 * target_queue_wait_s and occ < 0.5 and n > 1:
             want = n - 1
         else:
             want = n
+        if (
+            min_replicas == 0
+            and want <= 1
+            and busy == 0
+            and quiet_s > 60.0 * max(target_queue_wait_s, 0.1)
+            and all("durable" in c for c in caps)
+        ):
+            want = 0
         return max(min_replicas, min(want, max_replicas))
 
     def desired_replicas_by_role(
@@ -3057,6 +3299,10 @@ class FleetRouter:
                     self.p2p_fetch_fallback_total
                 ),
                 "fleet-p2p-bytes-in-total": self.p2p_bytes_in_total,
+                "fleet-p2p-cost-routed-total": self.p2p_cost_routed_total,
+                "fleet-p2p-bw-ema-bytes-s": round(self._p2p_bw_ema, 1),
+                "fleet-prefetch-total": self.prefetch_total,
+                "fleet-prefetch-fetch-total": self.prefetch_fetch_total,
                 "fleet-roles": {
                     role: sum(
                         1 for s in self._replicas.values() if s.role == role

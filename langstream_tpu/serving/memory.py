@@ -103,6 +103,13 @@ class ServingMemoryPlan:
     # layer uploads) — it appears so the startup log's RSS story covers
     # the load, the phase the pod is being health-probed through.
     weight_load_staging_bytes: int = 0
+    # durable session tier (serving/durable.py, docs/SERVING.md §23): the
+    # configured on-DISK checkpoint budget (`durable-max-bytes`; 0 with
+    # the tier off or uncapped). Neither HBM nor RAM — it appears in the
+    # summary so the startup log names every byte tier the engine can
+    # touch, and so an operator sizing the durable volume sees the cap
+    # they configured next to the arena it checkpoints.
+    durable_disk_bytes: int = 0
     # self-speculative verify chunk (engine._verify_chunk): the multi-token
     # forward materializes fp32 logits for ALL k+1 positions of every slot
     # ([B, k+1, V] — k+1 times the decode step's [B, V], which the flat
@@ -178,6 +185,11 @@ class ServingMemoryPlan:
                     f" [+ migrate staging "
                     f"{self.migrate_staging_bytes / gib:.2f}GiB RAM]"
                 )
+            if self.durable_disk_bytes:
+                host += (
+                    f" [+ durable KV tier "
+                    f"≤{self.durable_disk_bytes / gib:.2f}GiB disk]"
+                )
             host += self._weight_load_suffix()
             return (
                 f"weights {self.weights_bytes / gib:.2f}GiB + "
@@ -243,6 +255,7 @@ def plan_serving_memory(
     grammar_states: int = 0,
     migrate_staging: bool = False,
     weight_load_staging: int = 0,
+    durable_max_bytes: int = 0,
 ) -> ServingMemoryPlan:
     """Account a ServingEngine's HBM from the actual pytree shapes.
 
@@ -280,6 +293,9 @@ def plan_serving_memory(
     mark of the streamed weight-load pipeline (models/streamload.py) —
     reported like host_spill_bytes, excluded from the HBM total; 0 omits
     it (eager load, or no checkpoint).
+    ``durable_max_bytes``: configured on-disk cap of the durable session
+    tier (serving/durable.py, §23) — disk, reported-only, excluded from
+    every RAM/HBM total; 0 omits it (tier off or uncapped).
     """
     from langstream_tpu.models.quant import init_random_quantized_params
     from langstream_tpu.models.transformer import init_params, make_kv_cache
@@ -362,6 +378,7 @@ def plan_serving_memory(
             host_spill_bytes=host_spill_bytes,
             migrate_staging_bytes=migrate_staging_bytes,
             weight_load_staging_bytes=max(0, int(weight_load_staging)),
+            durable_disk_bytes=max(0, int(durable_max_bytes)),
             verify_chunk_bytes=(
                 5 * max_batch * (speculation_tokens + 1) * config.vocab_size * 4
                 if speculation_tokens > 0

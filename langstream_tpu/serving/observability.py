@@ -97,6 +97,22 @@ ENGINE_HISTOGRAMS: dict[str, dict[str, Any]] = {
                 "admission (s)",
         "buckets": log_buckets(1e-4, 60.0, 4),
     },
+    # durable session tier (docs/SERVING.md §23): checkpoint runs on the
+    # durable worker thread (arena/device bytes → temp+fsync+rename frame
+    # stream, per entry); restore runs ON the admission path (disk read +
+    # CRC/checksum verify + device upload of a checkpointed prefix) — its
+    # tail is added TTFT for a resurrected session, same reasoning as
+    # engine_restore_s above, one tier further down
+    "engine_durable_checkpoint_s": {
+        "help": "durable-tier checkpoint (serialize + fsync + rename) per "
+                "entry (s)",
+        "buckets": log_buckets(1e-4, 120.0, 4),
+    },
+    "engine_durable_restore_s": {
+        "help": "durable-tier restore (disk read + verify + device "
+                "upload) per resurrected admission (s)",
+        "buckets": log_buckets(1e-4, 120.0, 4),
+    },
     # cold start (docs/SERVING.md §22, ROADMAP 3a): one sample per engine
     # build — checkpoint-to-device wall time of the weight load (streamed
     # pipeline or eager). Sparse by design (engines build once), but the
@@ -320,6 +336,12 @@ DUMP_REASONS = (
     # ROUTER with the owner/destination ids, the advertised match depth
     # and the fallback taken (local cold prefill), never page content
     "p2p-fetch-failed",
+    # a durable-tier restore failed (torn/corrupt checkpoint, stale
+    # manifest, missing object, stalled or full volume — docs/SERVING.md
+    # §23): dumped by the ENGINE's admit path with the entry digest, the
+    # failure and the fallback taken (local cold prefill) — the entry is
+    # marked dead so the failure fires once, never page or token content
+    "durable-restore-failed",
 )
 
 # process-global recent dumps (newest last): the runtime HTTP server's

@@ -627,6 +627,24 @@ class PrefixPageIndex:
                 return p, entry
         return None
 
+    @staticmethod
+    def entry_tokens(entry: "PrefixPages") -> list[int]:
+        """Reconstruct the token prefix backing ``entry`` from its trie
+        node's parent edges (an entry stores only its digest — the tokens
+        live nowhere else once the request is gone). The durable tier's
+        checkpoint begin frame carries these (serving/durable.py) so ANY
+        replica can re-key the restored prefix into its own trie; the
+        fleet beacon still ships digests only."""
+        node = entry.node
+        parts: list[tuple] = []
+        while node is not None and node.edge:
+            parts.append(node.edge)
+            node = node.parent
+        out: list[int] = []
+        for seg in reversed(parts):
+            out.extend(int(t) for t in seg)
+        return out
+
     def advertised(self, top_k: int = 32) -> list[tuple[str, int, str]]:
         """Most-recently-used ``top_k`` prefix digests as ``(digest,
         length, tier)`` triples — the beacon's affinity advertisement.

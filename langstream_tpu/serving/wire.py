@@ -263,6 +263,16 @@ def encode_mig_frame(frame: dict) -> bytes:
     raise WireError(f"unknown migration frame kind {kind!r}")
 
 
+def encode_mig_stream(frames) -> bytes:
+    """A whole migration body — preamble + every frame — as one byte
+    string. The durable tier (serving/durable.py) writes THIS to disk:
+    the wire codec IS the checkpoint format, so a checkpointed session
+    can be decoded by ``decode_mig_frames`` wherever it lands (local
+    restore, P2P fetch, resurrection on a foreign replica) and every
+    frame's CRC32 prelude doubles as torn-write detection."""
+    return KVMIG2_PREAMBLE + b"".join(encode_mig_frame(f) for f in frames)
+
+
 def decode_mig_frames(
     read: Callable[[int], bytes], max_payload: int,
 ) -> Iterator[dict]:

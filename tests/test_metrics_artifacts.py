@@ -148,6 +148,17 @@ def test_dashboard_regexes_match_live_exposition():
         "fleet_p2p_bytes_in_total",
         "weight_load_s",
         "weight_load_bytes_total",
+        "durable_entries",
+        "durable_bytes_on_disk",
+        "durable_checkpoints_total",
+        "durable_checkpoint_bytes_total",
+        "durable_restores_total",
+        "durable_restore_bytes_total",
+        "durable_restore_failures_total",
+        "durable_dead_entries_total",
+        "fleet_prefetch_total",
+        "fleet_prefetch_fetch_total",
+        "fleet_p2p_cost_routed_total",
     ):
         serving.gauge(n)
     # the wire byte counter is a LABELED pair of series (§21 protocol split)
@@ -460,3 +471,40 @@ def test_grafana_provisioning_parses():
         (METRICS_DIR / "provisioning" / "dashboards" / "dashboards.yaml").read_text()
     )
     assert dash["providers"][0]["type"] == "file"
+
+
+def test_durable_tier_panels_present():
+    """The ISSUE-18 durable-tier panels must survive dashboard edits: the
+    checkpoint/restore latency quantile pair (the hibernate-vs-resurrect
+    wall the §23 drill tracks) and the occupancy/failures panel (entries,
+    bytes on disk, resurrections, dead entries — the scale-to-zero health
+    trio plus the prefetch fetch counter)."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    lat = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "durable" in t.lower() and "latency" in t.lower()
+        ),
+        None,
+    )
+    assert lat is not None, "durable checkpoint/restore latency panel missing"
+    assert "engine_durable_checkpoint_s" in lat
+    assert "engine_durable_restore_s" in lat
+    occ = next(
+        (
+            e for t, e in exprs_by_title.items()
+            if "durable" in t.lower() and "occupancy" in t.lower()
+        ),
+        None,
+    )
+    assert occ is not None, "durable occupancy/failures panel missing"
+    assert "durable_entries" in occ
+    assert "durable_bytes_on_disk" in occ
+    assert "durable_restores_total" in occ
+    assert "durable_restore_failures_total" in occ
+    assert "durable_dead_entries_total" in occ
+    assert "fleet_prefetch_fetch_total" in occ
