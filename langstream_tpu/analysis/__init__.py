@@ -1,0 +1,44 @@
+"""lstpu-check: the repo-native static analysis suite.
+
+The serving core is a multi-threaded ~11k-line engine whose correctness
+rests on hand-enforced invariants: counters mutate only under their
+annotated lock, flight dumps / beacons / wire frames never carry token
+content, the jit compile surface stays a fixed warmed ladder, and every
+fault site / dump reason / knob / gauge stays in sync with its chaos
+test, Grafana panel and docs section. Those invariants used to live in
+reviewers' heads and a handful of runtime tests; CHANGES.md records at
+least three shipped races a static pass would have flagged at PR time
+(the submit-side shed counts lost outside the lock, the finish-waker
+teardown race, the spill-worker wedged-join arena hazard).
+
+This package is the static twin of the runtime checks
+(docs/ANALYSIS.md):
+
+- ``core``            shared visitor/reporting frame: file discovery,
+                      parent-annotated ASTs, ``# lstpu: ignore[CODE]``
+                      suppressions, the committed baseline file
+- ``locks``           LSA1xx lock discipline (the ``_GUARDED`` class
+                      registry convention)
+- ``redaction``       LSA2xx redaction taint (dump extras, span
+                      attributes, beacons, wire frames)
+- ``compile_surface`` LSA3xx compile-surface lint (the warmed-program
+                      registry that keeps ``compiled_programs`` flat)
+- ``registry_drift``  LSA4xx registry drift (fault sites, dump reasons,
+                      knobs, gauges vs tests / docs / dashboards)
+- ``threads``         LSA5xx thread-shutdown hygiene (explicit
+                      ``daemon=``, reachable join on the close path)
+- ``lockorder``       the RUNTIME companion: a test-only lock-order
+                      recorder that wraps the annotated locks during
+                      the chaos suite and fails on acquisition cycles
+
+Run ``python -m langstream_tpu.analysis --strict`` (the tier-1 CI
+analysis job). No jax imports anywhere in the package: the suite parses
+source, it never executes it.
+"""
+
+from langstream_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Repo,
+    all_checkers,
+    run_checks,
+)

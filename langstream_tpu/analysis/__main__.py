@@ -1,0 +1,120 @@
+"""CLI: ``python -m langstream_tpu.analysis [--strict] [--only PASS]``.
+
+Exit codes: 0 clean (after suppressions + baseline), 1 findings (or, in
+--strict mode, stale baseline entries), 2 usage/internal error. The
+tier-1 CI analysis job runs ``--strict``; the whole-repo-clean test in
+tests/test_analysis.py runs the same entry programmatically, so drift
+fails tier-1 even where CI config is not in play.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from langstream_tpu.analysis.core import (
+    all_checkers,
+    apply_baseline,
+    load_baseline,
+    repo_root_from_here,
+    run_checks,
+    summarize,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m langstream_tpu.analysis",
+        description="lstpu-check: repo-native static analysis "
+        "(docs/ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="also fail on stale baseline entries (the CI mode)",
+    )
+    parser.add_argument(
+        "--only",
+        action="append",
+        metavar="PASS",
+        help="run a single pass (repeatable); default: all",
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="repo root (default: derived from the package location)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable findings on stdout",
+    )
+    parser.add_argument(
+        "--list",
+        action="store_true",
+        help="list the registered passes and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in all_checkers():
+            print(name)
+        return 0
+
+    root = args.root or repo_root_from_here()
+    try:
+        repo, findings = run_checks(root, only=args.only)
+        baseline = load_baseline(root)
+        findings, stale = apply_baseline(findings, baseline)
+    except RuntimeError as e:
+        print(f"lstpu-check: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in findings],
+                    "stale_baseline": stale,
+                    "summary": summarize(findings),
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        if stale:
+            for key, n in sorted(stale.items()):
+                print(
+                    f"stale baseline entry {key} ({n} unused)",
+                    file=sys.stderr,
+                )
+    if findings:
+        s = summarize(findings)
+        print(
+            f"lstpu-check: {s['total']} finding(s) "
+            + " ".join(
+                f"{c}={n}" for c, n in sorted(s["by_code"].items())
+            ),
+            file=sys.stderr,
+        )
+        return 1
+    if args.strict and stale:
+        print(
+            "lstpu-check: clean tree but stale baseline — shrink "
+            ".lstpu-baseline.json",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"lstpu-check: clean ({len(repo.files)} files, "
+        f"{len(all_checkers() if not args.only else args.only)} passes)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

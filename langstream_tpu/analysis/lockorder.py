@@ -1,0 +1,206 @@
+"""Runtime lock-order recorder — the dynamic companion to the static
+``locks`` pass (docs/ANALYSIS.md).
+
+The static pass proves every guarded write holds ITS lock; it cannot
+prove two locks are always taken in one order. This recorder can:
+``install()`` swaps ``threading.Lock`` for a factory that wraps locks
+created from langstream_tpu frames, tags each with its CREATION site
+(file:line — the stable identity across engine instances), and records
+a directed edge ``held-site -> acquiring-site`` every time a thread
+acquires one lock while holding another. A cycle in that graph is a
+lock-order inversion: two threads interleaving those paths can deadlock
+even though every individual acquisition is lock-correct.
+
+Test-only by design: the wrapper costs a dict lookup per acquire, so it
+is armed via ``LSTPU_LOCKORDER=1`` (the chaos CI step) through the
+conftest session fixture, never in production. Same-site edges are
+skipped — two INSTANCES of one class locking in sequence (router A then
+router B) share a creation site, and ordering between instances is a
+different discipline (address-ordered locking) the recorder cannot
+judge from sites alone.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Optional
+
+_REAL_LOCK = threading.Lock
+
+
+def _caller_site(depth: int = 2) -> Optional[str]:
+    """``file:line`` of the frame creating the lock, repo-relative, or
+    None when the creation site is outside langstream_tpu (stdlib queue/
+    logging locks stay untracked and untaxed)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return None
+    fn = frame.f_code.co_filename
+    marker = "langstream_tpu" + os.sep
+    idx = fn.rfind(marker)
+    if idx < 0:
+        return None
+    rel = fn[idx:].replace(os.sep, "/")
+    if rel.startswith("langstream_tpu/analysis/"):
+        return None  # never instrument ourselves
+    return f"{rel}:{frame.f_lineno}"
+
+
+class _TrackedLock:
+    """A real lock plus edge recording. Proxy, not subclass —
+    ``threading.Lock`` is a factory function, not a type."""
+
+    __slots__ = ("_lock", "_site", "_rec")
+
+    def __init__(self, rec: "LockOrderRecorder", site: str) -> None:
+        self._lock = _REAL_LOCK()
+        self._site = site
+        self._rec = rec
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._rec._note_acquire(self._site)
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._rec._note_held(self._site)
+        return got
+
+    def release(self) -> None:
+        self._rec._note_release(self._site)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockOrderRecorder:
+    """Process-wide edge collector; one instance per test session."""
+
+    def __init__(self) -> None:
+        self._edges: dict[tuple[str, str], int] = {}
+        self._elock = _REAL_LOCK()
+        self._tls = threading.local()
+        self._installed = False
+
+    # -- instrumentation hooks (called from _TrackedLock) ------------------
+
+    def _held(self) -> list[str]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, site: str) -> None:
+        held = self._held()
+        new_edges = [
+            (h, site) for h in held if h != site
+        ]
+        if new_edges:
+            with self._elock:
+                for e in new_edges:
+                    self._edges[e] = self._edges.get(e, 0) + 1
+
+    def _note_held(self, site: str) -> None:
+        self._held().append(site)
+
+    def _note_release(self, site: str) -> None:
+        held = self._held()
+        # release order may not mirror acquire order; drop the LAST match
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                break
+
+    # -- install / report --------------------------------------------------
+
+    def install(self) -> None:
+        if self._installed:
+            return
+
+        rec = self
+
+        def _factory() -> object:
+            site = _caller_site()
+            if site is None:
+                return _REAL_LOCK()
+            return _TrackedLock(rec, site)
+
+        threading.Lock = _factory  # type: ignore[assignment]
+        self._installed = True
+
+    def uninstall(self) -> None:
+        if self._installed:
+            threading.Lock = _REAL_LOCK  # type: ignore[assignment]
+            self._installed = False
+
+    def edges(self) -> dict[tuple[str, str], int]:
+        with self._elock:
+            return dict(self._edges)
+
+    def cycles(self) -> list[list[str]]:
+        """Every elementary inversion witness found by DFS over the
+        aggregated edge graph (usually length 2: A->B and B->A)."""
+        graph: dict[str, set[str]] = {}
+        for (a, b) in self.edges():
+            graph.setdefault(a, set()).add(b)
+        out: list[list[str]] = []
+        seen_cycles: set[tuple[str, ...]] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start:
+                        cyc = path + [start]
+                        # canonicalize by rotation so each cycle reports once
+                        body = tuple(sorted(cyc[:-1]))
+                        if body not in seen_cycles:
+                            seen_cycles.add(body)
+                            out.append(cyc)
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + [nxt]))
+        return out
+
+    def report(self) -> str:
+        lines = []
+        for cyc in self.cycles():
+            lines.append(
+                "lock-order inversion: " + " -> ".join(cyc)
+            )
+        return "\n".join(lines)
+
+
+_ACTIVE: Optional[LockOrderRecorder] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("LSTPU_LOCKORDER", "") == "1"
+
+
+def activate() -> LockOrderRecorder:
+    """Install the process-wide recorder (idempotent); the conftest
+    session fixture calls this when LSTPU_LOCKORDER=1."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = LockOrderRecorder()
+        _ACTIVE.install()
+    return _ACTIVE
+
+
+def deactivate() -> Optional[LockOrderRecorder]:
+    """Uninstall and return the recorder (for the end-of-session cycle
+    assertion)."""
+    global _ACTIVE
+    rec = _ACTIVE
+    if rec is not None:
+        rec.uninstall()
+        _ACTIVE = None
+    return rec

@@ -648,6 +648,20 @@ class SpmdChannel:
             raise out
         return out
 
+    def close(self, timeout_s: float = 1.0) -> None:
+        """Retire the receive helper. The falsy sentinel is honoured the
+        next time the helper is idle between requests; a helper parked
+        INSIDE the collective cannot be interrupted portably (it is
+        ``daemon=True`` for exactly that case), so the join is bounded —
+        a clean OP_STOP shutdown reaps it, a wedged one abandons it to
+        process exit."""
+        t = self._rx_thread
+        if t is None:
+            return
+        self._rx_req.put(False)
+        t.join(timeout=timeout_s)
+        self._rx_thread = None
+
     def _recv_blocking(self) -> ControlBlock:
         zeros = self._blank  # shape templates only; broadcast never mutates
         head, slots, mask = self._broadcast((zeros[0], zeros[3], zeros[7]))
@@ -879,6 +893,7 @@ def follower_loop(
             last_seq = block.seq
             expected_seq = block.seq % SpmdChannel.SEQ_MOD + 1  # wrap rule
         if block.op == OP_STOP:
+            channel.close()
             return
         if block.op == OP_IDLE:
             continue

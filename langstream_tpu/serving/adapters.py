@@ -222,6 +222,10 @@ class AdapterRegistry:
     from beacon/metrics threads, hence the one lock around the advertised
     snapshot — the same crossing-threads pattern as PrefixPageIndex."""
 
+    # lock discipline registry (analysis pass `locks`): only the
+    # advertised-names snapshot crosses threads (beacon/metrics readers).
+    _GUARDED = {"_ad_lock": ("_advertised",)}
+
     def __init__(
         self,
         config: ModelConfig,

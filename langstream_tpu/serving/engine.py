@@ -1166,6 +1166,20 @@ class ServingEngine:
     # default rows per prefill call — fixed so each width bucket compiles ONCE
     PREFILL_BATCH = 8
 
+    # lock discipline registry (analysis pass `locks`, docs/ANALYSIS.md):
+    # every write to a guarded attribute outside `with self.<lock>:` is an
+    # LSA101 finding. `__init__` and `*_locked` helpers are exempt by
+    # convention.
+    _GUARDED = {
+        "_stats_lock": (
+            "shed_total", "cancelled_total", "deadline_queue_total",
+            "deadline_decode_total", "quarantined_slots_total",
+            "nan_guard_total", "engine_restarts_total", "total_generated",
+            "total_requests", "_busy_steps", "_queue_wait_ema_s",
+        ),
+        "_waiting_lock": ("_waiting",),
+    }
+
     def __init__(
         self,
         config: ModelConfig,
@@ -3939,7 +3953,7 @@ class ServingEngine:
             {
                 "slot": -1,
                 "path": "queued",
-                "prompt_tokens": len(request.prompt_tokens),
+                "prompt_len": len(request.prompt_tokens),
                 "generated_tokens": 0,
                 "finish_reason": reason,
             },
@@ -6489,7 +6503,7 @@ class ServingEngine:
                     {
                         "slot": idx,
                         "path": "long",
-                        "prompt_tokens": len(request.prompt_tokens),
+                        "prompt_len": len(request.prompt_tokens),
                         "generated_tokens": 0,
                         "finish_reason": reason,
                         "prefill_chunks": st["seg"],
@@ -7410,7 +7424,7 @@ class ServingEngine:
         attrs = {
             "slot": idx,
             "path": slot.path,
-            "prompt_tokens": len(request.prompt_tokens),
+            "prompt_len": len(request.prompt_tokens),
             "generated_tokens": len(slot.generated),
             "finish_reason": reason,
             "prefill_chunks": slot.prefill_chunks,

@@ -1646,6 +1646,27 @@ class FleetRouter:
 
     POLICIES = ("affinity", "round-robin", "least-loaded")
 
+    # lock discipline registry (analysis pass `locks`, docs/ANALYSIS.md):
+    # routing state and every counter stats() snapshots live under _lock;
+    # histograms record under their own _hist_lock so a slow percentile
+    # read never blocks route().
+    _GUARDED = {
+        "_lock": (
+            "_replicas", "_sticky", "_rr", "_last_demand_t", "_p2p_bw_ema",
+            "routed_affinity_total", "routed_sticky_total",
+            "sticky_held_total", "routed_balanced_total",
+            "routed_adapter_total", "shed_total", "failover_total",
+            "stream_failover_total", "beacon_failures_total",
+            "circuit_open_total", "tenant_shed_total",
+            "routed_tenant_affinity_total", "routed_prefill_total",
+            "migrations_total", "migrate_pages_total",
+            "migrate_bytes_total", "migrate_fallbacks_total",
+            "p2p_fetch_total", "p2p_fetch_fallback_total",
+            "p2p_bytes_in_total", "p2p_cost_routed_total",
+            "prefetch_total", "prefetch_fetch_total",
+        ),
+    }
+
     def __init__(
         self,
         replicas: list[Any],
@@ -1855,14 +1876,14 @@ class FleetRouter:
             except ReplicaError as e:
                 log.debug("beacon refresh failed: %s", e)
                 with self._lock:
-                    self._note_failure(state, beacon_fetch=True)
+                    self._note_failure_locked(state, beacon_fetch=True)
                 continue
             except Exception:  # noqa: BLE001 — refresher must never die
                 log.exception(
                     "beacon refresh crashed for %s", state.handle.replica_id
                 )
                 with self._lock:
-                    self._note_failure(state, beacon_fetch=True)
+                    self._note_failure_locked(state, beacon_fetch=True)
                 continue
             with self._lock:
                 state.beacon = beacon
@@ -1906,7 +1927,9 @@ class FleetRouter:
             ok += 1
         return ok
 
-    def _note_failure(self, state: _ReplicaState, beacon_fetch: bool) -> None:
+    def _note_failure_locked(
+        self, state: _ReplicaState, beacon_fetch: bool
+    ) -> None:
         """One beacon-fetch or dispatch failure (caller holds ``_lock``):
         advance the breaker — exponential probe backoff from the first
         failure, the OPEN transition (counted once) at the threshold."""
@@ -1979,7 +2002,7 @@ class FleetRouter:
             # the beacon that routed us here predates the failure — drop it
             # so recovery requires a refresh newer than the incident
             state.beacon_at = -1e18
-            self._note_failure(state, beacon_fetch=False)
+            self._note_failure_locked(state, beacon_fetch=False)
 
     def _routable(self, state: _ReplicaState, now: float) -> bool:
         if now - state.failed_at < self.fail_cooldown_s:
@@ -2117,12 +2140,12 @@ class FleetRouter:
                 state = live[self._rr % len(live)]
                 self._rr += 1
                 self.routed_balanced_total += 1
-                return self._decide(state, "balanced", 0, session_id, now)
+                return self._decide_locked(state, "balanced", 0, session_id, now)
             # sticky: same session stays on its replica while that replica
             # stays routable (its aliased pages are live there)
             pin_session = session_id
             if session_id:
-                self._prune_sticky(now)
+                self._prune_sticky_locked(now)
                 held = self._sticky.get(session_id)
                 if held is not None:
                     rid, last_used = held
@@ -2133,7 +2156,7 @@ class FleetRouter:
                         and state in live
                     ):
                         self.routed_sticky_total += 1
-                        return self._decide(state, "sticky", 0, session_id, now)
+                        return self._decide_locked(state, "sticky", 0, session_id, now)
                     if (
                         now - last_used <= self.sticky_ttl_s
                         and self._recovering_hold(state, now)
@@ -2153,7 +2176,7 @@ class FleetRouter:
             if self.policy == "least-loaded":
                 state = min(live, key=lambda s: self._load(s.beacon))
                 self.routed_balanced_total += 1
-                return self._decide(state, "balanced", 0, pin_session, now)
+                return self._decide_locked(state, "balanced", 0, pin_session, now)
             # affinity scoring: hash the prompt once per advertised length
             # (device-resident AND hibernated advertisements both probe)
             lengths = sorted(
@@ -2280,17 +2303,17 @@ class FleetRouter:
                         continue
                     if raw > owner_raw:
                         owner, owner_raw = s, raw
-                if owner is not None and self._p2p_worth_it(
+                if owner is not None and self._p2p_worth_it_locked(
                     best, owner, best_raw, owner_raw
                 ):
                     p2p_source = owner.handle.replica_id
                     p2p_match = owner_raw
-            return self._decide(
+            return self._decide_locked(
                 best, kind, best_match, pin_session, now, disagg=disagg,
                 p2p_source=p2p_source, p2p_match=p2p_match,
             )
 
-    def _p2p_worth_it(
+    def _p2p_worth_it_locked(
         self,
         best: _ReplicaState,
         owner: _ReplicaState,
@@ -2327,7 +2350,7 @@ class FleetRouter:
             return False
         return gap >= self.p2p_threshold
 
-    def _decide(
+    def _decide_locked(
         self,
         state: _ReplicaState,
         kind: str,
@@ -2352,7 +2375,7 @@ class FleetRouter:
             p2p_match=p2p_match,
         )
 
-    def _prune_sticky(self, now: float) -> None:
+    def _prune_sticky_locked(self, now: float) -> None:
         if len(self._sticky) < 4096:
             return
         self._sticky = {
@@ -2399,7 +2422,7 @@ class FleetRouter:
             if not pool:
                 return None
             best = min(pool, key=lambda s: self._load(s.beacon))
-            return self._decide(best, "migrated", 0, None, now)
+            return self._decide_locked(best, "migrated", 0, None, now)
 
     def _handoff_target(
         self,

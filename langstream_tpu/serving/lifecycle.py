@@ -45,6 +45,10 @@ class Cancellable(Protocol):
 
 
 _lock = threading.Lock()
+# lock discipline registry (analysis pass `locks`, docs/ANALYSIS.md):
+# both registries are written from gateway threads and read from
+# cancel/debug paths — every mutation must hold _lock.
+_GUARDED = {"_lock": ("_by_key", "_remote_by_key")}
 _by_key: dict[str, dict[int, Any]] = {}
 # session → {replica base URL: refcount}: which REMOTE replicas currently
 # own in-flight work for the session (fleet dispatch). Refcounted — a

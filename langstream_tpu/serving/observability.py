@@ -365,6 +365,13 @@ class FlightRecorder:
     debounced per reason so a fault storm produces one artifact, not
     hundreds."""
 
+    # lock discipline registry (analysis pass `locks`): ring, dump
+    # sequencing and the shed-burst window are all record/dump
+    # cross-thread state.
+    _GUARDED = {
+        "_lock": ("_ring", "_seq", "_last_dump_t", "_shed_window"),
+    }
+
     def __init__(
         self,
         capacity: int = 256,

@@ -324,6 +324,11 @@ class HostPageTier:
     concurrently (the checksum map takes a small lock because the engine
     reads entries the worker wrote)."""
 
+    # lock discipline registry (analysis pass `locks`): only the checksum
+    # map crosses the engine/spill-worker boundary — everything else in
+    # this class is engine-thread-only by the contract above.
+    _GUARDED = {"_sum_lock": ("_sums",)}
+
     def __init__(self, dev_pool: Any, num_pages: int) -> None:
         if num_pages < 1:
             raise ValueError("host page tier needs >= 1 page")
@@ -502,6 +507,10 @@ class PrefixPageIndex:
     page (copy-on-write) and overwrite its tail with their own suffix; the
     columns below the published length are stable by the same
     positions-only-grow argument."""
+
+    # lock discipline registry (analysis pass `locks`): the beacon
+    # advertisement map is the one surface read from the /state thread.
+    _GUARDED = {"_ad_lock": ("_ads",)}
 
     def __init__(self, boundaries: tuple[int, ...], max_entries: int = 512):
         self.boundaries = tuple(sorted({int(b) for b in boundaries if b > 0}))

@@ -16,6 +16,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Lock-order recording (chaos CI step: LSTPU_LOCKORDER=1) must be armed
+# BEFORE any langstream_tpu import so module-level locks (lifecycle,
+# observability) are created through the tracking factory.
+if os.environ.get("LSTPU_LOCKORDER") == "1":
+    from langstream_tpu.analysis import lockorder as _lockorder
+
+    _lockorder.activate()
+else:
+    _lockorder = None
+
 import asyncio  # noqa: E402
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -38,6 +48,26 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from tier-1 (runs in the chaos CI step)"
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # the whole suite is ONE lock-order experiment: every inter-lock
+    # acquisition edge observed across every test aggregates into a
+    # single graph, and any cycle fails the session even when each
+    # individual test passed (two tests can each exercise one half of
+    # an inversion)
+    if _lockorder is None:
+        return
+    rec = _lockorder.deactivate()
+    if rec is None:
+        return
+    report = rec.report()
+    if report:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        if tr is not None:
+            tr.write_line("")
+            tr.write_line(report, red=True)
+        session.exitstatus = 1
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,22 @@
+"""Seeded LSA3xx violations (see ../README.md). This module is NOT in
+the warmed-program registry, so every jit site here is also LSA301."""
+
+import jax
+
+
+def build(fns):
+    compiled = []
+    for fn in fns:
+        compiled.append(jax.jit(fn))  # line 10: LSA302 (jit in loop) + LSA301
+    return compiled
+
+
+def _step(x):
+    return x * 2
+
+
+step = jax.jit(_step)  # line 18: LSA301 (module outside the registry)
+
+
+def run(tokens):
+    return step(tokens[: len(tokens)])  # line 22: LSA303 len-bounded shape

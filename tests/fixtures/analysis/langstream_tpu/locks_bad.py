@@ -1,0 +1,52 @@
+"""Seeded LSA1xx violations (see ../README.md)."""
+
+import threading
+
+
+class Counters:
+    _GUARDED = {"_lock": ("shed_total", "routed")}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.shed_total = 0
+        self.routed = {}
+
+    def shed(self):
+        self.shed_total += 1  # line 15: LSA101 unlocked counter bump
+
+    def shed_ok(self):
+        with self._lock:
+            self.shed_total += 1  # locked: clean
+
+    def route(self, k, v):
+        with self._lock:
+            def waker():
+                self.routed[k] = v  # line 24: LSA101 closure outlives lock
+            return waker
+
+    def _bump_locked(self):
+        self.shed_total += 1  # _locked suffix: caller-holds convention
+
+    def suppressed(self):
+        self.shed_total += 1  # lstpu: ignore[LSA101] — single-thread path
+
+
+class BadRegistry:
+    _GUARDED = {"_missing_lock": ("x",)}  # line 35: LSA102 no such lock
+
+    def __init__(self):
+        self.x = 0
+
+
+_mlock = threading.Lock()
+_GUARDED = {"_mlock": ("_registry",)}
+_registry = {}
+
+
+def put(key, value):
+    _registry[key] = value  # line 47: LSA101 module-global write unlocked
+
+
+def put_ok(key, value):
+    with _mlock:
+        _registry[key] = value

@@ -1,0 +1,17 @@
+"""Seeded LSA401/LSA402 violations (see ../../README.md)."""
+
+
+def consult(injector):
+    return injector.fires("ghost-site")  # line 5: LSA401 unregistered site
+
+
+def consult_known(injector):
+    return injector.fires("drilled")
+
+
+def dump_unknown(recorder):
+    recorder.dump("ghost-reason", extra={})  # line 13: LSA402
+
+
+def dump_known(recorder):
+    recorder.dump("on-demand", extra={})

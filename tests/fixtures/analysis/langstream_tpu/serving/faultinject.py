@@ -1,0 +1,6 @@
+"""Mini fault-site registry for fixtures."""
+
+SITES = (
+    "drilled",
+    "undrilled",  # registered but never drilled nor documented
+)

@@ -1,0 +1,16 @@
+"""Seeded LSA203 violations: the beacon literal both carries a
+forbidden key and omits a required field (see ../../README.md)."""
+
+
+def beacon_from_engine(rid, engine):
+    return {
+        "schema": "lstpu-beacon-v1",
+        "id": rid,
+        "at": 0.0,
+        "load_score": 0.0,
+        "queue_wait_ema_s": 0.0,
+        "draining": False,
+        "quarantined": False,
+        # "prefixes" omitted: LSA203 (validate_beacon requires it)
+        "prompt": "leaky",  # line 15: LSA203 forbidden key
+    }

@@ -1,0 +1,18 @@
+"""Seeded LSA204 violations: frame keys outside the wire schema
+allowlist (see ../../README.md)."""
+
+
+def end_frame(seq):
+    return {
+        "v": 2,
+        "seq": seq,
+        "kind": "end",
+        "finish_reason": "length",
+        "debug_note": "oops",  # line 11: LSA204 key outside the allowlist
+    }
+
+
+def grown_frame(seq):
+    frame = {"v": 2, "seq": seq, "kind": "heartbeat"}
+    frame["load_hint"] = 0.5  # line 17: LSA204 key-store outside allowlist
+    return frame

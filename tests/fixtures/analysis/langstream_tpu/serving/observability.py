@@ -1,0 +1,12 @@
+"""Mini registry twin for fixtures: the drift/redaction passes parse
+these names from THIS path inside the fixture root."""
+
+_FORBIDDEN_KEYS = frozenset(
+    {"tokens", "token", "prompt", "prompt_tokens", "generated", "text",
+     "drafts", "value"}
+)
+
+DUMP_REASONS = (
+    "on-demand",
+    "orphan-reason",  # registered but never drilled nor documented
+)

@@ -1,0 +1,32 @@
+"""Seeded LSA201/LSA202 violations (see ../../README.md)."""
+
+
+def dump_with_tokens(recorder, slot, toks):
+    extra = {"slot": slot}
+    extra["tokens"] = toks  # line 6: LSA201 token content into dump extra
+    recorder.dump("on-demand", extra=extra)
+
+
+def dump_literal(recorder, prompt):
+    recorder.dump(
+        "on-demand",
+        extra={"prompt": prompt},  # line 13: LSA201 literal at call site
+    )
+
+
+def dump_clean(recorder, slot):
+    recorder.dump("on-demand", extra={"slot": slot})
+
+
+def span_with_prompt(emit_request_spans, trace_id, stamps, toks):
+    emit_request_spans(
+        trace_id,
+        stamps,
+        {"path": "cold", "prompt_tokens": toks},  # line 25: LSA202
+        status="ok",
+    )
+
+
+def dump_suppressed(recorder, toks):
+    # lstpu: ignore[LSA201] — suppression demo: the next line is exempt
+    recorder.dump("on-demand", extra={"drafts": toks})

@@ -1,0 +1,41 @@
+"""Seeded LSA5xx violations (see ../README.md)."""
+
+import threading
+
+
+class Owner:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        # line 8: LSA502 — self-held thread, no join anywhere in the class
+
+    def _run(self):
+        pass
+
+
+class OwnerJoins:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        t = self._worker  # alias-join: the engine stop() shape
+        t.join(timeout=1.0)
+
+
+def fire_and_forget():
+    t = threading.Thread(target=print)  # line 28: LSA501 implicit daemon
+    t.start()                           # ... and LSA502: never joined
+    return t
+
+
+def scoped_join():
+    t = threading.Thread(target=print, daemon=False)
+    t.start()
+    t.join()  # joined in scope: clean
+
+
+def suppressed_leak():
+    t = threading.Thread(target=print, daemon=False)  # lstpu: ignore[LSA502]
+    t.start()  # the runner joins this out-of-band (suppression demo)

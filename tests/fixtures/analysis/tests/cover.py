@@ -1,0 +1,4 @@
+"""Fixture test corpus: mentions `drilled` and `on-demand` so only the
+orphaned registry entries draw LSA403."""
+
+COVERED = ("drilled", "on-demand")

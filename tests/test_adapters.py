@@ -376,6 +376,12 @@ def test_adapter_fault_site_quarantines_victim_only():
         stats = engine.stats()
         assert stats["quarantined-slots-total"] == 1
         assert stats["engine-restarts-total"] == 0
+        # the incident artifact: an "adapter-quarantine" flight dump
+        # naming the victim slot (registry-drift pass LSA403 — every
+        # DUMP_REASONS entry gets a drill that actually fires it)
+        dump = engine._obs.flight.last_dump
+        assert dump is not None and dump["reason"] == "adapter-quarantine"
+        assert dump["extra"]["slot"] in range(engine.max_batch)
         # the engine still serves the quarantined tenant afterwards
         again = engine.generate(list(PROMPT), dataclasses.replace(
             GREEDY, adapter=victims[0][0],

@@ -1,0 +1,207 @@
+"""lstpu-check: the checkers checked.
+
+Three layers: (1) per-pass fixture tests assert the exact (path, line,
+code) multiset each seeded-violation module produces — a checker that
+stops firing OR starts over-firing fails here; (2) the whole-repo-clean
+test runs the same entry point CI's --strict job runs, so reintroducing
+an unlocked counter bump / a token-content dump key / an unregistered
+fault site fails tier-1 even where workflow config is not in play;
+(3) lock-order recorder units, including the synthetic A->B/B->A
+inversion."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+from langstream_tpu.analysis import run_checks
+from langstream_tpu.analysis.core import (
+    apply_baseline,
+    load_baseline,
+    repo_root_from_here,
+)
+from langstream_tpu.analysis.lockorder import LockOrderRecorder, _TrackedLock
+
+FIXTURE_ROOT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "analysis"
+)
+
+
+def _findings(only=None):
+    _, findings = run_checks(FIXTURE_ROOT, only=only)
+    return sorted((f.path, f.line, f.code) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: exact codes + lines per pass
+# ---------------------------------------------------------------------------
+
+
+def test_locks_fixture_exact_findings():
+    assert _findings(only=["locks"]) == [
+        ("langstream_tpu/locks_bad.py", 15, "LSA101"),  # unlocked bump
+        ("langstream_tpu/locks_bad.py", 24, "LSA101"),  # closure escape
+        ("langstream_tpu/locks_bad.py", 35, "LSA102"),  # lock never made
+        ("langstream_tpu/locks_bad.py", 47, "LSA101"),  # module global
+    ]
+    # NOT in the list: the locked bump (19), the _locked-suffix helper
+    # (28), and the suppressed line (31) — the three exemption channels.
+
+
+def test_redaction_fixture_exact_findings():
+    assert _findings(only=["redaction"]) == [
+        ("langstream_tpu/serving/fleet.py", 6, "LSA203"),   # no prefixes
+        ("langstream_tpu/serving/fleet.py", 15, "LSA203"),  # prompt key
+        ("langstream_tpu/serving/frames_bad.py", 11, "LSA204"),
+        ("langstream_tpu/serving/frames_bad.py", 17, "LSA204"),
+        ("langstream_tpu/serving/redaction_bad.py", 6, "LSA201"),
+        ("langstream_tpu/serving/redaction_bad.py", 13, "LSA201"),
+        ("langstream_tpu/serving/redaction_bad.py", 25, "LSA202"),
+    ]
+
+
+def test_compile_surface_fixture_exact_findings():
+    assert _findings(only=["compile-surface"]) == [
+        ("langstream_tpu/compile_bad.py", 10, "LSA301"),  # unregistered
+        ("langstream_tpu/compile_bad.py", 10, "LSA302"),  # jit in loop
+        ("langstream_tpu/compile_bad.py", 18, "LSA301"),  # unregistered
+        ("langstream_tpu/compile_bad.py", 22, "LSA303"),  # len() shape
+    ]
+
+
+def test_registry_drift_fixture_exact_findings():
+    assert _findings(only=["registry-drift"]) == [
+        ("langstream_tpu/serving/drift_bad.py", 5, "LSA401"),
+        ("langstream_tpu/serving/drift_bad.py", 13, "LSA402"),
+        # 'undrilled': no test coverage AND no docs mention
+        ("langstream_tpu/serving/faultinject.py", 5, "LSA403"),
+        ("langstream_tpu/serving/faultinject.py", 5, "LSA403"),
+        # 'orphan-reason': same two findings
+        ("langstream_tpu/serving/observability.py", 11, "LSA403"),
+        ("langstream_tpu/serving/observability.py", 11, "LSA403"),
+    ]
+
+
+def test_threads_fixture_exact_findings():
+    assert _findings(only=["threads"]) == [
+        ("langstream_tpu/threads_bad.py", 8, "LSA502"),   # never joined
+        ("langstream_tpu/threads_bad.py", 28, "LSA501"),  # implicit daemon
+        ("langstream_tpu/threads_bad.py", 28, "LSA502"),  # fire-and-forget
+    ]
+    # OwnerJoins (alias join) and scoped_join stay clean; the
+    # suppressed_leak LSA502 is silenced by its ignore comment.
+
+
+# ---------------------------------------------------------------------------
+# the real tree is clean — the same gate CI's --strict job runs
+# ---------------------------------------------------------------------------
+
+
+def test_whole_repo_clean_under_all_passes():
+    root = repo_root_from_here()
+    _, findings = run_checks(root)
+    findings, stale = apply_baseline(findings, load_baseline(root))
+    assert not findings, "\n".join(f.render() for f in findings)
+    assert not stale, f"stale baseline entries: {sorted(stale)}"
+
+
+def test_cli_exit_codes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.analysis", "--strict"],
+        capture_output=True, text=True, env=env,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "langstream_tpu.analysis",
+         "--root", FIXTURE_ROOT, "--json"],
+        capture_output=True, text=True, env=env,
+    )
+    assert dirty.returncode == 1
+    payload = json.loads(dirty.stdout)
+    assert payload["summary"]["total"] == len(payload["findings"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# lock-order recorder
+# ---------------------------------------------------------------------------
+
+
+def test_lockorder_cycle_detected():
+    rec = LockOrderRecorder()
+    a = _TrackedLock(rec, "x.py:1")
+    b = _TrackedLock(rec, "y.py:2")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # the inversion — single-threaded, still an edge cycle
+            pass
+    cycles = rec.cycles()
+    assert cycles, "A->B then B->A must be reported"
+    assert set(cycles[0][:-1]) == {"x.py:1", "y.py:2"}
+    assert "lock-order inversion" in rec.report()
+
+
+def test_lockorder_consistent_order_is_clean():
+    rec = LockOrderRecorder()
+    a = _TrackedLock(rec, "x.py:1")
+    b = _TrackedLock(rec, "y.py:2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert rec.cycles() == []
+    assert rec.report() == ""
+
+
+def test_lockorder_same_site_self_edge_skipped():
+    rec = LockOrderRecorder()
+    a1 = _TrackedLock(rec, "x.py:1")
+    a2 = _TrackedLock(rec, "x.py:1")  # second INSTANCE, same site
+    with a1:
+        with a2:
+            pass
+    assert rec.edges() == {}
+
+
+def test_lockorder_edges_are_per_thread():
+    rec = LockOrderRecorder()
+    a = _TrackedLock(rec, "x.py:1")
+    b = _TrackedLock(rec, "y.py:2")
+
+    def holder_a():
+        with a:
+            barrier.wait()
+            barrier.wait()
+
+    barrier = threading.Barrier(2)
+    t = threading.Thread(target=holder_a, daemon=True)
+    t.start()
+    barrier.wait()  # thread holds a...
+    with b:  # ...but THIS thread holds nothing: no a->b edge
+        pass
+    barrier.wait()
+    t.join(timeout=5)
+    assert rec.edges() == {}
+
+
+def test_lockorder_factory_filters_by_caller(tmp_path):
+    rec = LockOrderRecorder()
+    rec.install()
+    try:
+        # this test file is not under langstream_tpu/ — untracked
+        plain = threading.Lock()
+        assert not isinstance(plain, _TrackedLock)
+        # a langstream_tpu module creating a lock now IS tracked
+        from langstream_tpu.serving import observability
+
+        fr = observability.FlightRecorder(capacity=8)
+        assert isinstance(fr._lock, _TrackedLock)
+        fr.record({"t": 0.0})  # acquire/release through the wrapper
+        assert rec.cycles() == []
+    finally:
+        rec.uninstall()
+    assert threading.Lock is not rec  # restored
+    assert not isinstance(threading.Lock(), _TrackedLock)

@@ -691,25 +691,30 @@ def test_cost_model_fetch_vs_prefill():
     s_best = router._replicas["best"]
     s_owner = router._replicas["owner"]
 
+    def worth_it(gap, match):
+        # _locked suffix: the real caller (_route) holds router._lock
+        with router._lock:
+            return router._p2p_worth_it_locked(s_best, s_owner, gap, match)
+
     # telemetry-complete, cheap wire: 4 pages × 4096 B at 10 MB/s
     # (~1.6 ms) beats prefilling a 64-token gap at 1000 tok/s (64 ms)
     router._p2p_bw_ema = 10e6
-    assert router._p2p_worth_it(s_best, s_owner, 0, 64) is True
+    assert worth_it(0, 64) is True
     assert router.p2p_cost_routed_total == 1
 
     # same geometry, starved wire: 4 pages at 100 B/s loses to prefill
     router._p2p_bw_ema = 100.0
-    assert router._p2p_worth_it(s_best, s_owner, 0, 64) is False
+    assert worth_it(0, 64) is False
 
     # min-gap floors even a free wire
     router._p2p_bw_ema = 10e6
-    assert router._p2p_worth_it(s_best, s_owner, 60, 64) is False
+    assert worth_it(60, 64) is False
 
     # no bandwidth observation yet → the flat threshold decides
     router._p2p_bw_ema = 0.0
-    assert router._p2p_worth_it(s_best, s_owner, 0, 64) is False  # 64 < 4096
+    assert worth_it(0, 64) is False  # 64 < 4096
     router.p2p_threshold = 32
-    assert router._p2p_worth_it(s_best, s_owner, 0, 64) is True
+    assert worth_it(0, 64) is True
 
 
 def test_prefetch_counts_and_fetch_path(monkeypatch):
