@@ -617,6 +617,111 @@ def bench_adapters(preset: str, quantize: bool, *, max_batch: int,
     return out
 
 
+def bench_constrained(preset: str, quantize: bool, *, max_batch: int,
+                      n_requests: int, new_tokens: int, max_seq_len: int,
+                      decode_chunk: int, n_grammars: int = 16) -> dict:
+    """The packed grammar pool's cost model (ISSUE 20, docs/SERVING.md
+    §15), measured on fresh engines over the same params:
+
+    - mask-apply ms/step: constrained ON (every request under a schema
+      grammar) vs OFF over the same workload — the packed path's
+      device-side price per step (word gather + shift/AND expand +
+      masked sample + searchsorted advance);
+    - residency at scale: n_grammars DISTINCT grammars mixed in one
+      batch on the 64-slot default pool — resident count, swap count
+      and proof the mix rides the same compiled programs;
+    - packed-vs-dense pool bytes at this engine's actual vocab/states,
+      plus the 256k-vocab projection (the 32×-smaller headline)."""
+    import jax
+    import numpy as np
+
+    from langstream_tpu.models.configs import MODEL_PRESETS, GenerationOptions
+    from langstream_tpu.models.transformer import init_params
+    from langstream_tpu.serving.constrain import grammar_pool_bytes
+    from langstream_tpu.serving.engine import GenerationRequest, ServingEngine
+    from langstream_tpu.serving.tokenizer import ByteTokenizer
+
+    config = MODEL_PRESETS[preset]
+    if quantize:
+        from langstream_tpu.models.quant import init_random_quantized_params
+
+        params = init_random_quantized_params(config, jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+    else:
+        params = init_params(config, jax.random.PRNGKey(0))
+
+    tok = ByteTokenizer()
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, min(config.vocab_size, 255), size=24).tolist()
+        for _ in range(n_requests)
+    ]
+    opts = dict(max_new_tokens=new_tokens, temperature=0.0)
+    # n_grammars distinct schemas (distinct maxLength ⇒ distinct DFAs):
+    # all resident at once on the 64-slot default pool
+    n_grammars = min(n_grammars, n_requests)
+    formats = [
+        {"type": "json_schema", "json_schema": {"schema": {
+            "type": "object",
+            "properties": {"v": {"type": "string", "maxLength": 4 + i}},
+        }}}
+        for i in range(n_grammars)
+    ]
+    out: dict = {"constrained_grammars": n_grammars}
+
+    def run(tag: str, engine_kw: dict, request_opts) -> dict:
+        engine = ServingEngine(
+            config, params, max_batch=max_batch,
+            max_seq_len=min(max_seq_len, config.max_seq_len),
+            prefill_buckets=(64,), decode_chunk=decode_chunk,
+            prefill_batch=max_batch, precompile=True, **engine_kw,
+        )
+        engine.start()
+        try:
+            engine.submit(GenerationRequest(
+                prompt_tokens=list(prompts[0]), options=request_opts(0),
+            )).result(timeout=1200)
+            start = time.monotonic()
+            requests = [
+                engine.submit(GenerationRequest(
+                    prompt_tokens=list(p), options=request_opts(j),
+                ))
+                for j, p in enumerate(prompts)
+            ]
+            results = [r.result(timeout=1200) for r in requests]
+            elapsed = time.monotonic() - start
+            stats = engine.stats()
+        finally:
+            engine.stop()
+        total = sum(len(r.tokens) for r in results)
+        out[f"{tag}_ms_per_token"] = round(1e3 * elapsed / max(1, total), 4)
+        out[f"{tag}_compiled_programs"] = stats["compiled_programs"]
+        _reclaim()
+        return stats
+
+    con_kw = dict(constrained_decoding="auto", grammar_tokenizer=tok)
+    st = run("grammar_mix", con_kw,
+             lambda j: GenerationOptions(
+                 **opts, response_format=formats[j % n_grammars]))
+    out["grammar_rows_resident"] = st["grammars-resident"]
+    out["grammar_swaps"] = st["grammar-swaps-total"]
+    out["grammar_pool_bytes"] = st["grammar-pool-bytes"]
+    out["constrain_host_overhead_ms"] = st["constrain-overhead-ms"]
+    run("grammar_off", dict(constrained_decoding="off"),
+        lambda j: GenerationOptions(**opts))
+    out["mask_apply_ms_per_step"] = round(
+        out["grammar_mix_ms_per_token"] - out["grammar_off_ms_per_token"], 4,
+    )
+    # packed vs dense, at this vocab and at the 256k headline vocab
+    slots, states = 64, 128
+    dense = (slots + 1) * states * config.vocab_size * 4
+    out["grammar_dense_equiv_bytes"] = dense
+    packed_256k = grammar_pool_bytes(slots, states, 256000)
+    dense_256k = (slots + 1) * states * 256000 * 4
+    out["grammar_packed_vs_dense_256k"] = round(dense_256k / packed_256k, 1)
+    return out
+
+
 def bench_tiered_kv(preset: str, quantize: bool, *, n_sessions: int = 8,
                     rounds: int = 3, new_tokens: int = 16,
                     page_size: int = 16, kv_int8: bool = False) -> dict:
@@ -2062,6 +2167,21 @@ def main() -> None:
         ))
     except Exception as e:  # noqa: BLE001 — the headline phases already ran
         print(f"[bench] adapters phase failed: {e}", file=sys.stderr, flush=True)
+    _reclaim()
+    # packed grammar pool (ISSUE 20 acceptance, docs §15): mask-apply
+    # ms/step pair, n_grammars-deep residency on the 64-slot default
+    # pool, packed-vs-dense pool bytes + the 256k-vocab ratio
+    print("[bench] constrained (packed grammar pool) phase", file=sys.stderr,
+          flush=True)
+    try:
+        extras.update(bench_constrained(
+            preset, quantize, max_batch=max_batch,
+            n_requests=min(n_requests, 32), new_tokens=min(new_tokens, 64),
+            max_seq_len=max_seq_len, decode_chunk=decode_chunk,
+            n_grammars=16,
+        ))
+    except Exception as e:  # noqa: BLE001 — the headline phases already ran
+        print(f"[bench] constrained phase failed: {e}", file=sys.stderr, flush=True)
     _reclaim()
     # tiered-KV idle-session churn: next-turn TTFT with the host tier on
     # vs off over a pool sized to thrash (ISSUE 11 acceptance; docs §16)

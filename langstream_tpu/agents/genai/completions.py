@@ -225,6 +225,16 @@ class _BaseCompletionsStep(Step):
             "host-side constrained-decoding bookkeeping per dispatch "
             "(grammar swaps + verify state tables), EMA ms",
         )
+        self._m_grammar_pool_bytes = metrics.gauge(
+            "engine_grammar_pool_bytes",
+            "HBM held by the packed grammar pool (bitmask + default/"
+            "exception planes across all slots), bytes",
+        )
+        self._m_grammar_rows = metrics.gauge(
+            "engine_grammar_rows_resident",
+            "grammars currently resident in the device pool (swap "
+            "pressure shows in engine_grammar_swaps via stats)",
+        )
         # multi-tenant overload control (serving/tenancy.py, docs/
         # SERVING.md §19): cross-tenant shed volume, the worst tenant's
         # queue-wait EMA (the noisy-neighbor victim signal — per-tenant
@@ -493,6 +503,8 @@ class _BaseCompletionsStep(Step):
         self._m_adapter_swaps.set(stats.get("adapter-swaps-total", 0))
         self._m_constrained.set(stats.get("constrained-requests-total", 0))
         self._m_constrain_overhead.set(stats.get("constrain-overhead-ms", 0))
+        self._m_grammar_pool_bytes.set(stats.get("grammar-pool-bytes", 0))
+        self._m_grammar_rows.set(stats.get("grammars-resident", 0))
         tenants = stats.get("tenants") or {}
         self._m_tenant_shed.set(
             sum(int(t.get("shed-total", 0)) for t in tenants.values())

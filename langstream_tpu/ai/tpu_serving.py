@@ -95,9 +95,13 @@ Resource configuration:
     `response-format: {type: json_schema|regex, ...}` compiles to a
     token-level DFA and the sampler masks illegal tokens every step, so
     structured output is guaranteed valid — including through the
-    speculative verify path. `grammar-slots` (default 4) and
-    `grammar-states` (default 128) size the device DFA pool; the memory
-    plan logs the V-linear cost (≈0.7GiB at a 256k vocab — docs §15)
+    speculative verify path. `grammar-slots` (default 64 — the packed
+    bitmask pool made rows ~32× cheaper than the old dense table, so
+    hundreds of resident grammars are affordable; 0 disables constrained
+    decoding), `grammar-states` (default 128) and `grammar-exceptions`
+    (default 65536 — per-row capacity for non-default transitions) size
+    the device pool; the memory plan logs the cost (≈0.3GiB at a 256k
+    vocab with 64 slots — docs §15 has the sizing table)
   queue-depth / shed-policy: bounded admission queue; "block" (default)
     backpressures the broker poll loop, "reject" sheds with a retry-after
     (ShedError) so front doors degrade to fast 429s under overload
@@ -591,8 +595,11 @@ class _EngineHolder:
                 else None
             ),
             constrained_decoding=constrained,
-            grammar_slots=int(self.config.get("grammar-slots", 4)),
+            grammar_slots=int(self.config.get("grammar-slots", 64)),
             grammar_states=int(self.config.get("grammar-states", 128)),
+            grammar_exceptions=int(
+                self.config.get("grammar-exceptions", 65536)
+            ),
             grammar_tokenizer=self.tokenizer(),
             # request lifecycle / fault recovery (docs/SERVING.md §9)
             queue_depth=(

@@ -10,15 +10,25 @@ every time, not most times. This module compiles a ``response_format``
     construction) ──► token DFA: ``next[state, token_id]`` = the DFA state
     after consuming the token's UTF-8 bytes, or -1 when any byte dies.
 
-The ``next`` table is the WHOLE device contract: a token is legal in state
-``s`` iff ``next[s, t] >= 0``, so the mask and the state advance are one
-int32 gather (serving/engine.py folds it into ``sampling.sample``'s
-filter path, and the fused decode chunk advances the state on device so a
-16-step chunk stays ONE dispatch). The engine keeps the authoritative
-state mirror HOST-side — advanced per delivered token — which is what
-detects completion and builds the per-position state ids the speculative
-verify path masks drafts with (token-exactness under masks: the same
-per-position mask plain masked decode would apply — serving/sampling.py).
+The dense ``next`` table stays HOST-side (the authoritative mirror the
+engine advances per delivered token — completion detection + the
+per-position state ids the speculative verify path masks drafts with).
+The DEVICE carries a packed twin, ~32× smaller (the dense ``[G+1, S, V]``
+int32 pool was V-linear: ~670 MB at a 256k vocab with 4 slots × 128
+states, which is why "hundreds of resident grammars" used to be
+impossible):
+
+- **legality bitmask** ``bits [S, ceil(V/32)]`` uint32 — the sign bit of
+  ``next`` packed LSB-first (token ``t`` → bit ``t % 32`` of word
+  ``t // 32``); ``sampling.sample`` expands it on device with one
+  shift/AND inside its existing mask fold;
+- **default-successor + sorted-exceptions transition table** — per-state
+  modal successor ``defaults [S]`` plus a sorted composite-key exceptions
+  array (``key = state · V + token``) probed with ``searchsorted``, so
+  fused decode/verify chunks still advance the DFA on device and a
+  16-step chunk stays ONE dispatch. Legal tokens advance EXACTLY as the
+  dense table (exceptions hold every legal token whose successor is not
+  the state's mode); illegal tokens are never sampled (masked to −inf).
 
 Invariants the compiler enforces (the engine's safety net depends on them):
 
@@ -34,10 +44,14 @@ Invariants the compiler enforces (the engine's safety net depends on them):
   the grammar); the engine's normal stop handling does the rest.
 
 ``GrammarRegistry`` is the residency layer, shaped like the adapter pool
-(serving/adapters.py): one device ``[G+1, S_max, V]`` int32 pool whose row
-0 is the unconstrained all-legal self-loop (every base slot rides it), an
-LRU over rows G ≥ 1, refcounts pinning rows that active requests read, and
-a traced-row upload program warmed at engine startup.
+(serving/adapters.py): four packed device planes — bits ``[G+1, S, W]``
+uint32, defaults ``[G+1, S]`` int32, exception key/next ``[G+1, E]``
+int32 — whose row 0 is the unconstrained all-legal self-loop (every base
+slot rides it), an LRU over rows G ≥ 1, refcounts pinning rows that
+active requests read, and ONE fused traced-row upload program (all four
+planes in a single dispatch) warmed at engine startup. Residency state
+is lock-guarded: ``release()`` runs from the request ``_finalize``
+completion hook OFF the engine thread.
 """
 
 from __future__ import annotations
@@ -54,6 +68,12 @@ log = logging.getLogger(__name__)
 
 DEAD = -1
 MAX_DFA_STATES = 4096  # subset-construction blowup guard
+BITS_PER_WORD = 32  # uint32 legality-bitmask packing width
+DEFAULT_GRAMMAR_EXCEPTIONS = 65536  # per-row exception capacity default
+# exception-key pad value: strictly greater than any composite key
+# state·V+token the registry admits (it enforces S·V < 2**31 - 1), so a
+# searchsorted probe can never false-hit a padded tail entry
+_EXC_SENTINEL = 2**31 - 1
 
 
 class GrammarError(ValueError):
@@ -442,6 +462,78 @@ class TokenDFA:
     def is_complete(self, state: int) -> bool:
         return state in self.complete
 
+    def packed(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """The packed device product ``(bits, defaults, exc_key,
+        exc_next)`` — computed once per compiled grammar and cached on
+        the instance (packing is O(S·V), same order as building ``next``
+        itself)."""
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = pack_next_table(self.next)
+            self._packed_cache = cached
+        return cached
+
+    @property
+    def n_exceptions(self) -> int:
+        """Exception rows this grammar needs in the pool (capacity check
+        against the registry's ``max_exceptions``)."""
+        return int(self.packed()[2].shape[0])
+
+
+def pack_next_table(
+    next_table: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Dense ``next [S, V]`` int32 → the packed device representation:
+
+    - ``bits [S, ceil(V/32)]`` uint32 — legality bitmask, LSB-first
+      (token ``t`` → bit ``t % 32`` of word ``t // 32``), matching the
+      shift/AND expansion in ``sampling._expand_allowed``;
+    - ``defaults [S]`` int32 — the state's MODAL successor over its legal
+      tokens (0 for all-dead states: padded rows park at state 0);
+    - ``exc_key [E]`` int64 / ``exc_next [E]`` int32 — SORTED composite
+      keys ``s · V + t`` for every legal token whose successor differs
+      from the state default, successor alongside (keys are int64 here;
+      the registry casts to int32 after enforcing ``S · V < 2**31``).
+
+    Legal tokens reproduce the dense table EXACTLY (default unless the
+    key probe hits an exception). Illegal tokens also resolve to
+    default/exception, but they are masked to -inf by the bitmask and
+    never sampled, so that value is never delivered."""
+    n_states, vocab = next_table.shape
+    legal = next_table >= 0
+    n_words = (vocab + BITS_PER_WORD - 1) // BITS_PER_WORD
+    padded = np.zeros((n_states, n_words * BITS_PER_WORD), dtype=bool)
+    padded[:, :vocab] = legal
+    weights = np.uint64(1) << np.arange(BITS_PER_WORD, dtype=np.uint64)
+    bits = (
+        (padded.reshape(n_states, n_words, BITS_PER_WORD).astype(np.uint64)
+         * weights).sum(axis=-1)
+    ).astype(np.uint32)
+
+    defaults = np.zeros(n_states, np.int32)
+    exc_key_parts: list[np.ndarray] = []
+    exc_next_parts: list[np.ndarray] = []
+    for s in range(n_states):
+        row = next_table[s]
+        mask = legal[s]
+        if not mask.any():
+            continue  # unreachable dead state: park at 0, mask all -inf
+        counts = np.bincount(row[mask])
+        d = int(counts.argmax())
+        defaults[s] = d
+        toks = np.nonzero(mask & (row != d))[0]
+        if toks.size:
+            exc_key_parts.append(np.int64(s) * vocab + toks.astype(np.int64))
+            exc_next_parts.append(row[toks].astype(np.int32))
+    if exc_key_parts:
+        # ascending state, ascending token within a state → already sorted
+        exc_key = np.concatenate(exc_key_parts)
+        exc_next = np.concatenate(exc_next_parts)
+    else:
+        exc_key = np.zeros(0, np.int64)
+        exc_next = np.zeros(0, np.int32)
+    return bits, defaults, exc_key, exc_next
+
 
 def _token_byte_table(
     tokenizer: Any, vocab_size: int
@@ -600,13 +692,26 @@ def compile_response_format(
 # ---------------------------------------------------------------------------
 
 
-def grammar_pool_bytes(slots: int, states: int, vocab_size: int) -> int:
-    """Plan-term arithmetic (serving/memory.py): the ``[G+1, S, V]`` int32
-    next-state pool. At gemma's 256k vocab the DEFAULTS (4 slots, 128
-    states) cost ~670MB — the §15 sizing table is why the knobs exist."""
+def grammar_pool_bytes(
+    slots: int,
+    states: int,
+    vocab_size: int,
+    exceptions: int = DEFAULT_GRAMMAR_EXCEPTIONS,
+) -> int:
+    """Plan-term arithmetic (serving/memory.py) for the PACKED pool:
+    bits ``[G+1, S, ceil(V/32)]`` uint32 + defaults ``[G+1, S]`` int32 +
+    exception key/next ``[G+1, E]`` int32 each. ~28× smaller than the
+    dense ``[G+1, S, V]`` int32 table this replaced (~670 MB at gemma's
+    256k vocab with the OLD defaults 4×128 — docs §15 has the sizing
+    table; 64 slots now fit in ~0.3 GB). ``slots <= 0`` is the shared
+    DISABLED contract: constrained decoding off, 0 bytes, and the
+    registry refuses construction (the engine never builds one)."""
     if slots <= 0:
         return 0
-    return (slots + 1) * states * vocab_size * 4
+    rows = slots + 1
+    words = (vocab_size + BITS_PER_WORD - 1) // BITS_PER_WORD
+    per_row = states * words * 4 + states * 4 + 2 * max(0, exceptions) * 4
+    return rows * per_row
 
 
 @dataclass
@@ -621,36 +726,84 @@ class GrammarRegistry:
     """Compile cache + device residency for token DFAs. Same shape as
     AdapterRegistry: row 0 = unconstrained (all tokens legal, self-loop at
     state 0), rows 1..G hot-swapped LRU, refcounts pin rows active
-    requests read. Engine-thread-only except ``stats()``."""
+    requests read. Residency state is ``_lock``-guarded: ``release()``
+    runs from the request ``_finalize`` completion hook OFF the engine
+    thread, and ``compile()`` runs caller-side on any thread."""
+
+    _GUARDED = {
+        "_lock": (
+            "pool",
+            "_by_key",
+            "_row_owner",
+            "_free_rows",
+            "_tick",
+            "compiled_total",
+            "swaps_total",
+        ),
+    }
 
     def __init__(
         self,
         tokenizer: Any,
         vocab_size: int,
         eos_token_id: Optional[int],
-        slots: int = 4,
+        slots: int = 64,
         max_states: int = 128,
+        max_exceptions: int = DEFAULT_GRAMMAR_EXCEPTIONS,
     ) -> None:
         import jax.numpy as jnp
 
-        if slots < 1 or max_states < 2:
+        if slots < 1:
             raise ValueError(
-                f"grammar pool needs >= 1 slot and >= 2 states; got "
-                f"slots={slots} max_states={max_states}"
+                "grammar-slots <= 0 disables constrained decoding "
+                "(grammar_pool_bytes(slots<=0) == 0 is the same "
+                "zero/disabled contract); the registry is only built "
+                f"with slots >= 1, got slots={slots}"
+            )
+        if max_states < 2 or max_exceptions < 1:
+            raise ValueError(
+                f"grammar pool needs >= 2 states and >= 1 exception row; "
+                f"got max_states={max_states} "
+                f"max_exceptions={max_exceptions}"
+            )
+        if int(max_states) * int(vocab_size) > _EXC_SENTINEL:
+            raise ValueError(
+                "grammar-states × vocab_size must stay below 2**31 - 1: "
+                "the device transition probe uses int32 composite keys "
+                f"(state·V+token); got {max_states} × {vocab_size}"
             )
         self.tokenizer = tokenizer
         self.vocab_size = int(vocab_size)
         self.eos_token_id = eos_token_id
         self.slots = int(slots)
         self.max_states = int(max_states)
-        # row 0: every token legal, self-loop at state 0 (base slots)
-        host = np.full(
-            (self.slots + 1, self.max_states, self.vocab_size), DEAD, np.int32
+        self.max_exceptions = int(max_exceptions)
+        self.n_words = (
+            self.vocab_size + BITS_PER_WORD - 1
+        ) // BITS_PER_WORD
+        # row 0: every token legal (all-ones bitmask), self-loop at state
+        # 0 (defaults 0, no exceptions) — every base slot rides it. Rows
+        # 1..G start all-illegal and park at state 0 until a swap-in.
+        bits = np.zeros(
+            (self.slots + 1, self.max_states, self.n_words), np.uint32
         )
-        host[0] = 0
-        self.pool = jnp.asarray(host)
+        bits[0] = np.uint32(0xFFFFFFFF)
+        defaults = np.zeros((self.slots + 1, self.max_states), np.int32)
+        exc_key = np.full(
+            (self.slots + 1, self.max_exceptions), _EXC_SENTINEL, np.int32
+        )
+        exc_next = np.zeros((self.slots + 1, self.max_exceptions), np.int32)
+        self.pool = (
+            jnp.asarray(bits),
+            jnp.asarray(defaults),
+            jnp.asarray(exc_key),
+            jnp.asarray(exc_next),
+        )
         self.pool_bytes = grammar_pool_bytes(
-            self.slots, self.max_states, self.vocab_size
+            self.slots,
+            self.max_states,
+            self.vocab_size,
+            self.max_exceptions,
         )
         self._by_key: dict[str, _GrammarState] = {}
         self._row_owner: dict[int, _GrammarState] = {}
@@ -678,6 +831,12 @@ class GrammarRegistry:
                 f"grammar needs {dfa.n_states} DFA states but the pool is "
                 f"sized for {self.max_states}; raise grammar-states"
             )
+        if dfa.n_exceptions > self.max_exceptions:
+            raise GrammarError(
+                f"grammar needs {dfa.n_exceptions} transition exceptions "
+                f"but the pool is sized for {self.max_exceptions}; raise "
+                "grammar-exceptions"
+            )
         with self._lock:
             state = self._by_key.get(key)
             if state is None:
@@ -690,26 +849,30 @@ class GrammarRegistry:
 
     def acquire(self, dfa: TokenDFA) -> int:
         """Pool row for a compiled grammar, swapping it in when absent.
-        Refcounted; release() when the request finishes."""
-        state = self._by_key.get(dfa.key)
-        if state is None:  # compiled outside the cache (tests)
-            state = _GrammarState(dfa=dfa)
-            self._by_key[dfa.key] = state
-        self._tick += 1
-        state.last_used = self._tick
-        if state.row is None:
-            self._swap_in(state)
-        state.refs += 1
-        return state.row
+        Refcounted; release() when the request finishes. Lock-guarded:
+        release() runs from the _finalize hook off the engine thread, and
+        an unguarded refs bump here would race it."""
+        with self._lock:
+            state = self._by_key.get(dfa.key)
+            if state is None:  # compiled outside the cache (tests)
+                state = _GrammarState(dfa=dfa)
+                self._by_key[dfa.key] = state
+            self._tick += 1
+            state.last_used = self._tick
+            if state.row is None:
+                self._swap_in_locked(state)
+            state.refs += 1
+            return state.row
 
     def release(self, dfa: TokenDFA) -> None:
-        state = self._by_key.get(dfa.key)
-        if state is None:
-            return
-        assert state.refs > 0
-        state.refs -= 1
+        with self._lock:
+            state = self._by_key.get(dfa.key)
+            if state is None:
+                return
+            assert state.refs > 0
+            state.refs -= 1
 
-    def _swap_in(self, state: _GrammarState) -> None:
+    def _swap_in_locked(self, state: _GrammarState) -> None:
         import jax.numpy as jnp
 
         if not self._free_rows:
@@ -724,12 +887,33 @@ class GrammarRegistry:
             self._row_owner.pop(victim.row, None)
             victim.row = None
         row = self._free_rows.pop()
-        padded = np.full((self.max_states, self.vocab_size), DEAD, np.int32)
-        padded[: state.dfa.n_states] = state.dfa.next
+        bits, defaults, exc_key, exc_next = state.dfa.packed()
+        n = state.dfa.n_states
+        n_exc = exc_key.shape[0]
+        if n_exc > self.max_exceptions:  # acquire() bypassing compile()
+            raise GrammarError(
+                f"grammar needs {n_exc} transition exceptions but the "
+                f"pool is sized for {self.max_exceptions}; raise "
+                "grammar-exceptions"
+            )
+        pb = np.zeros((self.max_states, self.n_words), np.uint32)
+        pb[:n] = bits
+        pd = np.zeros(self.max_states, np.int32)
+        pd[:n] = defaults
+        pk = np.full(self.max_exceptions, _EXC_SENTINEL, np.int32)
+        # int32 cast is safe: __init__ enforces max_states·V < 2**31
+        pk[:n_exc] = exc_key.astype(np.int32)
+        pn = np.zeros(self.max_exceptions, np.int32)
+        pn[:n_exc] = exc_next
         if self.on_load_program is not None:
             self.on_load_program()
         self.pool = _grammar_load_row(
-            self.pool, jnp.asarray(row, jnp.int32), jnp.asarray(padded)
+            self.pool,
+            jnp.asarray(row, jnp.int32),
+            jnp.asarray(pb),
+            jnp.asarray(pd),
+            jnp.asarray(pk),
+            jnp.asarray(pn),
         )
         state.row = row
         self._row_owner[row] = state
@@ -744,14 +928,20 @@ class GrammarRegistry:
 
         if self.on_load_program is not None:
             self.on_load_program()
-        self.pool = _grammar_load_row(
-            self.pool,
-            jnp.asarray(self.slots + 1, jnp.int32),
-            jnp.asarray(
-                np.full((self.max_states, self.vocab_size), DEAD, np.int32)
-            ),
-        )
-        jax.block_until_ready(self.pool)
+        with self._lock:
+            self.pool = _grammar_load_row(
+                self.pool,
+                jnp.asarray(self.slots + 1, jnp.int32),
+                jnp.asarray(
+                    np.zeros((self.max_states, self.n_words), np.uint32)
+                ),
+                jnp.asarray(np.zeros(self.max_states, np.int32)),
+                jnp.asarray(
+                    np.full(self.max_exceptions, _EXC_SENTINEL, np.int32)
+                ),
+                jnp.asarray(np.zeros(self.max_exceptions, np.int32)),
+            )
+            jax.block_until_ready(self.pool)
 
     @property
     def resident(self) -> int:
@@ -763,17 +953,19 @@ class GrammarRegistry:
             "resident": self.resident,
             "slots": self.slots,
             "states": self.max_states,
+            "exceptions": self.max_exceptions,
             "swaps-total": self.swaps_total,
             "pool-bytes": self.pool_bytes,
         }
 
 
-def _grammar_load_row(pool, row, table):
-    """One traced-row upload program, jitted ONCE at module scope (the
-    same shape as adapters._load_row) — defining the jit wrapper inside
-    the call would retrace and recompile on EVERY swap, which is exactly
-    the mid-traffic stall warmup() exists to prevent."""
-    return _GRAMMAR_LOAD_JIT(pool, row, table)
+def _grammar_load_row(pool, row, bits, defaults, exc_key, exc_next):
+    """One traced-row upload program covering ALL FOUR packed planes in a
+    single dispatch, jitted ONCE at module scope (the same shape as
+    adapters._load_row) — defining the jit wrapper inside the call would
+    retrace and recompile on EVERY swap, which is exactly the mid-traffic
+    stall warmup() exists to prevent."""
+    return _GRAMMAR_LOAD_JIT(pool, row, bits, defaults, exc_key, exc_next)
 
 
 def _make_grammar_load_jit():
@@ -782,8 +974,14 @@ def _make_grammar_load_jit():
     import jax
 
     @_functools.partial(jax.jit, donate_argnames=("p",))
-    def _load(p, r, t):
-        return p.at[r].set(t, mode="drop")
+    def _load(p, r, b, d, k, n):
+        pb, pd, pk, pn = p
+        return (
+            pb.at[r].set(b, mode="drop"),
+            pd.at[r].set(d, mode="drop"),
+            pk.at[r].set(k, mode="drop"),
+            pn.at[r].set(n, mode="drop"),
+        )
 
     return _load
 

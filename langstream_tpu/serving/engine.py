@@ -248,21 +248,36 @@ class _Slot:
 
 
 def _dfa_mask(dfa, g, state):
-    """Per-slot grammar mask for ONE sampling step: the DFA pool row
-    gathered by (grammar row, current state). Legality IS the sign bit —
-    ``next[s, t] >= 0`` — so mask and advance are one int32 gather
-    (serving/constrain.py)."""
-    nrow = dfa[g, state]  # [B, V] int32
-    return nrow, nrow >= 0
+    """Per-slot grammar mask for ONE sampling step: the PACKED legality
+    bitmask row gathered by (grammar row, current state) — [B, W] uint32,
+    1 bit per token, expanded to bool inside sampling's mask fold
+    (serving/constrain.py, sampling._expand_allowed). ``dfa`` is the
+    registry's 4-plane pool (bits, defaults, exc_key, exc_next)."""
+    return dfa[0][g, state]  # [B, ceil(V/32)] uint32
 
 
-def _dfa_advance(nrow, tokens, state):
-    """Advance each slot's DFA state past its sampled token. The NaN
-    sentinel (-1) clamps to index 0 and dead targets clamp to state 0 —
-    both only reachable for slots the engine is about to quarantine or
-    that are not constrained at all (row 0 self-loops at 0)."""
-    tclip = jnp.clip(tokens, 0, nrow.shape[-1] - 1)
-    nxt = jnp.take_along_axis(nrow, tclip[:, None], axis=1)[:, 0]
+def _dfa_advance(dfa, g, tokens, state, vocab_size):
+    """Advance each slot's DFA state past its sampled token ON DEVICE:
+    the state's default successor unless the sorted per-row exceptions
+    array holds the composite key ``state · V + token`` (a searchsorted
+    probe — constrain.py packs every legal-but-non-modal transition
+    there, so legal tokens advance EXACTLY as the dense table did). The
+    NaN sentinel (-1) clamps to token 0; wherever that lands is harmless
+    — the engine quarantines the slot on sight and re-seeds its state at
+    the next admit, and free slots ride row 0 (defaults all 0, no
+    exceptions: the unconstrained self-loop)."""
+    _, defaults, exc_key, exc_next = dfa
+    tclip = jnp.clip(tokens, 0, vocab_size - 1)
+    # int32-safe: the registry enforces max_states · V < 2**31
+    key = state * vocab_size + tclip  # [B]
+    rows_k = exc_key[g]  # [B, E] sorted, sentinel-padded
+    idx = jax.vmap(functools.partial(jnp.searchsorted, side="left"))(
+        rows_k, key
+    )
+    idx = jnp.minimum(idx, rows_k.shape[-1] - 1)
+    hit_key = jnp.take_along_axis(rows_k, idx[:, None], axis=1)[:, 0]
+    hit_next = jnp.take_along_axis(exc_next[g], idx[:, None], axis=1)[:, 0]
+    nxt = jnp.where(hit_key == key, hit_next, defaults[g, state])
     return jnp.maximum(nxt, 0).astype(state.dtype)
 
 
@@ -309,13 +324,16 @@ def _decode_chunk(
         key, sub = jax.random.split(key)
         if dfa is not None:
             # constrained decoding rides the FUSED chunk: mask this step's
-            # logits with each slot's current DFA row, then advance the
-            # state past the sampled token ON DEVICE — the host mirror
-            # replays the same table per delivered token, so a 16-step
-            # chunk stays one dispatch with both sides in lockstep
-            nrow, allowed = _dfa_mask(dfa, g, dstate)
+            # logits with each slot's packed bitmask row, then advance the
+            # state past the sampled token ON DEVICE (default-successor +
+            # exceptions probe) — the host mirror replays the dense table
+            # per delivered token, so a 16-step chunk stays one dispatch
+            # with both sides in lockstep
+            allowed = _dfa_mask(dfa, g, dstate)
             next_tokens = sample(logits, sub, temp, top_k, top_p, allowed)
-            dstate = _dfa_advance(nrow, next_tokens, dstate)
+            dstate = _dfa_advance(
+                dfa, g, next_tokens, dstate, config.vocab_size
+            )
         else:
             next_tokens = sample(logits, sub, temp, top_k, top_p)
         return (next_tokens, positions + 1, cache, key, dstate), next_tokens
@@ -374,7 +392,7 @@ def _verify_chunk(
         # position (state after consuming drafts 0..j-1 — the same mask
         # plain masked decode would apply, the exactness invariant under
         # constraints; serving/constrain.py verify_states)
-        allowed = dfa[g[:, None], vstates] >= 0  # [B, K+1, V]
+        allowed = dfa[0][g[:, None], vstates]  # [B, K+1, W] packed uint32
     out, accept = speculative_verify(
         logits, drafts, sub, temp, top_k, top_p, allowed
     )
@@ -386,8 +404,7 @@ def _verify_chunk(
         # state after the LAST emitted token: gather the pre-state at the
         # accept position, advance past the emitted correction/bonus
         pre = jnp.take_along_axis(vstates, accept[:, None], axis=1)[:, 0]
-        nrow = dfa[g, pre]
-        dstate = _dfa_advance(nrow, tokens, pre)
+        dstate = _dfa_advance(dfa, g, tokens, pre, config.vocab_size)
     if full is not None:
         cache = jax.tree.map(
             lambda big, small: lax.dynamic_update_slice(
@@ -465,9 +482,8 @@ def _prefill_segment_and_sample(
     key, sub = jax.random.split(key)
     if dfa is not None:
         s0 = state0 if state0 is not None else jnp.zeros_like(g)
-        nrow = dfa[g, s0]
-        first = sample(logits, sub, temp, top_k, top_p, nrow >= 0)
-        s1 = _dfa_advance(nrow, first, s0)
+        first = sample(logits, sub, temp, top_k, top_p, _dfa_mask(dfa, g, s0))
+        s1 = _dfa_advance(dfa, g, first, s0, config.vocab_size)
         state_dev = state_dev.at[state_slot].set(s1[0], mode="drop")
     else:
         first = sample(logits, sub, temp, top_k, top_p)
@@ -499,9 +515,11 @@ def _paged_decode_chunk(
         )
         key, sub = jax.random.split(key)
         if dfa is not None:
-            nrow, allowed = _dfa_mask(dfa, g, dstate)
+            allowed = _dfa_mask(dfa, g, dstate)
             next_tokens = sample(logits, sub, temp, top_k, top_p, allowed)
-            dstate = _dfa_advance(nrow, next_tokens, dstate)
+            dstate = _dfa_advance(
+                dfa, g, next_tokens, dstate, config.vocab_size
+            )
         else:
             next_tokens = sample(logits, sub, temp, top_k, top_p)
         return (next_tokens, positions + 1, pool, key, dstate), next_tokens
@@ -534,7 +552,7 @@ def _paged_verify_chunk(
     key, sub = jax.random.split(key)
     allowed = None
     if dfa is not None:
-        allowed = dfa[g[:, None], vstates] >= 0  # [B, K+1, V]
+        allowed = dfa[0][g[:, None], vstates]  # [B, K+1, W] packed uint32
     out, accept = speculative_verify(
         logits, drafts, sub, temp, top_k, top_p, allowed
     )
@@ -543,8 +561,7 @@ def _paged_verify_chunk(
     dstate = None
     if dfa is not None:
         pre = jnp.take_along_axis(vstates, accept[:, None], axis=1)[:, 0]
-        nrow = dfa[g, pre]
-        dstate = _dfa_advance(nrow, tokens, pre)
+        dstate = _dfa_advance(dfa, g, tokens, pre, config.vocab_size)
     packed = jnp.concatenate([out, accept[:, None]], axis=1)  # [B, k+2]
     return packed, tokens, positions, pool, key, dstate
 
@@ -571,9 +588,8 @@ def _paged_segment_and_sample(
     key, sub = jax.random.split(key)
     if dfa is not None:
         s0 = state0 if state0 is not None else jnp.zeros_like(g)
-        nrow = dfa[g, s0]
-        first = sample(logits, sub, temp, top_k, top_p, nrow >= 0)
-        s1 = _dfa_advance(nrow, first, s0)
+        first = sample(logits, sub, temp, top_k, top_p, _dfa_mask(dfa, g, s0))
+        s1 = _dfa_advance(dfa, g, first, s0, config.vocab_size)
         state_dev = state_dev.at[state_slot].set(s1[0], mode="drop")
     else:
         first = sample(logits, sub, temp, top_k, top_p)
@@ -685,9 +701,10 @@ def _make_admit_group(mesh):
             # token — the NEXT decode chunk (often dispatched before this
             # fetch even lands) reads a coherent state
             s0 = g_state0 if g_state0 is not None else jnp.zeros_like(g_rows)
-            nrow = dfa[g_rows, s0]
-            first = sample(logits, sub, temps, top_ks, top_ps, nrow >= 0)
-            s1 = _dfa_advance(nrow, first, s0)
+            first = sample(
+                logits, sub, temps, top_ks, top_ps, _dfa_mask(dfa, g_rows, s0)
+            )
+            s1 = _dfa_advance(dfa, g_rows, first, s0, config.vocab_size)
             state_dev = state_dev.at[slots].set(s1, mode="drop")
         else:
             first = sample(logits, sub, temps, top_ks, top_ps)
@@ -757,9 +774,10 @@ def _make_paged_admit_group(mesh=None):
         if dfa is not None:
             # initial state per row (g_state0): 0 fresh, carried on resume
             s0 = g_state0 if g_state0 is not None else jnp.zeros_like(g_rows)
-            nrow = dfa[g_rows, s0]
-            first = sample(logits, sub, temps, top_ks, top_ps, nrow >= 0)
-            s1 = _dfa_advance(nrow, first, s0)
+            first = sample(
+                logits, sub, temps, top_ks, top_ps, _dfa_mask(dfa, g_rows, s0)
+            )
+            s1 = _dfa_advance(dfa, g_rows, first, s0, config.vocab_size)
             state_dev = state_dev.at[slots].set(s1, mode="drop")
         else:
             first = sample(logits, sub, temps, top_ks, top_ps)
@@ -1220,8 +1238,9 @@ class ServingEngine:
         adapter_rank: Optional[int] = None,
         adapter_pool_rows: Optional[int] = None,
         constrained_decoding: Any = "auto",
-        grammar_slots: int = 4,
+        grammar_slots: int = 64,
         grammar_states: int = 128,
+        grammar_exceptions: int = 65536,
         grammar_tokenizer: Optional[Any] = None,
         queue_depth: Optional[int] = None,
         shed_policy: str = "block",
@@ -1607,11 +1626,14 @@ class ServingEngine:
         # each slot's factors by its adapter ROW (host-uploaded [B] int32 —
         # data, not shape, so base + N adapters mix in ONE program).
         # Constrained decoding: response_format grammars compile to token
-        # DFAs (serving/constrain.py); the [G+1, S, V] next-state pool
-        # lives on device, per-slot grammar rows ride each dispatch, and
-        # the DFA state advances ON DEVICE inside fused chunks while the
-        # host mirrors it per delivered token (completion detection + the
-        # speculative verify masks).
+        # DFAs (serving/constrain.py); the PACKED pool — legality bitmask
+        # [G+1, S, ceil(V/32)] uint32 + default-successor/exceptions
+        # transition planes, ~32× smaller than the old dense [G+1, S, V]
+        # int32 table — lives on device, per-slot grammar rows ride each
+        # dispatch, and the DFA state advances ON DEVICE inside fused
+        # chunks (searchsorted exceptions probe) while the host mirrors it
+        # per delivered token (completion detection + the speculative
+        # verify masks).
         adapters_cfg = list(adapters or [])
         constrain_on = (
             constrained_decoding is True
@@ -1686,6 +1708,17 @@ class ServingEngine:
             )
             for s in specs:
                 self._adapters.register(s)
+        if constrain_on and int(grammar_slots) <= 0:
+            # the zero/disabled contract (shared with grammar_pool_bytes,
+            # which returns 0 here, and with the registry, which refuses
+            # slots < 1): no pool rows means constrained decoding is OFF,
+            # not a silently-coerced 1-slot pool
+            log.info(
+                "grammar-slots <= 0: constrained decoding disabled "
+                "(grammar_pool_bytes contract)"
+            )
+            constrain_on = False
+            self._agentic = bool(adapters_cfg)
         if constrain_on:
             from langstream_tpu.serving.constrain import GrammarRegistry
 
@@ -1696,8 +1729,9 @@ class ServingEngine:
                 tok = ByteTokenizer()
             self._constrain_reg = GrammarRegistry(
                 tok, config.vocab_size, eos_token_id,
-                slots=max(1, int(grammar_slots)),
+                slots=int(grammar_slots),
                 max_states=max(2, int(grammar_states)),
+                max_exceptions=max(1, int(grammar_exceptions)),
             )
             self._constrain_reg.on_load_program = functools.partial(
                 self._record_program, "grammar-load"
@@ -1926,6 +1960,11 @@ class ServingEngine:
                 ),
                 grammar_states=(
                     self._constrain_reg.max_states if self._constrain_reg else 0
+                ),
+                grammar_exceptions=(
+                    self._constrain_reg.max_exceptions
+                    if self._constrain_reg
+                    else 0
                 ),
                 # role-tagged replicas (§18): budget the host-RAM staging
                 # one in-flight KV migration claims on this end
@@ -2641,6 +2680,9 @@ class ServingEngine:
             ),
             "grammar-swaps-total": (
                 self._constrain_reg.swaps_total if self._constrain_reg else 0
+            ),
+            "grammar-pool-bytes": (
+                self._constrain_reg.pool_bytes if self._constrain_reg else 0
             ),
             "constrain-overhead-ms": round(self._constrain_host_ema_ms, 4),
             # request lifecycle / fault recovery (this PR's acceptance

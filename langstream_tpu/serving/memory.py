@@ -78,10 +78,12 @@ class ServingMemoryPlan:
     # the engine's lifetime. Sized by `adapter-pool-fraction`; 0 when no
     # adapters are configured.
     adapter_pool_bytes: int = 0
-    # grammar DFA pool (serving/constrain.py): the [G+1, S, V] int32
-    # next-state table constrained decoding gathers per step. V-linear —
-    # at a 256k vocab the defaults cost ~0.7GiB, which is exactly why it
-    # is a PLAN term and not a surprise (docs/SERVING.md §15 sizing).
+    # grammar DFA pool (serving/constrain.py): the PACKED planes — the
+    # [G+1, S, ceil(V/32)] uint32 legality bitmask plus default-successor
+    # [G+1, S] and exception key/next [G+1, E] int32 transition arrays.
+    # ~1/28 of the dense [G+1, S, V] int32 table this replaced (~0.7 GiB
+    # at a 256k vocab with 4×128; 64 slots now fit in ~0.3 GiB —
+    # docs/SERVING.md §15 has the sizing table).
     grammar_pool_bytes: int = 0
     # tiered KV host arena (serving/pagepool.HostPageTier): pinned HOST
     # RAM, not HBM — deliberately excluded from total_bytes (which is the
@@ -253,6 +255,7 @@ def plan_serving_memory(
     adapter_rank: int = 0,
     grammar_slots: int = 0,
     grammar_states: int = 0,
+    grammar_exceptions: int = 65536,
     migrate_staging: bool = False,
     weight_load_staging: int = 0,
     durable_max_bytes: int = 0,
@@ -287,8 +290,10 @@ def plan_serving_memory(
     HBM total; 0 omits it (tier off, and always 0 under the dense layout).
     ``adapter_pool_rows``/``adapter_rank``: shape of the multi-LoRA device
     pool (serving/adapters.py) — 0 omits the term (no adapters).
-    ``grammar_slots``/``grammar_states``: shape of the constrained-decoding
-    DFA pool (serving/constrain.py) — 0 omits the term.
+    ``grammar_slots``/``grammar_states``/``grammar_exceptions``: shape of
+    the constrained-decoding packed DFA pool (serving/constrain.py —
+    bitmask + default-successor/exceptions planes) — grammar_slots 0
+    omits the term (the shared zero/disabled contract).
     ``weight_load_staging``: measured (or estimated) host-RAM high-water
     mark of the streamed weight-load pipeline (models/streamload.py) —
     reported like host_spill_bytes, excluded from the HBM total; 0 omits
@@ -310,7 +315,8 @@ def plan_serving_memory(
         from langstream_tpu.serving.constrain import grammar_pool_bytes
 
         grammar_bytes = grammar_pool_bytes(
-            grammar_slots, grammar_states, config.vocab_size
+            grammar_slots, grammar_states, config.vocab_size,
+            grammar_exceptions,
         )
 
     paged = kv_layout == "paged"

@@ -55,6 +55,26 @@ def _greedy_argmax(logits: jax.Array) -> jax.Array:
     return top_group * group + jnp.argmax(winner, axis=-1)
 
 
+def _expand_allowed(allowed: jax.Array, vocab: int) -> jax.Array:
+    """Grammar mask → [..., V] bool. Two spellings arrive here:
+
+    - packed ``[..., ceil(V/32)]`` uint32 (serving/constrain.py's
+      legality bitmask, LSB-first: token t → bit t % 32 of word t // 32)
+      — expanded on device with one shift/AND, so the mask rides HBM at
+      1 bit/token and only becomes bytes inside the fused step;
+    - legacy ``[..., V]`` bool — passed through untouched.
+
+    The dtype dispatch is a Python branch: dtypes are static under jit,
+    so each spelling traces its own (already-distinct-signature) program.
+    """
+    if allowed.dtype != jnp.uint32:
+        return allowed
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (allowed[..., None] >> shifts) & jnp.uint32(1)  # [..., W, 32]
+    flat = bits.reshape(*allowed.shape[:-1], allowed.shape[-1] * 32)
+    return flat[..., :vocab].astype(bool)
+
+
 def _apply_filters(s: jax.Array, top_k: jax.Array, top_p: jax.Array) -> jax.Array:
     """top-k + top-p cutoffs over [R, V] scaled logits with per-row params
     (0 / 1.0 = disabled); one descending sort serves both. Shared by
@@ -83,7 +103,7 @@ def sample(
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = disabled
     top_p: jax.Array,  # [B] fp32, 1.0 = disabled
-    allowed: jax.Array = None,  # [B, V] bool — grammar mask, True = legal
+    allowed: jax.Array = None,  # [B, W] uint32 packed / [B, V] bool mask
 ) -> jax.Array:
     """Returns sampled token ids [B]. temperature 0 → greedy for that slot.
 
@@ -109,7 +129,7 @@ def sample(
     b, v = logits.shape
     finite = jnp.all(jnp.isfinite(logits), axis=-1)  # [B]
     if allowed is not None:
-        logits = jnp.where(allowed, logits, -jnp.inf)
+        logits = jnp.where(_expand_allowed(allowed, v), logits, -jnp.inf)
     greedy = _greedy_argmax(logits)
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
@@ -137,7 +157,7 @@ def speculative_verify(
     temperature: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = disabled
     top_p: jax.Array,  # [B] fp32, 1.0 = disabled
-    allowed: jax.Array = None,  # [B, K+1, V] bool — per-POSITION grammar mask
+    allowed: jax.Array = None,  # [B, K+1, W] uint32 / [B, K+1, V] bool mask
 ) -> tuple[jax.Array, jax.Array]:
     """Batched draft verification for self-speculative decoding.
 
@@ -182,7 +202,7 @@ def speculative_verify(
     k = k1 - 1
     finite = jnp.all(jnp.isfinite(logits.reshape(b, -1)), axis=-1)  # [B]
     if allowed is not None:
-        logits = jnp.where(allowed, logits, -jnp.inf)
+        logits = jnp.where(_expand_allowed(allowed, v), logits, -jnp.inf)
     greedy = _greedy_argmax(logits.reshape(b * k1, v)).reshape(b, k1)
     greedy_acc = drafts == greedy[:, :k]  # [B, K]
 

@@ -253,8 +253,168 @@ def test_grammar_registry_rejects_oversized_grammar():
 
 
 def test_grammar_pool_bytes_arithmetic():
-    assert grammar_pool_bytes(4, 128, 512) == 5 * 128 * 512 * 4
+    # packed planes: bits [G+1, S, ceil(V/32)] uint32 + defaults [G+1, S]
+    # int32 + exception key/next [G+1, E] int32 each
+    assert grammar_pool_bytes(4, 128, 512, 64) == 5 * (
+        128 * 16 * 4 + 128 * 4 + 2 * 64 * 4
+    )
     assert grammar_pool_bytes(0, 128, 512) == 0
+    # the word count rounds UP for vocabs that are not multiples of 32
+    assert grammar_pool_bytes(1, 2, 33, 1) == 2 * (2 * 2 * 4 + 2 * 4 + 8)
+
+
+def test_packed_pool_beats_dense_by_24x_at_256k_vocab():
+    """ISSUE 20 acceptance: the packed pool term is ≤ 1/24 of the dense
+    [G+1, S, V] int32 pool at a 256k vocab — asserted at BOTH the
+    arithmetic and the memory-plan layer."""
+    slots, states, vocab = 64, 128, 256000
+    dense = (slots + 1) * states * vocab * 4
+    packed = grammar_pool_bytes(slots, states, vocab)
+    assert packed * 24 <= dense
+    from langstream_tpu.serving.memory import plan_serving_memory
+
+    big = dataclasses.replace(CFG, vocab_size=vocab)
+    plan = plan_serving_memory(
+        big, 4, 128, grammar_slots=slots, grammar_states=states
+    )
+    assert plan.grammar_pool_bytes == packed
+    assert plan.grammar_pool_bytes * 24 <= dense
+
+
+def test_pack_next_table_roundtrip_matches_dense():
+    """The packed product reproduces the dense table exactly: bitmask
+    expansion == legality, and the default-successor + sorted-exceptions
+    probe (replayed with numpy searchsorted — the same formula the device
+    advance uses) == dense next for every LEGAL token."""
+    from langstream_tpu.serving.constrain import _EXC_SENTINEL, pack_next_table
+
+    dfa = compile_response_format(RF, TOK, CFG.vocab_size, TOK.eos_token_id)
+    bits, defaults, exc_key, exc_next = pack_next_table(dfa.next)
+    n_states, vocab = dfa.next.shape
+    n_words = (vocab + 31) // 32
+    assert bits.shape == (n_states, n_words) and bits.dtype == np.uint32
+    expanded = (
+        (bits[..., None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).reshape(n_states, n_words * 32)[:, :vocab].astype(bool)
+    assert np.array_equal(expanded, dfa.next >= 0)
+    assert np.all(np.diff(exc_key) >= 0)  # sorted: searchsorted-probeable
+    padded_keys = np.concatenate([exc_key, [np.int64(_EXC_SENTINEL)]])
+    for s in range(n_states):
+        for t in np.nonzero(dfa.next[s] >= 0)[0]:
+            key = np.int64(s) * vocab + t
+            i = np.searchsorted(padded_keys, key, side="left")
+            got = (
+                int(exc_next[i])
+                if i < len(exc_key) and padded_keys[i] == key
+                else int(defaults[s])
+            )
+            assert got == dfa.next[s, t], (s, t)
+
+
+def test_registry_uploads_packed_rows_device_exact():
+    """LRU swap-under-pressure keeps pool rows EXACT: after churning more
+    grammars than rows through a 1-slot pool, the resident row's device
+    planes equal the grammar's host-packed product (the token-exactness
+    substrate: the fused chunks read only these planes)."""
+    reg = GrammarRegistry(TOK, CFG.vocab_size, None, slots=1, max_states=64)
+    for pat in ("ab", "cd", "[0-9]+"):
+        dfa = reg.compile({"type": "regex", "regex": pat})
+        row = reg.acquire(dfa)
+        bits, defaults, exc_key, exc_next = dfa.packed()
+        pool_bits, pool_defaults, pool_key, pool_next = reg.pool
+        n = dfa.n_states
+        assert np.array_equal(np.asarray(pool_bits)[row, :n], bits)
+        assert np.array_equal(np.asarray(pool_defaults)[row, :n], defaults)
+        e = len(exc_key)
+        assert np.array_equal(
+            np.asarray(pool_key)[row, :e], exc_key.astype(np.int32)
+        )
+        assert np.array_equal(np.asarray(pool_next)[row, :e], exc_next)
+        # padded exception tail stays at the sentinel (no false probe hits)
+        from langstream_tpu.serving.constrain import _EXC_SENTINEL
+
+        assert np.all(np.asarray(pool_key)[row, e:] == _EXC_SENTINEL)
+        reg.release(dfa)
+    assert reg.swaps_total == 3
+
+
+def test_pool_exhaustion_at_default_slots_raises_documented_error():
+    """Satellite: at the 64-slot default, pinning every row makes the
+    65th acquire raise the documented GrammarError (the shed path's
+    trigger), and releasing one row swaps-in fine again."""
+    reg = GrammarRegistry(TOK, CFG.vocab_size, None, max_states=16)
+    assert reg.slots == 64  # the new default
+    dfas = []
+    for i in range(64):
+        d = reg.compile({"type": "regex", "regex": f"x{i:02d}"})
+        reg.acquire(d)
+        dfas.append(d)
+    assert reg.resident == 64
+    extra = reg.compile({"type": "regex", "regex": "z+"})
+    with pytest.raises(GrammarError, match="pinned"):
+        reg.acquire(extra)
+    reg.release(dfas[0])
+    assert reg.acquire(extra) >= 1  # LRU recycled the released row
+
+
+def test_registry_exceptions_capacity_contract():
+    """A grammar needing more exception rows than the pool carries fails
+    at compile with the documented knob name (mirrors grammar-states)."""
+    reg = GrammarRegistry(
+        TOK, CFG.vocab_size, None, slots=1, max_states=64, max_exceptions=1
+    )
+    with pytest.raises(GrammarError, match="grammar-exceptions"):
+        reg.compile({"type": "regex", "regex": "(ab|cd|ef)"})
+
+
+def test_registry_refcounts_survive_cross_thread_release():
+    """acquire()/release() are lock-guarded (release runs from the
+    request _finalize hook off the engine thread): hammering the pair
+    from many threads must leave refs at exactly zero — an unguarded
+    `refs -= 1` loses decrements under the race."""
+    import threading
+
+    reg = GrammarRegistry(TOK, CFG.vocab_size, None, slots=2, max_states=64)
+    dfa = reg.compile({"type": "regex", "regex": "ab"})
+    n, rounds = 8, 200
+    barrier = threading.Barrier(n)
+
+    def churn():
+        barrier.wait()
+        for _ in range(rounds):
+            reg.acquire(dfa)
+            reg.release(dfa)
+
+    threads = [threading.Thread(target=churn) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg._by_key[dfa.key].refs == 0
+
+
+def test_zero_slots_unified_disabled_contract():
+    """Satellite: grammar_pool_bytes(slots<=0) == 0 and the registry's
+    slots<1 rejection are ONE contract — the registry's error names it,
+    and an engine built with grammar_slots=0 disables constrained
+    decoding instead of silently coercing a 1-slot pool."""
+    assert grammar_pool_bytes(0, 128, 512) == 0
+    assert grammar_pool_bytes(-3, 128, 512) == 0
+    with pytest.raises(ValueError, match="disables constrained decoding"):
+        GrammarRegistry(TOK, CFG.vocab_size, None, slots=0)
+    engine = ServingEngine(
+        CFG, PARAMS, max_batch=2, max_seq_len=128,
+        constrained_decoding="auto", grammar_slots=0, grammar_tokenizer=TOK,
+        eos_token_id=TOK.eos_token_id,
+    )
+    assert engine._constrain_reg is None
+    assert engine.stats()["constrained-decoding"] is False
+    assert engine.stats()["grammar-pool-bytes"] == 0
+    with pytest.raises(ValueError):
+        engine.submit(GenerationRequest(
+            prompt_tokens=TOK.encode("x"),
+            options=GenerationOptions(response_format=RF),
+        ))
 
 
 # ---------------------------------------------------------------------------

@@ -313,6 +313,25 @@ def test_cold_start_panels_present():
     assert "engine_weight_load_s" in cold
 
 
+def test_grammar_pool_panel_present():
+    """The ISSUE-20 packed-grammar-pool panel must survive dashboard
+    edits: HBM held by the packed bitmask/exception planes plus the
+    resident-row count (serving/constrain.py, docs/SERVING.md §15) — the
+    pool-thrash signal that pairs with the constrained-decoding panel."""
+    doc = json.loads((METRICS_DIR / "dashboards" / "serving.json").read_text())
+    exprs_by_title = {
+        p.get("title", ""): " ".join(t["expr"] for t in p.get("targets", []))
+        for p in doc["panels"]
+    }
+    pool = next(
+        (e for t, e in exprs_by_title.items() if "grammar pool" in t.lower()),
+        None,
+    )
+    assert pool is not None, "grammar-pool panel missing"
+    assert "engine_grammar_pool_bytes" in pool
+    assert "engine_grammar_rows_resident" in pool
+
+
 def test_grafana_provisioning_parses():
     ds = yaml.safe_load(
         (METRICS_DIR / "provisioning" / "datasources" / "prometheus.yaml").read_text()
